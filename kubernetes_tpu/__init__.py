@@ -1,0 +1,30 @@
+"""kubernetes_tpu — a TPU-native cluster-scheduling framework.
+
+A from-scratch re-design of Kubernetes' kube-scheduler (reference:
+kubernetes @ ~v1.15.0-alpha.3) for TPU hardware: the per-cycle NodeInfo
+snapshot lives as a dense struct-of-arrays matrix in HBM, and the full
+Filter/Score plugin suite runs as vmapped, jitted JAX kernels over all
+nodes at once, with integer-exact score parity against the reference
+algorithm (see `kubernetes_tpu.oracle` for the pure-Python referee).
+
+Layout:
+  api/        pruned Pod/Node/config data model (reference: pkg/apis, pkg/scheduler/api)
+  oracle/     pure-Python semantic oracle — exact reference formulas, the parity referee
+  ops/        JAX kernels: encoding, device snapshot, filter/score/select
+  parallel/   multi-chip sharding of the node axis (mesh, per-shard top-k, all-gather)
+  framework/  plugin framework: registry, extension points, cycle context
+  cache/      scheduler cache: assume/confirm/expire, generations, snapshots
+  queue/      scheduling queue: activeQ / backoffQ / unschedulableQ
+  store/      in-memory versioned object store with list/watch (etcd+apiserver analog)
+  models/     workload & cluster models for benchmarks (scheduler_perf / kubemark analog)
+  perf/       benchmark harness
+  utils/      heap, clock, backoff helpers
+"""
+
+import jax
+
+# Reference resource math is int64 (milliCPU, memory bytes); exact score
+# parity requires 64-bit integer arithmetic on device.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from kubernetes_tpu.api.types import *  # noqa: F401,F403
+from kubernetes_tpu.api import quantity  # noqa: F401

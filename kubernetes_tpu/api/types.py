@@ -1,0 +1,489 @@
+"""Pruned Kubernetes API data model — the subset the scheduler reads.
+
+Mirrors the semantics (not the code) of the reference's `k8s.io/api/core/v1`
+types as consumed by `pkg/scheduler` (reference: pkg/scheduler/nodeinfo/
+node_info.go:47,139; pkg/apis/core/types.go). Quantities are plain integers:
+CPU in milli-cores, memory/ephemeral-storage in bytes, scalar (extended)
+resources in their native integer unit.
+"""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+# ---------------------------------------------------------------------------
+# Resource names (reference: k8s.io/api/core/v1/types.go ResourceName)
+# ---------------------------------------------------------------------------
+RESOURCE_CPU = "cpu"
+RESOURCE_MEMORY = "memory"
+RESOURCE_EPHEMERAL_STORAGE = "ephemeral-storage"
+RESOURCE_PODS = "pods"
+
+# Default requests applied by priorities (NOT predicates) when a pod does not
+# specify them (reference: algorithm/priorities/util/non_zero.go:31-34).
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+# Zone/region well-known labels (reference: k8s.io/api/core/v1/well_known_labels.go)
+LABEL_ZONE_FAILURE_DOMAIN = "failure-domain.beta.kubernetes.io/zone"
+LABEL_ZONE_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+# Taint applied for `node.Spec.Unschedulable` (reference: pkg/scheduler/api/well_known_labels.go)
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+
+def is_extended_resource_name(name: str) -> bool:
+    """Reference: k8s.io/api/core/v1/helper.IsExtendedResourceName — any
+    resource not in the default kubernetes.io namespace and not a native one."""
+    if name in (RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_EPHEMERAL_STORAGE, RESOURCE_PODS):
+        return False
+    if name.startswith("requests."):
+        return False
+    return "/" in name and not name.startswith("kubernetes.io/")
+
+
+# ---------------------------------------------------------------------------
+# Label selectors
+# ---------------------------------------------------------------------------
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One match expression: node-selector ops include Gt/Lt; label-selector
+    ops are In/NotIn/Exists/DoesNotExist."""
+    key: str
+    op: str
+    values: tuple[str, ...] = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        has = self.key in labels
+        val = labels.get(self.key)
+        if self.op == IN:
+            return has and val in self.values
+        if self.op == NOT_IN:
+            # Reference labels.Requirement: NotIn also matches when key absent.
+            return not has or val not in self.values
+        if self.op == EXISTS:
+            return has
+        if self.op == DOES_NOT_EXIST:
+            return not has
+        if self.op in (GT, LT):
+            # Reference: both label value and requirement value must parse as
+            # integers; non-parse → no match.
+            if not has:
+                return False
+            try:
+                lv = int(val)
+                rv = int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lv > rv if self.op == GT else lv < rv
+        raise ValueError(f"unknown selector op {self.op!r}")
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """metav1.LabelSelector: match_labels AND match_expressions. A None
+    selector matches nothing; an empty selector matches everything
+    (reference: apimachinery LabelSelectorAsSelector)."""
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[Requirement, ...] = ()
+
+    @staticmethod
+    def from_dict(match_labels: dict[str, str] | None = None,
+                  match_expressions: Iterable[Requirement] = ()) -> "LabelSelector":
+        return LabelSelector(
+            match_labels=tuple(sorted((match_labels or {}).items())),
+            match_expressions=tuple(match_expressions),
+        )
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        for k, v in self.match_labels:
+            if labels.get(k) != v:
+                return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """Terms are ORed; requirements within a term are ANDed. An empty term
+    (no requirements) matches nothing (reference: predicates.go:889 comments)."""
+    match_expressions: tuple[Requirement, ...] = ()
+
+    def matches(self, labels: dict[str, str]) -> bool:
+        if not self.match_expressions:
+            return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+
+def node_selector_terms_match(terms: Iterable[NodeSelectorTerm], labels: dict[str, str]) -> bool:
+    """ORed terms; empty list matches nothing (reference: predicates.go:833-838)."""
+    return any(t.matches(labels) for t in terms)
+
+
+# ---------------------------------------------------------------------------
+# Affinity
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int  # 1-100
+    preference: NodeSelectorTerm
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    # None → matches all nodes; empty tuple → matches no node.
+    required: Optional[tuple[NodeSelectorTerm, ...]] = None
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    label_selector: Optional[LabelSelector]
+    topology_key: str
+    namespaces: tuple[str, ...] = ()  # empty → pod's own namespace
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int  # 1-100
+    term: PodAffinityTerm
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PodAntiAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# ---------------------------------------------------------------------------
+# Taints & tolerations
+# ---------------------------------------------------------------------------
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EXISTS = "Exists"
+TOLERATION_OP_EQUAL = "Equal"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""  # empty key with Exists → tolerates everything
+    op: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""  # empty → matches all effects
+
+    def tolerates(self, taint: Taint) -> bool:
+        """Reference: k8s.io/api/core/v1/toleration.go ToleratesTaint."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.op in (TOLERATION_OP_EXISTS, ""):
+            # "" defaults to Equal in the API but Exists when key is empty;
+            # we normalize: empty key + any op tolerates all keys only with Exists.
+            if self.op == TOLERATION_OP_EXISTS:
+                return True
+            return self.value == taint.value
+        if self.op == TOLERATION_OP_EQUAL:
+            return self.value == taint.value
+        return False
+
+
+def tolerations_tolerate_taint(tolerations: Iterable[Toleration], taint: Taint) -> bool:
+    return any(t.tolerates(taint) for t in tolerations)
+
+
+def find_intolerable_taint(taints: Iterable[Taint], tolerations: Iterable[Toleration],
+                           effect_filter) -> Optional[Taint]:
+    """Reference: v1helper.TolerationsTolerateTaintsWithFilter — first
+    filtered taint not tolerated, else None."""
+    for taint in taints:
+        if not effect_filter(taint):
+            continue
+        if not tolerations_tolerate_taint(tolerations, taint):
+            return taint
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Containers & pods
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass(frozen=True)
+class Container:
+    name: str = ""
+    image: str = ""
+    # resource requests; missing keys mean "not specified"
+    requests: tuple[tuple[str, int], ...] = ()
+    ports: tuple[ContainerPort, ...] = ()
+
+    @staticmethod
+    def make(name: str = "", image: str = "",
+             requests: dict[str, int] | None = None,
+             ports: Iterable[ContainerPort] = ()) -> "Container":
+        return Container(name=name, image=image,
+                         requests=tuple(sorted((requests or {}).items())),
+                         ports=tuple(ports))
+
+    def requests_dict(self) -> dict[str, int]:
+        return dict(self.requests)
+
+
+_pod_uid_counter = itertools.count(1)
+
+
+@dataclass
+class Pod:
+    """Pruned v1.Pod: metadata + the spec/status fields the scheduler reads."""
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: dict[str, str] = field(default_factory=dict)
+    # spec
+    node_name: str = ""          # spec.nodeName (set by binding)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: tuple[Toleration, ...] = ()
+    containers: tuple[Container, ...] = ()
+    init_containers: tuple[Container, ...] = ()
+    priority: int = 0            # resolved PriorityClass value
+    scheduler_name: str = "default-scheduler"
+    volumes: tuple[str, ...] = ()      # names of referenced PVCs (subset)
+    # status
+    nominated_node_name: str = ""
+    phase: str = "Pending"
+    start_time: Optional[float] = None
+    # controller owner reference (kind, name, uid) — read by
+    # NodePreferAvoidPods priority and selector-spread listers
+    owner_ref: Optional[tuple[str, str, str]] = None
+    # bookkeeping
+    resource_version: int = 0
+    creation_timestamp: float = 0.0
+    deleted: bool = False
+
+    def __post_init__(self):
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}/{next(_pod_uid_counter)}"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ImageState:
+    names: tuple[str, ...]
+    size_bytes: int
+
+
+@dataclass(frozen=True)
+class NodeCondition:
+    type: str       # Ready, MemoryPressure, DiskPressure, PIDPressure, ...
+    status: str     # "True" / "False" / "Unknown"
+
+
+@dataclass
+class Node:
+    """Pruned v1.Node."""
+    name: str
+    labels: dict[str, str] = field(default_factory=dict)
+    # spec
+    taints: tuple[Taint, ...] = ()
+    unschedulable: bool = False
+    # scheduler.alpha.kubernetes.io/preferAvoidPods annotation, reduced to
+    # the controller UIDs it names (reference: node_prefer_avoid_pods.go)
+    prefer_avoid_pod_uids: tuple[str, ...] = ()
+    # status
+    allocatable: dict[str, int] = field(default_factory=dict)  # cpu(milli), memory(bytes), pods, ephemeral-storage, scalar
+    images: tuple[ImageState, ...] = ()
+    conditions: tuple[NodeCondition, ...] = ()
+    # bookkeeping
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+
+def get_zone_key(node: Node) -> str:
+    """Reference: pkg/util/node.GetZoneKey — region+":\\x00:"+zone from the
+    failure-domain labels; empty string when both are empty."""
+    region = node.labels.get(LABEL_ZONE_REGION, "")
+    zone = node.labels.get(LABEL_ZONE_FAILURE_DOMAIN, "")
+    if region == "" and zone == "":
+        return ""
+    return region + ":\x00:" + zone
+
+
+# ---------------------------------------------------------------------------
+# Workload objects used by SelectorSpread (services / RCs / RSs / STSs)
+# ---------------------------------------------------------------------------
+@dataclass
+class Service:
+    name: str
+    namespace: str = "default"
+    selector: dict[str, str] = field(default_factory=dict)  # empty → selects nothing
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ReplicaSet:
+    """Stands in for RC/RS/StatefulSet — anything with a label selector."""
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class PodDisruptionBudget:
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# Resource aggregate (reference: nodeinfo.Resource, node_info.go:139)
+# ---------------------------------------------------------------------------
+@dataclass
+class ResourceAgg:
+    milli_cpu: int = 0
+    memory: int = 0
+    ephemeral_storage: int = 0
+    allowed_pod_number: int = 0
+    scalar: dict[str, int] = field(default_factory=dict)
+
+    @staticmethod
+    def from_allocatable(alloc: dict[str, int]) -> "ResourceAgg":
+        r = ResourceAgg()
+        for name, q in alloc.items():
+            if name == RESOURCE_CPU:
+                r.milli_cpu = q
+            elif name == RESOURCE_MEMORY:
+                r.memory = q
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                r.ephemeral_storage = q
+            elif name == RESOURCE_PODS:
+                r.allowed_pod_number = q
+            else:
+                r.scalar[name] = q
+        return r
+
+    def add_requests(self, requests: dict[str, int]) -> None:
+        for name, q in requests.items():
+            if name == RESOURCE_CPU:
+                self.milli_cpu += q
+            elif name == RESOURCE_MEMORY:
+                self.memory += q
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                self.ephemeral_storage += q
+            elif name != RESOURCE_PODS:
+                self.scalar[name] = self.scalar.get(name, 0) + q
+
+    def set_max(self, requests: dict[str, int]) -> None:
+        """Reference: Resource.SetMaxResource — elementwise max (for init containers)."""
+        for name, q in requests.items():
+            if name == RESOURCE_CPU:
+                self.milli_cpu = max(self.milli_cpu, q)
+            elif name == RESOURCE_MEMORY:
+                self.memory = max(self.memory, q)
+            elif name == RESOURCE_EPHEMERAL_STORAGE:
+                self.ephemeral_storage = max(self.ephemeral_storage, q)
+            elif name != RESOURCE_PODS:
+                self.scalar[name] = max(self.scalar.get(name, 0), q)
+
+    def clone(self) -> "ResourceAgg":
+        return ResourceAgg(self.milli_cpu, self.memory, self.ephemeral_storage,
+                           self.allowed_pod_number, dict(self.scalar))
+
+
+def get_resource_request(pod: Pod) -> ResourceAgg:
+    """Reference: predicates.GetResourceRequest (predicates.go:743) —
+    sum over containers, then elementwise max with each init container."""
+    r = ResourceAgg()
+    for c in pod.containers:
+        r.add_requests(c.requests_dict())
+    for c in pod.init_containers:
+        r.set_max(c.requests_dict())
+    return r
+
+
+def get_nonzero_requests(requests: dict[str, int]) -> tuple[int, int]:
+    """Reference: priorities/util/non_zero.go:38 — default 100m CPU / 200MB
+    memory when *unset* (explicit zero stays zero)."""
+    cpu = requests[RESOURCE_CPU] if RESOURCE_CPU in requests else DEFAULT_MILLI_CPU_REQUEST
+    mem = requests[RESOURCE_MEMORY] if RESOURCE_MEMORY in requests else DEFAULT_MEMORY_REQUEST
+    return cpu, mem
+
+
+def get_pod_nonzero_requests(pod: Pod) -> tuple[int, int]:
+    """Reference: priorities/resource_allocation.go:97 getNonZeroRequests —
+    per-container defaulted sums (init containers are NOT considered)."""
+    cpu = mem = 0
+    for c in pod.containers:
+        ccpu, cmem = get_nonzero_requests(c.requests_dict())
+        cpu += ccpu
+        mem += cmem
+    return cpu, mem
+
+
+def get_container_ports(*pods: Pod) -> list[ContainerPort]:
+    """Reference: pkg/scheduler/util.GetContainerPorts — ports with HostPort>0."""
+    out = []
+    for pod in pods:
+        for c in pod.containers:
+            for p in c.ports:
+                if p.host_port > 0:
+                    out.append(p)
+    return out

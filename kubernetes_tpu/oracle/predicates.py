@@ -1,0 +1,392 @@
+"""Semantic oracle: Filter predicates, exact reference behavior.
+
+Pure-Python transliteration of the *semantics* (not code) of
+pkg/scheduler/algorithm/predicates/predicates.go — the parity referee every
+JAX kernel is tested against. Each predicate returns (fit, [reason...]).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Taint,
+    get_resource_request, get_container_ports,
+    node_selector_terms_match,
+    NO_SCHEDULE, NO_EXECUTE,
+    TAINT_NODE_UNSCHEDULABLE, find_intolerable_taint,
+    RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_PODS, RESOURCE_EPHEMERAL_STORAGE,
+)
+from kubernetes_tpu.cache.node_info import NodeInfo
+
+# Failure reasons (reference: predicates/error.go)
+ERR_NODE_SELECTOR_NOT_MATCH = "NodeSelectorNotMatch"
+ERR_POD_NOT_MATCH_HOST_NAME = "PodNotMatchHostName"
+ERR_POD_NOT_FITS_HOST_PORTS = "PodNotFitsHostPorts"
+ERR_TAINTS_TOLERATIONS_NOT_MATCH = "TaintsTolerationsNotMatch"
+ERR_NODE_UNSCHEDULABLE = "NodeUnschedulable"
+ERR_NODE_UNKNOWN_CONDITION = "NodeUnknownCondition"
+ERR_NODE_NOT_READY = "NodeNotReady"
+ERR_NODE_NETWORK_UNAVAILABLE = "NodeNetworkUnavailable"
+ERR_NODE_UNDER_MEMORY_PRESSURE = "NodeUnderMemoryPressure"
+ERR_NODE_UNDER_DISK_PRESSURE = "NodeUnderDiskPressure"
+ERR_NODE_UNDER_PID_PRESSURE = "NodeUnderPIDPressure"
+ERR_POD_AFFINITY_NOT_MATCH = "PodAffinityNotMatch"
+ERR_POD_AFFINITY_RULES_NOT_MATCH = "PodAffinityRulesNotMatch"
+ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH = "PodAntiAffinityRulesNotMatch"
+ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH = "ExistingPodsAntiAffinityRulesNotMatch"
+
+
+def insufficient_resource(resource: str) -> str:
+    return f"InsufficientResource:{resource}"
+
+
+# Predicate evaluation order (reference: predicates.go:143-149)
+PREDICATE_ORDERING = [
+    "CheckNodeCondition", "CheckNodeUnschedulable",
+    "GeneralPredicates", "HostName", "PodFitsHostPorts",
+    "MatchNodeSelector", "PodFitsResources", "NoDiskConflict",
+    "PodToleratesNodeTaints", "PodToleratesNodeNoExecuteTaints",
+    "CheckNodeLabelPresence", "CheckServiceAffinity",
+    "MaxEBSVolumeCount", "MaxGCEPDVolumeCount", "MaxCSIVolumeCountPred",
+    "MaxAzureDiskVolumeCount", "MaxCinderVolumeCount",
+    "CheckVolumeBinding", "NoVolumeZoneConflict",
+    "CheckNodeMemoryPressure", "CheckNodePIDPressure", "CheckNodeDiskPressure",
+    "MatchInterPodAffinity",
+]
+
+# Failure reasons that preemption cannot resolve (reference: generic_scheduler.go:65-84)
+UNRESOLVABLE_FAILURES = {
+    ERR_NODE_SELECTOR_NOT_MATCH,
+    ERR_POD_AFFINITY_RULES_NOT_MATCH,
+    ERR_POD_NOT_MATCH_HOST_NAME,
+    ERR_TAINTS_TOLERATIONS_NOT_MATCH,
+    "NodeLabelPresenceViolated",
+    ERR_NODE_NOT_READY,
+    ERR_NODE_NETWORK_UNAVAILABLE,
+    ERR_NODE_UNSCHEDULABLE,
+    ERR_NODE_UNKNOWN_CONDITION,
+    ERR_NODE_UNDER_MEMORY_PRESSURE,
+    ERR_NODE_UNDER_DISK_PRESSURE,
+    ERR_NODE_UNDER_PID_PRESSURE,
+}
+
+
+# ---------------------------------------------------------------------------
+# Individual predicates
+# ---------------------------------------------------------------------------
+def pod_fits_resources(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    """Reference: predicates.go:764 PodFitsResources."""
+    fails: list[str] = []
+    allowed = node_info.allocatable.allowed_pod_number
+    if len(node_info.pods) + 1 > allowed:
+        fails.append(insufficient_resource(RESOURCE_PODS))
+
+    req = get_resource_request(pod)
+    if req.milli_cpu == 0 and req.memory == 0 and req.ephemeral_storage == 0 and not req.scalar:
+        return len(fails) == 0, fails
+
+    alloc = node_info.allocatable
+    used = node_info.requested
+    if alloc.milli_cpu < req.milli_cpu + used.milli_cpu:
+        fails.append(insufficient_resource(RESOURCE_CPU))
+    if alloc.memory < req.memory + used.memory:
+        fails.append(insufficient_resource(RESOURCE_MEMORY))
+    if alloc.ephemeral_storage < req.ephemeral_storage + used.ephemeral_storage:
+        fails.append(insufficient_resource(RESOURCE_EPHEMERAL_STORAGE))
+    for name, q in req.scalar.items():
+        if alloc.scalar.get(name, 0) < q + used.scalar.get(name, 0):
+            fails.append(insufficient_resource(name))
+    return len(fails) == 0, fails
+
+
+def pod_matches_node_selector_and_affinity(pod: Pod, node: Node) -> bool:
+    """Reference: predicates.go:854 podMatchesNodeSelectorAndAffinityTerms."""
+    if pod.node_selector:
+        for k, v in pod.node_selector.items():
+            if node.labels.get(k) != v:
+                return False
+    affinity = pod.affinity
+    if affinity is not None and affinity.node_affinity is not None:
+        na = affinity.node_affinity
+        if na.required is None:
+            return True
+        return node_selector_terms_match(na.required, node.labels)
+    return True
+
+
+def pod_match_node_selector(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    if node_info.node is None:
+        return False, [ERR_NODE_UNKNOWN_CONDITION]
+    if pod_matches_node_selector_and_affinity(pod, node_info.node):
+        return True, []
+    return False, [ERR_NODE_SELECTOR_NOT_MATCH]
+
+
+def pod_fits_host(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    if not pod.node_name:
+        return True, []
+    if node_info.node is None:
+        return False, [ERR_NODE_UNKNOWN_CONDITION]
+    if pod.node_name == node_info.node.name:
+        return True, []
+    return False, [ERR_POD_NOT_MATCH_HOST_NAME]
+
+
+def pod_fits_host_ports(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    want = get_container_ports(pod)
+    if not want:
+        return True, []
+    for p in want:
+        if node_info.used_ports.check_conflict(p.host_ip, p.protocol, p.host_port):
+            return False, [ERR_POD_NOT_FITS_HOST_PORTS]
+    return True, []
+
+
+def general_predicates(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    """Reference: predicates.go:1112 — resources + host + ports + selector,
+    accumulating all failures (no short-circuit inside GeneralPredicates)."""
+    fails: list[str] = []
+    for pred in (pod_fits_resources, pod_fits_host, pod_fits_host_ports, pod_match_node_selector):
+        fit, reasons = pred(pod, node_info)
+        if not fit:
+            fails.extend(reasons)
+    return len(fails) == 0, fails
+
+
+def check_node_unschedulable(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    """Reference: predicates.go:1511."""
+    if node_info.node is None:
+        return False, [ERR_NODE_UNKNOWN_CONDITION]
+    tolerates = any(
+        t.tolerates(Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE))
+        for t in pod.tolerations
+    )
+    if node_info.node.unschedulable and not tolerates:
+        return False, [ERR_NODE_UNSCHEDULABLE]
+    return True, []
+
+
+def pod_tolerates_node_taints(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    """Reference: predicates.go:1531 — NoSchedule + NoExecute taints."""
+    if node_info.node is None:
+        return False, [ERR_NODE_UNKNOWN_CONDITION]
+    bad = find_intolerable_taint(
+        node_info.taints, pod.tolerations,
+        lambda t: t.effect in (NO_SCHEDULE, NO_EXECUTE))
+    if bad is None:
+        return True, []
+    return False, [ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+
+
+def pod_tolerates_node_no_execute_taints(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    bad = find_intolerable_taint(node_info.taints, pod.tolerations,
+                                 lambda t: t.effect == NO_EXECUTE)
+    if bad is None:
+        return True, []
+    return False, [ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+
+
+def _condition(node: Optional[Node], ctype: str) -> str:
+    if node is None:
+        return "Unknown"
+    for c in node.conditions:
+        if c.type == ctype:
+            return c.status
+    return "Unknown"
+
+
+def check_node_condition(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    """Reference: predicates.go:1610 — Ready must be True, NetworkUnavailable
+    must be False; node.Spec.Unschedulable also fails here."""
+    if node_info.node is None:
+        return False, [ERR_NODE_UNKNOWN_CONDITION]
+    reasons = []
+    for c in node_info.node.conditions:
+        if c.type == "Ready" and c.status != "True":
+            reasons.append(ERR_NODE_NOT_READY)
+        elif c.type == "NetworkUnavailable" and c.status != "False":
+            reasons.append(ERR_NODE_NETWORK_UNAVAILABLE)
+    if node_info.node.unschedulable:
+        reasons.append(ERR_NODE_UNSCHEDULABLE)
+    return len(reasons) == 0, reasons
+
+
+def is_pod_best_effort(pod: Pod) -> bool:
+    """QoS BestEffort — no container has any request (limits are out of our
+    pruned model; requests-only matches the scheduler-relevant behavior)."""
+    for c in list(pod.containers) + list(pod.init_containers):
+        if c.requests:
+            return False
+    return True
+
+
+def check_node_memory_pressure(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    if not is_pod_best_effort(pod):
+        return True, []
+    if _condition(node_info.node, "MemoryPressure") == "True":
+        return False, [ERR_NODE_UNDER_MEMORY_PRESSURE]
+    return True, []
+
+
+def check_node_disk_pressure(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    if _condition(node_info.node, "DiskPressure") == "True":
+        return False, [ERR_NODE_UNDER_DISK_PRESSURE]
+    return True, []
+
+
+def check_node_pid_pressure(pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+    if _condition(node_info.node, "PIDPressure") == "True":
+        return False, [ERR_NODE_UNDER_PID_PRESSURE]
+    return True, []
+
+
+# ---------------------------------------------------------------------------
+# Inter-pod affinity (reference: predicates.go:1196-1500)
+# ---------------------------------------------------------------------------
+def term_namespaces(defining_pod: Pod, term) -> tuple[str, ...]:
+    """Reference: priorities/util.GetNamespacesFromPodAffinityTerm."""
+    return term.namespaces if term.namespaces else (defining_pod.namespace,)
+
+
+def pod_matches_term_props(target: Pod, defining_pod: Pod, term) -> bool:
+    """Namespace + label selector match (PodMatchesTermsNamespaceAndSelector)."""
+    if target.namespace not in term_namespaces(defining_pod, term):
+        return False
+    if term.label_selector is None:
+        return False
+    return term.label_selector.matches(target.labels)
+
+
+def nodes_same_topology(a: Optional[Node], b: Optional[Node], key: str) -> bool:
+    """Reference: priorities/util.NodesHaveSameTopologyKey."""
+    if a is None or b is None or not key:
+        return False
+    return key in a.labels and key in b.labels and a.labels[key] == b.labels[key]
+
+
+class InterPodAffinityChecker:
+    """MatchInterPodAffinity over a full snapshot {node name -> NodeInfo}."""
+
+    def __init__(self, node_infos: dict[str, NodeInfo]):
+        self.node_infos = node_infos
+
+    def _node_of(self, pod: Pod) -> Optional[Node]:
+        ni = self.node_infos.get(pod.node_name)
+        return ni.node if ni else None
+
+    def check(self, pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+        node = node_info.node
+        # 1. Existing pods' required anti-affinity must not be violated by adding `pod`.
+        if not self._satisfies_existing_anti_affinity(pod, node):
+            return False, [ERR_POD_AFFINITY_NOT_MATCH,
+                           ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH]
+        # 2. `pod`'s own required affinity/anti-affinity.
+        a = pod.affinity
+        if a is None or (a.pod_affinity is None and a.pod_anti_affinity is None):
+            return True, []
+        ok, reason = self._satisfies_pod_affinity_anti_affinity(pod, node)
+        if not ok:
+            return False, [ERR_POD_AFFINITY_NOT_MATCH, reason]
+        return True, []
+
+    def _satisfies_existing_anti_affinity(self, pod: Pod, node: Node) -> bool:
+        for ni in self.node_infos.values():
+            for existing in ni.pods_with_affinity:
+                ea = existing.affinity
+                if ea is None or ea.pod_anti_affinity is None:
+                    continue
+                for term in ea.pod_anti_affinity.required:
+                    if pod_matches_term_props(pod, existing, term) and \
+                            nodes_same_topology(node, self._node_of(existing), term.topology_key):
+                        return False
+        return True
+
+    def _term_satisfied(self, pod: Pod, node: Node, term) -> tuple[bool, bool]:
+        """Returns (satisfied-on-node, matching-pod-exists-anywhere)."""
+        exists = False
+        satisfied = False
+        for ni in self.node_infos.values():
+            for existing in ni.pods:
+                if pod_matches_term_props(existing, pod, term):
+                    exists = True
+                    if nodes_same_topology(node, self._node_of(existing), term.topology_key):
+                        satisfied = True
+        return satisfied, exists
+
+    def _satisfies_pod_affinity_anti_affinity(self, pod: Pod, node: Node) -> tuple[bool, str]:
+        a = pod.affinity
+        if a.pod_affinity is not None:
+            for term in a.pod_affinity.required:
+                satisfied, exists = self._term_satisfied(pod, node, term)
+                if not satisfied:
+                    # First-pod-in-cluster rule (reference: predicates.go:1454-1464):
+                    # if no pod anywhere matches the term, the term is waived when
+                    # the pod matches its own term (it would otherwise never schedule).
+                    if not exists and pod_matches_term_props(pod, pod, term):
+                        continue
+                    return False, ERR_POD_AFFINITY_RULES_NOT_MATCH
+        if a.pod_anti_affinity is not None:
+            for term in a.pod_anti_affinity.required:
+                satisfied, _ = self._term_satisfied(pod, node, term)
+                if satisfied:
+                    return False, ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH
+        return True, ""
+
+
+# ---------------------------------------------------------------------------
+# Driver: run predicates in reference order with short-circuit
+# ---------------------------------------------------------------------------
+def default_predicate_set(node_infos: dict[str, NodeInfo],
+                          taint_nodes_by_condition: bool = True) -> dict[str, Callable]:
+    """The DefaultProvider predicate set (reference: defaults.go:40), keyed by
+    name; evaluated in PREDICATE_ORDERING.
+
+    TaintNodesByCondition is Beta/default-on in this snapshot
+    (kube_features.go:468), so the effective default set drops the
+    condition/pressure predicates and adds the mandatory
+    PodToleratesNodeTaints + CheckNodeUnschedulable (defaults.go:60-90).
+    Pass taint_nodes_by_condition=False for the pre-gate behavior.
+
+    Volume-topology predicates (NoVolumeZoneConflict, Max*VolumeCount,
+    NoDiskConflict, CheckVolumeBinding) are registered as always-fit until
+    the volume model lands."""
+    ipa = InterPodAffinityChecker(node_infos)
+    always_fit = lambda pod, ni: (True, [])
+    preds = {
+        "GeneralPredicates": general_predicates,
+        "PodToleratesNodeTaints": pod_tolerates_node_taints,
+        "MatchInterPodAffinity": ipa.check,
+        "NoDiskConflict": always_fit,
+        "MaxEBSVolumeCount": always_fit,
+        "MaxGCEPDVolumeCount": always_fit,
+        "MaxAzureDiskVolumeCount": always_fit,
+        "MaxCSIVolumeCountPred": always_fit,
+        "CheckVolumeBinding": always_fit,
+        "NoVolumeZoneConflict": always_fit,
+    }
+    if taint_nodes_by_condition:
+        preds["CheckNodeUnschedulable"] = check_node_unschedulable
+    else:
+        preds["CheckNodeCondition"] = check_node_condition
+        preds["CheckNodeMemoryPressure"] = check_node_memory_pressure
+        preds["CheckNodeDiskPressure"] = check_node_disk_pressure
+        preds["CheckNodePIDPressure"] = check_node_pid_pressure
+    return preds
+
+
+def pod_fits_on_node(pod: Pod, node_info: NodeInfo,
+                     predicate_funcs: dict[str, Callable],
+                     always_check_all: bool = False) -> tuple[bool, list[str]]:
+    """One pass of podFitsOnNode (reference: generic_scheduler.go:598) without
+    nominated-pod handling (the caller layers that on)."""
+    failed: list[str] = []
+    for key in PREDICATE_ORDERING:
+        pred = predicate_funcs.get(key)
+        if pred is None:
+            continue
+        fit, reasons = pred(pod, node_info)
+        if not fit:
+            failed.extend(reasons)
+            if not always_check_all:
+                break
+    return len(failed) == 0, failed

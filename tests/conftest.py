@@ -1,0 +1,11 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import — mirrors how the driver validates
+multi-chip sharding without real chips.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

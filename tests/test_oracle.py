@@ -1,0 +1,518 @@
+"""Golden tests for the semantic oracle — cases transcribed (by behavior,
+not code) from the reference's table-driven tests:
+generic_scheduler_test.go, predicates_test.go, priorities/*_test.go.
+"""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, ContainerPort, Taint, Toleration, Affinity,
+    NodeAffinity, NodeSelectorTerm, Requirement, PreferredSchedulingTerm,
+    PodAffinity, PodAntiAffinity, PodAffinityTerm, WeightedPodAffinityTerm,
+    LabelSelector, NodeCondition, IN, EXISTS, NO_SCHEDULE, PREFER_NO_SCHEDULE,
+)
+from kubernetes_tpu.api.quantity import requests
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.oracle import predicates as preds
+from kubernetes_tpu.oracle import priorities as prios
+from kubernetes_tpu.oracle.generic_scheduler import GenericScheduler, FitError
+
+
+def mknode(name, cpu=4000, mem=32 * 1024**3, pods=110, labels=None, **kw):
+    return Node(name=name, labels=labels or {},
+                allocatable={"cpu": cpu, "memory": mem, "pods": pods}, **kw)
+
+
+def mkpod(name, cpu=None, mem=None, **kw):
+    reqs = {}
+    if cpu is not None:
+        reqs["cpu"] = cpu
+    if mem is not None:
+        reqs["memory"] = mem
+    containers = (Container.make(name="c", requests=reqs),) if reqs else \
+        (Container.make(name="c"),)
+    return Pod(name=name, containers=containers, **kw)
+
+
+def snapshot(nodes, pods_by_node=None):
+    infos = {}
+    for n in nodes:
+        ni = NodeInfo(n)
+        for p in (pods_by_node or {}).get(n.name, []):
+            p.node_name = n.name
+            ni.add_pod(p)
+        infos[n.name] = ni
+    return infos
+
+
+# ---------------------------------------------------------------------------
+# Predicates
+# ---------------------------------------------------------------------------
+class TestPodFitsResources:
+    def test_fits_empty_node(self):
+        ni = NodeInfo(mknode("n1"))
+        fit, reasons = preds.pod_fits_resources(mkpod("p", cpu=1000, mem=1024**3), ni)
+        assert fit and not reasons
+
+    def test_insufficient_cpu(self):
+        ni = NodeInfo(mknode("n1", cpu=1000))
+        ni.add_pod(mkpod("existing", cpu=600))
+        fit, reasons = preds.pod_fits_resources(mkpod("p", cpu=600), ni)
+        assert not fit
+        assert reasons == [preds.insufficient_resource("cpu")]
+
+    def test_insufficient_cpu_and_memory(self):
+        ni = NodeInfo(mknode("n1", cpu=1000, mem=1024))
+        ni.add_pod(mkpod("existing", cpu=600, mem=600))
+        fit, reasons = preds.pod_fits_resources(mkpod("p", cpu=600, mem=600), ni)
+        assert not fit
+        assert set(reasons) == {preds.insufficient_resource("cpu"),
+                                preds.insufficient_resource("memory")}
+
+    def test_zero_request_always_fits(self):
+        ni = NodeInfo(mknode("n1", cpu=100, mem=100))
+        ni.add_pod(mkpod("existing", cpu=100, mem=100))
+        fit, _ = preds.pod_fits_resources(mkpod("p"), ni)
+        assert fit
+
+    def test_pod_count_limit(self):
+        ni = NodeInfo(mknode("n1", pods=1))
+        ni.add_pod(mkpod("existing"))
+        fit, reasons = preds.pod_fits_resources(mkpod("p"), ni)
+        assert not fit
+        assert reasons == [preds.insufficient_resource("pods")]
+
+    def test_init_container_max(self):
+        # max(sum(containers), any init container): init 2000m dominates 500m
+        pod = Pod(name="p",
+                  containers=(Container.make(requests=requests(cpu="500m")),),
+                  init_containers=(Container.make(requests=requests(cpu="2")),))
+        ni = NodeInfo(mknode("n1", cpu=1000))
+        fit, reasons = preds.pod_fits_resources(pod, ni)
+        assert not fit
+
+    def test_node_aggregate_excludes_init_containers(self):
+        # NodeInfo.add_pod sums regular containers only (node_info.go:578);
+        # init-container max applies to the incoming pod, not node usage.
+        existing = Pod(name="e",
+                       containers=(Container.make(requests=requests(cpu="500m")),),
+                       init_containers=(Container.make(requests=requests(cpu="2")),))
+        ni = NodeInfo(mknode("n1", cpu=2000))
+        ni.add_pod(existing)
+        assert ni.requested.milli_cpu == 500
+        fit, _ = preds.pod_fits_resources(mkpod("p", cpu=1500), ni)
+        assert fit
+
+    def test_scalar_resource(self):
+        n = mknode("n1")
+        n.allocatable["example.com/foo"] = 2
+        ni = NodeInfo(n)
+        pod = Pod(name="p", containers=(
+            Container.make(requests={"example.com/foo": 3}),))
+        fit, reasons = preds.pod_fits_resources(pod, ni)
+        assert not fit
+        assert reasons == [preds.insufficient_resource("example.com/foo")]
+
+
+class TestNodeSelectorAndAffinity:
+    def test_node_selector_match(self):
+        ni = NodeInfo(mknode("n1", labels={"zone": "us-1"}))
+        pod = mkpod("p", node_selector={"zone": "us-1"})
+        assert preds.pod_match_node_selector(pod, ni)[0]
+
+    def test_node_selector_mismatch(self):
+        ni = NodeInfo(mknode("n1", labels={"zone": "us-2"}))
+        pod = mkpod("p", node_selector={"zone": "us-1"})
+        fit, reasons = preds.pod_match_node_selector(pod, ni)
+        assert not fit and reasons == [preds.ERR_NODE_SELECTOR_NOT_MATCH]
+
+    def test_required_affinity_in_operator(self):
+        term = NodeSelectorTerm((Requirement("zone", IN, ("a", "b")),))
+        pod = mkpod("p", affinity=Affinity(node_affinity=NodeAffinity(required=(term,))))
+        assert preds.pod_match_node_selector(pod, NodeInfo(mknode("n", labels={"zone": "a"})))[0]
+        assert not preds.pod_match_node_selector(pod, NodeInfo(mknode("n", labels={"zone": "c"})))[0]
+
+    def test_empty_required_terms_match_nothing(self):
+        pod = mkpod("p", affinity=Affinity(node_affinity=NodeAffinity(required=())))
+        assert not preds.pod_match_node_selector(pod, NodeInfo(mknode("n")))[0]
+
+    def test_gt_lt_operators(self):
+        term = NodeSelectorTerm((Requirement("gpu-count", "Gt", ("2",)),))
+        pod = mkpod("p", affinity=Affinity(node_affinity=NodeAffinity(required=(term,))))
+        assert preds.pod_match_node_selector(pod, NodeInfo(mknode("n", labels={"gpu-count": "4"})))[0]
+        assert not preds.pod_match_node_selector(pod, NodeInfo(mknode("n", labels={"gpu-count": "1"})))[0]
+
+
+class TestHostPorts:
+    def test_conflict(self):
+        ni = NodeInfo(mknode("n1"))
+        existing = Pod(name="e", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080),)),))
+        ni.add_pod(existing)
+        pod = Pod(name="p", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080),)),))
+        fit, reasons = preds.pod_fits_host_ports(pod, ni)
+        assert not fit and reasons == [preds.ERR_POD_NOT_FITS_HOST_PORTS]
+
+    def test_different_ip_no_conflict(self):
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(Pod(name="e", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080, host_ip="127.0.0.1"),)),)))
+        pod = Pod(name="p", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080, host_ip="10.0.0.1"),)),))
+        assert preds.pod_fits_host_ports(pod, ni)[0]
+
+    def test_wildcard_conflicts_specific(self):
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(Pod(name="e", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080, host_ip="127.0.0.1"),)),)))
+        pod = Pod(name="p", containers=(
+            Container.make(ports=(ContainerPort(host_port=8080),)),))  # 0.0.0.0
+        assert not preds.pod_fits_host_ports(pod, ni)[0]
+
+
+class TestTaints:
+    def test_intolerable_noschedule(self):
+        ni = NodeInfo(mknode("n1", taints=(Taint("dedicated", "gpu", NO_SCHEDULE),)))
+        fit, reasons = preds.pod_tolerates_node_taints(mkpod("p"), ni)
+        assert not fit and reasons == [preds.ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+
+    def test_tolerated(self):
+        ni = NodeInfo(mknode("n1", taints=(Taint("dedicated", "gpu", NO_SCHEDULE),)))
+        pod = mkpod("p", tolerations=(Toleration("dedicated", "Equal", "gpu", NO_SCHEDULE),))
+        assert preds.pod_tolerates_node_taints(pod, ni)[0]
+
+    def test_prefer_no_schedule_ignored_by_predicate(self):
+        ni = NodeInfo(mknode("n1", taints=(Taint("k", "v", PREFER_NO_SCHEDULE),)))
+        assert preds.pod_tolerates_node_taints(mkpod("p"), ni)[0]
+
+    def test_exists_toleration(self):
+        ni = NodeInfo(mknode("n1", taints=(Taint("dedicated", "gpu", NO_SCHEDULE),)))
+        pod = mkpod("p", tolerations=(Toleration("dedicated", "Exists", "", ""),))
+        assert preds.pod_tolerates_node_taints(pod, ni)[0]
+
+
+class TestNodeUnschedulable:
+    def test_unschedulable_blocks(self):
+        ni = NodeInfo(mknode("n1", unschedulable=True))
+        fit, reasons = preds.check_node_unschedulable(mkpod("p"), ni)
+        assert not fit and reasons == [preds.ERR_NODE_UNSCHEDULABLE]
+
+    def test_toleration_unlocks(self):
+        ni = NodeInfo(mknode("n1", unschedulable=True))
+        pod = mkpod("p", tolerations=(
+            Toleration("node.kubernetes.io/unschedulable", "Exists", "", ""),))
+        assert preds.check_node_unschedulable(pod, ni)[0]
+
+    def test_default_set_uses_gate(self):
+        infos = snapshot([mknode("n1", unschedulable=True)])
+        s = preds.default_predicate_set(infos)
+        assert "CheckNodeUnschedulable" in s and "CheckNodeCondition" not in s
+        s_pregate = preds.default_predicate_set(infos, taint_nodes_by_condition=False)
+        assert "CheckNodeCondition" in s_pregate and "CheckNodeUnschedulable" not in s_pregate
+
+    def test_none_node_with_check_all(self):
+        ni = NodeInfo()  # no node set
+        fit, reasons = preds.pod_fits_on_node(
+            mkpod("p"), ni, preds.default_predicate_set({}, taint_nodes_by_condition=False),
+            always_check_all=True)
+        assert not fit and preds.ERR_NODE_UNKNOWN_CONDITION in reasons
+
+
+class TestImageLocality:
+    def test_image_scoring(self):
+        from kubernetes_tpu.api.types import ImageState
+        n = mknode("n1")
+        n.images = (ImageState(("registry/img:v1",), 270 * prios.MB),)
+        ni = NodeInfo(n)
+        pod = Pod(name="p", containers=(Container.make(image="registry/img:v1"),))
+        # 1 node total -> spread 1.0 -> sum 270MB; 10*(270-23)/(1000-23) = 2
+        assert prios.image_locality_map(pod, ni, total_num_nodes=1) == 2
+
+    def test_absent_image_scores_zero(self):
+        ni = NodeInfo(mknode("n1"))
+        pod = Pod(name="p", containers=(Container.make(image="registry/img:v1"),))
+        assert prios.image_locality_map(pod, ni, total_num_nodes=1) == 0
+
+
+class TestNodePreferAvoidPods:
+    def test_avoided_controller(self):
+        n = mknode("n1", prefer_avoid_pod_uids=("rc-uid-1",))
+        ni = NodeInfo(n)
+        pod = Pod(name="p", owner_ref=("ReplicationController", "rc", "rc-uid-1"))
+        assert prios.node_prefer_avoid_pods_map(pod, ni) == 0
+        other = Pod(name="q", owner_ref=("ReplicaSet", "rs", "other-uid"))
+        assert prios.node_prefer_avoid_pods_map(other, ni) == 10
+        bare = Pod(name="r")
+        assert prios.node_prefer_avoid_pods_map(bare, ni) == 10
+
+
+class TestInterPodAffinity:
+    def _cluster(self):
+        n1 = mknode("n1", labels={"zone": "z1", "kubernetes.io/hostname": "n1"})
+        n2 = mknode("n2", labels={"zone": "z2", "kubernetes.io/hostname": "n2"})
+        return n1, n2
+
+    def test_required_affinity_satisfied_same_zone(self):
+        n1, n2 = self._cluster()
+        svc_pod = Pod(name="svc", labels={"app": "db"})
+        infos = snapshot([n1, n2], {"n1": [svc_pod]})
+        checker = preds.InterPodAffinityChecker(infos)
+        pod = mkpod("p", affinity=Affinity(pod_affinity=PodAffinity(required=(
+            PodAffinityTerm(LabelSelector.from_dict({"app": "db"}), "zone"),))))
+        assert checker.check(pod, infos["n1"])[0]
+        assert not checker.check(pod, infos["n2"])[0]
+
+    def test_anti_affinity_blocks(self):
+        n1, n2 = self._cluster()
+        other = Pod(name="other", labels={"app": "web"})
+        infos = snapshot([n1, n2], {"n1": [other]})
+        checker = preds.InterPodAffinityChecker(infos)
+        pod = Pod(name="p", labels={"app": "web"},
+                  affinity=Affinity(pod_anti_affinity=PodAntiAffinity(required=(
+                      PodAffinityTerm(LabelSelector.from_dict({"app": "web"}), "zone"),))))
+        assert not checker.check(pod, infos["n1"])[0]
+        assert checker.check(pod, infos["n2"])[0]
+
+    def test_existing_anti_affinity_blocks_incoming(self):
+        n1, n2 = self._cluster()
+        existing = Pod(name="e", labels={"app": "lonely"},
+                       affinity=Affinity(pod_anti_affinity=PodAntiAffinity(required=(
+                           PodAffinityTerm(LabelSelector.from_dict({"app": "web"}), "zone"),))))
+        infos = snapshot([n1, n2], {"n1": [existing]})
+        checker = preds.InterPodAffinityChecker(infos)
+        pod = Pod(name="p", labels={"app": "web"})
+        assert not checker.check(pod, infos["n1"])[0]
+        assert checker.check(pod, infos["n2"])[0]
+
+    def test_first_pod_self_match_rule(self):
+        n1, _ = self._cluster()
+        infos = snapshot([n1])
+        checker = preds.InterPodAffinityChecker(infos)
+        # No pod matches anywhere, but the pod matches its own term -> allowed.
+        pod = Pod(name="p", labels={"app": "db"},
+                  affinity=Affinity(pod_affinity=PodAffinity(required=(
+                      PodAffinityTerm(LabelSelector.from_dict({"app": "db"}), "zone"),))))
+        assert checker.check(pod, infos["n1"])[0]
+        # Pod does NOT match its own term -> rejected.
+        pod2 = Pod(name="p2", labels={"app": "web"},
+                   affinity=Affinity(pod_affinity=PodAffinity(required=(
+                       PodAffinityTerm(LabelSelector.from_dict({"app": "db"}), "zone"),))))
+        assert not checker.check(pod2, infos["n1"])[0]
+
+
+# ---------------------------------------------------------------------------
+# Priorities — exact integer scores
+# ---------------------------------------------------------------------------
+class TestLeastRequested:
+    def test_empty_node_nonzero_defaults(self):
+        # Pod with no requests gets 100m/200MB defaults; node 4000m/32Gi
+        # cpu: (4000-100)*10/4000 = 9; mem: (32Gi-200Mi)*10/32Gi = 9 -> (9+9)/2 = 9
+        ni = NodeInfo(mknode("n1"))
+        assert prios.least_requested_map(mkpod("p"), ni) == 9
+
+    def test_reference_case_3000_5000(self):
+        # From reference least_requested_test: cpu req 3000/10000 -> 7,
+        # mem 5000/20000 -> 7 => 7
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=3000, mem=5000)
+        assert prios.least_requested_map(pod, ni) == 7
+
+    def test_overcommit_scores_zero(self):
+        ni = NodeInfo(mknode("n1", cpu=1000, mem=1000))
+        pod = mkpod("p", cpu=2000, mem=500)
+        # cpu req > cap -> 0; mem (1000-500)*10/1000=5 -> (0+5)/2=2
+        assert prios.least_requested_map(pod, ni) == 2
+
+    def test_existing_pods_counted(self):
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        ni.add_pod(mkpod("e1", cpu=3000, mem=5000))
+        pod = mkpod("p", cpu=3000, mem=5000)
+        # cpu 6000/10000 -> 4; mem 10000/20000 -> 5 => 4
+        assert prios.least_requested_map(pod, ni) == 4
+
+
+class TestMostRequested:
+    def test_basic(self):
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=3000, mem=5000)
+        # cpu 3000*10/10000=3; mem 5000*10/20000=2 -> (3+2)/2=2
+        assert prios.most_requested_map(pod, ni) == 2
+
+
+class TestBalancedAllocation:
+    def test_perfectly_balanced(self):
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=5000, mem=10000)  # both 50%
+        assert prios.balanced_allocation_map(pod, ni) == 10
+
+    def test_imbalanced(self):
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=10000, mem=0)
+        # explicit zero mem request stays 0: cpuF=1.0 -> >= 1 -> 0
+        assert prios.balanced_allocation_map(pod, ni) == 0
+
+    def test_half_diff(self):
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=6000, mem=2000)  # cpuF=.6 memF=.1 diff=.5 -> 5
+        assert prios.balanced_allocation_map(pod, ni) == 5
+
+
+class TestRTCR:
+    def test_default_shape(self):
+        rtcr = prios.make_rtcr_map()
+        ni = NodeInfo(mknode("n1", cpu=10000, mem=20000))
+        pod = mkpod("p", cpu=5000, mem=10000)
+        # utilization 50 -> score 10 - 10*50/100 = 5 for both -> 5
+        assert rtcr(pod, ni) == 5
+
+    def test_broken_linear_interpolation(self):
+        shape = ((0, 0), (50, 10), (100, 0))
+        assert prios.broken_linear(shape, 0) == 0
+        assert prios.broken_linear(shape, 25) == 5
+        assert prios.broken_linear(shape, 50) == 10
+        assert prios.broken_linear(shape, 75) == 5
+        assert prios.broken_linear(shape, 100) == 0
+
+
+class TestNodeAffinityPriority:
+    def test_weights_and_normalize(self):
+        pref = (
+            PreferredSchedulingTerm(2, NodeSelectorTerm((Requirement("a", EXISTS),))),
+            PreferredSchedulingTerm(5, NodeSelectorTerm((Requirement("b", EXISTS),))),
+        )
+        pod = mkpod("p", affinity=Affinity(node_affinity=NodeAffinity(preferred=pref)))
+        ni_both = NodeInfo(mknode("n1", labels={"a": "1", "b": "1"}))
+        ni_a = NodeInfo(mknode("n2", labels={"a": "1"}))
+        ni_none = NodeInfo(mknode("n3"))
+        raw = [prios.node_affinity_map(pod, ni) for ni in (ni_both, ni_a, ni_none)]
+        assert raw == [7, 2, 0]
+        assert prios.normalize_reduce(10, False, raw) == [10, 2, 0]
+
+
+class TestTaintTolerationPriority:
+    def test_counts_and_reverse_normalize(self):
+        pod = mkpod("p")
+        ni0 = NodeInfo(mknode("n1"))
+        ni1 = NodeInfo(mknode("n2", taints=(Taint("k1", "v", PREFER_NO_SCHEDULE),)))
+        ni2 = NodeInfo(mknode("n3", taints=(Taint("k1", "v", PREFER_NO_SCHEDULE),
+                                            Taint("k2", "v", PREFER_NO_SCHEDULE))))
+        raw = [prios.taint_toleration_map(pod, ni) for ni in (ni0, ni1, ni2)]
+        assert raw == [0, 1, 2]
+        assert prios.normalize_reduce(10, True, raw) == [10, 5, 0]
+
+    def test_all_tolerable_gives_max(self):
+        pod = mkpod("p", tolerations=(Toleration("k1", "Exists", "", ""),))
+        ni = NodeInfo(mknode("n", taints=(Taint("k1", "v", PREFER_NO_SCHEDULE),)))
+        assert prios.taint_toleration_map(pod, ni) == 0
+        assert prios.normalize_reduce(10, True, [0]) == [10]
+
+
+class TestSelectorSpread:
+    def test_zone_blend(self):
+        za = {"failure-domain.beta.kubernetes.io/zone": "za"}
+        zb = {"failure-domain.beta.kubernetes.io/zone": "zb"}
+        n1, n2, n3 = mknode("n1", labels=za), mknode("n2", labels=za), mknode("n3", labels=zb)
+        svc_selector = {"app": "web"}
+        mk = lambda i: Pod(name=f"e{i}", labels={"app": "web"})
+        infos = snapshot([n1, n2, n3], {"n1": [mk(1), mk(2)], "n2": [mk(3)]})
+        pod = Pod(name="p", labels={"app": "web"})
+        counts = [prios.selector_spread_map(pod, infos[h], [svc_selector])
+                  for h in ("n1", "n2", "n3")]
+        assert counts == [2, 1, 0]
+        scores = prios.selector_spread_reduce(infos, ["n1", "n2", "n3"], counts)
+        # node scores: 10*(2-2)/2=0, 10*(2-1)/2=5, 10
+        # zone counts: za=3, zb=0 -> zone scores: 0, 0, 10
+        # blend: 1/3*node + 2/3*zone
+        assert scores == [0, int(5 / 3), 10]
+
+
+class TestInterPodAffinityPriority:
+    def test_preferred_affinity(self):
+        za = {"zone": "za"}
+        zb = {"zone": "zb"}
+        n1, n2 = mknode("n1", labels=za), mknode("n2", labels=zb)
+        existing = Pod(name="e", labels={"app": "db"})
+        infos = snapshot([n1, n2], {"n1": [existing]})
+        pod = mkpod("p", affinity=Affinity(pod_affinity=PodAffinity(preferred=(
+            WeightedPodAffinityTerm(100, PodAffinityTerm(
+                LabelSelector.from_dict({"app": "db"}), "zone")),))))
+        scores = prios.interpod_affinity_priority(pod, infos, [n1, n2])
+        assert scores == [10, 0]
+
+    def test_hard_affinity_symmetry(self):
+        za = {"zone": "za"}
+        zb = {"zone": "zb"}
+        n1, n2 = mknode("n1", labels=za), mknode("n2", labels=zb)
+        existing = Pod(name="e", labels={"app": "db"},
+                       affinity=Affinity(pod_affinity=PodAffinity(required=(
+                           PodAffinityTerm(LabelSelector.from_dict({"app": "web"}), "zone"),))))
+        infos = snapshot([n1, n2], {"n1": [existing]})
+        pod = Pod(name="p", labels={"app": "web"})
+        scores = prios.interpod_affinity_priority(pod, infos, [n1, n2],
+                                                  hard_pod_affinity_weight=5)
+        assert scores == [10, 0]
+
+
+# ---------------------------------------------------------------------------
+# Generic scheduler
+# ---------------------------------------------------------------------------
+class TestNumFeasibleNodes:
+    @pytest.mark.parametrize("num_all,percentage,expected", [
+        (10, 50, 10),          # below floor -> all
+        (100, 50, 100),        # at floor boundary -> all (100 < min is false; 100*50/100=50<100 -> 100)
+        (1000, 50, 500),
+        (1000, 100, 1000),
+        (1000, 0, 420),        # adaptive: 50 - 1000/125 = 42%
+        (6000, 0, 300),        # adaptive clamps at 5%
+        (400, 0, 188),         # 50 - 3 = 47% -> 188
+        (150, 25, 100),        # 37 < 100 -> floor 100
+    ])
+    def test_cases(self, num_all, percentage, expected):
+        g = GenericScheduler(percentage_of_nodes_to_score=percentage)
+        assert g.num_feasible_nodes_to_find(num_all) == expected
+
+
+class TestSelectHost:
+    def test_round_robin_among_ties(self):
+        g = GenericScheduler()
+        hp = [("n1", 5), ("n2", 9), ("n3", 9), ("n4", 9)]
+        picks = [g.select_host(hp) for _ in range(6)]
+        assert picks == ["n2", "n3", "n4", "n2", "n3", "n4"]
+
+    def test_single_max(self):
+        g = GenericScheduler()
+        assert g.select_host([("n1", 1), ("n2", 3)]) == "n2"
+
+
+class TestSchedule:
+    def test_picks_least_loaded(self):
+        nodes = [mknode(f"n{i}") for i in range(3)]
+        infos = snapshot(nodes, {"n0": [mkpod("e", cpu=3000, mem=8 * 1024**3)]})
+        g = GenericScheduler(percentage_of_nodes_to_score=100)
+        result = g.schedule(mkpod("p", cpu=1000, mem=1024**3), infos,
+                            [n.name for n in nodes])
+        assert result.suggested_host in ("n1", "n2")  # n0 is loaded
+
+    def test_fit_error_when_infeasible(self):
+        nodes = [mknode("n0", cpu=100)]
+        infos = snapshot(nodes)
+        g = GenericScheduler()
+        with pytest.raises(FitError) as ei:
+            g.schedule(mkpod("p", cpu=200), infos, ["n0"])
+        assert "n0" in ei.value.failed_predicates
+
+    def test_last_index_rotation(self):
+        nodes = [mknode(f"n{i}") for i in range(4)]
+        infos = snapshot(nodes)
+        names = [n.name for n in nodes]
+        g = GenericScheduler(percentage_of_nodes_to_score=100)
+        g.schedule(mkpod("p1"), infos, names)
+        assert g.last_index == 0  # processed all 4, 4 % 4 == 0
+
+    def test_single_feasible_skips_scoring(self):
+        nodes = [mknode("n0"), mknode("n1", cpu=50)]
+        infos = snapshot(nodes)
+        g = GenericScheduler(percentage_of_nodes_to_score=100)
+        result = g.schedule(mkpod("p", cpu=100), infos, ["n0", "n1"])
+        assert result.suggested_host == "n0"
+        assert result.feasible_nodes == 1
