@@ -21,10 +21,8 @@ Layout:
   utils/      heap, clock, backoff helpers
 """
 
-import jax
-
-# Reference resource math is int64 (milliCPU, memory bytes); exact score
-# parity requires 64-bit integer arithmetic on device.
-jax.config.update("jax_enable_x64", True)
-
 __version__ = "0.1.0"
+
+# NOTE: jax is imported (and jax_enable_x64 switched on — reference resource
+# math is int64) by `kubernetes_tpu.ops`, the first layer that touches the
+# device. The api/oracle/cache/queue/store layers stay pure Python.

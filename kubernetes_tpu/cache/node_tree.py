@@ -1,0 +1,82 @@
+"""Zone-aware node enumeration (reference: internal/cache/node_tree.go:31).
+
+Nodes are grouped by zone; `next()` round-robins across zones so the
+scheduler's node walk interleaves failure domains (node_tree.go:165). A full
+enumeration of num_nodes names exhausts every zone and resets, so each
+scheduling cycle sees the same interleaved order — that order is the node
+axis of the device matrix.
+"""
+from __future__ import annotations
+
+from kubernetes_tpu.api.types import Node, get_zone_key
+
+
+class NodeTree:
+    def __init__(self):
+        self._tree: dict[str, list[str]] = {}   # zone -> node names
+        self._zones: list[str] = []             # insertion-ordered zone keys
+        self._zone_index = 0
+        self._last_index: dict[str, int] = {}   # per-zone cursor
+        self._exhausted: set[str] = set()
+        self.num_nodes = 0
+
+    def add_node(self, node: Node) -> None:
+        zone = get_zone_key(node)
+        names = self._tree.get(zone)
+        if names is None:
+            names = []
+            self._tree[zone] = names
+            self._zones.append(zone)
+            self._last_index[zone] = 0
+        if node.name in names:
+            return
+        names.append(node.name)
+        self.num_nodes += 1
+
+    def remove_node(self, node: Node) -> None:
+        zone = get_zone_key(node)
+        names = self._tree.get(zone)
+        if names is None or node.name not in names:
+            return
+        names.remove(node.name)
+        self.num_nodes -= 1
+        if not names:
+            del self._tree[zone]
+            self._zones.remove(zone)
+            del self._last_index[zone]
+            self._exhausted.discard(zone)
+        self._zone_index = 0
+
+    def update_node(self, old: Node, new: Node) -> None:
+        if get_zone_key(old) == get_zone_key(new):
+            return
+        self.remove_node(old)
+        self.add_node(new)
+
+    def _reset_exhausted(self) -> None:
+        for zone in self._exhausted:
+            self._last_index[zone] = 0
+        self._exhausted.clear()
+
+    def next(self) -> str:
+        """Next node name in zone-interleaved round-robin order."""
+        if not self._zones:
+            return ""
+        while True:
+            if len(self._exhausted) == len(self._zones):
+                self._reset_exhausted()
+            zone = self._zones[self._zone_index]
+            self._zone_index = (self._zone_index + 1) % len(self._zones)
+            if zone in self._exhausted:
+                continue
+            idx = self._last_index[zone]
+            names = self._tree[zone]
+            if idx >= len(names) - 1:
+                self._exhausted.add(zone)
+            if idx < len(names):
+                self._last_index[zone] = idx + 1
+                return names[idx]
+
+    def list_names(self) -> list[str]:
+        """One full interleaved enumeration — the per-cycle node order."""
+        return [self.next() for _ in range(self.num_nodes)]

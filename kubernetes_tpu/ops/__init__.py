@@ -1,0 +1,10 @@
+"""JAX device layer: dense node-state encoding and filter/score/select kernels.
+
+Importing this package configures jax for the framework: 64-bit integers are
+enabled because the reference's resource math is int64 (milliCPU ints,
+memory in bytes, scores summed as int64 — pkg/scheduler/api/types.go:35) and
+exact score parity requires the same arithmetic on device.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)

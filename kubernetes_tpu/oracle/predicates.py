@@ -265,72 +265,95 @@ def nodes_same_topology(a: Optional[Node], b: Optional[Node], key: str) -> bool:
 
 
 class InterPodAffinityChecker:
-    """MatchInterPodAffinity over a full snapshot {node name -> NodeInfo}."""
+    """MatchInterPodAffinity over a full snapshot {node name -> NodeInfo}.
+
+    Like the reference's predicate metadata (predicates/metadata.go:71), the
+    cluster-wide scans run once per incoming pod, producing topology-pair
+    sets; the per-node check is then O(terms) label lookups. This is also the
+    shape the device kernel consumes: per-term topology-value sets become
+    dictionary-encoded masks over the node axis.
+    """
 
     def __init__(self, node_infos: dict[str, NodeInfo]):
         self.node_infos = node_infos
+        self._meta_uid: Optional[str] = None
+        self._meta = None
 
     def _node_of(self, pod: Pod) -> Optional[Node]:
         ni = self.node_infos.get(pod.node_name)
         return ni.node if ni else None
 
-    def check(self, pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
-        node = node_info.node
-        # 1. Existing pods' required anti-affinity must not be violated by adding `pod`.
-        if not self._satisfies_existing_anti_affinity(pod, node):
-            return False, [ERR_POD_AFFINITY_NOT_MATCH,
-                           ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH]
-        # 2. `pod`'s own required affinity/anti-affinity.
-        a = pod.affinity
-        if a is None or (a.pod_affinity is None and a.pod_anti_affinity is None):
-            return True, []
-        ok, reason = self._satisfies_pod_affinity_anti_affinity(pod, node)
-        if not ok:
-            return False, [ERR_POD_AFFINITY_NOT_MATCH, reason]
-        return True, []
-
-    def _satisfies_existing_anti_affinity(self, pod: Pod, node: Node) -> bool:
+    def _metadata(self, pod: Pod):
+        if self._meta_uid == pod.uid:
+            return self._meta
+        # (a) Existing pods' required anti-affinity: every (topologyKey, value)
+        # the incoming pod would violate by landing in that topology.
+        violating: set[tuple[str, str]] = set()
         for ni in self.node_infos.values():
             for existing in ni.pods_with_affinity:
                 ea = existing.affinity
                 if ea is None or ea.pod_anti_affinity is None:
                     continue
+                e_node = self._node_of(existing)
+                if e_node is None:
+                    continue
                 for term in ea.pod_anti_affinity.required:
-                    if pod_matches_term_props(pod, existing, term) and \
-                            nodes_same_topology(node, self._node_of(existing), term.topology_key):
-                        return False
-        return True
+                    if term.topology_key in e_node.labels and \
+                            pod_matches_term_props(pod, existing, term):
+                        violating.add((term.topology_key,
+                                       e_node.labels[term.topology_key]))
 
-    def _term_satisfied(self, pod: Pod, node: Node, term) -> tuple[bool, bool]:
-        """Returns (satisfied-on-node, matching-pod-exists-anywhere)."""
-        exists = False
-        satisfied = False
-        for ni in self.node_infos.values():
-            for existing in ni.pods:
-                if pod_matches_term_props(existing, pod, term):
-                    exists = True
-                    if nodes_same_topology(node, self._node_of(existing), term.topology_key):
-                        satisfied = True
-        return satisfied, exists
+        # (b) The pod's own required terms: per term, the set of topology
+        # values hosting a matching pod, plus whether any match exists at all.
+        def term_values(term) -> tuple[set[str], bool]:
+            values: set[str] = set()
+            exists = False
+            for ni in self.node_infos.values():
+                for existing in ni.pods:
+                    if pod_matches_term_props(existing, pod, term):
+                        exists = True
+                        e_node = self._node_of(existing)
+                        if e_node is not None and term.topology_key in e_node.labels:
+                            values.add(e_node.labels[term.topology_key])
+            return values, exists
 
-    def _satisfies_pod_affinity_anti_affinity(self, pod: Pod, node: Node) -> tuple[bool, str]:
         a = pod.affinity
-        if a.pod_affinity is not None:
+        aff_terms = []
+        anti_terms = []
+        if a is not None and a.pod_affinity is not None:
             for term in a.pod_affinity.required:
-                satisfied, exists = self._term_satisfied(pod, node, term)
-                if not satisfied:
-                    # First-pod-in-cluster rule (reference: predicates.go:1454-1464):
-                    # if no pod anywhere matches the term, the term is waived when
-                    # the pod matches its own term (it would otherwise never schedule).
-                    if not exists and pod_matches_term_props(pod, pod, term):
-                        continue
-                    return False, ERR_POD_AFFINITY_RULES_NOT_MATCH
-        if a.pod_anti_affinity is not None:
+                aff_terms.append((term, *term_values(term)))
+        if a is not None and a.pod_anti_affinity is not None:
             for term in a.pod_anti_affinity.required:
-                satisfied, _ = self._term_satisfied(pod, node, term)
-                if satisfied:
-                    return False, ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH
-        return True, ""
+                anti_terms.append((term, *term_values(term)))
+        self._meta = (violating, aff_terms, anti_terms)
+        self._meta_uid = pod.uid
+        return self._meta
+
+    def check(self, pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
+        node = node_info.node
+        labels = node.labels if node is not None else {}
+        violating, aff_terms, anti_terms = self._metadata(pod)
+        # 1. Existing pods' required anti-affinity must not be violated.
+        for key, value in violating:
+            if labels.get(key) == value:
+                return False, [ERR_POD_AFFINITY_NOT_MATCH,
+                               ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH]
+        # 2. The pod's own required affinity/anti-affinity.
+        for term, values, exists in aff_terms:
+            if labels.get(term.topology_key) not in values:
+                # First-pod-in-cluster rule (reference: predicates.go:1454-1464):
+                # if no pod anywhere matches the term, the term is waived when
+                # the pod matches its own term (it would otherwise never schedule).
+                if not exists and pod_matches_term_props(pod, pod, term):
+                    continue
+                return False, [ERR_POD_AFFINITY_NOT_MATCH,
+                               ERR_POD_AFFINITY_RULES_NOT_MATCH]
+        for term, values, _ in anti_terms:
+            if labels.get(term.topology_key) in values:
+                return False, [ERR_POD_AFFINITY_NOT_MATCH,
+                               ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH]
+        return True, []
 
 
 # ---------------------------------------------------------------------------

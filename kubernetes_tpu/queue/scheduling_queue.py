@@ -1,0 +1,311 @@
+"""Three-part pending-pod queue with backoff and event-driven wake-ups.
+
+Mirrors the semantics of pkg/scheduler/internal/queue/scheduling_queue.go:
+- activeQ: heap by (priority desc, enqueue time asc) — pods ready to schedule.
+- podBackoffQ: heap by backoff-completion time — pods recently failed.
+- unschedulableQ: map — pods waiting for a cluster event.
+- moveRequestCycle (:290): a failed pod whose scheduling cycle predates the
+  last MoveAllToActiveQueue request goes to backoff (something changed while
+  it was being scheduled), otherwise to unschedulable.
+- Backoff 1s initial, doubling to 10s max (pod_backoff.go:41, wired :184).
+- Unschedulable pods are flushed to active after 60s (:52, :368).
+- nominatedPodMap (:725): pods nominated onto a node by preemption.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.utils.clock import Clock, RealClock
+from kubernetes_tpu.utils.heap import KeyedHeap
+
+INITIAL_BACKOFF = 1.0          # seconds (scheduling_queue.go:184)
+MAX_BACKOFF = 10.0
+UNSCHEDULABLE_TIMEOUT = 60.0   # seconds (scheduling_queue.go:52)
+
+
+@dataclass
+class _QueuedPod:
+    pod: Pod
+    timestamp: float
+    seq: int = 0        # FIFO tie-break for equal (priority, timestamp)
+    expiry: float = 0.0  # backoff-completion time, snapshotted at enqueue so
+    #                      the backoffQ heap key never mutates under the heap
+
+
+class PodBackoffMap:
+    """Per-pod attempt counter → exponential backoff (pod_backoff.go:41)."""
+
+    def __init__(self, initial: float = INITIAL_BACKOFF, max_backoff: float = MAX_BACKOFF):
+        self.initial = initial
+        self.max = max_backoff
+        self._attempts: dict[str, int] = {}
+        self._last_update: dict[str, float] = {}
+
+    def backoff_pod(self, key: str, now: float) -> None:
+        self._attempts[key] = self._attempts.get(key, 0) + 1
+        self._last_update[key] = now
+
+    def backoff_time(self, key: str) -> float:
+        """Duration of the current backoff window for the pod."""
+        attempts = self._attempts.get(key, 0)
+        if attempts == 0:
+            return 0.0
+        return min(self.initial * (2 ** (attempts - 1)), self.max)
+
+    def backoff_expiry(self, key: str) -> float:
+        return self._last_update.get(key, 0.0) + self.backoff_time(key)
+
+    def clear(self, key: str) -> None:
+        self._attempts.pop(key, None)
+        self._last_update.pop(key, None)
+
+
+class NominatedPodMap:
+    """pods nominated to run on nodes by preemption (:725)."""
+
+    def __init__(self):
+        self._by_node: dict[str, list[Pod]] = {}
+        self._node_of: dict[str, str] = {}  # pod key -> node
+
+    def add(self, pod: Pod, node_name: str = "") -> None:
+        self.delete(pod)
+        node = node_name or pod.nominated_node_name
+        if not node:
+            return
+        self._node_of[pod.key] = node
+        self._by_node.setdefault(node, []).append(pod)
+
+    def delete(self, pod: Pod) -> None:
+        node = self._node_of.pop(pod.key, None)
+        if node is None:
+            return
+        lst = self._by_node.get(node, [])
+        self._by_node[node] = [p for p in lst if p.key != pod.key]
+        if not self._by_node[node]:
+            del self._by_node[node]
+
+    def update(self, old: Pod, new: Pod) -> None:
+        self.delete(old)
+        self.add(new)
+
+    def pods_for_node(self, node_name: str) -> list[Pod]:
+        return list(self._by_node.get(node_name, []))
+
+
+def _pod_has_affinity_terms(pod: Pod) -> bool:
+    a = pod.affinity
+    return a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None)
+
+
+class PriorityQueue:
+    def __init__(self, clock: Optional[Clock] = None,
+                 initial_backoff: float = INITIAL_BACKOFF,
+                 max_backoff: float = MAX_BACKOFF,
+                 unschedulable_timeout: float = UNSCHEDULABLE_TIMEOUT):
+        self.clock = clock or RealClock()
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = itertools.count()
+        self._active = KeyedHeap(
+            key_fn=lambda q: q.pod.key,
+            less_fn=lambda a, b: (
+                (-a.pod.priority, a.timestamp, a.seq) < (-b.pod.priority, b.timestamp, b.seq)))
+        self._backoffq = KeyedHeap(
+            key_fn=lambda q: q.pod.key,
+            less_fn=lambda a, b: (a.expiry, a.seq) < (b.expiry, b.seq))
+        self._unschedulable: dict[str, _QueuedPod] = {}
+        self._backoff = PodBackoffMap(initial_backoff, max_backoff)
+        self.unschedulable_timeout = unschedulable_timeout
+        self.nominated = NominatedPodMap()
+        self._scheduling_cycle = 0
+        self._move_request_cycle = -1
+        self._closed = False
+
+    # -- basic ops ----------------------------------------------------------
+    def add(self, pod: Pod) -> None:
+        """New pending pod → activeQ (reference: Add :267)."""
+        with self._cond:
+            q = _QueuedPod(pod, self.clock.now(), next(self._seq))
+            self._active.add(q)
+            self._unschedulable.pop(pod.key, None)
+            self._backoffq.delete(pod.key)
+            self.nominated.add(pod)
+            self._cond.notify()
+
+    def add_if_not_present(self, pod: Pod) -> None:
+        with self._cond:
+            if pod.key in self._active or pod.key in self._backoffq \
+                    or pod.key in self._unschedulable:
+                return
+            self.add(pod)
+
+    def add_unschedulable_if_not_present(self, pod: Pod, pod_scheduling_cycle: int) -> None:
+        """Failed pod re-entry (reference: :300)."""
+        with self._cond:
+            if pod.key in self._unschedulable or pod.key in self._active \
+                    or pod.key in self._backoffq:
+                return
+            now = self.clock.now()
+            self._backoff.backoff_pod(pod.key, now)
+            q = _QueuedPod(pod, now, next(self._seq),
+                           expiry=self._backoff.backoff_expiry(pod.key))
+            if self._move_request_cycle >= pod_scheduling_cycle:
+                self._backoffq.add(q)
+                self._cond.notify()
+            else:
+                self._unschedulable[pod.key] = q
+            self.nominated.add(pod)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
+        """Blocks until a pod is ready (reference: :389). Flushes backoff /
+        unschedulable timers opportunistically so single-threaded callers
+        don't need the background goroutines.
+
+        The blocking `timeout` is wall-clock (it is caller plumbing, not
+        scheduling semantics), while backoff/flush timing uses the injected
+        clock — so a FakeClock test can time out of an empty queue."""
+        import time as _time
+        with self._cond:
+            deadline = None if timeout is None else _time.monotonic() + timeout
+            while True:
+                self._flush_locked()
+                q = self._active.pop()
+                if q is not None:
+                    self._scheduling_cycle += 1
+                    return q.pod
+                if self._closed:
+                    return None
+                wait = 0.02
+                if deadline is not None:
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    wait = min(wait, remaining)
+                self._cond.wait(wait)
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        """Reference: :430 — refresh in place; an update to an unschedulable
+        pod's spec moves it back to active."""
+        with self._cond:
+            self.nominated.update(old or new, new)
+            if new.key in self._active:
+                self._active.add(_QueuedPod(new, self.clock.now(), next(self._seq)))
+                self._cond.notify()
+                return
+            if new.key in self._backoffq:
+                expiry = self._backoffq.get(new.key).expiry
+                self._backoffq.add(_QueuedPod(new, self.clock.now(), next(self._seq),
+                                              expiry=expiry))
+                return
+            if new.key in self._unschedulable:
+                del self._unschedulable[new.key]
+                self._backoff.clear(new.key)
+                self._active.add(_QueuedPod(new, self.clock.now(), next(self._seq)))
+                self._cond.notify()
+                return
+            self.add(new)
+
+    def delete(self, pod: Pod) -> None:
+        with self._cond:
+            self._active.delete(pod.key)
+            self._backoffq.delete(pod.key)
+            self._unschedulable.pop(pod.key, None)
+            self._backoff.clear(pod.key)
+            self.nominated.delete(pod)
+
+    # -- event-driven moves --------------------------------------------------
+    def move_all_to_active(self) -> None:
+        """Cluster changed → retry everything (reference: :519)."""
+        with self._cond:
+            now = self.clock.now()
+            for key, q in list(self._unschedulable.items()):
+                q.expiry = self._backoff.backoff_expiry(key)
+                if q.expiry > now:
+                    self._backoffq.add(q)
+                else:
+                    self._active.add(q)
+                del self._unschedulable[key]
+            self._move_request_cycle = self._scheduling_cycle
+            self._cond.notify_all()
+
+    def assigned_pod_added(self, pod: Pod) -> None:
+        """An assigned pod landed → unschedulable pods with (anti)affinity may
+        now fit (reference: AssignedPodAdded :486)."""
+        self._move_pods_with_affinity()
+
+    def assigned_pod_updated(self, pod: Pod) -> None:
+        self._move_pods_with_affinity()
+
+    def _move_pods_with_affinity(self) -> None:
+        with self._cond:
+            now = self.clock.now()
+            moved = False
+            for key, q in list(self._unschedulable.items()):
+                if _pod_has_affinity_terms(q.pod):
+                    q.expiry = self._backoff.backoff_expiry(key)
+                    if q.expiry > now:
+                        self._backoffq.add(q)
+                    else:
+                        self._active.add(q)
+                    del self._unschedulable[key]
+                    moved = True
+            if moved:
+                self._move_request_cycle = self._scheduling_cycle
+                self._cond.notify_all()
+
+    # -- timers --------------------------------------------------------------
+    def _flush_locked(self) -> None:
+        now = self.clock.now()
+        # backoff completed → active (reference: :334)
+        while True:
+            head = self._backoffq.peek()
+            if head is None or head.expiry > now:
+                break
+            self._backoffq.pop()
+            self._active.add(head)
+        # unschedulable leftover > 60s → active (reference: :368)
+        for key, q in list(self._unschedulable.items()):
+            if now - q.timestamp > self.unschedulable_timeout:
+                del self._unschedulable[key]
+                self._active.add(q)
+
+    def flush(self) -> None:
+        with self._cond:
+            self._flush_locked()
+            self._cond.notify_all()
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def scheduling_cycle(self) -> int:
+        with self._lock:
+            return self._scheduling_cycle
+
+    def pending_pods(self) -> dict[str, list[Pod]]:
+        with self._lock:
+            return {
+                "active": [q.pod for q in self._active.list()],
+                "backoff": [q.pod for q in self._backoffq.list()],
+                "unschedulable": [q.pod for q in self._unschedulable.values()],
+            }
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._active) + len(self._backoffq) + len(self._unschedulable)
+
+    def clear_backoff(self, pod: Pod) -> None:
+        with self._cond:
+            self._backoff.clear(pod.key)
+            q = self._backoffq.delete(pod.key)
+            if q is not None:
+                q.expiry = 0.0
+                self._active.add(q)
+                self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()

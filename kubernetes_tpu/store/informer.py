@@ -1,0 +1,204 @@
+"""Reflector + shared informer over the versioned store.
+
+Mirrors the reference's client-side cache pipeline (SURVEY §3.4):
+Reflector.ListAndWatch (client-go/tools/cache/reflector.go:159) →
+DeltaFIFO → sharedIndexInformer.HandleDeltas (shared_informer.go:180) →
+registered handlers. Here the transport is the in-process Store watch; the
+delta queue is the Watch's event queue; handlers see the same
+add/update/delete callbacks with old+new objects.
+
+Two pump modes:
+- `start()` — background thread, like the reference's informer goroutines.
+- `pump(max_events)` — synchronous drain for deterministic tests and for
+  the benchmark loop (keeps the hot path single-threaded).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from kubernetes_tpu.store.store import (
+    Store, Watch, Event, ADDED, MODIFIED, DELETED, ExpiredError,
+)
+
+Handler = Callable[[Any], None]
+UpdateHandler = Callable[[Any, Any], None]
+
+
+class ResourceEventHandler:
+    """One registered handler set, optionally filtered
+    (reference: cache.FilteringResourceEventHandler)."""
+
+    def __init__(self,
+                 on_add: Optional[Handler] = None,
+                 on_update: Optional[UpdateHandler] = None,
+                 on_delete: Optional[Handler] = None,
+                 filter_fn: Optional[Callable[[Any], bool]] = None):
+        self.on_add = on_add
+        self.on_update = on_update
+        self.on_delete = on_delete
+        self.filter_fn = filter_fn
+
+    def _passes(self, obj: Any) -> bool:
+        return self.filter_fn is None or self.filter_fn(obj)
+
+    def handle(self, ev_type: str, old: Any, new: Any) -> None:
+        if ev_type == ADDED:
+            if self._passes(new) and self.on_add:
+                self.on_add(new)
+        elif ev_type == MODIFIED:
+            old_ok = old is not None and self._passes(old)
+            new_ok = self._passes(new)
+            # reference filtering semantics: update→update / add / delete
+            if old_ok and new_ok:
+                if self.on_update:
+                    self.on_update(old, new)
+            elif new_ok:
+                if self.on_add:
+                    self.on_add(new)
+            elif old_ok:
+                if self.on_delete:
+                    self.on_delete(old)
+        elif ev_type == DELETED:
+            if self._passes(new) and self.on_delete:
+                self.on_delete(new)
+
+
+class SharedInformer:
+    """List+watch one kind; maintain a local cache; fan events out."""
+
+    def __init__(self, store: Store, kind: str):
+        self.store = store
+        self.kind = kind
+        self._handlers: list[ResourceEventHandler] = []
+        self._cache: dict[str, Any] = {}
+        self._watch: Optional[Watch] = None
+        self._synced = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.RLock()
+
+    # -- registration -------------------------------------------------------
+    def add_event_handler(self,
+                          on_add: Optional[Handler] = None,
+                          on_update: Optional[UpdateHandler] = None,
+                          on_delete: Optional[Handler] = None,
+                          filter_fn: Optional[Callable[[Any], bool]] = None) -> None:
+        self._handlers.append(ResourceEventHandler(on_add, on_update, on_delete, filter_fn))
+
+    # -- lister (reference: informer.Lister()) ------------------------------
+    def list(self) -> list[Any]:
+        with self._lock:
+            return list(self._cache.values())
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._cache.get(key)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced
+
+    # -- list+watch ---------------------------------------------------------
+    def sync(self) -> None:
+        """Initial list + open watch at the list's resourceVersion."""
+        while True:
+            objs, rv = self.store.list(self.kind)
+            try:
+                self._watch = self.store.watch(self.kind, since_rv=rv)
+            except ExpiredError:
+                continue
+            break
+        with self._lock:
+            self._cache = {o.key: o for o in objs}
+        for obj in objs:
+            self._dispatch(ADDED, None, obj)
+        self._synced = True
+
+    def pump(self, max_events: Optional[int] = None,
+             timeout: float = 0.0) -> int:
+        """Synchronously apply pending watch events. Returns count applied."""
+        if self._watch is None:
+            self.sync()
+        n = 0
+        while max_events is None or n < max_events:
+            ev = self._watch.next(timeout=timeout) if timeout else self._watch.try_next()
+            if ev is None:
+                break
+            self._apply(ev)
+            n += 1
+        return n
+
+    def _apply(self, ev: Event) -> None:
+        old = None
+        with self._lock:
+            if ev.type in (ADDED, MODIFIED):
+                old = self._cache.get(ev.obj.key)
+                self._cache[ev.obj.key] = ev.obj
+            elif ev.type == DELETED:
+                old = self._cache.pop(ev.obj.key, None)
+        # An ADDED for a key we already had behaves as update (re-list replay)
+        etype = ev.type
+        if etype == ADDED and old is not None:
+            etype = MODIFIED
+        self._dispatch(etype, old, ev.obj)
+
+    def _dispatch(self, ev_type: str, old: Any, new: Any) -> None:
+        for h in self._handlers:
+            h.handle(ev_type, old, new)
+
+    # -- background mode ----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        if self._watch is None:
+            self.sync()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"informer-{self.kind}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            ev = self._watch.next(timeout=0.05)
+            if ev is not None:
+                self._apply(ev)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+
+class InformerFactory:
+    """SharedInformerFactory analog: one informer per kind, shared."""
+
+    def __init__(self, store: Store):
+        self.store = store
+        self._informers: dict[str, SharedInformer] = {}
+
+    def informer(self, kind: str) -> SharedInformer:
+        inf = self._informers.get(kind)
+        if inf is None:
+            inf = SharedInformer(self.store, kind)
+            self._informers[kind] = inf
+        return inf
+
+    def sync_all(self) -> None:
+        for inf in self._informers.values():
+            if not inf.has_synced:
+                inf.sync()
+
+    def pump_all(self) -> int:
+        return sum(inf.pump() for inf in self._informers.values())
+
+    def start_all(self) -> None:
+        for inf in self._informers.values():
+            inf.start()
+
+    def stop_all(self) -> None:
+        for inf in self._informers.values():
+            inf.stop()

@@ -1,0 +1,247 @@
+"""In-memory versioned object store with list/watch — the etcd+apiserver analog.
+
+Provides the same distributed-communication contract the reference's control
+plane is built on (SURVEY §2.4): a single authoritative store assigning a
+monotonically increasing resourceVersion to every write, optimistic
+concurrency via resourceVersion preconditions (reference:
+staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go GuaranteedUpdate),
+and resumable watch streams with a bounded event log (reference:
+storage/cacher/cacher.go:217 watch cache; etcd3/watcher.go:99).
+
+Objects are the pruned dataclasses from `kubernetes_tpu.api.types`. The
+store snapshots (deep-copies) objects on write and on read so no caller can
+mutate shared state — the stand-in for the reference's serialize/deserialize
+boundary.
+"""
+from __future__ import annotations
+
+import copy
+import queue as _queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+# Well-known kinds (the reference's resource names)
+PODS = "pods"
+NODES = "nodes"
+SERVICES = "services"
+REPLICASETS = "replicasets"
+PDBS = "poddisruptionbudgets"
+LEASES = "leases"  # leader-election locks (resourcelock analog)
+
+DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
+
+
+class ConflictError(Exception):
+    """resourceVersion precondition failed (optimistic-concurrency loss)."""
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+class ExpiredError(Exception):
+    """Watch asked to resume from a resourceVersion older than the log window
+    (the reference returns 410 Gone → client re-lists)."""
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str            # ADDED | MODIFIED | DELETED
+    kind: str
+    obj: Any             # snapshot of the object at this version
+    resource_version: int
+
+
+class Watch:
+    """One watch stream: a bounded queue of Events plus a stop handle."""
+
+    def __init__(self, store: "Store", kind: str):
+        self._store = store
+        self.kind = kind
+        self._q: _queue.Queue[Optional[Event]] = _queue.Queue()
+        self._stopped = False
+
+    def _deliver(self, event: Event) -> None:
+        if not self._stopped:
+            self._q.put(event)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        """Next event, or None on timeout / stream close."""
+        try:
+            return self._q.get(timeout=timeout)
+        except _queue.Empty:
+            return None
+
+    def try_next(self) -> Optional[Event]:
+        """Non-blocking next event, or None when the queue is empty."""
+        try:
+            return self._q.get_nowait()
+        except _queue.Empty:
+            return None
+
+    def drain(self) -> list[Event]:
+        out = []
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except _queue.Empty:
+                return out
+            if ev is not None:
+                out.append(ev)
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._store._remove_watch(self)
+        self._q.put(None)  # wake any blocked next()
+
+
+def _key_of(obj: Any) -> str:
+    return obj.key
+
+
+class Store:
+    """Threadsafe versioned KV with per-kind watch fan-out."""
+
+    def __init__(self, watch_log_size: int = DEFAULT_WATCH_LOG):
+        self._lock = threading.RLock()
+        self._rv = 0
+        self._objs: dict[str, dict[str, Any]] = {}
+        self._watchers: dict[str, list[Watch]] = {}
+        # per-kind ring of recent events for watch resume
+        self._log: dict[str, list[Event]] = {}
+        self._log_size = watch_log_size
+
+    # -- reads --------------------------------------------------------------
+    def get(self, kind: str, key: str) -> Any:
+        with self._lock:
+            obj = self._objs.get(kind, {}).get(key)
+            if obj is None:
+                raise NotFoundError(f"{kind}/{key}")
+            return copy.deepcopy(obj)
+
+    def list(self, kind: str) -> tuple[list[Any], int]:
+        """Objects plus the store resourceVersion the list is consistent at."""
+        with self._lock:
+            objs = [copy.deepcopy(o) for o in self._objs.get(kind, {}).values()]
+            return objs, self._rv
+
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- writes -------------------------------------------------------------
+    def create(self, kind: str, obj: Any) -> Any:
+        with self._lock:
+            bucket = self._objs.setdefault(kind, {})
+            key = _key_of(obj)
+            if key in bucket:
+                raise AlreadyExistsError(f"{kind}/{key}")
+            stored = copy.deepcopy(obj)
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[key] = stored
+            self._emit(Event(ADDED, kind, copy.deepcopy(stored), self._rv))
+            return copy.deepcopy(stored)
+
+    def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
+        with self._lock:
+            bucket = self._objs.setdefault(kind, {})
+            key = _key_of(obj)
+            current = bucket.get(key)
+            if current is None:
+                raise NotFoundError(f"{kind}/{key}")
+            if expect_rv is not None and current.resource_version != expect_rv:
+                raise ConflictError(
+                    f"{kind}/{key}: rv {current.resource_version} != expected {expect_rv}")
+            stored = copy.deepcopy(obj)
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[key] = stored
+            self._emit(Event(MODIFIED, kind, copy.deepcopy(stored), self._rv))
+            return copy.deepcopy(stored)
+
+    def guaranteed_update(self, kind: str, key: str,
+                          mutate: Callable[[Any], Any]) -> Any:
+        """Read-modify-write retry loop (reference: GuaranteedUpdate)."""
+        while True:
+            current = self.get(kind, key)
+            rv = current.resource_version
+            updated = mutate(current)
+            try:
+                return self.update(kind, updated, expect_rv=rv)
+            except ConflictError:
+                continue
+
+    def delete(self, kind: str, key: str) -> Any:
+        with self._lock:
+            bucket = self._objs.get(kind, {})
+            obj = bucket.pop(key, None)
+            if obj is None:
+                raise NotFoundError(f"{kind}/{key}")
+            self._rv += 1
+            self._emit(Event(DELETED, kind, copy.deepcopy(obj), self._rv))
+            return obj
+
+    # -- pod conveniences (the scheduler's write surface) --------------------
+    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+        """POST pods/<p>/binding analog (reference: factory.go:710)."""
+        def mutate(pod):
+            pod.node_name = node_name
+            return pod
+        return self.guaranteed_update(PODS, pod_key, mutate)
+
+    def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
+        def mutate(pod):
+            pod.nominated_node_name = node_name
+            return pod
+        return self.guaranteed_update(PODS, pod_key, mutate)
+
+    # -- watch --------------------------------------------------------------
+    def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:
+        """Stream events for `kind` after `since_rv` (None → only new events).
+
+        Raises ExpiredError when since_rv has fallen out of the event log —
+        callers re-list, exactly like the reference's Reflector on 410 Gone.
+        """
+        with self._lock:
+            w = Watch(self, kind)
+            if since_rv is not None:
+                log = self._log.get(kind, [])
+                if log and since_rv < log[0].resource_version - 1:
+                    # Can't prove no gap: the oldest retained event may not
+                    # be the first after since_rv.
+                    raise ExpiredError(
+                        f"{kind}: rv {since_rv} older than log window")
+                for ev in log:
+                    if ev.resource_version > since_rv:
+                        w._deliver(ev)
+            self._watchers.setdefault(kind, []).append(w)
+            return w
+
+    def _remove_watch(self, w: Watch) -> None:
+        with self._lock:
+            lst = self._watchers.get(w.kind, [])
+            if w in lst:
+                lst.remove(w)
+
+    def _emit(self, event: Event) -> None:
+        log = self._log.setdefault(event.kind, [])
+        log.append(event)
+        if len(log) > self._log_size:
+            del log[: len(log) - self._log_size]
+        for w in self._watchers.get(event.kind, []):
+            w._deliver(event)
+
+    # -- bulk load (benchmark harness) --------------------------------------
+    def load(self, kind: str, objs: Iterable[Any]) -> None:
+        for o in objs:
+            self.create(kind, o)

@@ -1,0 +1,98 @@
+"""Keyed binary heap with in-place update/delete by key.
+
+Mirrors the semantics of the reference's scheduler heap
+(pkg/scheduler/util/heap.go:127): items are keyed objects ordered by an
+arbitrary less-function; Add/Update re-sift in place, Delete removes by key.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class KeyedHeap:
+    def __init__(self, key_fn: Callable[[Any], str], less_fn: Callable[[Any, Any], bool]):
+        self._key_fn = key_fn
+        self._less = less_fn
+        self._items: list[Any] = []
+        self._index: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._index
+
+    def get(self, key: str) -> Optional[Any]:
+        i = self._index.get(key)
+        return self._items[i] if i is not None else None
+
+    def list(self) -> list[Any]:
+        return list(self._items)
+
+    def add(self, item: Any) -> None:
+        """Insert or replace by key, restoring heap order."""
+        key = self._key_fn(item)
+        i = self._index.get(key)
+        if i is not None:
+            self._items[i] = item
+            self._sift_down(self._sift_up(i))
+        else:
+            self._items.append(item)
+            self._index[key] = len(self._items) - 1
+            self._sift_up(len(self._items) - 1)
+
+    update = add
+
+    def add_if_not_present(self, item: Any) -> None:
+        if self._key_fn(item) not in self._index:
+            self.add(item)
+
+    def delete(self, key: str) -> Optional[Any]:
+        i = self._index.get(key)
+        if i is None:
+            return None
+        item = self._items[i]
+        last = len(self._items) - 1
+        self._swap(i, last)
+        self._items.pop()
+        del self._index[key]
+        if i < last:
+            self._sift_down(self._sift_up(i))
+        return item
+
+    def peek(self) -> Optional[Any]:
+        return self._items[0] if self._items else None
+
+    def pop(self) -> Optional[Any]:
+        if not self._items:
+            return None
+        return self.delete(self._key_fn(self._items[0]))
+
+    # -- internals ----------------------------------------------------------
+    def _swap(self, i: int, j: int) -> None:
+        items = self._items
+        items[i], items[j] = items[j], items[i]
+        self._index[self._key_fn(items[i])] = i
+        self._index[self._key_fn(items[j])] = j
+
+    def _sift_up(self, i: int) -> int:
+        while i > 0:
+            parent = (i - 1) // 2
+            if self._less(self._items[i], self._items[parent]):
+                self._swap(i, parent)
+                i = parent
+            else:
+                break
+        return i
+
+    def _sift_down(self, i: int) -> int:
+        n = len(self._items)
+        while True:
+            smallest = i
+            for c in (2 * i + 1, 2 * i + 2):
+                if c < n and self._less(self._items[c], self._items[smallest]):
+                    smallest = c
+            if smallest == i:
+                return i
+            self._swap(i, smallest)
+            i = smallest

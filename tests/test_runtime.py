@@ -1,0 +1,398 @@
+"""Tests for the runtime layer: store/watch, informers, cache, node tree,
+scheduling queue, keyed heap. Behavior cases mirror the reference's
+table-driven tests (cache_test.go, scheduling_queue_test.go, node_tree_test.go).
+"""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION,
+)
+from kubernetes_tpu.api.quantity import requests
+from kubernetes_tpu.cache.cache import SchedulerCache, Snapshot, CacheError
+from kubernetes_tpu.cache.node_tree import NodeTree
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.store.store import (
+    Store, ConflictError, NotFoundError, AlreadyExistsError, ExpiredError,
+    PODS, NODES, ADDED, MODIFIED, DELETED,
+)
+from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.heap import KeyedHeap
+
+
+def mknode(name, cpu=4000, mem=32 * 1024**3, pods=110, zone=None, region=None):
+    labels = {}
+    if zone:
+        labels[LABEL_ZONE_FAILURE_DOMAIN] = zone
+    if region:
+        labels[LABEL_ZONE_REGION] = region
+    return Node(name=name, labels=labels,
+                allocatable={"cpu": cpu, "memory": mem, "pods": pods})
+
+
+def mkpod(name, cpu=1000, mem=1024**3, node="", priority=0):
+    return Pod(name=name, node_name=node, priority=priority,
+               containers=(Container.make(name="c", requests=requests(cpu=f"{cpu}m", mem=mem)),))
+
+
+# ---------------------------------------------------------------------------
+# KeyedHeap
+# ---------------------------------------------------------------------------
+class TestKeyedHeap:
+    def test_ordering_and_update(self):
+        h = KeyedHeap(key_fn=lambda x: x[0], less_fn=lambda a, b: a[1] < b[1])
+        h.add(("a", 3)); h.add(("b", 1)); h.add(("c", 2))
+        assert h.peek() == ("b", 1)
+        h.update(("b", 10))  # push down
+        assert h.pop() == ("c", 2)
+        assert h.pop() == ("a", 3)
+        assert h.pop() == ("b", 10)
+        assert h.pop() is None
+
+    def test_delete_by_key(self):
+        h = KeyedHeap(key_fn=lambda x: x[0], less_fn=lambda a, b: a[1] < b[1])
+        for item in [("a", 5), ("b", 2), ("c", 8), ("d", 1)]:
+            h.add(item)
+        assert h.delete("b") == ("b", 2)
+        assert "b" not in h
+        assert [h.pop() for _ in range(3)] == [("d", 1), ("a", 5), ("c", 8)]
+
+
+# ---------------------------------------------------------------------------
+# Store + watch
+# ---------------------------------------------------------------------------
+class TestStore:
+    def test_crud_and_rv_monotonic(self):
+        s = Store()
+        p = s.create(PODS, mkpod("p1"))
+        assert p.resource_version == 1
+        p2 = s.create(PODS, mkpod("p2"))
+        assert p2.resource_version == 2
+        with pytest.raises(AlreadyExistsError):
+            s.create(PODS, mkpod("p1"))
+        got = s.get(PODS, "default/p1")
+        got.node_name = "n1"
+        updated = s.update(PODS, got, expect_rv=got.resource_version)
+        assert updated.resource_version == 3
+        with pytest.raises(ConflictError):
+            s.update(PODS, got, expect_rv=1)
+        s.delete(PODS, "default/p2")
+        with pytest.raises(NotFoundError):
+            s.get(PODS, "default/p2")
+
+    def test_store_isolates_objects(self):
+        s = Store()
+        pod = mkpod("p1")
+        s.create(PODS, pod)
+        pod.node_name = "mutated-after-create"
+        assert s.get(PODS, "default/p1").node_name == ""
+        got = s.get(PODS, "default/p1")
+        got.node_name = "mutated-read"
+        assert s.get(PODS, "default/p1").node_name == ""
+
+    def test_watch_stream_and_resume(self):
+        s = Store()
+        s.create(PODS, mkpod("p1"))
+        objs, rv = s.list(PODS)
+        w = s.watch(PODS, since_rv=rv)
+        s.create(PODS, mkpod("p2"))
+        s.bind_pod("default/p2", "n9")
+        s.delete(PODS, "default/p1")
+        evs = w.drain()
+        assert [(e.type, e.obj.key) for e in evs] == [
+            (ADDED, "default/p2"), (MODIFIED, "default/p2"), (DELETED, "default/p1")]
+        assert evs[1].obj.node_name == "n9"
+        # resume from mid-stream rv replays the tail
+        w2 = s.watch(PODS, since_rv=evs[0].resource_version)
+        assert [(e.type, e.obj.key) for e in w2.drain()] == [
+            (MODIFIED, "default/p2"), (DELETED, "default/p1")]
+
+    def test_watch_expired_window(self):
+        s = Store(watch_log_size=2)
+        for i in range(6):
+            s.create(PODS, mkpod(f"p{i}"))
+        with pytest.raises(ExpiredError):
+            s.watch(PODS, since_rv=1)
+
+    def test_guaranteed_update_retries(self):
+        s = Store()
+        s.create(PODS, mkpod("p1"))
+        calls = []
+
+        def mutate(pod):
+            if not calls:
+                # conflicting write sneaks in between read and write
+                s.bind_pod("default/p1", "other")
+            calls.append(1)
+            pod.nominated_node_name = "n1"
+            return pod
+
+        out = s.guaranteed_update(PODS, "default/p1", mutate)
+        assert len(calls) == 2
+        assert out.nominated_node_name == "n1"
+        assert out.node_name == "other"
+
+
+class TestInformer:
+    def test_list_then_watch_dispatch(self):
+        s = Store()
+        s.create(NODES, mknode("n1"))
+        factory = InformerFactory(s)
+        inf = factory.informer(NODES)
+        adds, updates, deletes = [], [], []
+        inf.add_event_handler(
+            on_add=lambda o: adds.append(o.name),
+            on_update=lambda old, new: updates.append((old.name, new.resource_version)),
+            on_delete=lambda o: deletes.append(o.name))
+        inf.sync()
+        assert adds == ["n1"] and inf.has_synced
+        s.create(NODES, mknode("n2"))
+        n1 = s.get(NODES, "n1")
+        s.update(NODES, n1)
+        s.delete(NODES, "n2")
+        inf.pump()
+        assert adds == ["n1", "n2"]
+        assert updates == [("n1", 3)]
+        assert deletes == ["n2"]
+        assert {o.name for o in inf.list()} == {"n1"}
+
+    def test_filtered_handler_transitions(self):
+        s = Store()
+        factory = InformerFactory(s)
+        inf = factory.informer(PODS)
+        assigned_adds, assigned_dels = [], []
+        inf.add_event_handler(
+            on_add=lambda o: assigned_adds.append(o.key),
+            on_delete=lambda o: assigned_dels.append(o.key),
+            filter_fn=lambda o: bool(o.node_name))
+        inf.sync()
+        s.create(PODS, mkpod("p1"))       # unassigned: filtered out
+        inf.pump()
+        assert assigned_adds == []
+        s.bind_pod("default/p1", "n1")    # update crosses filter -> add
+        inf.pump()
+        assert assigned_adds == ["default/p1"]
+        s.delete(PODS, "default/p1")
+        inf.pump()
+        assert assigned_dels == ["default/p1"]
+
+
+# ---------------------------------------------------------------------------
+# NodeTree
+# ---------------------------------------------------------------------------
+class TestNodeTree:
+    def test_zone_interleaving(self):
+        t = NodeTree()
+        for name, zone in [("a1", "z1"), ("a2", "z1"), ("b1", "z2"), ("c1", "z3")]:
+            t.add_node(mknode(name, zone=zone, region="r"))
+        order = t.list_names()
+        assert order == ["a1", "b1", "c1", "a2"]
+        # the zone cursor persists across enumerations (reference
+        # node_tree.go:165: zoneIndex is not reset by resetExhausted), so the
+        # next full enumeration starts at the following zone
+        assert t.list_names() == ["b1", "c1", "a1", "a2"]
+
+    def test_remove_node_and_zone(self):
+        t = NodeTree()
+        t.add_node(mknode("a1", zone="z1", region="r"))
+        t.add_node(mknode("b1", zone="z2", region="r"))
+        t.remove_node(mknode("b1", zone="z2", region="r"))
+        assert t.num_nodes == 1
+        assert t.list_names() == ["a1"]
+
+
+# ---------------------------------------------------------------------------
+# SchedulerCache
+# ---------------------------------------------------------------------------
+class TestSchedulerCache:
+    def test_assume_confirm_lifecycle(self):
+        clock = FakeClock()
+        c = SchedulerCache(ttl=30.0, clock=clock)
+        c.add_node(mknode("n1"))
+        pod = mkpod("p1", cpu=500, node="n1")
+        c.assume_pod(pod)
+        assert c.is_assumed_pod(pod)
+        snap = c.update_snapshot(Snapshot())
+        assert snap.node_infos["n1"].requested.milli_cpu == 500
+        # informer confirms
+        c.add_pod(pod)
+        assert not c.is_assumed_pod(pod)
+        clock.step(100)
+        assert c.cleanup_assumed_pods() == []  # confirmed pods never expire
+        assert c.pod_count() == 1
+
+    def test_assume_expire(self):
+        clock = FakeClock()
+        c = SchedulerCache(ttl=30.0, clock=clock)
+        c.add_node(mknode("n1"))
+        pod = mkpod("p1", cpu=500, node="n1")
+        c.assume_pod(pod)
+        c.finish_binding(pod)
+        clock.step(31)
+        expired = c.cleanup_assumed_pods()
+        assert [p.key for p in expired] == ["default/p1"]
+        snap = c.update_snapshot(Snapshot())
+        assert snap.node_infos["n1"].requested.milli_cpu == 0
+
+    def test_forget_pod(self):
+        c = SchedulerCache(clock=FakeClock())
+        c.add_node(mknode("n1"))
+        pod = mkpod("p1", cpu=500, node="n1")
+        c.assume_pod(pod)
+        c.forget_pod(pod)
+        snap = c.update_snapshot(Snapshot())
+        assert snap.node_infos["n1"].requested.milli_cpu == 0
+        with pytest.raises(CacheError):
+            c.forget_pod(mkpod("p2", node="n1"))  # never assumed
+        p3 = mkpod("p3", node="n1")
+        c.add_pod(p3)
+        with pytest.raises(CacheError):
+            c.forget_pod(p3)                      # added, not assumed
+
+    def test_incremental_snapshot_only_clones_changed(self):
+        c = SchedulerCache(clock=FakeClock())
+        for i in range(4):
+            c.add_node(mknode(f"n{i}"))
+        snap = c.update_snapshot(Snapshot())
+        gen0 = snap.generation
+        before = {name: id(ni) for name, ni in snap.node_infos.items()}
+        c.add_pod(mkpod("p1", cpu=100, node="n2"))
+        snap = c.update_snapshot(snap)
+        assert snap.generation > gen0
+        after = {name: id(ni) for name, ni in snap.node_infos.items()}
+        assert after["n2"] != before["n2"]          # changed node re-cloned
+        for name in ("n0", "n1", "n3"):             # untouched nodes reused
+            assert after[name] == before[name]
+
+    def test_snapshot_drops_removed_nodes(self):
+        c = SchedulerCache(clock=FakeClock())
+        c.add_node(mknode("n1"))
+        c.add_node(mknode("n2"))
+        snap = c.update_snapshot(Snapshot())
+        assert set(snap.node_infos) == {"n1", "n2"}
+        c.remove_node(mknode("n2"))
+        snap = c.update_snapshot(snap)
+        assert set(snap.node_infos) == {"n1"}
+
+    def test_pod_before_node_placeholder(self):
+        c = SchedulerCache(clock=FakeClock())
+        c.add_pod(mkpod("p1", cpu=100, node="n1"))  # node not yet known
+        snap = c.update_snapshot(Snapshot())
+        assert "n1" not in snap.node_infos           # placeholder not exported
+        c.add_node(mknode("n1"))
+        snap = c.update_snapshot(snap)
+        assert snap.node_infos["n1"].requested.milli_cpu == 100
+
+
+def _last_added(cache):
+    # helper: fetch the single non-assumed pod state
+    for uid, state in cache._pod_states.items():
+        if uid not in cache._assumed:
+            return state.pod
+    raise AssertionError("no added pod")
+
+
+# ---------------------------------------------------------------------------
+# PriorityQueue
+# ---------------------------------------------------------------------------
+class TestPriorityQueue:
+    def test_priority_then_fifo_order(self):
+        q = PriorityQueue(clock=FakeClock())
+        q.add(mkpod("low1", priority=0))
+        q.add(mkpod("high", priority=10))
+        q.add(mkpod("low2", priority=0))
+        assert q.pop().name == "high"
+        assert q.pop().name == "low1"
+        assert q.pop().name == "low2"
+
+    def test_unschedulable_then_move_all(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        pod = q.pop()
+        cycle = q.scheduling_cycle
+        q.add_unschedulable_if_not_present(pod, cycle)
+        assert q.num_pending() == 1
+        assert q.pop(timeout=0.01) is None     # parked in unschedulableQ
+        q.move_all_to_active()                 # node event
+        clock.step(2.0)                        # past 1s initial backoff
+        assert q.pop(timeout=0.01).name == "p1"
+
+    def test_move_request_cycle_races_to_backoff(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        pod = q.pop()
+        cycle = q.scheduling_cycle
+        q.move_all_to_active()                 # event arrives mid-cycle
+        q.add_unschedulable_if_not_present(pod, cycle)
+        # went to backoffQ, not unschedulableQ: pops after backoff expires
+        assert q.pending_pods()["backoff"] != []
+        clock.step(1.1)
+        assert q.pop(timeout=0.01).name == "p1"
+
+    def test_backoff_doubles_and_caps(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        expected = [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]
+        for want in expected:
+            pod = q.pop()
+            assert pod is not None
+            cycle = q.scheduling_cycle
+            q.move_all_to_active()
+            q.add_unschedulable_if_not_present(pod, cycle)
+            assert q._backoff.backoff_time(pod.key) == want
+            clock.step(want + 0.01)
+
+    def test_unschedulable_leftover_flush(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        pod = q.pop()
+        q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
+        clock.step(61)
+        assert q.pop(timeout=0.01).name == "p1"
+
+    def test_assigned_pod_added_moves_affinity_pods(self):
+        from kubernetes_tpu.api.types import (
+            Affinity, PodAffinity, PodAffinityTerm, LabelSelector)
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        aff = Affinity(pod_affinity=PodAffinity(required=(
+            PodAffinityTerm(label_selector=LabelSelector.from_dict({"app": "db"}),
+                            topology_key="kubernetes.io/hostname"),)))
+        plain = mkpod("plain")
+        wants = Pod(name="wants-db", affinity=aff,
+                    containers=(Container.make(name="c"),))
+        q.add(plain); q.add(wants)
+        p1, p2 = q.pop(), q.pop()
+        q.add_unschedulable_if_not_present(p1, q.scheduling_cycle)
+        q.add_unschedulable_if_not_present(p2, q.scheduling_cycle)
+        q.assigned_pod_added(mkpod("db-pod", node="n1"))
+        pending = q.pending_pods()
+        moved = {p.name for p in pending["active"]} | {p.name for p in pending["backoff"]}
+        assert moved == {"wants-db"}
+        assert {p.name for p in pending["unschedulable"]} == {"plain"}
+
+    def test_delete_and_update(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        q.delete(mkpod("p1"))
+        assert q.num_pending() == 0
+        # update of an unschedulable pod reactivates it
+        q.add(mkpod("p2"))
+        pod = q.pop()
+        q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
+        q.update(pod, pod)
+        assert q.pop(timeout=0.01).name == "p2"
+
+    def test_nominated_pods(self):
+        q = PriorityQueue(clock=FakeClock())
+        pod = mkpod("preemptor", priority=100)
+        pod.nominated_node_name = "n1"
+        q.add_unschedulable_if_not_present(pod, 0)
+        assert [p.name for p in q.nominated.pods_for_node("n1")] == ["preemptor"]
+        q.delete(pod)
+        assert q.nominated.pods_for_node("n1") == []
