@@ -8,6 +8,7 @@ resources in their native integer unit.
 """
 from __future__ import annotations
 
+import copy
 import itertools
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
@@ -177,6 +178,14 @@ class Affinity:
     pod_anti_affinity: Optional[PodAntiAffinity] = None
 
 
+def has_pod_affinity_terms(pod) -> bool:
+    """True when the pod carries any inter-pod (anti-)affinity terms — the
+    predicate behind NodeInfo.pods_with_affinity and the queue's
+    assigned-pod wake-up filter."""
+    a = pod.affinity
+    return a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None)
+
+
 # ---------------------------------------------------------------------------
 # Taints & tolerations
 # ---------------------------------------------------------------------------
@@ -306,6 +315,15 @@ class Pod:
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
 
+    def clone(self) -> "Pod":
+        """Fast copy: nested spec structures are frozen dataclasses and are
+        shared; only the mutable dicts and top-level fields are fresh. The
+        store uses this on every read/write (the serialize boundary)."""
+        out = copy.copy(self)
+        out.labels = dict(self.labels)
+        out.node_selector = dict(self.node_selector)
+        return out
+
 
 @dataclass(frozen=True)
 class ImageState:
@@ -340,6 +358,12 @@ class Node:
     @property
     def key(self) -> str:
         return self.name
+
+    def clone(self) -> "Node":
+        out = copy.copy(self)
+        out.labels = dict(self.labels)
+        out.allocatable = dict(self.allocatable)
+        return out
 
 
 def get_zone_key(node: Node) -> str:

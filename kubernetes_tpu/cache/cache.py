@@ -165,7 +165,13 @@ class SchedulerCache:
         item = self._nodes.get(pod.node_name)
         if item is not None:
             item.info.remove_pod(pod)
-            self._move_to_head(item)
+            # drop an empty placeholder once its last pod is gone
+            # (reference: cache.go removePod -> removeNodeInfoFromList)
+            if item.info.node is None and not item.info.pods:
+                self._remove_from_list(item)
+                del self._nodes[pod.node_name]
+            else:
+                self._move_to_head(item)
 
     def is_assumed_pod(self, pod: Pod) -> bool:
         with self._lock:
@@ -239,8 +245,10 @@ class SchedulerCache:
                 if info.node is not None:
                     snapshot.node_infos[info.node.name] = info.clone()
                 item = item.next
-            # drop nodes deleted from the cache
-            if len(snapshot.node_infos) > len(self._nodes):
+            # drop nodes deleted from the cache; placeholders (node=None)
+            # don't count as live, so compare against the node tree
+            # (reference: cache.go:210 compares against nodeTree.numNodes)
+            if len(snapshot.node_infos) > self.node_tree.num_nodes:
                 live = {n for n, it in self._nodes.items() if it.info.node is not None}
                 for name in list(snapshot.node_infos):
                     if name not in live:

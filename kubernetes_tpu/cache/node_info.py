@@ -13,6 +13,7 @@ from typing import Optional
 
 from kubernetes_tpu.api.types import (
     Node, Pod, ResourceAgg, get_pod_nonzero_requests, get_container_ports,
+    has_pod_affinity_terms,
 )
 
 
@@ -90,9 +91,7 @@ class HostPortInfo:
         return sum(len(s) for s in self._by_ip.values())
 
 
-def _pod_has_affinity(pod: Pod) -> bool:
-    a = pod.affinity
-    return a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None)
+
 
 
 class NodeInfo:
@@ -145,7 +144,7 @@ class NodeInfo:
         self.nonzero_cpu += ncpu
         self.nonzero_mem += nmem
         self.pods.append(pod)
-        if _pod_has_affinity(pod):
+        if has_pod_affinity_terms(pod):
             self.pods_with_affinity.append(pod)
         for p in get_container_ports(pod):
             self.used_ports.add(p.host_ip, p.protocol, p.host_port)

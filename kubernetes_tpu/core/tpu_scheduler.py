@@ -1,0 +1,262 @@
+"""TPU-backed scheduling algorithm — the device twin of the oracle.
+
+Drop-in for oracle.GenericScheduler (same schedule() contract, same
+ScheduleResult/FitError), but filter/score/select run as one fused kernel
+over the dense node matrix (ops/kernels.py). Decision parity: identical
+suggested hosts, feasible sets, evaluated counts, and integer scores.
+
+Two paths:
+- schedule(): one pod per launch — used for parity testing and for pods with
+  features the burst path doesn't batch yet.
+- schedule_burst(): a `lax.scan` over many pending pods against one
+  snapshot, folding each decision's resource delta into device state —
+  serially-equivalent decisions at one launch (the throughput path;
+  reference equivalent is the serial scheduleOne loop, scheduler.go:438).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.oracle import predicates as P
+from kubernetes_tpu.oracle.generic_scheduler import (
+    ScheduleResult, FitError, num_feasible_nodes_to_find,
+    DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE,
+)
+from kubernetes_tpu.ops.node_state import (
+    NodeStateEncoder, PodEncoder, PodFeatures, NodeBatch,
+    IPA_EXISTING_ANTI, IPA_OWN_AFFINITY, IPA_OWN_ANTI,
+)
+from kubernetes_tpu.ops import kernels as K
+
+import jax.numpy as jnp
+
+
+def _pad_pow2(n: int, minimum: int = 1) -> int:
+    c = minimum
+    while c < n:
+        c *= 2
+    return c
+
+
+class TPUScheduler:
+    def __init__(self,
+                 percentage_of_nodes_to_score: int = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE,
+                 hard_pod_affinity_weight: int = 1,
+                 services_fn=lambda: [],
+                 replicasets_fn=lambda: [],
+                 collect_host_priority: bool = True):
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.services_fn = services_fn
+        self.replicasets_fn = replicasets_fn
+        self.collect_host_priority = collect_host_priority
+        self.last_index = 0
+        self.last_node_index = 0
+        self.encoder = NodeStateEncoder()
+        self._defaults_cache: dict = {}
+
+    # -- device input assembly ----------------------------------------------
+    def _node_arrays(self, b: NodeBatch) -> dict:
+        return {
+            "valid": jnp.asarray(b.valid),
+            "alloc_cpu": jnp.asarray(b.alloc_cpu),
+            "alloc_mem": jnp.asarray(b.alloc_mem),
+            "alloc_eph": jnp.asarray(b.alloc_eph),
+            "allowed_pods": jnp.asarray(b.allowed_pods),
+            "req_cpu": jnp.asarray(b.req_cpu),
+            "req_mem": jnp.asarray(b.req_mem),
+            "req_eph": jnp.asarray(b.req_eph),
+            "nz_cpu": jnp.asarray(b.nz_cpu),
+            "nz_mem": jnp.asarray(b.nz_mem),
+            "pod_count": jnp.asarray(b.pod_count),
+            "alloc_scalar": jnp.asarray(b.alloc_scalar),
+            "req_scalar": jnp.asarray(b.req_scalar),
+            "zone_id": jnp.asarray(b.zone_id),
+        }
+
+    def _defaults(self, n_pad: int):
+        d = self._defaults_cache.get(n_pad)
+        if d is None:
+            d = {
+                "ones_bool": np.ones(n_pad, dtype=bool),
+                "zeros_i64": np.zeros(n_pad, dtype=np.int64),
+                "zeros_i8": np.zeros(n_pad, dtype=np.int8),
+                "zeros_bool": np.zeros(n_pad, dtype=bool),
+                "tens_i64": np.full(n_pad, 10, dtype=np.int64),
+            }
+            self._defaults_cache[n_pad] = d
+        return d
+
+    def _pod_arrays(self, f: PodFeatures, n_pad: int,
+                    upd_fields: bool = False, pod: Optional[Pod] = None) -> dict:
+        d = self._defaults(n_pad)
+        out = {
+            "req_cpu": np.int64(f.req_cpu),
+            "req_mem": np.int64(f.req_mem),
+            "req_eph": np.int64(f.req_eph),
+            "req_scalar": f.req_scalar,
+            "has_request": np.bool_(f.has_request),
+            "unknown_scalar": np.bool_(bool(f.unknown_scalars)),
+            "skip": np.bool_(False),
+            "nz_cpu": np.int64(f.nz_cpu),
+            "nz_mem": np.int64(f.nz_mem),
+            "sel_ok": f.sel_ok if f.sel_ok is not None else d["ones_bool"],
+            "taints_ok": f.taints_ok if f.taints_ok is not None else d["ones_bool"],
+            "unsched_ok": f.unsched_ok if f.unsched_ok is not None else d["ones_bool"],
+            "ports_ok": f.ports_ok if f.ports_ok is not None else d["ones_bool"],
+            "host_ok": f.host_ok if f.host_ok is not None else d["ones_bool"],
+            "interpod_code": f.interpod_code if f.interpod_code is not None else d["zeros_i8"],
+            "node_aff_counts": f.node_aff_counts if f.node_aff_counts is not None else d["zeros_i64"],
+            "taint_counts": f.taint_counts if f.taint_counts is not None else d["zeros_i64"],
+            "spread_counts": f.spread_counts if f.spread_counts is not None else d["zeros_i64"],
+            "interpod_counts": f.interpod_counts if f.interpod_counts is not None else d["zeros_i64"],
+            "interpod_tracked": f.interpod_tracked if f.interpod_tracked is not None else d["zeros_bool"],
+            "image_sums": f.image_sums if f.image_sums is not None else d["zeros_i64"],
+            "prefer_avoid": f.prefer_avoid if f.prefer_avoid is not None else d["tens_i64"],
+        }
+        if upd_fields:
+            # node-state delta on add (regular containers only, node_info.py
+            # calculate_resource; reference: node_info.go:578)
+            from kubernetes_tpu.cache.node_info import calculate_resource
+            upd = calculate_resource(pod)
+            upd_scalar = np.zeros_like(f.req_scalar)
+            for name, q in upd.scalar.items():
+                upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
+            out.update({
+                "upd_cpu": np.int64(upd.milli_cpu),
+                "upd_mem": np.int64(upd.memory),
+                "upd_eph": np.int64(upd.ephemeral_storage),
+                "upd_scalar": upd_scalar,
+            })
+        return out
+
+    # -- reason decoding -----------------------------------------------------
+    def _decode_reasons(self, b: NodeBatch, f: PodFeatures, idx: int,
+                        fail_first: np.ndarray, general_bits: np.ndarray) -> list[str]:
+        code = int(fail_first[idx])
+        if code == K.FAIL_UNSCHEDULABLE:
+            return [P.ERR_NODE_UNSCHEDULABLE]
+        if code == K.FAIL_TAINTS:
+            return [P.ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+        if code == K.FAIL_INTERPOD:
+            ipa = int(f.interpod_code[idx]) if f.interpod_code is not None else 0
+            if ipa == IPA_EXISTING_ANTI:
+                return [P.ERR_POD_AFFINITY_NOT_MATCH,
+                        P.ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH]
+            if ipa == IPA_OWN_AFFINITY:
+                return [P.ERR_POD_AFFINITY_NOT_MATCH, P.ERR_POD_AFFINITY_RULES_NOT_MATCH]
+            return [P.ERR_POD_AFFINITY_NOT_MATCH, P.ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH]
+        # general predicates, reason order as predicates.general_predicates
+        bits = int(general_bits[idx])
+        reasons = []
+        if bits & (1 << K.BIT_PODS):
+            reasons.append(P.insufficient_resource("pods"))
+        if bits & (1 << K.BIT_CPU):
+            reasons.append(P.insufficient_resource("cpu"))
+        if bits & (1 << K.BIT_MEM):
+            reasons.append(P.insufficient_resource("memory"))
+        if bits & (1 << K.BIT_EPH):
+            reasons.append(P.insufficient_resource("ephemeral-storage"))
+        for s, name in enumerate(b.scalar_names):
+            if bits & (1 << (K.BIT_SCALAR0 + s)):
+                reasons.append(P.insufficient_resource(name))
+        if bits & (1 << K.BIT_UNKNOWN_SCALAR):
+            reasons.extend(P.insufficient_resource(n) for n in f.unknown_scalars)
+        if bits & (1 << K.BIT_HOST):
+            reasons.append(P.ERR_POD_NOT_MATCH_HOST_NAME)
+        if bits & (1 << K.BIT_PORTS):
+            reasons.append(P.ERR_POD_NOT_FITS_HOST_PORTS)
+        if bits & (1 << K.BIT_SELECTOR):
+            reasons.append(P.ERR_NODE_SELECTOR_NOT_MATCH)
+        return reasons
+
+    # -- single-pod cycle ----------------------------------------------------
+    def schedule(self, pod: Pod, node_infos: dict[str, NodeInfo],
+                 all_node_names: list[str]) -> ScheduleResult:
+        if not all_node_names:
+            raise FitError(pod, 0, {})
+        b = self.encoder.encode(node_infos, all_node_names)
+        nodes = self._node_arrays(b)
+        enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+        feats = enc.encode(pod)
+        pod_in = self._pod_arrays(feats, b.n_pad)
+        n = b.n_real
+        num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
+        z_pad = _pad_pow2(len(b.zone_names), 4)
+        out = K.schedule_cycle(nodes, pod_in, self.last_index, self.last_node_index,
+                               num_to_find, n, z_pad)
+        found = int(out["found"])
+        evaluated = int(out["evaluated"])
+        start = self.last_index
+        self.last_index = int(out["next_last_index"])
+        if found == 0:
+            fail_first = np.asarray(out["fail_first"])
+            general_bits = np.asarray(out["general_bits"])
+            failed = {}
+            for pos in range(evaluated):
+                idx = (start + pos) % n
+                failed[b.names[idx]] = self._decode_reasons(
+                    b, feats, idx, fail_first, general_bits)
+            raise FitError(pod, n, failed)
+        self.last_node_index = int(out["next_last_node_index"])
+        sel = int(out["selected"])
+        host = b.names[sel]
+        host_priority = []
+        failed = {}
+        if self.collect_host_priority:
+            kept = np.asarray(out["kept"])
+            total = np.asarray(out["total"])
+            fail_first = np.asarray(out["fail_first"])
+            general_bits = np.asarray(out["general_bits"])
+            for pos in range(evaluated):
+                idx = (start + pos) % n
+                if kept[idx]:
+                    # single-feasible-node cycles skip scoring entirely
+                    # (generic_scheduler.go:244-250)
+                    score = 0 if found == 1 else int(total[idx])
+                    host_priority.append((b.names[idx], score))
+                elif fail_first[idx] != K.FAIL_NONE:
+                    failed[b.names[idx]] = self._decode_reasons(
+                        b, feats, idx, fail_first, general_bits)
+        return ScheduleResult(host, evaluated, found, host_priority, failed)
+
+    # -- burst path ----------------------------------------------------------
+    def schedule_burst(self, pods: list[Pod], node_infos: dict[str, NodeInfo],
+                       all_node_names: list[str],
+                       bucket: Optional[int] = None) -> list[Optional[str]]:
+        """Schedule `pods` against one snapshot; returns per-pod host (or
+        None when unschedulable). Decisions are serially equivalent to
+        calling schedule() per pod with cache assumes in between."""
+        if not all_node_names or not pods:
+            return [None] * len(pods)
+        b = self.encoder.encode(node_infos, all_node_names)
+        nodes = self._node_arrays(b)
+        enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+        per_pod = [self._pod_arrays(enc.encode(p), b.n_pad, upd_fields=True, pod=p)
+                   for p in pods]
+        # pad the burst to a power-of-two bucket so lax.scan compiles once
+        # per bucket instead of once per burst length
+        bucket = _pad_pow2(bucket if bucket else len(per_pod), 16)
+        if len(per_pod) < bucket:
+            pad = dict(per_pod[-1])
+            pad["skip"] = np.bool_(True)
+            per_pod.extend([pad] * (bucket - len(per_pod)))
+        stacked = {k: np.stack([pp[k] for pp in per_pod]) for k in per_pod[0]}
+        n = b.n_real
+        num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
+        z_pad = _pad_pow2(len(b.zone_names), 4)
+        state, li, lni, outs = K.schedule_batch(
+            nodes, stacked, self.last_index, self.last_node_index, num_to_find, n, z_pad)
+        self.last_index = int(li)
+        self.last_node_index = int(lni)
+        selected = np.asarray(outs["selected"])[: len(pods)]
+        # sync the host mirror with the on-device folds so the next encode()
+        # doesn't resurrect stale rows: the caller is expected to apply the
+        # same assumes to the cache, after which encode() rewrites those rows.
+        return [b.names[int(s)] if int(s) >= 0 else None for s in selected]

@@ -1,0 +1,315 @@
+"""Fused scheduling-cycle kernels: filter + score + select over all nodes.
+
+One jitted computation replaces the reference's per-cycle goroutine fan-out
+(core/generic_scheduler.go:457 findNodesThatFit, :672 PrioritizeNodes, :286
+selectHost): every node is evaluated at once on the MXU/VPU, and the
+reference's *sequential* semantics are reproduced exactly:
+
+- adaptive partial search (numFeasibleNodesToFind :434): feasibility is
+  computed for all nodes, then the first `num_to_find` feasible nodes *in
+  rotation order from last_index* are kept (a cumsum emulates the
+  sequential walk's stopping point — same feasible set, same "evaluated"
+  count, same last_index advance).
+- integer 0-10 scores with the reference's exact int64/float64 formulas,
+  normalized over the kept set only.
+- round-robin tie-break among max-score nodes via last_node_index (:292).
+
+The batched variant runs a `lax.scan` over a burst of pending pods against
+one snapshot, folding each decision's resource deltas into the node state on
+device — serially-equivalent decisions at one kernel launch for the burst.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+import kubernetes_tpu.ops  # noqa: F401  (enables x64)
+
+MAX_PRIORITY = 10
+MB = 1024 * 1024
+IMAGE_MIN = 23 * MB
+IMAGE_MAX = 1000 * MB
+ZONE_WEIGHTING = 2.0 / 3.0
+
+# fail-first codes (order of the default predicate set in
+# predicates.PREDICATE_ORDERING)
+FAIL_NONE = 0
+FAIL_UNSCHEDULABLE = 1
+FAIL_GENERAL = 2
+FAIL_TAINTS = 3
+FAIL_INTERPOD = 4
+
+# general_bits layout (GeneralPredicates sub-failures, predicates.go:1112)
+BIT_PODS = 0
+BIT_CPU = 1
+BIT_MEM = 2
+BIT_EPH = 3
+BIT_SCALAR0 = 4          # bit 4+s for scalar resource s (s < 36)
+BIT_UNKNOWN_SCALAR = 59     # pod wants a scalar no node advertises
+BIT_HOST = 60
+BIT_PORTS = 61
+BIT_SELECTOR = 62
+
+# default priority weights (reference: defaults.go:108, register_priorities.go)
+DEFAULT_WEIGHTS = {
+    "selector_spread": 1,
+    "interpod": 1,
+    "least_requested": 1,
+    "balanced": 1,
+    "prefer_avoid": 10000,
+    "node_affinity": 1,
+    "taint_toleration": 1,
+    "image_locality": 1,
+}
+
+
+def _i64(x):
+    return jnp.asarray(x, dtype=jnp.int64)
+
+
+def _fit_scores(nodes, pod, kept, weights, z_pad):
+    """All default priorities, masked-normalized over `kept`. Returns total[N] i64."""
+    alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
+    req_cpu = pod["nz_cpu"] + nodes["nz_cpu"]
+    req_mem = pod["nz_mem"] + nodes["nz_mem"]
+
+    def least(req, cap):
+        ok = (cap > 0) & (req <= cap)
+        return jnp.where(ok, (cap - req) * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+
+    least_score = (least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) // 2
+
+    cpu_f = jnp.where(alloc_cpu == 0, 1.0, req_cpu / alloc_cpu)
+    mem_f = jnp.where(alloc_mem == 0, 1.0, req_mem / alloc_mem)
+    balanced = jnp.where(
+        (cpu_f >= 1.0) | (mem_f >= 1.0), 0,
+        ((1.0 - jnp.abs(cpu_f - mem_f)) * float(MAX_PRIORITY)).astype(jnp.int64))
+
+    # NodeAffinity: NormalizeReduce(10, reverse=False) over kept
+    na = pod["node_aff_counts"]
+    na_max = jnp.max(jnp.where(kept, na, 0))
+    node_aff = jnp.where(na_max == 0, na, MAX_PRIORITY * na // jnp.maximum(na_max, 1))
+
+    # TaintToleration: NormalizeReduce(10, reverse=True) over kept
+    tt = pod["taint_counts"]
+    tt_max = jnp.max(jnp.where(kept, tt, 0))
+    taint_tol = jnp.where(
+        tt_max == 0, MAX_PRIORITY,
+        MAX_PRIORITY - MAX_PRIORITY * tt // jnp.maximum(tt_max, 1))
+
+    # SelectorSpread: node + zone blend (selector_spreading.go:99)
+    sc = pod["spread_counts"]
+    zone_id = nodes["zone_id"]
+    max_by_node = jnp.max(jnp.where(kept, sc, 0))
+    f = jnp.where(max_by_node > 0,
+                  float(MAX_PRIORITY) * ((max_by_node - sc) / jnp.maximum(max_by_node, 1)),
+                  float(MAX_PRIORITY))
+    in_zone = kept & (zone_id > 0)
+    zone_counts = jnp.zeros(z_pad, dtype=jnp.int64).at[zone_id].add(
+        jnp.where(in_zone, sc, 0))
+    zone_present = jnp.zeros(z_pad, dtype=bool).at[zone_id].max(in_zone)
+    have_zones = jnp.any(in_zone)
+    max_by_zone = jnp.max(jnp.where(zone_present, zone_counts, 0))
+    zc = zone_counts[zone_id]
+    zs = jnp.where(max_by_zone > 0,
+                   float(MAX_PRIORITY) * ((max_by_zone - zc) / jnp.maximum(max_by_zone, 1)),
+                   float(MAX_PRIORITY))
+    f = jnp.where(have_zones & (zone_id > 0),
+                  f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zs, f)
+    spread = f.astype(jnp.int64)
+
+    # InterPodAffinity preferred: min-max over kept∩tracked, 0 in the fold
+    ic = pod["interpod_counts"]
+    tracked = pod["interpod_tracked"]
+    sel = kept & tracked
+    ic_max = jnp.maximum(jnp.max(jnp.where(sel, ic, jnp.iinfo(jnp.int64).min)), 0)
+    ic_min = jnp.minimum(jnp.min(jnp.where(sel, ic, jnp.iinfo(jnp.int64).max)), 0)
+    diff = ic_max - ic_min
+    interpod = jnp.where(
+        (diff > 0) & tracked,
+        (float(MAX_PRIORITY) * ((ic - ic_min) / jnp.maximum(diff, 1))).astype(jnp.int64),
+        0)
+
+    # ImageLocality (image_locality.go:42)
+    s = jnp.clip(pod["image_sums"], IMAGE_MIN, IMAGE_MAX)
+    image = MAX_PRIORITY * (s - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN)
+
+    total = (
+        weights["selector_spread"] * spread
+        + weights["interpod"] * interpod
+        + weights["least_requested"] * least_score
+        + weights["balanced"] * balanced
+        + weights["prefer_avoid"] * pod["prefer_avoid"]
+        + weights["node_affinity"] * node_aff
+        + weights["taint_toleration"] * taint_tol
+        + weights["image_locality"] * image
+    )
+    return total
+
+
+def _feasibility(nodes, pod):
+    """Returns (feasible[N], fail_first[N] i8, general_bits[N] i64)."""
+    valid = nodes["valid"]
+    # GeneralPredicates: resources
+    bits = jnp.zeros(valid.shape, dtype=jnp.int64)
+    pods_over = nodes["pod_count"] + 1 > nodes["allowed_pods"]
+    bits |= jnp.where(pods_over, 1 << BIT_PODS, 0)
+    has_req = pod["has_request"]
+    over_cpu = nodes["alloc_cpu"] < pod["req_cpu"] + nodes["req_cpu"]
+    over_mem = nodes["alloc_mem"] < pod["req_mem"] + nodes["req_mem"]
+    over_eph = nodes["alloc_eph"] < pod["req_eph"] + nodes["req_eph"]
+    bits |= jnp.where(has_req & over_cpu, 1 << BIT_CPU, 0)
+    bits |= jnp.where(has_req & over_mem, 1 << BIT_MEM, 0)
+    bits |= jnp.where(has_req & over_eph, 1 << BIT_EPH, 0)
+    # scalar resources: [N,S]
+    over_scalar = nodes["alloc_scalar"] < pod["req_scalar"][None, :] + nodes["req_scalar"]
+    wants_scalar = pod["req_scalar"][None, :] > 0
+    scalar_fail = has_req & wants_scalar & over_scalar          # [N,S]
+    s_count = scalar_fail.shape[1]
+    scalar_bits = jnp.sum(
+        jnp.where(scalar_fail,
+                  (1 << (BIT_SCALAR0 + jnp.arange(s_count, dtype=jnp.int64)))[None, :],
+                  0), axis=1)
+    bits |= scalar_bits
+    bits |= jnp.where(pod["unknown_scalar"], _i64(1) << BIT_UNKNOWN_SCALAR, 0)
+    bits |= jnp.where(~pod["host_ok"], 1 << BIT_HOST, 0)
+    bits |= jnp.where(~pod["ports_ok"], 1 << BIT_PORTS, 0)
+    bits |= jnp.where(~pod["sel_ok"], 1 << BIT_SELECTOR, 0)
+
+    general_fail = bits != 0
+    unsched_fail = ~pod["unsched_ok"]
+    # padding entries in a burst bucket: infeasible everywhere, no state fold
+    skip = pod["skip"]
+    taints_fail = ~pod["taints_ok"]
+    ipa_fail = pod["interpod_code"] > 0
+
+    fail_first = jnp.where(
+        unsched_fail, FAIL_UNSCHEDULABLE,
+        jnp.where(general_fail, FAIL_GENERAL,
+                  jnp.where(taints_fail, FAIL_TAINTS,
+                            jnp.where(ipa_fail, FAIL_INTERPOD, FAIL_NONE))))
+    feasible = valid & (fail_first == FAIL_NONE) & ~skip
+    return feasible, fail_first.astype(jnp.int8), bits
+
+
+def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
+                weights, z_pad):
+    n_pad = nodes["valid"].shape[0]
+    i = jnp.arange(n_pad, dtype=jnp.int64)
+    in_range = i < n_real
+    n_safe = jnp.maximum(n_real, 1)
+    perm = (last_index + i) % n_safe          # rotation order positions
+
+    feasible, fail_first, general_bits = _feasibility(nodes, pod)
+
+    feas_rot = feasible[perm] & in_range
+    cum = jnp.cumsum(feas_rot.astype(jnp.int64))
+    total_feasible = cum[-1]
+    keep_rot = feas_rot & (cum <= num_to_find)
+    found = jnp.minimum(total_feasible, num_to_find)
+    reached = total_feasible >= num_to_find
+    stop_pos = jnp.argmax(cum >= num_to_find)  # first rotation index reaching the quota
+    evaluated = jnp.where(reached, stop_pos + 1, n_real)
+    # a skip (bucket-padding) pod consumes no rotation state
+    evaluated = jnp.where(pod["skip"], 0, evaluated)
+
+    kept = jnp.zeros(n_pad, dtype=bool).at[perm].max(keep_rot)
+
+    total = _fit_scores(nodes, pod, kept, weights, z_pad)
+
+    total_rot = jnp.where(keep_rot, total[perm], jnp.iinfo(jnp.int64).min)
+    max_score = jnp.max(total_rot)
+    is_tie = keep_rot & (total_rot == max_score)
+    num_ties = jnp.maximum(jnp.sum(is_tie.astype(jnp.int64)), 1)
+    k = last_node_index % num_ties
+    tie_rank = jnp.cumsum(is_tie.astype(jnp.int64))
+    sel_pos = jnp.argmax(is_tie & (tie_rank == k + 1))
+    selected = jnp.where(found > 0, perm[sel_pos], -1)
+
+    return {
+        "selected": selected,
+        "found": found,
+        "evaluated": evaluated,
+        "max_score": jnp.where(found > 0, max_score, 0),
+        "total": total,
+        "kept": kept,
+        "feasible": feasible,
+        "fail_first": fail_first,
+        "general_bits": general_bits,
+        "next_last_index": (last_index + evaluated) % n_safe,
+        # selectHost is skipped when only one node is feasible
+        # (generic_scheduler.go:244-250), so the tie counter doesn't move
+        "next_last_node_index": last_node_index + jnp.where(found > 1, 1, 0),
+    }
+
+
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _schedule_cycle_jit(nodes, pod, last_index, last_node_index, num_to_find,
+                        n_real, z_pad, weights_tuple):
+    weights = dict(weights_tuple)
+    return _cycle_core(nodes, pod, last_index, last_node_index, num_to_find,
+                       n_real, weights, z_pad)
+
+
+def schedule_cycle(nodes, pod, last_index, last_node_index, num_to_find, n_real,
+                   z_pad, weights=None):
+    """One scheduling cycle. `nodes`/`pod` are dicts of device arrays."""
+    weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    return _schedule_cycle_jit(
+        nodes, pod, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
+        _i64(n_real), z_pad, weights_tuple)
+
+
+# ---------------------------------------------------------------------------
+# Batched burst: lax.scan over pods, folding decisions into node state
+# ---------------------------------------------------------------------------
+_MUTABLE = ("req_cpu", "req_mem", "req_eph", "req_scalar",
+            "nz_cpu", "nz_mem", "pod_count")
+
+
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
+                        n_real, z_pad, weights_tuple):
+    weights = dict(weights_tuple)
+    static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
+
+    def step(carry, pod):
+        state, li, lni = carry
+        full = {**static, **state}
+        out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights, z_pad)
+        sel = out["selected"]
+        hit = out["found"] > 0
+        idx = jnp.maximum(sel, 0)
+        delta = jnp.where(hit, 1, 0)
+        new_state = {
+            "req_cpu": state["req_cpu"].at[idx].add(jnp.where(hit, pod["upd_cpu"], 0)),
+            "req_mem": state["req_mem"].at[idx].add(jnp.where(hit, pod["upd_mem"], 0)),
+            "req_eph": state["req_eph"].at[idx].add(jnp.where(hit, pod["upd_eph"], 0)),
+            "req_scalar": state["req_scalar"].at[idx].add(
+                jnp.where(hit, pod["upd_scalar"], jnp.zeros_like(pod["upd_scalar"]))),
+            "nz_cpu": state["nz_cpu"].at[idx].add(jnp.where(hit, pod["nz_cpu"], 0)),
+            "nz_mem": state["nz_mem"].at[idx].add(jnp.where(hit, pod["nz_mem"], 0)),
+            "pod_count": state["pod_count"].at[idx].add(delta),
+        }
+        return (new_state, out["next_last_index"], out["next_last_node_index"]), {
+            "selected": sel,
+            "found": out["found"],
+            "evaluated": out["evaluated"],
+            "max_score": out["max_score"],
+        }
+
+    init = ({k: nodes[k] for k in _MUTABLE}, last_index, last_node_index)
+    (state, li, lni), outs = jax.lax.scan(step, init, pods)
+    return state, li, lni, outs
+
+
+def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
+                   z_pad, weights=None):
+    """Schedule a burst of pods against one snapshot, decisions serially
+    equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays."""
+    weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    return _schedule_batch_jit(
+        nodes, pods, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
+        _i64(n_real), z_pad, weights_tuple)

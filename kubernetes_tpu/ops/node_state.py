@@ -1,0 +1,478 @@
+"""Dense node-state encoding: the NodeInfo snapshot as a struct-of-arrays.
+
+The host keeps a numpy mirror of the per-node aggregates the predicates and
+priorities read (reference: pkg/scheduler/nodeinfo/node_info.go:47,139); each
+scheduling cycle uploads it (or just the changed rows) to HBM, where the
+fused kernel evaluates every node at once. The node axis is ordered by the
+cache's zone-interleaved NodeTree enumeration, padded to a static capacity so
+XLA never recompiles as the cluster grows within a bucket.
+
+String-world features (labels, taints, selectors, topology keys) are
+dictionary-encoded host-side per pod into dense masks/counts — the shape the
+device consumes (SURVEY §7 "Set/string matching on device").
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu.api.types import (
+    Pod, Taint, NO_SCHEDULE, NO_EXECUTE, PREFER_NO_SCHEDULE,
+    TAINT_NODE_UNSCHEDULABLE, get_resource_request, get_pod_nonzero_requests,
+    get_container_ports, get_zone_key, tolerations_tolerate_taint,
+    find_intolerable_taint,
+)
+from kubernetes_tpu.cache.node_info import NodeInfo, normalized_image_name
+from kubernetes_tpu.oracle.predicates import (
+    pod_matches_node_selector_and_affinity, InterPodAffinityChecker,
+)
+from kubernetes_tpu.oracle.priorities import (
+    get_selectors, _selector_matches,
+)
+
+
+def _pad_capacity(n: int, minimum: int = 8) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclass
+class NodeBatch:
+    """Host-side numpy mirror of the device node matrix.
+
+    All integer fields are int64 (reference resource math is int64). Rows
+    [n_real:] are padding with valid=False.
+    """
+    names: list[str]
+    index: dict[str, int]
+    n_real: int
+    n_pad: int
+    scalar_names: list[str]            # extended-resource vocab
+    zone_names: list[str]              # zone vocab; index 0 reserved for ""
+    valid: np.ndarray                  # [N] bool
+    alloc_cpu: np.ndarray              # [N] i64 milli
+    alloc_mem: np.ndarray              # [N] i64 bytes
+    alloc_eph: np.ndarray              # [N] i64 bytes
+    allowed_pods: np.ndarray           # [N] i64
+    req_cpu: np.ndarray                # [N] i64
+    req_mem: np.ndarray                # [N] i64
+    req_eph: np.ndarray                # [N] i64
+    nz_cpu: np.ndarray                 # [N] i64 (NonZeroRequest)
+    nz_mem: np.ndarray                 # [N] i64
+    pod_count: np.ndarray              # [N] i64
+    alloc_scalar: np.ndarray           # [N,S] i64
+    req_scalar: np.ndarray             # [N,S] i64
+    zone_id: np.ndarray                # [N] i32 (0 = no zone)
+
+
+class NodeStateEncoder:
+    """Builds/refreshes a NodeBatch from a cache snapshot.
+
+    Incremental: rows are rewritten only when the NodeInfo generation changed
+    or the node moved within the enumeration order — mirroring the cache's
+    own generation walk (reference: cache.go:210).
+    """
+
+    def __init__(self):
+        self._batch: Optional[NodeBatch] = None
+        self._generations: dict[str, int] = {}
+        self._scalar_vocab: list[str] = []
+        self._zone_vocab: list[str] = [""]
+
+    def _collect_vocab(self, node_infos: dict[str, NodeInfo]) -> None:
+        known = set(self._scalar_vocab)
+        zones = set(self._zone_vocab)
+        for ni in node_infos.values():
+            for name in ni.allocatable.scalar:
+                if name not in known:
+                    known.add(name)
+                    self._scalar_vocab.append(name)
+            for name in ni.requested.scalar:
+                if name not in known:
+                    known.add(name)
+                    self._scalar_vocab.append(name)
+            if ni.node is not None:
+                z = get_zone_key(ni.node)
+                if z not in zones:
+                    zones.add(z)
+                    self._zone_vocab.append(z)
+
+    def encode(self, node_infos: dict[str, NodeInfo],
+               node_order: list[str]) -> NodeBatch:
+        self._collect_vocab(node_infos)
+        n_real = len(node_order)
+        n_pad = _pad_capacity(n_real)
+        s = max(1, len(self._scalar_vocab))
+        b = self._batch
+        rebuild = (
+            b is None or b.n_pad != n_pad
+            or len(b.scalar_names) != len(self._scalar_vocab)
+            or b.names != node_order
+        )
+        if rebuild:
+            b = self._fresh(node_order, n_real, n_pad, s)
+            self._generations = {}
+            self._batch = b
+        scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
+        zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
+        for i, name in enumerate(node_order):
+            ni = node_infos[name]
+            if self._generations.get(name) == ni.generation:
+                continue
+            self._generations[name] = ni.generation
+            self._write_row(b, i, ni, scalar_idx, zone_idx)
+        return b
+
+    def _fresh(self, node_order: list[str], n_real: int, n_pad: int, s: int) -> NodeBatch:
+        z = lambda dt=np.int64: np.zeros(n_pad, dtype=dt)
+        b = NodeBatch(
+            names=list(node_order),
+            index={name: i for i, name in enumerate(node_order)},
+            n_real=n_real, n_pad=n_pad,
+            scalar_names=list(self._scalar_vocab),
+            zone_names=list(self._zone_vocab),
+            valid=np.zeros(n_pad, dtype=bool),
+            alloc_cpu=z(), alloc_mem=z(), alloc_eph=z(), allowed_pods=z(),
+            req_cpu=z(), req_mem=z(), req_eph=z(),
+            nz_cpu=z(), nz_mem=z(), pod_count=z(),
+            alloc_scalar=np.zeros((n_pad, s), dtype=np.int64),
+            req_scalar=np.zeros((n_pad, s), dtype=np.int64),
+            zone_id=np.zeros(n_pad, dtype=np.int32),
+        )
+        b.valid[:n_real] = True
+        return b
+
+    def _write_row(self, b: NodeBatch, i: int, ni: NodeInfo,
+                   scalar_idx: dict[str, int], zone_idx: dict[str, int]) -> None:
+        b.alloc_cpu[i] = ni.allocatable.milli_cpu
+        b.alloc_mem[i] = ni.allocatable.memory
+        b.alloc_eph[i] = ni.allocatable.ephemeral_storage
+        b.allowed_pods[i] = ni.allocatable.allowed_pod_number
+        b.req_cpu[i] = ni.requested.milli_cpu
+        b.req_mem[i] = ni.requested.memory
+        b.req_eph[i] = ni.requested.ephemeral_storage
+        b.nz_cpu[i] = ni.nonzero_cpu
+        b.nz_mem[i] = ni.nonzero_mem
+        b.pod_count[i] = len(ni.pods)
+        b.alloc_scalar[i, :] = 0
+        b.req_scalar[i, :] = 0
+        for name, q in ni.allocatable.scalar.items():
+            b.alloc_scalar[i, scalar_idx[name]] = q
+        for name, q in ni.requested.scalar.items():
+            b.req_scalar[i, scalar_idx[name]] = q
+        if ni.node is not None:
+            b.zone_id[i] = zone_idx[get_zone_key(ni.node)]
+
+    def note_assumed(self, b: NodeBatch, node_name: str, pod: Pod) -> None:
+        """Apply an assume to the host mirror without a full re-encode.
+        Keeps `_generations` in sync with the cache's post-assume generation
+        so the next encode() skips the row unless it changed again."""
+        i = b.index[node_name]
+        req = get_resource_request(pod)
+        b.req_cpu[i] += req.milli_cpu
+        b.req_mem[i] += req.memory
+        b.req_eph[i] += req.ephemeral_storage
+        scalar_idx = {name: j for j, name in enumerate(b.scalar_names)}
+        for name, q in req.scalar.items():
+            b.req_scalar[i, scalar_idx[name]] += q
+        ncpu, nmem = get_pod_nonzero_requests(pod)
+        b.nz_cpu[i] += ncpu
+        b.nz_mem[i] += nmem
+        b.pod_count[i] += 1
+
+
+# ---------------------------------------------------------------------------
+# Per-pod encoding: masks + score counts over the node axis
+# ---------------------------------------------------------------------------
+# interpod failure codes (kernel output decoding)
+IPA_OK = 0
+IPA_EXISTING_ANTI = 1
+IPA_OWN_AFFINITY = 2
+IPA_OWN_ANTI = 3
+
+
+@dataclass
+class PodFeatures:
+    """Everything the kernel needs about one pod, over a NodeBatch's axis.
+
+    Mask arrays are None when the pod/cluster doesn't exercise the feature
+    (all-pass) so the common case uploads nothing.
+    """
+    req_cpu: int
+    req_mem: int
+    req_eph: int
+    req_scalar: np.ndarray             # [S] i64
+    has_request: bool                  # reference: predicates.go:786 early-out
+    nz_cpu: int
+    nz_mem: int
+    # filter masks (None => all pass)
+    sel_ok: Optional[np.ndarray] = None        # [N] bool — selector + req. node affinity
+    taints_ok: Optional[np.ndarray] = None     # [N] bool
+    unsched_ok: Optional[np.ndarray] = None    # [N] bool
+    ports_ok: Optional[np.ndarray] = None      # [N] bool
+    host_ok: Optional[np.ndarray] = None       # [N] bool
+    interpod_code: Optional[np.ndarray] = None  # [N] i8 IPA_* codes
+    # scalars requested by the pod but absent from every node's capacity:
+    # they fail PodFitsResources on all nodes (reference: predicates.go:806)
+    unknown_scalars: tuple = ()
+    # score inputs (None => zeros)
+    node_aff_counts: Optional[np.ndarray] = None   # [N] i64
+    taint_counts: Optional[np.ndarray] = None      # [N] i64
+    spread_counts: Optional[np.ndarray] = None     # [N] i64
+    interpod_counts: Optional[np.ndarray] = None   # [N] i64
+    interpod_tracked: Optional[np.ndarray] = None  # [N] bool
+    image_sums: Optional[np.ndarray] = None        # [N] i64
+    prefer_avoid: Optional[np.ndarray] = None      # [N] i64 (0 or 10)
+
+
+class PodEncoder:
+    """Encodes one pod against a snapshot into dense per-node arrays.
+
+    The string-matching work (selectors, taints, topology pairs) happens here
+    once per pod in O(N) dict lookups; the reference instead does it inside
+    every per-node goroutine (predicates.go:889,1531).
+    """
+
+    def __init__(self, node_infos: dict[str, NodeInfo], batch: NodeBatch,
+                 services=None, replicasets=None, total_num_nodes: Optional[int] = None,
+                 hard_pod_affinity_weight: int = 1):
+        self.node_infos = node_infos
+        self.batch = batch
+        self.services = services or []
+        self.replicasets = replicasets or []
+        self.total_num_nodes = total_num_nodes or max(1, batch.n_real)
+        self.hard_weight = hard_pod_affinity_weight
+        self._ipa = InterPodAffinityChecker(node_infos)
+        # cluster-wide feature flags: skip whole mask families when inert
+        self._any_taints = any(ni.taints for ni in node_infos.values())
+        self._any_unschedulable = any(
+            ni.node is not None and ni.node.unschedulable for ni in node_infos.values())
+        self._any_affinity_pods = any(ni.pods_with_affinity for ni in node_infos.values())
+        self._any_prefer_avoid = any(
+            ni.node is not None and ni.node.prefer_avoid_pod_uids
+            for ni in node_infos.values())
+        self._any_images = any(ni.image_states for ni in node_infos.values())
+
+    def _nodes(self):
+        b = self.batch
+        for i in range(b.n_real):
+            yield i, self.node_infos[b.names[i]]
+
+    def encode(self, pod: Pod) -> PodFeatures:
+        b = self.batch
+        req = get_resource_request(pod)
+        req_scalar = np.zeros(max(1, len(b.scalar_names)), dtype=np.int64)
+        scalar_idx = {name: i for i, name in enumerate(b.scalar_names)}
+        unknown = []
+        for name, q in req.scalar.items():
+            if name in scalar_idx:
+                req_scalar[scalar_idx[name]] = q
+            elif q > 0:
+                unknown.append(name)
+        nz_cpu, nz_mem = get_pod_nonzero_requests(pod)
+        f = PodFeatures(
+            req_cpu=req.milli_cpu, req_mem=req.memory, req_eph=req.ephemeral_storage,
+            req_scalar=req_scalar,
+            has_request=bool(req.milli_cpu or req.memory or req.ephemeral_storage
+                             or req.scalar),
+            nz_cpu=nz_cpu, nz_mem=nz_mem,
+            unknown_scalars=tuple(unknown),
+        )
+        self._encode_filters(pod, f)
+        self._encode_scores(pod, f)
+        return f
+
+    # -- filter masks -------------------------------------------------------
+    def _encode_filters(self, pod: Pod, f: PodFeatures) -> None:
+        b = self.batch
+        if pod.node_selector or (pod.affinity and pod.affinity.node_affinity):
+            m = np.zeros(b.n_pad, dtype=bool)
+            for i, ni in self._nodes():
+                m[i] = ni.node is not None and \
+                    pod_matches_node_selector_and_affinity(pod, ni.node)
+            f.sel_ok = m
+        if self._any_taints:
+            m = np.ones(b.n_pad, dtype=bool)
+            for i, ni in self._nodes():
+                bad = find_intolerable_taint(
+                    ni.taints, pod.tolerations,
+                    lambda t: t.effect in (NO_SCHEDULE, NO_EXECUTE))
+                m[i] = bad is None
+            f.taints_ok = m
+        if self._any_unschedulable:
+            tolerates = any(
+                t.tolerates(Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE))
+                for t in pod.tolerations)
+            m = np.ones(b.n_pad, dtype=bool)
+            if not tolerates:
+                for i, ni in self._nodes():
+                    m[i] = not (ni.node is not None and ni.node.unschedulable)
+            f.unsched_ok = m
+        ports = get_container_ports(pod)
+        if ports:
+            m = np.ones(b.n_pad, dtype=bool)
+            for i, ni in self._nodes():
+                m[i] = not any(
+                    ni.used_ports.check_conflict(p.host_ip, p.protocol, p.host_port)
+                    for p in ports)
+            f.ports_ok = m
+        if pod.node_name:
+            m = np.zeros(b.n_pad, dtype=bool)
+            idx = b.index.get(pod.node_name)
+            if idx is not None:
+                m[idx] = True
+            f.host_ok = m
+        has_own_terms = pod.affinity is not None and (
+            pod.affinity.pod_affinity is not None
+            or pod.affinity.pod_anti_affinity is not None)
+        if self._any_affinity_pods or has_own_terms:
+            codes = np.zeros(b.n_pad, dtype=np.int8)
+            for i, ni in self._nodes():
+                ok, reasons = self._ipa.check(pod, ni)
+                if not ok:
+                    from kubernetes_tpu.oracle import predicates as P
+                    if P.ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH in reasons:
+                        codes[i] = IPA_EXISTING_ANTI
+                    elif P.ERR_POD_AFFINITY_RULES_NOT_MATCH in reasons:
+                        codes[i] = IPA_OWN_AFFINITY
+                    else:
+                        codes[i] = IPA_OWN_ANTI
+            f.interpod_code = codes
+
+    # -- score inputs -------------------------------------------------------
+    def _encode_scores(self, pod: Pod, f: PodFeatures) -> None:
+        b = self.batch
+        a = pod.affinity
+        if a is not None and a.node_affinity is not None and a.node_affinity.preferred:
+            counts = np.zeros(b.n_pad, dtype=np.int64)
+            for i, ni in self._nodes():
+                if ni.node is None:
+                    continue
+                c = 0
+                for term in a.node_affinity.preferred:
+                    if term.weight == 0:
+                        continue
+                    if term.preference.match_expressions and \
+                            term.preference.matches(ni.node.labels):
+                        c += term.weight
+                counts[i] = c
+            f.node_aff_counts = counts
+        if self._any_taints:
+            tols = [t for t in pod.tolerations
+                    if not t.effect or t.effect == PREFER_NO_SCHEDULE]
+            counts = np.zeros(b.n_pad, dtype=np.int64)
+            for i, ni in self._nodes():
+                c = 0
+                for taint in ni.taints:
+                    if taint.effect == PREFER_NO_SCHEDULE and \
+                            not tolerations_tolerate_taint(tols, taint):
+                        c += 1
+                counts[i] = c
+            f.taint_counts = counts
+        selectors = get_selectors(pod, self.services, self.replicasets)
+        if selectors:
+            counts = np.zeros(b.n_pad, dtype=np.int64)
+            for i, ni in self._nodes():
+                c = 0
+                for existing in ni.pods:
+                    if existing.namespace != pod.namespace or existing.deleted:
+                        continue
+                    if all(_selector_matches(s, existing.labels) for s in selectors):
+                        c += 1
+                counts[i] = c
+            f.spread_counts = counts
+        has_pref_terms = a is not None and (
+            (a.pod_affinity is not None and a.pod_affinity.preferred)
+            or (a.pod_anti_affinity is not None and a.pod_anti_affinity.preferred))
+        if self._any_affinity_pods or has_pref_terms:
+            f.interpod_counts, f.interpod_tracked = self._interpod_pref_counts(pod)
+        if self._any_images:
+            sums = np.zeros(b.n_pad, dtype=np.int64)
+            for i, ni in self._nodes():
+                total = 0
+                for c in pod.containers:
+                    state = ni.image_states.get(normalized_image_name(c.image))
+                    if state is not None:
+                        spread = state.num_nodes / self.total_num_nodes
+                        total += int(state.size_bytes * spread)
+                sums[i] = total
+            f.image_sums = sums
+        if self._any_prefer_avoid:
+            scores = np.full(b.n_pad, 10, dtype=np.int64)
+            owner = pod.owner_ref
+            if owner is not None and owner[0] in ("ReplicationController", "ReplicaSet"):
+                for i, ni in self._nodes():
+                    if ni.node is not None and owner[2] in ni.node.prefer_avoid_pod_uids:
+                        scores[i] = 0
+            f.prefer_avoid = scores
+
+    def _interpod_pref_counts(self, pod: Pod):
+        """Mirror of the oracle's interpod_affinity_priority counting
+        (priorities.py), emitted as dense arrays."""
+        b = self.batch
+        # reuse the oracle's exact counting by running it over all nodes and
+        # reading back counts: the oracle normalizes internally, so instead we
+        # inline its counting here via its helper semantics.
+        from kubernetes_tpu.oracle.predicates import (
+            pod_matches_term_props, nodes_same_topology)
+        a = pod.affinity
+        has_aff = a is not None and a.pod_affinity is not None
+        has_anti = a is not None and a.pod_anti_affinity is not None
+        counts: dict[str, int] = {}
+        tracked: set[str] = set()
+        for name, ni in self.node_infos.items():
+            if has_aff or has_anti or ni.pods_with_affinity:
+                counts[name] = 0
+                tracked.add(name)
+
+        def node_of(p: Pod):
+            ni = self.node_infos.get(p.node_name)
+            return ni.node if ni else None
+
+        def process_term(term, defining, to_check, fixed_node, weight):
+            if fixed_node is None:
+                return
+            if pod_matches_term_props(to_check, defining, term):
+                for name in tracked:
+                    n = self.node_infos[name].node
+                    if n is not None and nodes_same_topology(n, fixed_node, term.topology_key):
+                        counts[name] += weight
+
+        def process_pod(existing: Pod):
+            existing_node = node_of(existing)
+            ea = existing.affinity
+            if has_aff:
+                for wt in a.pod_affinity.preferred:
+                    process_term(wt.term, pod, existing, existing_node, wt.weight)
+            if has_anti:
+                for wt in a.pod_anti_affinity.preferred:
+                    process_term(wt.term, pod, existing, existing_node, -wt.weight)
+            if ea is not None and ea.pod_affinity is not None:
+                if self.hard_weight > 0:
+                    for term in ea.pod_affinity.required:
+                        process_term(term, existing, pod, existing_node, self.hard_weight)
+                for wt in ea.pod_affinity.preferred:
+                    process_term(wt.term, existing, pod, existing_node, wt.weight)
+            if ea is not None and ea.pod_anti_affinity is not None:
+                for wt in ea.pod_anti_affinity.preferred:
+                    process_term(wt.term, existing, pod, existing_node, -wt.weight)
+
+        for ni in self.node_infos.values():
+            if ni.node is None:
+                continue
+            pods = ni.pods if (has_aff or has_anti) else ni.pods_with_affinity
+            for existing in pods:
+                process_pod(existing)
+
+        arr = np.zeros(b.n_pad, dtype=np.int64)
+        trk = np.zeros(b.n_pad, dtype=bool)
+        for name, c in counts.items():
+            i = b.index.get(name)
+            if i is not None:
+                arr[i] = c
+                trk[i] = True
+        return arr, trk

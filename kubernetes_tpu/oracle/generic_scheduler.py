@@ -24,6 +24,23 @@ MIN_FEASIBLE_PERCENTAGE = 5            # generic_scheduler.go:62
 DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE = 50  # api/types.go:40
 
 
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int) -> int:
+    """Adaptive partial-search quota (reference: generic_scheduler.go:434).
+    Shared by the oracle and the device scheduler so both stop the node walk
+    at exactly the same point."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or percentage >= 100:
+        return num_all_nodes
+    adaptive = percentage
+    if adaptive <= 0:
+        adaptive = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE - num_all_nodes // 125
+        if adaptive < MIN_FEASIBLE_PERCENTAGE:
+            adaptive = MIN_FEASIBLE_PERCENTAGE
+    num = num_all_nodes * adaptive // 100
+    if num < MIN_FEASIBLE_NODES_TO_FIND:
+        return MIN_FEASIBLE_NODES_TO_FIND
+    return num
+
+
 @dataclass
 class PriorityConfig:
     """One Score plugin entry (reference: priorities.PriorityConfig)."""
@@ -107,17 +124,8 @@ class GenericScheduler:
     # -- findNodesThatFit ---------------------------------------------------
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
         """Reference: generic_scheduler.go:434."""
-        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or self.percentage_of_nodes_to_score >= 100:
-            return num_all_nodes
-        adaptive = self.percentage_of_nodes_to_score
-        if adaptive <= 0:
-            adaptive = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE - num_all_nodes // 125
-            if adaptive < MIN_FEASIBLE_PERCENTAGE:
-                adaptive = MIN_FEASIBLE_PERCENTAGE
-        num = num_all_nodes * adaptive // 100
-        if num < MIN_FEASIBLE_NODES_TO_FIND:
-            return MIN_FEASIBLE_NODES_TO_FIND
-        return num
+        return num_feasible_nodes_to_find(num_all_nodes,
+                                          self.percentage_of_nodes_to_score)
 
     def find_nodes_that_fit(self, pod: Pod, node_infos: dict[str, NodeInfo],
                             all_node_names: list[str],

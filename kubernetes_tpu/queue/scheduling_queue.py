@@ -18,7 +18,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
-from kubernetes_tpu.api.types import Pod
+from kubernetes_tpu.api.types import Pod, has_pod_affinity_terms
 from kubernetes_tpu.utils.clock import Clock, RealClock
 from kubernetes_tpu.utils.heap import KeyedHeap
 
@@ -96,9 +96,7 @@ class NominatedPodMap:
         return list(self._by_node.get(node_name, []))
 
 
-def _pod_has_affinity_terms(pod: Pod) -> bool:
-    a = pod.affinity
-    return a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None)
+
 
 
 class PriorityQueue:
@@ -124,6 +122,7 @@ class PriorityQueue:
         self._scheduling_cycle = 0
         self._move_request_cycle = -1
         self._closed = False
+        self._last_backoff_sweep = self.clock.now()
 
     # -- basic ops ----------------------------------------------------------
     def add(self, pod: Pod) -> None:
@@ -243,9 +242,9 @@ class PriorityQueue:
     def _move_pods_with_affinity(self) -> None:
         with self._cond:
             now = self.clock.now()
-            moved = False
+            moved = False  # noqa: F841 kept for notify gating
             for key, q in list(self._unschedulable.items()):
-                if _pod_has_affinity_terms(q.pod):
+                if has_pod_affinity_terms(q.pod):
                     q.expiry = self._backoff.backoff_expiry(key)
                     if q.expiry > now:
                         self._backoffq.add(q)
@@ -253,8 +252,11 @@ class PriorityQueue:
                         self._active.add(q)
                     del self._unschedulable[key]
                     moved = True
+            # record the move request even when nothing moved: a pod mid-cycle
+            # must land in backoffQ, not unschedulableQ
+            # (reference: scheduling_queue.go:519 sets moveRequestCycle always)
+            self._move_request_cycle = self._scheduling_cycle
             if moved:
-                self._move_request_cycle = self._scheduling_cycle
                 self._cond.notify_all()
 
     # -- timers --------------------------------------------------------------
@@ -272,6 +274,16 @@ class PriorityQueue:
             if now - q.timestamp > self.unschedulable_timeout:
                 del self._unschedulable[key]
                 self._active.add(q)
+        # sweep stale backoff records for pods no longer queued
+        # (reference: PodBackoffMap.CleanupPodsCompletesBackingoff)
+        if now - self._last_backoff_sweep > 2 * self._backoff.max:
+            self._last_backoff_sweep = now
+            for key in list(self._backoff._attempts):
+                if key in self._active or key in self._backoffq \
+                        or key in self._unschedulable:
+                    continue
+                if self._backoff.backoff_expiry(key) + self._backoff.max < now:
+                    self._backoff.clear(key)
 
     def flush(self) -> None:
         with self._cond:

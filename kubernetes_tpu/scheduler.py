@@ -1,0 +1,291 @@
+"""Scheduler shell: owns the scheduling loop, one pod per cycle (or a burst
+per launch), assume → bind pipeline, informer wiring, failure re-queue.
+
+Mirrors pkg/scheduler/scheduler.go (New :121, Run :250, scheduleOne :438,
+assume :382, bind :411, recordSchedulingFailure :266) and
+pkg/scheduler/eventhandlers.go:319 AddAllEventHandlers. The algorithm is
+pluggable: the oracle (pure Python, the parity referee) or the TPU kernel
+path (core.TPUScheduler); binding I/O stays off the decision path like the
+reference's bind goroutine (scheduler.go:523).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.api.types import Pod, Node
+from kubernetes_tpu.cache.cache import SchedulerCache, Snapshot
+from kubernetes_tpu.oracle.generic_scheduler import (
+    GenericScheduler, FitError, ScheduleResult, default_priority_configs,
+)
+from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+from kubernetes_tpu.store.store import (
+    Store, PODS, NODES, SERVICES, REPLICASETS, PDBS, NotFoundError,
+)
+from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.utils.clock import Clock, RealClock
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+
+@dataclass
+class SchedulerMetrics:
+    """Counter mirror of pkg/scheduler/metrics/metrics.go."""
+    schedule_attempts: dict[str, int] = field(default_factory=lambda: {
+        "scheduled": 0, "unschedulable": 0, "error": 0})
+    binding_count: int = 0
+    preemption_attempts: int = 0
+    preemption_victims: int = 0
+    e2e_latency_sum: float = 0.0
+
+    def observe(self, result: str) -> None:
+        self.schedule_attempts[result] = self.schedule_attempts.get(result, 0) + 1
+
+
+class Scheduler:
+    """One scheduler instance: queue + cache + algorithm + binder."""
+
+    def __init__(self, store: Store,
+                 scheduler_name: str = DEFAULT_SCHEDULER_NAME,
+                 algorithm=None,
+                 use_tpu: bool = False,
+                 percentage_of_nodes_to_score: int = 50,
+                 hard_pod_affinity_weight: int = 1,
+                 clock: Optional[Clock] = None,
+                 disable_preemption: bool = False):
+        self.store = store
+        self.name = scheduler_name
+        self.clock = clock or RealClock()
+        self.cache = SchedulerCache(clock=self.clock)
+        self.queue = PriorityQueue(clock=self.clock)
+        self.metrics = SchedulerMetrics()
+        self.informers = InformerFactory(store)
+        self.disable_preemption = disable_preemption
+        self._snapshot = Snapshot()
+        self._stop = threading.Event()
+        services = self.informers.informer(SERVICES)
+        replicasets = self.informers.informer(REPLICASETS)
+        self._services_fn = services.list
+        self._replicasets_fn = replicasets.list
+        if algorithm is not None:
+            self.algorithm = algorithm
+        elif use_tpu:
+            from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+            self.algorithm = TPUScheduler(
+                percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                hard_pod_affinity_weight=hard_pod_affinity_weight,
+                services_fn=self._services_fn,
+                replicasets_fn=self._replicasets_fn)
+        else:
+            self.algorithm = GenericScheduler(
+                percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                hard_pod_affinity_weight=hard_pod_affinity_weight)
+        self._priority_configs = default_priority_configs(
+            services_fn=self._services_fn, replicasets_fn=self._replicasets_fn,
+            hard_pod_affinity_weight=hard_pod_affinity_weight)
+        self._add_all_event_handlers()
+
+    # -- event handlers (reference: eventhandlers.go:319) --------------------
+    def _responsible_for(self, pod: Pod) -> bool:
+        return pod.scheduler_name == self.name
+
+    def _add_all_event_handlers(self) -> None:
+        pods = self.informers.informer(PODS)
+        # assigned pods -> cache
+        pods.add_event_handler(
+            on_add=self._add_pod_to_cache,
+            on_update=self._update_pod_in_cache,
+            on_delete=self._delete_pod_from_cache,
+            filter_fn=lambda p: bool(p.node_name))
+        # unassigned pods owned by this scheduler -> queue
+        pods.add_event_handler(
+            on_add=self.queue.add,
+            on_update=self._update_pod_in_queue,
+            on_delete=self._delete_pod_from_queue,
+            filter_fn=lambda p: not p.node_name and self._responsible_for(p))
+        nodes = self.informers.informer(NODES)
+        nodes.add_event_handler(
+            on_add=self._add_node, on_update=self._update_node,
+            on_delete=self._delete_node)
+        # service/RS/PDB events wake the queue (eventhandlers.go:32-86)
+        for kind in (SERVICES, REPLICASETS, PDBS):
+            self.informers.informer(kind).add_event_handler(
+                on_add=lambda _o: self.queue.move_all_to_active(),
+                on_update=lambda _o, _n: self.queue.move_all_to_active(),
+                on_delete=lambda _o: self.queue.move_all_to_active())
+
+    def _add_pod_to_cache(self, pod: Pod) -> None:
+        self.cache.add_pod(pod)
+        self.queue.assigned_pod_added(pod)
+
+    def _update_pod_in_cache(self, old: Pod, new: Pod) -> None:
+        if self._skip_pod_update(old, new):
+            return
+        self.cache.update_pod(old, new)
+        self.queue.assigned_pod_updated(new)
+
+    def _skip_pod_update(self, old: Pod, new: Pod) -> bool:
+        """Ignore self-inflicted updates on assumed pods
+        (reference: eventhandlers.go:275 skipPodUpdate)."""
+        if not self.cache.is_assumed_pod(new):
+            return False
+        # changes besides nominated-node/status are real
+        return old.node_name == new.node_name
+
+    def _delete_pod_from_cache(self, pod: Pod) -> None:
+        self.cache.remove_pod(pod)
+        self.queue.move_all_to_active()
+
+    def _update_pod_in_queue(self, old: Pod, new: Pod) -> None:
+        self.queue.update(old, new)
+
+    def _delete_pod_from_queue(self, pod: Pod) -> None:
+        self.queue.delete(pod)
+
+    def _add_node(self, node: Node) -> None:
+        self.cache.add_node(node)
+        self.queue.move_all_to_active()
+
+    def _update_node(self, old: Node, new: Node) -> None:
+        self.cache.update_node(old, new)
+        if self._node_scheduling_properties_changed(old, new):
+            self.queue.move_all_to_active()
+
+    @staticmethod
+    def _node_scheduling_properties_changed(old: Node, new: Node) -> bool:
+        """Reference: eventhandlers.go:424 — only allocatable / labels /
+        taints / unschedulable / condition changes wake the queue."""
+        return (old.allocatable != new.allocatable
+                or old.labels != new.labels
+                or old.taints != new.taints
+                or old.unschedulable != new.unschedulable
+                or old.conditions != new.conditions)
+
+    def _delete_node(self, node: Node) -> None:
+        self.cache.remove_node(node)
+
+    # -- lifecycle -----------------------------------------------------------
+    def sync(self) -> None:
+        self.informers.sync_all()
+
+    def pump(self) -> int:
+        return self.informers.pump_all()
+
+    # -- one cycle (reference: scheduleOne :438) ------------------------------
+    def schedule_one(self, timeout: Optional[float] = 0.05) -> bool:
+        """Pop + schedule + assume + bind one pod. Returns False when the
+        queue stayed empty for `timeout`."""
+        pod = self.queue.pop(timeout=timeout)
+        if pod is None:
+            return False
+        if pod.deleted:
+            return True
+        cycle = self.queue.scheduling_cycle
+        start = self.clock.now()
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        names = self.cache.node_tree.list_names()
+        try:
+            result = self._schedule(pod, names)
+        except FitError as err:
+            self.metrics.observe("unschedulable")
+            if not self.disable_preemption:
+                self._preempt(pod, err)
+            self._record_failure(pod, cycle)
+            return True
+        except Exception:
+            self.metrics.observe("error")
+            self._record_failure(pod, cycle)
+            raise
+        assumed = pod.clone()
+        assumed.node_name = result.suggested_host
+        try:
+            self.cache.assume_pod(assumed)
+        except Exception:
+            self.metrics.observe("error")
+            self._record_failure(pod, cycle)
+            return True
+        self.queue.nominated.delete(pod)
+        self._bind(assumed, result.suggested_host, pod, cycle)
+        self.metrics.observe("scheduled")
+        self.metrics.e2e_latency_sum += self.clock.now() - start
+        return True
+
+    def _schedule(self, pod: Pod, names: list[str]) -> ScheduleResult:
+        if isinstance(self.algorithm, GenericScheduler):
+            return self.algorithm.schedule(
+                pod, self._snapshot.node_infos, names,
+                priority_configs=self._priority_configs)
+        return self.algorithm.schedule(pod, self._snapshot.node_infos, names)
+
+    def _bind(self, assumed: Pod, host: str, orig: Pod, cycle: int) -> None:
+        """Reference: the bind goroutine (scheduler.go:523) — store write +
+        FinishBinding; on failure ForgetPod + re-queue."""
+        try:
+            self.store.bind_pod(assumed.key, host)
+            self.cache.finish_binding(assumed)
+            self.metrics.binding_count += 1
+        except Exception:
+            self.cache.forget_pod(assumed)
+            self._record_failure(orig, cycle)
+
+    def _record_failure(self, pod: Pod, cycle: int) -> None:
+        """Reference: factory.go:643 MakeDefaultErrorFunc."""
+        try:
+            current = self.store.get(PODS, pod.key)
+        except NotFoundError:
+            self.queue.delete(pod)
+            return
+        if current.node_name:
+            return
+        self.queue.add_unschedulable_if_not_present(current, cycle)
+
+    # -- preemption placeholder (full impl lands with the preemption kernels) --
+    def _preempt(self, pod: Pod, err: FitError) -> None:
+        self.metrics.preemption_attempts += 1
+
+    # -- burst mode (TPU throughput path) -------------------------------------
+    def schedule_burst(self, max_pods: int = 1024) -> int:
+        """Drain up to max_pods from the queue and schedule them in one
+        device launch (TPU algorithm only). Returns pods bound."""
+        pods = []
+        cycles = []
+        while len(pods) < max_pods:
+            pod = self.queue.pop(timeout=0.0)
+            if pod is None:
+                break
+            if not pod.deleted:
+                pods.append(pod)
+                cycles.append(self.queue.scheduling_cycle)
+        if not pods:
+            return 0
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        names = self.cache.node_tree.list_names()
+        hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos, names,
+                                              bucket=max_pods)
+        bound = 0
+        for pod, host, cycle in zip(pods, hosts, cycles):
+            if host is None:
+                self.metrics.observe("unschedulable")
+                self._record_failure(pod, cycle)
+                continue
+            assumed = pod.clone()
+            assumed.node_name = host
+            self.cache.assume_pod(assumed)
+            self._bind(assumed, host, pod, cycle)
+            self.metrics.observe("scheduled")
+            bound += 1
+        return bound
+
+    def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
+        """wait.Until(scheduleOne, 0) analog; call from a thread."""
+        while not self._stop.is_set():
+            self.pump()
+            self.schedule_one()
+            if stop_after is not None and stop_after():
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.queue.close()

@@ -108,6 +108,13 @@ def _key_of(obj: Any) -> str:
     return obj.key
 
 
+def _clone(obj: Any) -> Any:
+    """Snapshot an object crossing the store boundary. Objects with a fast
+    clone() use it; anything else falls back to deepcopy."""
+    c = getattr(obj, "clone", None)
+    return c() if c is not None else copy.deepcopy(obj)
+
+
 class Store:
     """Threadsafe versioned KV with per-kind watch fan-out."""
 
@@ -126,12 +133,12 @@ class Store:
             obj = self._objs.get(kind, {}).get(key)
             if obj is None:
                 raise NotFoundError(f"{kind}/{key}")
-            return copy.deepcopy(obj)
+            return _clone(obj)
 
     def list(self, kind: str) -> tuple[list[Any], int]:
         """Objects plus the store resourceVersion the list is consistent at."""
         with self._lock:
-            objs = [copy.deepcopy(o) for o in self._objs.get(kind, {}).values()]
+            objs = [_clone(o) for o in self._objs.get(kind, {}).values()]
             return objs, self._rv
 
     def resource_version(self) -> int:
@@ -145,12 +152,12 @@ class Store:
             key = _key_of(obj)
             if key in bucket:
                 raise AlreadyExistsError(f"{kind}/{key}")
-            stored = copy.deepcopy(obj)
+            stored = _clone(obj)
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
-            self._emit(Event(ADDED, kind, copy.deepcopy(stored), self._rv))
-            return copy.deepcopy(stored)
+            self._emit(Event(ADDED, kind, _clone(stored), self._rv))
+            return _clone(stored)
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
         with self._lock:
@@ -162,12 +169,12 @@ class Store:
             if expect_rv is not None and current.resource_version != expect_rv:
                 raise ConflictError(
                     f"{kind}/{key}: rv {current.resource_version} != expected {expect_rv}")
-            stored = copy.deepcopy(obj)
+            stored = _clone(obj)
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
-            self._emit(Event(MODIFIED, kind, copy.deepcopy(stored), self._rv))
-            return copy.deepcopy(stored)
+            self._emit(Event(MODIFIED, kind, _clone(stored), self._rv))
+            return _clone(stored)
 
     def guaranteed_update(self, kind: str, key: str,
                           mutate: Callable[[Any], Any]) -> Any:
@@ -188,7 +195,7 @@ class Store:
             if obj is None:
                 raise NotFoundError(f"{kind}/{key}")
             self._rv += 1
-            self._emit(Event(DELETED, kind, copy.deepcopy(obj), self._rv))
+            self._emit(Event(DELETED, kind, _clone(obj), self._rv))
             return obj
 
     # -- pod conveniences (the scheduler's write surface) --------------------

@@ -1,11 +1,17 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import — mirrors how the driver validates
-multi-chip sharding without real chips.
+The interactive environment pins JAX_PLATFORMS=axon (the tunneled TPU) and a
+sitecustomize imports jax at interpreter startup, so setting env vars here is
+too late — the config must be updated through jax.config as well. Mirrors
+how the driver validates multi-chip sharding without real chips.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -396,3 +396,57 @@ class TestPriorityQueue:
         assert [p.name for p in q.nominated.pods_for_node("n1")] == ["preemptor"]
         q.delete(pod)
         assert q.nominated.pods_for_node("n1") == []
+
+
+class TestReviewRegressions:
+    def test_snapshot_purges_deleted_node_with_pods(self):
+        """A node deleted while hosting pods must leave the snapshot even
+        though its placeholder (with pods) stays in the cache."""
+        c = SchedulerCache(clock=FakeClock())
+        c.add_node(mknode("n1"))
+        c.add_node(mknode("n2"))
+        c.add_pod(mkpod("p1", cpu=100, node="n2"))
+        snap = c.update_snapshot(Snapshot())
+        assert set(snap.node_infos) == {"n1", "n2"}
+        c.remove_node(mknode("n2"))  # pods still reference n2 -> placeholder
+        snap = c.update_snapshot(snap)
+        assert set(snap.node_infos) == {"n1"}
+
+    def test_placeholder_dropped_when_last_pod_removed(self):
+        c = SchedulerCache(clock=FakeClock())
+        c.add_node(mknode("n1"))
+        pod = mkpod("p1", cpu=100, node="n1")
+        c.add_pod(pod)
+        c.remove_node(mknode("n1"))
+        assert c.node_count() == 1  # placeholder survives while pod exists
+        c.remove_pod(pod)
+        assert c.node_count() == 0  # placeholder reclaimed
+
+    def test_affinity_move_request_cycle_recorded_without_moves(self):
+        """assigned_pod_added with an empty unschedulableQ must still record
+        the move request so a mid-cycle failure goes to backoff, not parking."""
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        pod = q.pop()
+        cycle = q.scheduling_cycle
+        q.assigned_pod_added(mkpod("landed", node="n1"))  # nothing to move
+        q.add_unschedulable_if_not_present(pod, cycle)
+        assert q.pending_pods()["backoff"] != []
+
+    def test_backoff_map_swept_for_unqueued_pods(self):
+        clock = FakeClock()
+        q = PriorityQueue(clock=clock)
+        q.add(mkpod("p1"))
+        pod = q.pop()
+        q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
+        q.delete(pod)  # simulates bind elsewhere... but delete clears; redo
+        q.add(mkpod("p2"))
+        pod2 = q.pop()
+        q.add_unschedulable_if_not_present(pod2, q.scheduling_cycle)
+        clock.step(61)
+        assert q.pop(timeout=0.01).name == "p2"  # leftover flush
+        assert "default/p2" in q._backoff._attempts
+        clock.step(31)  # past sweep interval + expiry
+        q.flush()
+        assert "default/p2" not in q._backoff._attempts

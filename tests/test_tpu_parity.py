@@ -1,0 +1,288 @@
+"""Decision-parity fuzz: the TPU kernel path vs the pure-Python oracle.
+
+For random clusters and pod streams, both schedulers must agree on every
+suggested host, feasible-node set, evaluated count, per-node integer score,
+and failure-reason set — including the adaptive partial search rotation and
+the round-robin tie-break state, across a *sequence* of decisions with cache
+updates in between (the reference's serial scheduleOne semantics).
+"""
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, ContainerPort, Taint, Toleration, Affinity,
+    NodeAffinity, NodeSelectorTerm, Requirement, PreferredSchedulingTerm,
+    PodAffinity, PodAntiAffinity, PodAffinityTerm, WeightedPodAffinityTerm,
+    LabelSelector, Service, ImageState,
+    IN, EXISTS, NO_SCHEDULE, PREFER_NO_SCHEDULE,
+    LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION, LABEL_HOSTNAME,
+)
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.oracle.generic_scheduler import GenericScheduler, FitError
+
+
+GI = 1024 ** 3
+
+
+def make_cluster(rng, n, zones=0, taint_frac=0.0, labeled_frac=0.0,
+                 images=False):
+    nodes = []
+    for i in range(n):
+        labels = {LABEL_HOSTNAME: f"n{i}"}
+        if zones:
+            z = i % zones
+            labels[LABEL_ZONE_FAILURE_DOMAIN] = f"zone-{z}"
+            labels[LABEL_ZONE_REGION] = "r1"
+        if labeled_frac and rng.random() < labeled_frac:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+            labels["size"] = str(rng.randint(1, 100))
+        taints = ()
+        if taint_frac and rng.random() < taint_frac:
+            effect = rng.choice([NO_SCHEDULE, PREFER_NO_SCHEDULE])
+            taints = (Taint(key="team", value=rng.choice(["a", "b"]), effect=effect),)
+        imgs = ()
+        if images and rng.random() < 0.5:
+            imgs = (ImageState(names=(f"img-{rng.randint(0, 3)}:v1",),
+                               size_bytes=rng.randint(10, 2000) * 1024 * 1024),)
+        nodes.append(Node(
+            name=f"n{i}", labels=labels, taints=taints,
+            allocatable={"cpu": rng.choice([2000, 4000, 8000]),
+                         "memory": rng.choice([8, 16, 32]) * GI,
+                         "pods": rng.choice([4, 8, 110])},
+            images=imgs))
+    return nodes
+
+
+def make_pod(rng, j, selectors=False, tolerations=False, node_affinity=False,
+             pod_affinity=False, ports=False, images=False):
+    reqs = {}
+    if rng.random() < 0.9:
+        reqs["cpu"] = rng.choice([100, 500, 1000, 2000])
+    if rng.random() < 0.9:
+        reqs["memory"] = rng.choice([256, 512, 1024, 4096]) * 1024 * 1024
+    port_list = ()
+    if ports and rng.random() < 0.4:
+        port_list = (ContainerPort(host_port=rng.choice([80, 8080, 9090]),
+                                   container_port=80),)
+    image = f"img-{rng.randint(0, 3)}:v1" if images else ""
+    labels = {"app": rng.choice(["web", "db", "cache"])}
+    kw = {}
+    if selectors and rng.random() < 0.4:
+        kw["node_selector"] = {"disk": rng.choice(["ssd", "hdd"])}
+    if tolerations and rng.random() < 0.5:
+        kw["tolerations"] = (Toleration(key="team", op="Equal",
+                                        value=rng.choice(["a", "b"]),
+                                        effect=""),)
+    affinity_parts = {}
+    if node_affinity and rng.random() < 0.5:
+        affinity_parts["node_affinity"] = NodeAffinity(
+            required=(NodeSelectorTerm(match_expressions=(
+                Requirement(key="disk", op=IN, values=("ssd", "hdd")),)),)
+            if rng.random() < 0.5 else None,
+            preferred=(PreferredSchedulingTerm(
+                weight=rng.randint(1, 100),
+                preference=NodeSelectorTerm(match_expressions=(
+                    Requirement(key="disk", op=IN, values=("ssd",)),))),))
+    if pod_affinity and rng.random() < 0.6:
+        term = PodAffinityTerm(
+            label_selector=LabelSelector.from_dict({"app": rng.choice(["web", "db"])}),
+            topology_key=rng.choice([LABEL_HOSTNAME, LABEL_ZONE_FAILURE_DOMAIN]))
+        if rng.random() < 0.5:
+            affinity_parts["pod_affinity"] = PodAffinity(
+                required=(term,) if rng.random() < 0.5 else (),
+                preferred=(WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                                   term=term),))
+        else:
+            affinity_parts["pod_anti_affinity"] = PodAntiAffinity(
+                required=(term,) if rng.random() < 0.5 else (),
+                preferred=(WeightedPodAffinityTerm(weight=rng.randint(1, 100),
+                                                   term=term),))
+    if affinity_parts:
+        kw["affinity"] = Affinity(**affinity_parts)
+    return Pod(name=f"p{j}", labels=labels,
+               containers=(Container.make(name="c", requests=reqs, ports=port_list,
+                                          image=image),), **kw)
+
+
+def run_parity_sequence(rng, nodes, pods, percentage=100, services=None):
+    """Run both schedulers over the same decision stream; assert parity."""
+    node_infos = {n.name: NodeInfo(n) for n in nodes}
+    names = [n.name for n in nodes]
+    services = services or []
+    oracle = GenericScheduler(percentage_of_nodes_to_score=percentage)
+    tpu = TPUScheduler(percentage_of_nodes_to_score=percentage,
+                       services_fn=lambda: services)
+    from kubernetes_tpu.oracle.generic_scheduler import default_priority_configs
+    prio_cfgs = default_priority_configs(services_fn=lambda: services)
+    scheduled = 0
+    for pod in pods:
+        o_err = t_err = None
+        o_res = t_res = None
+        try:
+            o_res = oracle.schedule(pod, node_infos, names,
+                                    priority_configs=prio_cfgs)
+        except FitError as e:
+            o_err = e
+        try:
+            t_res = tpu.schedule(pod, node_infos, names)
+        except FitError as e:
+            t_err = e
+        assert (o_err is None) == (t_err is None), \
+            f"{pod.name}: oracle={'fit' if o_err is None else 'err'} tpu={'fit' if t_err is None else 'err'}"
+        if o_err is not None:
+            assert set(o_err.failed_predicates) == set(t_err.failed_predicates), pod.name
+            for k in o_err.failed_predicates:
+                assert set(o_err.failed_predicates[k]) == set(t_err.failed_predicates[k]), \
+                    (pod.name, k, o_err.failed_predicates[k], t_err.failed_predicates[k])
+            continue
+        assert o_res.suggested_host == t_res.suggested_host, \
+            (pod.name, o_res.suggested_host, t_res.suggested_host,
+             o_res.host_priority, t_res.host_priority)
+        assert o_res.evaluated_nodes == t_res.evaluated_nodes, pod.name
+        assert o_res.feasible_nodes == t_res.feasible_nodes, pod.name
+        assert o_res.host_priority == t_res.host_priority, \
+            (pod.name, o_res.host_priority, t_res.host_priority)
+        # apply the decision (assume) so the next pod sees it
+        placed = copy.deepcopy(pod)
+        placed.node_name = o_res.suggested_host
+        node_infos[o_res.suggested_host].add_pod(placed)
+        scheduled += 1
+    return scheduled
+
+
+class TestResourceParity:
+    @pytest.mark.parametrize("n,percentage", [(6, 100), (30, 100), (130, 50), (130, 0)])
+    def test_resources_only(self, n, percentage):
+        rng = random.Random(42 + n + percentage)
+        nodes = make_cluster(rng, n)
+        pods = [make_pod(rng, j) for j in range(30)]
+        assert run_parity_sequence(rng, nodes, pods, percentage) > 0
+
+    def test_saturation_fit_errors(self):
+        rng = random.Random(7)
+        nodes = make_cluster(rng, 4)
+        for node in nodes:
+            node.allocatable["pods"] = 2
+        pods = [make_pod(rng, j) for j in range(16)]  # 16 pods > 8 slots
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_extended_resources(self):
+        rng = random.Random(11)
+        nodes = make_cluster(rng, 8)
+        for i, node in enumerate(nodes):
+            if i % 2 == 0:
+                node.allocatable["example.com/gpu"] = 2
+        pods = []
+        for j in range(12):
+            p = make_pod(rng, j)
+            if j % 3 == 0:
+                reqs = dict(p.containers[0].requests)
+                reqs["example.com/gpu"] = 1
+                p.containers = (Container.make(name="c", requests=reqs),)
+            if j == 7:  # scalar that exists nowhere
+                p.containers = (Container.make(
+                    name="c", requests={"cpu": 100, "nosuch.io/dev": 1}),)
+            pods.append(p)
+        run_parity_sequence(rng, nodes, pods)
+
+
+class TestFeatureParity:
+    def test_taints_and_tolerations(self):
+        rng = random.Random(13)
+        nodes = make_cluster(rng, 20, taint_frac=0.5)
+        pods = [make_pod(rng, j, tolerations=True) for j in range(25)]
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_selectors_and_node_affinity(self):
+        rng = random.Random(17)
+        nodes = make_cluster(rng, 20, labeled_frac=0.7)
+        pods = [make_pod(rng, j, selectors=True, node_affinity=True)
+                for j in range(25)]
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_host_ports(self):
+        rng = random.Random(19)
+        nodes = make_cluster(rng, 6)
+        pods = [make_pod(rng, j, ports=True) for j in range(20)]
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_zones_and_selector_spread(self):
+        rng = random.Random(23)
+        nodes = make_cluster(rng, 12, zones=3)
+        services = [Service(name="web", selector={"app": "web"})]
+        pods = [make_pod(rng, j) for j in range(20)]
+        run_parity_sequence(rng, nodes, pods, services=services)
+
+    def test_interpod_affinity(self):
+        rng = random.Random(29)
+        nodes = make_cluster(rng, 8, zones=2)
+        pods = [make_pod(rng, j, pod_affinity=True) for j in range(18)]
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_image_locality(self):
+        rng = random.Random(31)
+        nodes = make_cluster(rng, 10, images=True)
+        pods = [make_pod(rng, j, images=True) for j in range(15)]
+        run_parity_sequence(rng, nodes, pods)
+
+    def test_everything_at_once(self):
+        rng = random.Random(37)
+        nodes = make_cluster(rng, 40, zones=3, taint_frac=0.3, labeled_frac=0.5,
+                             images=True)
+        services = [Service(name="web", selector={"app": "web"})]
+        pods = [make_pod(rng, j, selectors=True, tolerations=True,
+                         node_affinity=True, pod_affinity=True, ports=True,
+                         images=True) for j in range(40)]
+        run_parity_sequence(rng, nodes, pods, services=services)
+
+
+class TestBurstParity:
+    def test_burst_matches_serial_oracle(self):
+        rng = random.Random(41)
+        nodes = make_cluster(rng, 30, zones=3)
+        pods = [make_pod(rng, j) for j in range(60)]
+        # serial oracle with cache updates between decisions
+        oracle_infos = {n.name: NodeInfo(n) for n in nodes}
+        names = [n.name for n in nodes]
+        oracle = GenericScheduler(percentage_of_nodes_to_score=100)
+        expected = []
+        for pod in pods:
+            try:
+                res = oracle.schedule(pod, oracle_infos, names)
+                expected.append(res.suggested_host)
+                placed = copy.deepcopy(pod)
+                placed.node_name = res.suggested_host
+                oracle_infos[res.suggested_host].add_pod(placed)
+            except FitError:
+                expected.append(None)
+        # one burst on device
+        tpu_infos = {n.name: NodeInfo(n) for n in nodes}
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        got = tpu.schedule_burst(pods, tpu_infos, names)
+        assert got == expected
+
+    def test_burst_with_adaptive_percentage(self):
+        rng = random.Random(43)
+        nodes = make_cluster(rng, 130)
+        pods = [make_pod(rng, j) for j in range(40)]
+        oracle_infos = {n.name: NodeInfo(n) for n in nodes}
+        names = [n.name for n in nodes]
+        oracle = GenericScheduler(percentage_of_nodes_to_score=50)
+        expected = []
+        for pod in pods:
+            try:
+                res = oracle.schedule(pod, oracle_infos, names)
+                expected.append(res.suggested_host)
+                placed = copy.deepcopy(pod)
+                placed.node_name = res.suggested_host
+                oracle_infos[res.suggested_host].add_pod(placed)
+            except FitError:
+                expected.append(None)
+        tpu_infos = {n.name: NodeInfo(n) for n in nodes}
+        tpu = TPUScheduler(percentage_of_nodes_to_score=50)
+        got = tpu.schedule_burst(pods, tpu_infos, names)
+        assert got == expected
