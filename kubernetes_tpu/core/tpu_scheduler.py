@@ -48,12 +48,23 @@ class TPUScheduler:
                  hard_pod_affinity_weight: int = 1,
                  services_fn=lambda: [],
                  replicasets_fn=lambda: [],
-                 collect_host_priority: bool = True):
+                 collect_host_priority: bool = True,
+                 nominated=None):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.services_fn = services_fn
         self.replicasets_fn = replicasets_fn
         self.collect_host_priority = collect_host_priority
+        self.check_resources = True   # PodFitsResources enabled (provider/policy)
+        self.weights = None           # None -> kernels.DEFAULT_WEIGHTS
+        self.enabled_predicates = None  # None -> all
+        self.priority_name_weights = None  # provider/policy priorities by name
+        # NominatedPodMap handle; when preemption has nominated pods, cycles
+        # fall back to the oracle's two-pass fitting (podFitsOnNode :627) —
+        # the device kernel doesn't model ghost pods yet
+        self.nominated = nominated
+        self._oracle = None
+        self._oracle_cfgs = None
         self.last_index = 0
         self.last_node_index = 0
         self.encoder = NodeStateEncoder()
@@ -102,6 +113,7 @@ class TPUScheduler:
             "has_request": np.bool_(f.has_request),
             "unknown_scalar": np.bool_(bool(f.unknown_scalars)),
             "skip": np.bool_(False),
+            "check_resources": np.bool_(self.check_resources),
             "nz_cpu": np.int64(f.nz_cpu),
             "nz_mem": np.int64(f.nz_mem),
             "sel_ok": f.sel_ok if f.sel_ok is not None else d["ones_bool"],
@@ -174,22 +186,59 @@ class TPUScheduler:
             reasons.append(P.ERR_NODE_SELECTOR_NOT_MATCH)
         return reasons
 
+    def _oracle_fallback(self):
+        from kubernetes_tpu.oracle.generic_scheduler import (
+            GenericScheduler, default_priority_configs)
+        if self._oracle is None:
+            self._oracle = GenericScheduler(
+                percentage_of_nodes_to_score=self.percentage_of_nodes_to_score,
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                nominated_pods_fn=self.nominated.pods_for_node)
+            if self.priority_name_weights is not None:
+                from kubernetes_tpu.factory import build_priority_configs
+                self._oracle_cfgs = build_priority_configs(
+                    self.priority_name_weights,
+                    services_fn=self.services_fn,
+                    replicasets_fn=self.replicasets_fn,
+                    hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+            else:
+                self._oracle_cfgs = default_priority_configs(
+                    services_fn=self.services_fn, replicasets_fn=self.replicasets_fn,
+                    hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+        return self._oracle
+
     # -- single-pod cycle ----------------------------------------------------
     def schedule(self, pod: Pod, node_infos: dict[str, NodeInfo],
                  all_node_names: list[str]) -> ScheduleResult:
         if not all_node_names:
             raise FitError(pod, 0, {})
+        if self.nominated is not None and self.nominated.has_any():
+            o = self._oracle_fallback()
+            o.last_index, o.last_node_index = self.last_index, self.last_node_index
+            funcs = None
+            if self.enabled_predicates is not None:
+                from kubernetes_tpu.factory import build_predicate_set
+                funcs = build_predicate_set(sorted(self.enabled_predicates),
+                                            node_infos)
+            try:
+                return o.schedule(pod, node_infos, all_node_names,
+                                  predicate_funcs=funcs,
+                                  priority_configs=self._oracle_cfgs)
+            finally:
+                self.last_index = o.last_index
+                self.last_node_index = o.last_node_index
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
-                         hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                         enabled=self.enabled_predicates)
         feats = enc.encode(pod)
         pod_in = self._pod_arrays(feats, b.n_pad)
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
         out = K.schedule_cycle(nodes, pod_in, self.last_index, self.last_node_index,
-                               num_to_find, n, z_pad)
+                               num_to_find, n, z_pad, weights=self.weights)
         found = int(out["found"])
         evaluated = int(out["evaluated"])
         start = self.last_index
@@ -237,7 +286,8 @@ class TPUScheduler:
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
-                         hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                         enabled=self.enabled_predicates)
         per_pod = [self._pod_arrays(enc.encode(p), b.n_pad, upd_fields=True, pod=p)
                    for p in pods]
         # pad the burst to a power-of-two bucket so lax.scan compiles once
@@ -252,7 +302,8 @@ class TPUScheduler:
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
         state, li, lni, outs = K.schedule_batch(
-            nodes, stacked, self.last_index, self.last_node_index, num_to_find, n, z_pad)
+            nodes, stacked, self.last_index, self.last_node_index, num_to_find, n,
+            z_pad, weights=self.weights)
         self.last_index = int(li)
         self.last_node_index = int(lni)
         selected = np.asarray(outs["selected"])[: len(pods)]

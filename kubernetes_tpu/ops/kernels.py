@@ -57,6 +57,8 @@ DEFAULT_WEIGHTS = {
     "selector_spread": 1,
     "interpod": 1,
     "least_requested": 1,
+    "most_requested": 0,      # ClusterAutoscalerProvider swaps this for least
+    "rtcr": 0,                # RequestedToCapacityRatioPriority (default shape)
     "balanced": 1,
     "prefer_avoid": 10000,
     "node_affinity": 1,
@@ -80,6 +82,22 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
         return jnp.where(ok, (cap - req) * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
 
     least_score = (least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) // 2
+
+    def most(req, cap):
+        ok = (cap > 0) & (req <= cap)
+        return jnp.where(ok, req * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+
+    most_score = (most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem)) // 2
+
+    # RequestedToCapacityRatio with the default broken-linear shape
+    # {0 -> 10, 100 -> 0} (requested_to_capacity_ratio.go:39): for that shape
+    # score(p) = 10 - p*10//100 where p = 100 - (cap-req)*100//cap
+    def rtcr_res(req, cap):
+        p = jnp.where((cap == 0) | (req > cap), 100,
+                      100 - (cap - req) * 100 // jnp.maximum(cap, 1))
+        return 10 + (0 - 10) * p // 100
+
+    rtcr_score = (rtcr_res(req_cpu, alloc_cpu) + rtcr_res(req_mem, alloc_mem)) // 2
 
     cpu_f = jnp.where(alloc_cpu == 0, 1.0, req_cpu / alloc_cpu)
     mem_f = jnp.where(alloc_mem == 0, 1.0, req_mem / alloc_mem)
@@ -140,6 +158,8 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
         weights["selector_spread"] * spread
         + weights["interpod"] * interpod
         + weights["least_requested"] * least_score
+        + weights["most_requested"] * most_score
+        + weights["rtcr"] * rtcr_score
         + weights["balanced"] * balanced
         + weights["prefer_avoid"] * pod["prefer_avoid"]
         + weights["node_affinity"] * node_aff
@@ -154,9 +174,10 @@ def _feasibility(nodes, pod):
     valid = nodes["valid"]
     # GeneralPredicates: resources
     bits = jnp.zeros(valid.shape, dtype=jnp.int64)
-    pods_over = nodes["pod_count"] + 1 > nodes["allowed_pods"]
+    check_res = pod["check_resources"]
+    pods_over = check_res & (nodes["pod_count"] + 1 > nodes["allowed_pods"])
     bits |= jnp.where(pods_over, 1 << BIT_PODS, 0)
-    has_req = pod["has_request"]
+    has_req = pod["has_request"] & check_res
     over_cpu = nodes["alloc_cpu"] < pod["req_cpu"] + nodes["req_cpu"]
     over_mem = nodes["alloc_mem"] < pod["req_mem"] + nodes["req_mem"]
     over_eph = nodes["alloc_eph"] < pod["req_eph"] + nodes["req_eph"]
@@ -173,7 +194,8 @@ def _feasibility(nodes, pod):
                   (1 << (BIT_SCALAR0 + jnp.arange(s_count, dtype=jnp.int64)))[None, :],
                   0), axis=1)
     bits |= scalar_bits
-    bits |= jnp.where(pod["unknown_scalar"], _i64(1) << BIT_UNKNOWN_SCALAR, 0)
+    bits |= jnp.where(check_res & pod["unknown_scalar"],
+                      _i64(1) << BIT_UNKNOWN_SCALAR, 0)
     bits |= jnp.where(~pod["host_ok"], 1 << BIT_HOST, 0)
     bits |= jnp.where(~pod["ports_ok"], 1 << BIT_PORTS, 0)
     bits |= jnp.where(~pod["sel_ok"], 1 << BIT_SELECTOR, 0)

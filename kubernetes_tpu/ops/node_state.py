@@ -239,9 +239,12 @@ class PodEncoder:
 
     def __init__(self, node_infos: dict[str, NodeInfo], batch: NodeBatch,
                  services=None, replicasets=None, total_num_nodes: Optional[int] = None,
-                 hard_pod_affinity_weight: int = 1):
+                 hard_pod_affinity_weight: int = 1,
+                 enabled: Optional[set] = None):
         self.node_infos = node_infos
         self.batch = batch
+        # predicate names enabled by the provider/policy; None = all
+        self.enabled = enabled
         self.services = services or []
         self.replicasets = replicasets or []
         self.total_num_nodes = total_num_nodes or max(1, batch.n_real)
@@ -261,6 +264,9 @@ class PodEncoder:
         b = self.batch
         for i in range(b.n_real):
             yield i, self.node_infos[b.names[i]]
+
+    def _on(self, *names: str) -> bool:
+        return self.enabled is None or any(n in self.enabled for n in names)
 
     def encode(self, pod: Pod) -> PodFeatures:
         b = self.batch
@@ -289,13 +295,14 @@ class PodEncoder:
     # -- filter masks -------------------------------------------------------
     def _encode_filters(self, pod: Pod, f: PodFeatures) -> None:
         b = self.batch
-        if pod.node_selector or (pod.affinity and pod.affinity.node_affinity):
+        if (pod.node_selector or (pod.affinity and pod.affinity.node_affinity)) \
+                and self._on("GeneralPredicates", "MatchNodeSelector"):
             m = np.zeros(b.n_pad, dtype=bool)
             for i, ni in self._nodes():
                 m[i] = ni.node is not None and \
                     pod_matches_node_selector_and_affinity(pod, ni.node)
             f.sel_ok = m
-        if self._any_taints:
+        if self._any_taints and self._on("PodToleratesNodeTaints"):
             m = np.ones(b.n_pad, dtype=bool)
             for i, ni in self._nodes():
                 bad = find_intolerable_taint(
@@ -303,7 +310,7 @@ class PodEncoder:
                     lambda t: t.effect in (NO_SCHEDULE, NO_EXECUTE))
                 m[i] = bad is None
             f.taints_ok = m
-        if self._any_unschedulable:
+        if self._any_unschedulable and self._on("CheckNodeUnschedulable"):
             tolerates = any(
                 t.tolerates(Taint(key=TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE))
                 for t in pod.tolerations)
@@ -313,14 +320,14 @@ class PodEncoder:
                     m[i] = not (ni.node is not None and ni.node.unschedulable)
             f.unsched_ok = m
         ports = get_container_ports(pod)
-        if ports:
+        if ports and self._on("GeneralPredicates", "PodFitsHostPorts"):
             m = np.ones(b.n_pad, dtype=bool)
             for i, ni in self._nodes():
                 m[i] = not any(
                     ni.used_ports.check_conflict(p.host_ip, p.protocol, p.host_port)
                     for p in ports)
             f.ports_ok = m
-        if pod.node_name:
+        if pod.node_name and self._on("GeneralPredicates", "HostName"):
             m = np.zeros(b.n_pad, dtype=bool)
             idx = b.index.get(pod.node_name)
             if idx is not None:
@@ -329,7 +336,8 @@ class PodEncoder:
         has_own_terms = pod.affinity is not None and (
             pod.affinity.pod_affinity is not None
             or pod.affinity.pod_anti_affinity is not None)
-        if self._any_affinity_pods or has_own_terms:
+        if (self._any_affinity_pods or has_own_terms) \
+                and self._on("MatchInterPodAffinity"):
             codes = np.zeros(b.n_pad, dtype=np.int8)
             for i, ni in self._nodes():
                 ok, reasons = self._ipa.check(pod, ni)

@@ -114,10 +114,12 @@ class GenericScheduler:
     def __init__(self,
                  percentage_of_nodes_to_score: int = DEFAULT_PERCENTAGE_OF_NODES_TO_SCORE,
                  always_check_all_predicates: bool = False,
-                 hard_pod_affinity_weight: int = 1):
+                 hard_pod_affinity_weight: int = 1,
+                 nominated_pods_fn: Callable[[str], list[Pod]] = lambda n: []):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.always_check_all = always_check_all_predicates
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.nominated_pods_fn = nominated_pods_fn  # podFitsOnNode two-pass (:627)
         self.last_index = 0         # findNodesThatFit resumable rotation (:486)
         self.last_node_index = 0    # selectHost round-robin counter (:292)
 
@@ -144,8 +146,10 @@ class GenericScheduler:
             name = all_node_names[(self.last_index + i) % n]
             ni = node_infos[name]
             processed += 1
-            fit, reasons = preds.pod_fits_on_node(pod, ni, predicate_funcs,
-                                                  self.always_check_all)
+            from kubernetes_tpu.oracle.preemption import pod_fits_on_node_with_nominated
+            fit, reasons = pod_fits_on_node_with_nominated(
+                pod, ni, predicate_funcs, self.nominated_pods_fn,
+                self.always_check_all, node_infos=node_infos)
             if fit:
                 filtered.append(ni.node)
             else:

@@ -279,6 +279,14 @@ class InterPodAffinityChecker:
         self._meta_uid: Optional[str] = None
         self._meta = None
 
+    def invalidate(self) -> None:
+        """Drop the per-pod metadata cache. Callers that mutate the
+        snapshot mid-pod (nominated-ghost pass, preemption reprieve loop)
+        must call this, mirroring the reference's meta.AddPod/RemovePod
+        (predicates/metadata.go:210/:239)."""
+        self._meta_uid = None
+        self._meta = None
+
     def _node_of(self, pod: Pod) -> Optional[Node]:
         ni = self.node_infos.get(pod.node_name)
         return ni.node if ni else None
@@ -376,6 +384,9 @@ def default_predicate_set(node_infos: dict[str, NodeInfo],
     ipa = InterPodAffinityChecker(node_infos)
     always_fit = lambda pod, ni: (True, [])
     preds = {
+        # handle for callers that mutate snapshot state mid-pod; not a
+        # predicate (pod_fits_on_node iterates PREDICATE_ORDERING only)
+        "_ipa_checker": ipa,
         "GeneralPredicates": general_predicates,
         "PodToleratesNodeTaints": pod_tolerates_node_taints,
         "MatchInterPodAffinity": ipa.check,

@@ -95,6 +95,9 @@ class NominatedPodMap:
     def pods_for_node(self, node_name: str) -> list[Pod]:
         return list(self._by_node.get(node_name, []))
 
+    def has_any(self) -> bool:
+        return bool(self._by_node)
+
 
 
 

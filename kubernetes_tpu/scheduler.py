@@ -25,6 +25,9 @@ from kubernetes_tpu.store.store import (
     Store, PODS, NODES, SERVICES, REPLICASETS, PDBS, NotFoundError,
 )
 from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.framework.v1alpha1 import (
+    Framework, Registry, PluginContext, UNSCHEDULABLE as FW_UNSCHEDULABLE,
+)
 from kubernetes_tpu.utils.clock import Clock, RealClock
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
@@ -54,7 +57,12 @@ class Scheduler:
                  percentage_of_nodes_to_score: int = 50,
                  hard_pod_affinity_weight: int = 1,
                  clock: Optional[Clock] = None,
-                 disable_preemption: bool = False):
+                 disable_preemption: bool = False,
+                 plugin_registry: Optional[Registry] = None,
+                 plugins_enabled: Optional[list] = None,
+                 plugin_args: Optional[dict] = None,
+                 predicate_names: Optional[list] = None,
+                 priority_weights: Optional[dict] = None):
         self.store = store
         self.name = scheduler_name
         self.clock = clock or RealClock()
@@ -65,10 +73,13 @@ class Scheduler:
         self.disable_preemption = disable_preemption
         self._snapshot = Snapshot()
         self._stop = threading.Event()
+        self._bind_threads: list[threading.Thread] = []
         services = self.informers.informer(SERVICES)
         replicasets = self.informers.informer(REPLICASETS)
         self._services_fn = services.list
         self._replicasets_fn = replicasets.list
+        self._predicate_names = predicate_names
+        self._priority_weights = priority_weights
         if algorithm is not None:
             self.algorithm = algorithm
         elif use_tpu:
@@ -77,14 +88,37 @@ class Scheduler:
                 percentage_of_nodes_to_score=percentage_of_nodes_to_score,
                 hard_pod_affinity_weight=hard_pod_affinity_weight,
                 services_fn=self._services_fn,
-                replicasets_fn=self._replicasets_fn)
+                replicasets_fn=self._replicasets_fn,
+                nominated=self.queue.nominated)
+            if priority_weights is not None:
+                from kubernetes_tpu.factory import tpu_kernel_weights
+                self.algorithm.weights = tpu_kernel_weights(priority_weights)
+                self.algorithm.priority_name_weights = priority_weights
+            if predicate_names is not None:
+                self.algorithm.enabled_predicates = set(predicate_names)
+                self.algorithm.check_resources = bool(
+                    {"GeneralPredicates", "PodFitsResources"} & set(predicate_names))
         else:
             self.algorithm = GenericScheduler(
                 percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+                hard_pod_affinity_weight=hard_pod_affinity_weight,
+                nominated_pods_fn=self.queue.nominated.pods_for_node)
+        if priority_weights is not None:
+            from kubernetes_tpu.factory import build_priority_configs
+            self._priority_configs = build_priority_configs(
+                priority_weights, services_fn=self._services_fn,
+                replicasets_fn=self._replicasets_fn,
                 hard_pod_affinity_weight=hard_pod_affinity_weight)
-        self._priority_configs = default_priority_configs(
-            services_fn=self._services_fn, replicasets_fn=self._replicasets_fn,
-            hard_pod_affinity_weight=hard_pod_affinity_weight)
+        else:
+            self._priority_configs = default_priority_configs(
+                services_fn=self._services_fn, replicasets_fn=self._replicasets_fn,
+                hard_pod_affinity_weight=hard_pod_affinity_weight)
+        # plugin framework (framework/v1alpha1: registry -> per-point slices)
+        self.framework = Framework(
+            plugin_registry if plugin_registry is not None else Registry(),
+            plugin_args=plugin_args,
+            snapshot_fn=lambda: self._snapshot.node_infos,
+            store=store, enabled=plugins_enabled)
         self._add_all_event_handlers()
 
     # -- event handlers (reference: eventhandlers.go:319) --------------------
@@ -186,6 +220,7 @@ class Scheduler:
         start = self.clock.now()
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
+        self._last_names = names
         try:
             result = self._schedule(pod, names)
         except FitError as err:
@@ -200,35 +235,83 @@ class Scheduler:
             raise
         assumed = pod.clone()
         assumed.node_name = result.suggested_host
+        ctx = PluginContext()
+        # Reserve point (scheduler.go:507)
+        st = self.framework.run_reserve_plugins(ctx, assumed, result.suggested_host)
+        if not st.is_success():
+            self.metrics.observe("error")
+            self._record_failure(pod, cycle)
+            return True
         try:
             self.cache.assume_pod(assumed)
         except Exception:
+            self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
             self._record_failure(pod, cycle)
             return True
         self.queue.nominated.delete(pod)
-        self._bind(assumed, result.suggested_host, pod, cycle)
-        self.metrics.observe("scheduled")
+        # Permit may WAIT: when permit plugins exist, bind runs off the
+        # scheduling thread like the reference's bind goroutine
+        # (scheduler.go:523) so allow()/reject() can come from this loop
+        if self.framework.permit:
+            t = threading.Thread(
+                target=self._bind,
+                args=(assumed, result.suggested_host, pod, cycle, ctx),
+                daemon=True)
+            t.start()
+            self._bind_threads.append(t)
+        else:
+            self._bind(assumed, result.suggested_host, pod, cycle, ctx)
         self.metrics.e2e_latency_sum += self.clock.now() - start
         return True
 
+    def wait_for_binds(self, timeout: float = 5.0) -> None:
+        """Join outstanding async bind threads (test/shutdown helper)."""
+        for t in self._bind_threads:
+            t.join(timeout)
+        self._bind_threads = [t for t in self._bind_threads if t.is_alive()]
+
     def _schedule(self, pod: Pod, names: list[str]) -> ScheduleResult:
         if isinstance(self.algorithm, GenericScheduler):
+            funcs = None
+            if self._predicate_names is not None:
+                from kubernetes_tpu.factory import build_predicate_set
+                funcs = build_predicate_set(self._predicate_names,
+                                            self._snapshot.node_infos)
             return self.algorithm.schedule(
                 pod, self._snapshot.node_infos, names,
+                predicate_funcs=funcs,
                 priority_configs=self._priority_configs)
         return self.algorithm.schedule(pod, self._snapshot.node_infos, names)
 
-    def _bind(self, assumed: Pod, host: str, orig: Pod, cycle: int) -> None:
-        """Reference: the bind goroutine (scheduler.go:523) — store write +
-        FinishBinding; on failure ForgetPod + re-queue."""
+    def _bind(self, assumed: Pod, host: str, orig: Pod, cycle: int,
+              ctx: Optional[PluginContext] = None) -> None:
+        """Reference: the bind goroutine (scheduler.go:523) — Permit (may
+        wait) + Prebind + store write + FinishBinding; on failure
+        ForgetPod + Unreserve + re-queue."""
+        ctx = ctx or PluginContext()
+
+        def fail(unschedulable: bool) -> None:
+            self.cache.forget_pod(assumed)
+            self.framework.run_unreserve_plugins(ctx, assumed, host)
+            self.metrics.observe("unschedulable" if unschedulable else "error")
+            self._record_failure(orig, cycle)
+
+        st = self.framework.run_permit_plugins(ctx, assumed, host)
+        if not st.is_success():
+            fail(st.code == FW_UNSCHEDULABLE)
+            return
+        st = self.framework.run_prebind_plugins(ctx, assumed, host)
+        if not st.is_success():
+            fail(st.code == FW_UNSCHEDULABLE)
+            return
         try:
             self.store.bind_pod(assumed.key, host)
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
+            self.metrics.observe("scheduled")
         except Exception:
-            self.cache.forget_pod(assumed)
-            self._record_failure(orig, cycle)
+            fail(False)
 
     def _record_failure(self, pod: Pod, cycle: int) -> None:
         """Reference: factory.go:643 MakeDefaultErrorFunc."""
@@ -241,9 +324,47 @@ class Scheduler:
             return
         self.queue.add_unschedulable_if_not_present(current, cycle)
 
-    # -- preemption placeholder (full impl lands with the preemption kernels) --
+    # -- preemption (reference: scheduler.go:292 preempt) ----------------------
     def _preempt(self, pod: Pod, err: FitError) -> None:
+        from kubernetes_tpu.oracle.preemption import Preemptor
         self.metrics.preemption_attempts += 1
+        try:
+            updated = self.store.get(PODS, pod.key)   # factory.go:732
+        except NotFoundError:
+            return
+        preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list)
+        predicate_set_fn = None
+        if self._predicate_names is not None:
+            from kubernetes_tpu.factory import build_predicate_set
+            predicate_set_fn = lambda infos: build_predicate_set(
+                self._predicate_names, infos)
+        result = preemptor.preempt(
+            updated, self._snapshot.node_infos,
+            getattr(self, "_last_names", list(self._snapshot.node_infos)),
+            err, nominated_pods_fn=self.queue.nominated.pods_for_node,
+            predicate_set_fn=predicate_set_fn)
+        if result.node is None:
+            return
+        # in-memory nomination first (scheduler.go:310), then the API write
+        self.queue.nominated.add(updated, result.node.name)
+        try:
+            self.store.set_nominated_node_name(pod.key, result.node.name)
+        except NotFoundError:
+            self.queue.nominated.delete(updated)
+            return
+        for victim in result.victims:
+            try:
+                self.store.delete(PODS, victim.key)
+            except NotFoundError:
+                pass
+            self.metrics.preemption_victims += 1
+        # lower-priority pods lose their nomination (scheduler.go:321)
+        for p in result.nominated_to_clear:
+            self.queue.nominated.delete(p)
+            try:
+                self.store.set_nominated_node_name(p.key, "")
+            except NotFoundError:
+                pass
 
     # -- burst mode (TPU throughput path) -------------------------------------
     def schedule_burst(self, max_pods: int = 1024) -> int:
@@ -260,6 +381,16 @@ class Scheduler:
                 cycles.append(self.queue.scheduling_cycle)
         if not pods:
             return 0
+        if self.queue.nominated.has_any():
+            # nominated pods need the two-pass oracle path; drain serially,
+            # bounded to this burst, and report pods actually bound
+            for pod in pods:
+                self.queue.add(pod)
+            before = self.metrics.schedule_attempts["scheduled"]
+            for _ in range(len(pods)):
+                if not self.schedule_one(timeout=0.0):
+                    break
+            return self.metrics.schedule_attempts["scheduled"] - before
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
         hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos, names,
@@ -273,8 +404,7 @@ class Scheduler:
             assumed = pod.clone()
             assumed.node_name = host
             self.cache.assume_pod(assumed)
-            self._bind(assumed, host, pod, cycle)
-            self.metrics.observe("scheduled")
+            self._bind(assumed, host, pod, cycle)  # observes "scheduled"
             bound += 1
         return bound
 

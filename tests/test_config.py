@@ -1,0 +1,187 @@
+"""Config / policy / provider / factory tests — mirroring
+pkg/scheduler/apis/config/validation, api/validation, and
+algorithmprovider behaviors (ClusterAutoscalerProvider pack-vs-spread).
+"""
+import json
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Node, Container
+from kubernetes_tpu.apis.config import (
+    SchedulerConfiguration, AlgorithmSource, validate, ValidationError,
+)
+from kubernetes_tpu.apis.policy import (
+    Policy, validate_policy, PolicyValidationError,
+)
+from kubernetes_tpu import factory
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, PODS, NODES
+
+GI = 1024 ** 3
+
+
+def mknode(name, cpu=4000):
+    return Node(name=name, allocatable={"cpu": cpu, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, cpu=400):
+    # cpu and memory at the same fraction of allocatable (0.1 each) so
+    # BalancedResourceAllocation is neutral and pack-vs-spread is decided by
+    # Least/MostRequested alone
+    return Pod(name=name, containers=(
+        Container.make(name="c", requests={"cpu": cpu, "memory": int(3.2 * GI)}),))
+
+
+class TestConfigValidation:
+    def test_defaults_valid_and_round_trip(self):
+        cfg = SchedulerConfiguration()
+        validate(cfg)
+        d = cfg.to_dict()
+        cfg2 = SchedulerConfiguration.from_dict(json.loads(json.dumps(d)))
+        assert cfg2.scheduler_name == cfg.scheduler_name
+        assert cfg2.algorithm_source.provider == "DefaultProvider"
+        assert cfg2.percentage_of_nodes_to_score == 50
+
+    @pytest.mark.parametrize("mutate,msg", [
+        (lambda c: setattr(c, "percentage_of_nodes_to_score", 101), "percentage"),
+        (lambda c: setattr(c, "hard_pod_affinity_symmetric_weight", -1), "hard_pod"),
+        (lambda c: setattr(c, "scheduler_name", ""), "scheduler_name"),
+        (lambda c: setattr(c, "bind_timeout_seconds", 0), "bind_timeout"),
+    ])
+    def test_invalid_configs_rejected(self, mutate, msg):
+        cfg = SchedulerConfiguration()
+        mutate(cfg)
+        with pytest.raises(ValidationError) as ei:
+            validate(cfg)
+        assert msg in str(ei.value)
+
+
+class TestPolicy:
+    def test_parse_and_validate(self):
+        policy = Policy.from_json(json.dumps({
+            "predicates": [{"name": "GeneralPredicates"},
+                           {"name": "PodToleratesNodeTaints"}],
+            "priorities": [{"name": "LeastRequestedPriority", "weight": 2},
+                           {"name": "BalancedResourceAllocation", "weight": 1}],
+            "hardPodAffinitySymmetricWeight": 10,
+        }))
+        validate_policy(policy)
+        assert [p.name for p in policy.predicates] == [
+            "GeneralPredicates", "PodToleratesNodeTaints"]
+        assert policy.priorities[0].weight == 2
+        assert policy.hard_pod_affinity_symmetric_weight == 10
+
+    def test_invalid_weight_rejected(self):
+        with pytest.raises(PolicyValidationError):
+            validate_policy(Policy.from_dict(
+                {"priorities": [{"name": "x", "weight": 0}]}))
+        with pytest.raises(PolicyValidationError):
+            validate_policy(Policy.from_dict(
+                {"priorities": [{"name": "x", "weight": 1 << 40}]}))
+
+
+class TestProviders:
+    def test_default_provider_contents(self):
+        p = factory.get_algorithm_provider("DefaultProvider")
+        assert "GeneralPredicates" in p.predicate_names
+        assert dict(p.priority_weights)["LeastRequestedPriority"] == 1
+        assert dict(p.priority_weights)["NodePreferAvoidPodsPriority"] == 10000
+
+    def test_cluster_autoscaler_provider_swaps_least_for_most(self):
+        p = factory.get_algorithm_provider("ClusterAutoscalerProvider")
+        w = dict(p.priority_weights)
+        assert "LeastRequestedPriority" not in w
+        assert w["MostRequestedPriority"] == 1
+
+    def test_unknown_provider_raises(self):
+        with pytest.raises(KeyError):
+            factory.get_algorithm_provider("NopeProvider")
+
+
+def run_cluster(cfg, n_nodes=4, n_pods=12):
+    store = Store()
+    for i in range(n_nodes):
+        store.create(NODES, mknode(f"n{i}"))
+    sched = factory.create_scheduler(store, cfg)
+    sched.sync()
+    for j in range(n_pods):
+        store.create(PODS, mkpod(f"p{j}"))
+    sched.pump()
+    while sched.schedule_one(timeout=0.0):
+        pass
+    sched.pump()
+    return store, sched, [store.get(PODS, f"default/p{j}").node_name
+                          for j in range(n_pods)]
+
+
+class TestCreateScheduler:
+    @pytest.mark.parametrize("tpu", [False, True])
+    def test_default_provider_spreads(self, tpu):
+        cfg = SchedulerConfiguration(percentage_of_nodes_to_score=100)
+        cfg.feature_gates["TPUScoring"] = tpu
+        _, sched, hosts = run_cluster(cfg)
+        assert all(hosts)
+        assert len(set(hosts)) == 4  # LeastRequested spreads
+
+    @pytest.mark.parametrize("tpu", [False, True])
+    def test_autoscaler_provider_packs(self, tpu):
+        cfg = SchedulerConfiguration(
+            percentage_of_nodes_to_score=100,
+            algorithm_source=AlgorithmSource(provider="ClusterAutoscalerProvider"))
+        cfg.feature_gates["TPUScoring"] = tpu
+        _, sched, hosts = run_cluster(cfg)
+        assert all(hosts)
+        # MostRequested packs: some node carries far more than an even share
+        counts = {h: hosts.count(h) for h in set(hosts)}
+        assert max(counts.values()) >= 6
+
+    @pytest.mark.parametrize("tpu", [False, True])
+    def test_policy_inline(self, tpu):
+        cfg = SchedulerConfiguration(
+            percentage_of_nodes_to_score=100,
+            algorithm_source=AlgorithmSource(provider=None, policy_inline={
+                "predicates": [{"name": "GeneralPredicates"}],
+                "priorities": [{"name": "MostRequestedPriority", "weight": 1}],
+            }))
+        cfg.feature_gates["TPUScoring"] = tpu
+        _, sched, hosts = run_cluster(cfg)
+        assert all(hosts)
+        counts = {h: hosts.count(h) for h in set(hosts)}
+        assert max(counts.values()) >= 6  # packing policy
+
+    def test_tpu_and_oracle_agree_under_policy(self):
+        def run(tpu):
+            cfg = SchedulerConfiguration(
+                percentage_of_nodes_to_score=100,
+                algorithm_source=AlgorithmSource(provider=None, policy_inline={
+                    "predicates": [{"name": "GeneralPredicates"},
+                                   {"name": "PodToleratesNodeTaints"}],
+                    "priorities": [{"name": "LeastRequestedPriority", "weight": 2},
+                                   {"name": "BalancedResourceAllocation", "weight": 1},
+                                   {"name": "TaintTolerationPriority", "weight": 3}],
+                }))
+            cfg.feature_gates["TPUScoring"] = tpu
+            return run_cluster(cfg, n_nodes=6, n_pods=24)[2]
+        assert run(True) == run(False)
+
+    def test_unsupported_priority_falls_back_to_oracle(self):
+        factory.register_priority(
+            "CustomPriority",
+            lambda w, s, r, h: __import__(
+                "kubernetes_tpu.oracle.generic_scheduler",
+                fromlist=["PriorityConfig"]).PriorityConfig(
+                    "CustomPriority", w,
+                    map_fn=lambda pod, ni: 5))
+        try:
+            cfg = SchedulerConfiguration(
+                percentage_of_nodes_to_score=100,
+                algorithm_source=AlgorithmSource(provider=None, policy_inline={
+                    "priorities": [{"name": "CustomPriority", "weight": 1}],
+                }))
+            store = Store()
+            store.create(NODES, mknode("n0"))
+            sched = factory.create_scheduler(store, cfg)
+            from kubernetes_tpu.oracle.generic_scheduler import GenericScheduler
+            assert isinstance(sched.algorithm, GenericScheduler)
+        finally:
+            factory._EXTRA_PRIORITIES.pop("CustomPriority", None)

@@ -1,0 +1,191 @@
+"""Plugin framework tests — behavior cases mirroring
+test/integration/scheduler/framework_test.go (reserve/prebind/permit/
+unreserve plugins driving real scheduling cycles).
+"""
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Node, Container
+from kubernetes_tpu.framework.v1alpha1 import (
+    Framework, Registry, PluginContext, Status, SUCCESS, ERROR, UNSCHEDULABLE,
+    WAIT, ReservePlugin, PrebindPlugin, UnreservePlugin, PermitPlugin,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, PODS, NODES
+from kubernetes_tpu.utils.clock import FakeClock
+
+GI = 1024 ** 3
+
+
+def mknode(name):
+    return Node(name=name, allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name):
+    return Pod(name=name, containers=(Container.make(name="c", requests={"cpu": 100}),))
+
+
+class RecordingReserve(ReservePlugin):
+    NAME = "recording-reserve"
+
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def reserve(self, ctx, pod, node_name):
+        self.calls.append((pod.name, node_name))
+        ctx.write("reserved-on", node_name)
+        return Status(ERROR, "boom") if self.fail else Status.success()
+
+
+class RecordingPrebind(PrebindPlugin):
+    NAME = "recording-prebind"
+
+    def __init__(self, code=SUCCESS):
+        self.calls = []
+        self.code = code
+
+    def prebind(self, ctx, pod, node_name):
+        # sees what reserve wrote in the same cycle
+        self.calls.append((pod.name, node_name, ctx.read("reserved-on")))
+        return Status(self.code, "nope" if self.code != SUCCESS else "")
+
+
+class RecordingUnreserve(UnreservePlugin):
+    NAME = "recording-unreserve"
+
+    def __init__(self):
+        self.calls = []
+
+    def unreserve(self, ctx, pod, node_name):
+        self.calls.append((pod.name, node_name))
+
+
+class GatePermit(PermitPlugin):
+    NAME = "gate-permit"
+
+    def __init__(self, decision="allow", timeout=1.0):
+        self.decision = decision
+        self.timeout = timeout
+        self.framework = None
+
+    def permit(self, ctx, pod, node_name):
+        if self.decision == "allow-immediately":
+            return Status.success(), 0.0
+        if self.decision == "reject-immediately":
+            return Status(UNSCHEDULABLE, "rejected"), 0.0
+        # wait: spawn a thread to decide
+        def decide():
+            wp = None
+            while wp is None:
+                wp = self.framework.get_waiting_pod(pod.uid)
+            if self.decision == "allow":
+                wp.allow()
+            elif self.decision == "reject":
+                wp.reject()
+            # "timeout": do nothing
+        threading.Thread(target=decide, daemon=True).start()
+        return Status(WAIT, ""), self.timeout
+
+
+def make_scheduler(store, plugins, args=None):
+    reg = Registry()
+    for p in plugins:
+        reg.register(p.NAME, lambda _args, _handle, _p=p: _p)
+    return Scheduler(store, percentage_of_nodes_to_score=100,
+                     plugin_registry=reg, clock=FakeClock())
+
+
+def run_all(sched):
+    sched.pump()
+    while sched.schedule_one(timeout=0.0):
+        pass
+    sched.wait_for_binds()  # permit plugins make binding async
+    sched.pump()
+
+
+class TestFrameworkPoints:
+    def test_reserve_and_prebind_share_context(self):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        res, pre = RecordingReserve(), RecordingPrebind()
+        sched = make_scheduler(store, [res, pre])
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        assert res.calls == [("p1", "n1")]
+        assert pre.calls == [("p1", "n1", "n1")]
+        assert store.get(PODS, "default/p1").node_name == "n1"
+
+    def test_reserve_failure_blocks_binding(self):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        sched = make_scheduler(store, [RecordingReserve(fail=True)])
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        assert store.get(PODS, "default/p1").node_name == ""
+        assert sched.metrics.schedule_attempts["error"] == 1
+        assert sched.queue.num_pending() == 1  # re-queued
+
+    def test_prebind_failure_unreserves(self):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        unres = RecordingUnreserve()
+        sched = make_scheduler(store, [RecordingReserve(),
+                                       RecordingPrebind(code=ERROR), unres])
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        assert store.get(PODS, "default/p1").node_name == ""
+        assert unres.calls == [("p1", "n1")]
+        # the assume was rolled back
+        assert sched.cache.pod_count() == 0
+
+    @pytest.mark.parametrize("decision,binds", [
+        ("allow-immediately", True),
+        ("reject-immediately", False),
+        ("allow", True),
+        ("reject", False),
+        ("timeout", False),
+    ])
+    def test_permit_decisions(self, decision, binds):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        gate = GatePermit(decision=decision, timeout=0.3)
+        sched = make_scheduler(store, [gate])
+        gate.framework = sched.framework
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        bound = store.get(PODS, "default/p1").node_name
+        assert bool(bound) == binds
+        if not binds:
+            assert sched.cache.pod_count() == 0  # forget rolled back
+
+
+class TestRegistry:
+    def test_duplicate_registration_rejected(self):
+        reg = Registry()
+        reg.register("x", lambda a, h: RecordingReserve())
+        with pytest.raises(ValueError):
+            reg.register("x", lambda a, h: RecordingReserve())
+        reg.unregister("x")
+        reg.register("x", lambda a, h: RecordingReserve())
+
+    def test_enabled_subset(self):
+        reg = Registry()
+        r1, r2 = RecordingReserve(), RecordingReserve()
+        reg.register("a", lambda a, h: r1)
+        reg.register("b", lambda a, h: r2)
+        fw = Framework(reg, enabled=["b"])
+        assert fw.reserve == [r2]
+
+    def test_plugin_context_isolation(self):
+        ctx = PluginContext()
+        ctx.write("k", 1)
+        assert ctx.read("k") == 1
+        ctx.delete("k")
+        with pytest.raises(KeyError):
+            ctx.read("k")

@@ -1,0 +1,305 @@
+"""Preemption tests — behavior cases mirroring the reference's
+generic_scheduler_test.go preemption tables and
+test/integration/scheduler/preemption_test.go (incl. PDB cases).
+"""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, LabelSelector, PodDisruptionBudget,
+)
+from kubernetes_tpu.api.quantity import requests
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.oracle import predicates as preds
+from kubernetes_tpu.oracle.generic_scheduler import GenericScheduler, FitError
+from kubernetes_tpu.oracle.preemption import (
+    Victims, Preemptor, select_victims_on_node, pick_one_node_for_preemption,
+    nodes_where_preemption_might_help, pod_eligible_to_preempt_others,
+    pod_fits_on_node_with_nominated,
+)
+
+GI = 1024 ** 3
+
+
+def mknode(name, cpu=4000, mem=32 * GI, pods=110):
+    return Node(name=name, allocatable={"cpu": cpu, "memory": mem, "pods": pods})
+
+
+def mkpod(name, cpu=1000, priority=0, node="", labels=None, start=None):
+    return Pod(name=name, priority=priority, node_name=node,
+               labels=labels or {}, start_time=start,
+               containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+def snapshot(nodes, pods_by_node):
+    infos = {}
+    for n in nodes:
+        ni = NodeInfo(n)
+        for p in pods_by_node.get(n.name, []):
+            p.node_name = n.name
+            ni.add_pod(p)
+        infos[n.name] = ni
+    return infos
+
+
+def fits(node_infos):
+    funcs = preds.default_predicate_set(node_infos)
+
+    def f(pod, ni):
+        ok, _ = preds.pod_fits_on_node(pod, ni, funcs)
+        return ok
+    return f
+
+
+class TestSelectVictims:
+    def test_reprieves_what_fits(self):
+        """Only as many victims as needed; higher-priority pods reprieved first."""
+        node = mknode("n1", cpu=4000)
+        low1 = mkpod("low1", cpu=1500, priority=1)
+        low2 = mkpod("low2", cpu=1500, priority=2)
+        low3 = mkpod("low3", cpu=1000, priority=3)
+        infos = snapshot([node], {"n1": [low1, low2, low3]})
+        preemptor = mkpod("pre", cpu=1500, priority=10)
+        v = select_victims_on_node(preemptor, infos["n1"], fits(infos), [])
+        assert v is not None
+        # need 1500 free: reprieve order low3(p3), low2(p2) fills 4000-? ...
+        # after removing all (1000 free + 3000 released): add back low3 (2500
+        # used incl preemptor), add back low2 (4000 used) -> low1 can't return
+        assert [p.name for p in v.pods] == ["low1"]
+        assert v.num_pdb_violations == 0
+
+    def test_no_help_when_higher_priority_blocks(self):
+        node = mknode("n1", cpu=2000)
+        high = mkpod("high", cpu=2000, priority=100)
+        infos = snapshot([node], {"n1": [high]})
+        preemptor = mkpod("pre", cpu=1000, priority=10)
+        assert select_victims_on_node(preemptor, infos["n1"], fits(infos), []) is None
+
+    def test_pdb_violating_reprieved_first(self):
+        """PDB-protected pods are re-added before unprotected ones, so the
+        unprotected pod becomes the victim even at equal priority."""
+        node = mknode("n1", cpu=3000)
+        protected = mkpod("protected", cpu=1000, priority=1,
+                          labels={"app": "guarded"})
+        plain = mkpod("plain", cpu=1000, priority=1)
+        infos = snapshot([node], {"n1": [protected, plain]})
+        pdbs = [PodDisruptionBudget(
+            name="pdb", selector=LabelSelector.from_dict({"app": "guarded"}),
+            disruptions_allowed=0)]
+        preemptor = mkpod("pre", cpu=2000, priority=10)
+        v = select_victims_on_node(preemptor, infos["n1"], fits(infos), pdbs)
+        assert [p.name for p in v.pods] == ["plain"]
+        assert v.num_pdb_violations == 0
+
+
+class TestPickOneNode:
+    def mkv(self, *specs):
+        """specs: (name, [(priority, start)], pdb_violations)"""
+        out = {}
+        for name, victims, pdb in specs:
+            out[name] = Victims(
+                pods=[mkpod(f"{name}-v{i}", priority=pr, start=st)
+                      for i, (pr, st) in enumerate(victims)],
+                num_pdb_violations=pdb)
+        return out
+
+    def test_no_victims_wins(self):
+        v = self.mkv(("a", [(5, 1.0)], 0), ("b", [], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+    def test_min_pdb_violations(self):
+        v = self.mkv(("a", [(1, 1.0)], 1), ("b", [(9, 1.0)], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+    def test_min_highest_priority(self):
+        v = self.mkv(("a", [(9, 1.0)], 0), ("b", [(5, 1.0), (5, 1.0)], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+    def test_min_sum_priorities(self):
+        v = self.mkv(("a", [(5, 1.0), (5, 1.0)], 0), ("b", [(5, 1.0), (1, 1.0)], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+    def test_fewest_victims(self):
+        v = self.mkv(("a", [(5, 1.0), (1, 1.0), (1, 1.0)], 0),
+                     ("b", [(5, 1.0), (2, 1.0)], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+    def test_latest_start_time(self):
+        v = self.mkv(("a", [(5, 100.0)], 0), ("b", [(5, 200.0)], 0))
+        assert pick_one_node_for_preemption(v) == "b"
+
+
+class TestCandidateNodes:
+    def test_unresolvable_failures_excluded(self):
+        infos = snapshot([mknode("n1"), mknode("n2"), mknode("n3")], {})
+        failed = {
+            "n1": [preds.insufficient_resource("cpu")],
+            "n2": [preds.ERR_TAINTS_TOLERATIONS_NOT_MATCH],
+            "n3": [preds.ERR_NODE_SELECTOR_NOT_MATCH],
+        }
+        out = nodes_where_preemption_might_help(infos, ["n1", "n2", "n3"], failed)
+        assert out == ["n1"]
+
+    def test_eligibility_with_terminating_victim(self):
+        node = mknode("n1")
+        dying = mkpod("dying", priority=1, node="n1")
+        dying.deleted = True
+        infos = snapshot([node], {})
+        infos["n1"].add_pod(dying)
+        pre = mkpod("pre", priority=10)
+        pre.nominated_node_name = "n1"
+        assert not pod_eligible_to_preempt_others(pre, infos)
+        pre2 = mkpod("pre2", priority=0)   # victim not lower priority
+        pre2.nominated_node_name = "n1"
+        assert pod_eligible_to_preempt_others(pre2, infos)
+
+
+class TestPreemptor:
+    def test_picks_cheapest_node(self):
+        nodes = [mknode("n1", cpu=2000), mknode("n2", cpu=2000)]
+        infos = snapshot(nodes, {
+            "n1": [mkpod("v1", cpu=2000, priority=50)],
+            "n2": [mkpod("v2", cpu=2000, priority=5)],
+        })
+        pre = mkpod("pre", cpu=1000, priority=100)
+        sched = GenericScheduler(percentage_of_nodes_to_score=100)
+        with pytest.raises(FitError) as ei:
+            sched.schedule(pre, infos, ["n1", "n2"])
+        result = Preemptor().preempt(pre, infos, ["n1", "n2"], ei.value)
+        assert result.node.name == "n2"
+        assert [p.name for p in result.victims] == ["v2"]
+
+    def test_no_candidates_returns_none(self):
+        nodes = [mknode("n1", cpu=2000)]
+        infos = snapshot(nodes, {"n1": [mkpod("high", cpu=2000, priority=200)]})
+        pre = mkpod("pre", cpu=1000, priority=100)
+        sched = GenericScheduler(percentage_of_nodes_to_score=100)
+        with pytest.raises(FitError) as ei:
+            sched.schedule(pre, infos, ["n1"])
+        result = Preemptor().preempt(pre, infos, ["n1"], ei.value)
+        assert result.node is None
+
+
+class TestNominatedTwoPass:
+    def test_nominated_pod_reserves_capacity(self):
+        """A lower-priority pod must not squeeze out a nominated pod: pass 1
+        (with the ghost) fails on resources."""
+        node = mknode("n1", cpu=2000)
+        infos = snapshot([node], {})
+        nominated = mkpod("nominated", cpu=1500, priority=100)
+        funcs = preds.default_predicate_set(infos)
+        newcomer = mkpod("newcomer", cpu=1000, priority=1)
+        fit, reasons = pod_fits_on_node_with_nominated(
+            newcomer, infos["n1"], funcs, lambda n: [nominated])
+        assert not fit
+        assert preds.insufficient_resource("cpu") in reasons
+        # a higher-priority newcomer ignores the lower-priority nomination
+        big = mkpod("big", cpu=1000, priority=200)
+        fit, _ = pod_fits_on_node_with_nominated(
+            big, infos["n1"], funcs, lambda n: [nominated])
+        assert fit
+
+
+class TestShellPreemption:
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_end_to_end_preempt_and_bind(self, use_tpu):
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store()
+        store.create(NODES, mknode("n1", cpu=2000, pods=10))
+        sched = Scheduler(store, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100, clock=clock)
+        sched.sync()
+        # fill the node with low-priority pods
+        for j in range(2):
+            store.create(PODS, mkpod(f"low{j}", cpu=1000, priority=1))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert all(store.get(PODS, f"default/low{j}").node_name for j in range(2))
+        # high-priority pod arrives; must preempt
+        store.create(PODS, mkpod("urgent", cpu=1000, priority=1000))
+        sched.pump()
+        assert sched.schedule_one(timeout=0.0)   # fails + preempts
+        assert sched.metrics.preemption_attempts == 1
+        assert sched.metrics.preemption_victims == 1
+        urgent = store.get(PODS, "default/urgent")
+        assert urgent.nominated_node_name == "n1"
+        # victim deletion flows through the watch; retry after backoff
+        sched.pump()
+        clock.step(1.1)
+        for _ in range(5):
+            sched.schedule_one(timeout=0.0)
+            sched.pump()
+            if store.get(PODS, "default/urgent").node_name:
+                break
+        assert store.get(PODS, "default/urgent").node_name == "n1"
+
+
+class TestPickOneNodeReferenceSubtleties:
+    """Exact mirrors of the reference's non-obvious behaviors (:876,:899)."""
+
+    def test_first_victim_priority_not_true_max(self):
+        """Pods[0] (top PDB-violating victim) decides criterion 2 even when a
+        later non-violating victim has higher priority."""
+        va = Victims(pods=[mkpod("a-viol", priority=3),
+                           mkpod("a-plain", priority=9)], num_pdb_violations=1)
+        vb = Victims(pods=[mkpod("b-viol", priority=5)], num_pdb_violations=1)
+        # criterion 2 compares 3 (a) vs 5 (b): a wins despite its max being 9
+        assert pick_one_node_for_preemption({"a": va, "b": vb}) == "a"
+
+    def test_sum_offset_makes_count_dominate_negatives(self):
+        """Two victims at priority -5 must lose to one victim at -5 (the 2^31
+        offset per pod makes count dominate)."""
+        va = Victims(pods=[mkpod("a1", priority=-5), mkpod("a2", priority=-5)])
+        vb = Victims(pods=[mkpod("b1", priority=-5)])
+        assert pick_one_node_for_preemption({"a": va, "b": vb}) == "b"
+
+    def test_latest_earliest_start_of_highest_priority(self):
+        """Criterion 5 looks at the EARLIEST start among the highest-priority
+        victims per node, then picks the node where that is LATEST."""
+        va = Victims(pods=[mkpod("a1", priority=5, start=100.0),
+                           mkpod("a2", priority=5, start=900.0)])
+        vb = Victims(pods=[mkpod("b1", priority=5, start=200.0),
+                           mkpod("b2", priority=5, start=300.0)])
+        # earliest-of-highest: a=100, b=200 -> b is later -> b wins
+        assert pick_one_node_for_preemption({"a": va, "b": vb}) == "b"
+
+
+class TestDoublePreemptorCoordination:
+    """Two equal-priority preemptors must not live-lock: victim selection
+    runs the nominated-ghost two-pass (reference passes the scheduling queue
+    into selectVictimsOnNode, generic_scheduler.go:985)."""
+
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_two_urgent_pods_both_bind(self, use_tpu):
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        store = Store()
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}", cpu=2000))
+        sched = Scheduler(store, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100, clock=clock)
+        sched.sync()
+        for j in range(6):
+            store.create(PODS, mkpod(f"low{j}", cpu=1000, priority=1))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        store.create(PODS, mkpod("urgent-a", cpu=1000, priority=100))
+        store.create(PODS, mkpod("urgent-b", cpu=1000, priority=100))
+        sched.pump()
+        for _ in range(12):
+            sched.schedule_one(timeout=0.0)
+            sched.pump()
+            clock.step(1.2)
+        assert store.get(PODS, "default/urgent-a").node_name
+        assert store.get(PODS, "default/urgent-b").node_name
+        assert sched.metrics.preemption_victims == 2
