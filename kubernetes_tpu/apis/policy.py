@@ -48,6 +48,16 @@ class Policy:
 
     @staticmethod
     def from_dict(d: dict) -> "Policy":
+        def snake(name: str) -> str:
+            out = []
+            for ch in name:
+                if ch.isupper():
+                    out.append("_")
+                    out.append(ch.lower())
+                else:
+                    out.append(ch)
+            return "".join(out)
+
         p = Policy()
         for pd in d.get("predicates", []):
             p.predicates.append(PredicatePolicy(name=pd["name"]))
@@ -55,10 +65,24 @@ class Policy:
             p.priorities.append(PriorityPolicy(
                 name=pr["name"], weight=pr.get("weight", 1)))
         for ex in d.get("extenders", []):
-            p.extenders.append(ExtenderConfig(**{
-                k: ex[k] for k in ExtenderConfig.__dataclass_fields__ if k in ex}))
+            # accept both the reference's camelCase keys (urlPrefix,
+            # filterVerb, managedResources...) and snake_case
+            fields = ExtenderConfig.__dataclass_fields__
+            kw = {}
+            for key, value in ex.items():
+                norm = key if key in fields else snake(key)
+                if norm in fields:
+                    if norm == "managed_resources":
+                        # reference shape: [{"name": "example.com/gpu"}, ...]
+                        value = tuple(
+                            m["name"] if isinstance(m, dict) else m
+                            for m in value)
+                    kw[norm] = value
+            p.extenders.append(ExtenderConfig(**kw))
         if "hardPodAffinitySymmetricWeight" in d:
             p.hard_pod_affinity_symmetric_weight = d["hardPodAffinitySymmetricWeight"]
+        elif "hard_pod_affinity_symmetric_weight" in d:
+            p.hard_pod_affinity_symmetric_weight = d["hard_pod_affinity_symmetric_weight"]
         return p
 
     @staticmethod

@@ -1,0 +1,84 @@
+"""Cache debugger: dump + cache-vs-informer comparison.
+
+Mirrors pkg/scheduler/internal/cache/debugger/: CacheDumper.DumpAll
+(dumper.go:39), CacheComparer.Compare (comparer.go:41) — the SIGUSR2
+diagnostics that catch cache drift against the authoritative informers.
+"""
+from __future__ import annotations
+
+import json
+import signal
+from typing import Optional
+
+from kubernetes_tpu.store.informer import SharedInformer
+
+
+class CacheComparer:
+    """Compare the scheduler cache (+ queue) against informer truth."""
+
+    def __init__(self, cache, queue, pod_informer: SharedInformer,
+                 node_informer: SharedInformer):
+        self.cache = cache
+        self.queue = queue
+        self.pod_informer = pod_informer
+        self.node_informer = node_informer
+
+    def compare_nodes(self) -> list[str]:
+        informer_nodes = {n.name for n in self.node_informer.list()}
+        cached = set(self.cache.dump()["nodes"])
+        problems = []
+        for name in informer_nodes - cached:
+            problems.append(f"node {name} in informer but not in cache")
+        for name in cached - informer_nodes:
+            problems.append(f"node {name} in cache but not in informer")
+        return problems
+
+    def compare_pods(self) -> list[str]:
+        """Assigned/assumed pods must match informer + queue state
+        (comparer.go ComparePods: cached = assigned ∪ assumed; informer
+        assigned ∪ queued must cover it)."""
+        informer_assigned = {p.key for p in self.pod_informer.list()
+                            if p.node_name}
+        dump = self.cache.dump()
+        cached_pods = {key for node in dump["nodes"].values()
+                       for key in node["pods"]}
+        assumed = set(dump["assumed_pods"])
+        problems = []
+        for key in informer_assigned - cached_pods:
+            problems.append(f"pod {key} assigned in informer but not in cache")
+        for key in cached_pods - informer_assigned - assumed:
+            problems.append(f"pod {key} in cache but not assigned in informer")
+        return problems
+
+    def compare(self) -> list[str]:
+        return self.compare_nodes() + self.compare_pods()
+
+
+class CacheDumper:
+    def __init__(self, cache, queue):
+        self.cache = cache
+        self.queue = queue
+
+    def dump_all(self) -> str:
+        pending = self.queue.pending_pods()
+        return json.dumps({
+            "cache": self.cache.dump(),
+            "queue": {name: [p.key for p in pods]
+                      for name, pods in pending.items()},
+        }, indent=2)
+
+
+class CacheDebugger:
+    """debugger.go:29 — wires comparer+dumper, optionally onto SIGUSR2."""
+
+    def __init__(self, cache, queue, pod_informer, node_informer):
+        self.comparer = CacheComparer(cache, queue, pod_informer, node_informer)
+        self.dumper = CacheDumper(cache, queue)
+
+    def listen_for_signal(self, signum: int = signal.SIGUSR2) -> None:
+        def handler(_sig, _frame):
+            problems = self.comparer.compare()
+            print(self.dumper.dump_all())
+            for p in problems:
+                print("CACHE DRIFT:", p)
+        signal.signal(signum, handler)

@@ -1,0 +1,189 @@
+"""Scheduler command — cmd/kube-scheduler analog.
+
+Mirrors cmd/kube-scheduler/app/server.go: flag/config layering
+(options → SchedulerConfiguration → algorithm source), optional leader
+election (:248-263), healthz (:201) and /metrics (:284) endpoints, then the
+scheduling loop. The cluster substrate is the in-process store, loaded from
+a cluster-spec JSON (hollow nodes + pods) or left empty for API-driven use.
+
+Run: python -m kubernetes_tpu.cmd.scheduler --cluster-spec spec.json --once
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kubernetes_tpu.apis.config import (
+    SchedulerConfiguration, AlgorithmSource,
+)
+from kubernetes_tpu.factory import create_scheduler
+from kubernetes_tpu.metrics import render_metrics, reset_metrics
+from kubernetes_tpu.models.hollow import NodeStrategy, PodStrategy, populate_store, make_pods
+from kubernetes_tpu.store.store import Store, PODS
+from kubernetes_tpu.utils.leader_election import LeaderElector, LeaderElectionConfig
+
+
+def build_config(args) -> SchedulerConfiguration:
+    if args.config:
+        cfg = SchedulerConfiguration.from_file(args.config)
+    else:
+        cfg = SchedulerConfiguration()
+    if args.algorithm_provider:
+        cfg.algorithm_source = AlgorithmSource(provider=args.algorithm_provider)
+    if args.policy_config_file:
+        cfg.algorithm_source = AlgorithmSource(
+            provider=None, policy_file=args.policy_config_file)
+    if args.scheduler_name:
+        cfg.scheduler_name = args.scheduler_name
+    if args.percentage_of_nodes_to_score is not None:
+        cfg.percentage_of_nodes_to_score = args.percentage_of_nodes_to_score
+    if args.disable_preemption:
+        cfg.disable_preemption = True
+    if args.leader_elect:
+        cfg.leader_election.leader_elect = True
+    if args.feature_gates:
+        for item in args.feature_gates.split(","):
+            key, _, value = item.partition("=")
+            cfg.feature_gates[key.strip()] = value.strip().lower() != "false"
+    return cfg
+
+
+def load_cluster_spec(store: Store, path: str) -> None:
+    """Cluster-spec JSON: {"nodes": [NodeStrategy kwargs...],
+    "existing_pods": [PodStrategy kwargs...], "pending_pods": [...]}"""
+    with open(path) as f:
+        spec = json.load(f)
+    node_strategies = [NodeStrategy(**n) for n in spec.get("nodes", [])]
+    existing = [PodStrategy(**p) for p in spec.get("existing_pods", [])]
+    populate_store(store, node_strategies, existing)
+    idx = 0
+    for p in spec.get("pending_pods", []):
+        st = PodStrategy(**p)
+        for pod in make_pods(st, start_index=idx):
+            store.create(PODS, pod)
+        idx += st.count
+
+
+class _Handler(BaseHTTPRequestHandler):
+    scheduler = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, code: int, body: str, ctype: str = "text/plain"):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, "ok")
+        elif self.path == "/metrics":
+            self._send(200, render_metrics(self.scheduler))
+        elif self.path == "/configz":
+            self._send(200, json.dumps(self.scheduler_config.to_dict()),
+                       "application/json")
+        else:
+            self._send(404, "not found")
+
+    def do_DELETE(self):
+        if self.path == "/metrics":
+            reset_metrics(self.scheduler)
+            self._send(200, "reset")
+        else:
+            self._send(404, "not found")
+
+
+def serve_http(sched, cfg, port: int) -> ThreadingHTTPServer:
+    handler = type("Handler", (_Handler,), {
+        "scheduler": sched, "scheduler_config": cfg})
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kube-scheduler-tpu")
+    ap.add_argument("--config", help="SchedulerConfiguration JSON file")
+    ap.add_argument("--algorithm-provider")
+    ap.add_argument("--policy-config-file")
+    ap.add_argument("--scheduler-name")
+    ap.add_argument("--percentage-of-nodes-to-score", type=int)
+    ap.add_argument("--disable-preemption", action="store_true")
+    ap.add_argument("--leader-elect", action="store_true")
+    ap.add_argument("--leader-elect-identity", default="scheduler-0")
+    ap.add_argument("--feature-gates", help="k=v,k2=v2 (e.g. TPUScoring=false)")
+    ap.add_argument("--cluster-spec", help="cluster-spec JSON to load")
+    ap.add_argument("--port", type=int, default=0, help="healthz/metrics port")
+    ap.add_argument("--once", action="store_true",
+                    help="drain the queue once and exit (bench/CI mode)")
+    ap.add_argument("--burst", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    cfg = build_config(args)
+    store = Store(watch_log_size=1 << 20)
+    if args.cluster_spec:
+        load_cluster_spec(store, args.cluster_spec)
+    sched = create_scheduler(store, cfg)
+    sched.sync()
+    server = serve_http(sched, cfg, args.port) if args.port else None
+
+    def run_loop():
+        sched.pump()
+        if args.once:
+            while (sched.schedule_burst(max_pods=args.burst)
+                   if args.burst else sched.schedule_one(timeout=0.0)):
+                pass
+            sched.pump()
+        else:
+            sched.run()
+
+    if cfg.leader_election.leader_elect:
+        # the scheduling loop runs on its own thread so the elector keeps
+        # renewing the lease (client-go runs OnStartedLeading in a goroutine
+        # while the renew loop continues — otherwise a blocked winner stops
+        # renewing and a second instance goes active: split-brain)
+        loop_done = threading.Event()
+
+        def start_leading():
+            def wrapped():
+                try:
+                    run_loop()
+                finally:
+                    loop_done.set()
+            threading.Thread(target=wrapped, daemon=True).start()
+
+        elector = LeaderElector(store, LeaderElectionConfig(
+            lock_name=cfg.leader_election.lock_object_name,
+            identity=args.leader_elect_identity,
+            lease_duration=cfg.leader_election.lease_duration,
+            renew_deadline=cfg.leader_election.renew_deadline,
+            retry_period=cfg.leader_election.retry_period,
+            on_started_leading=start_leading,
+            on_stopped_leading=lambda: sched.stop()))
+        while not loop_done.is_set():
+            elector.step()
+            if loop_done.wait(cfg.leader_election.retry_period):
+                break
+        elector.release()
+    else:
+        run_loop()
+
+    if args.once:
+        attempts = sched.metrics.schedule_attempts
+        print(json.dumps({"scheduled": attempts["scheduled"],
+                          "unschedulable": attempts["unschedulable"],
+                          "errors": attempts["error"]}))
+    if server:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

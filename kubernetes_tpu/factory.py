@@ -41,7 +41,9 @@ def build_predicate_set(names: list[str],
     """CreateFromKeys predicate assembly: the named subset, evaluated in
     predicates.PREDICATE_ORDERING."""
     base = preds.default_predicate_set(node_infos)
-    out = {}
+    # keep the metadata-invalidation handle (not a predicate; preemption and
+    # the nominated-ghost two-pass need it)
+    out = {"_ipa_checker": base["_ipa_checker"]}
     for name in names:
         if name in base:
             out[name] = base[name]
@@ -239,19 +241,28 @@ def resolve_algorithm(cfg: SchedulerConfiguration
             Policy())
 
 
-def create_scheduler(store, cfg: Optional[SchedulerConfiguration] = None, **kw):
+def create_scheduler(store, cfg: Optional[SchedulerConfiguration] = None,
+                     extender_endpoints: Optional[dict] = None, **kw):
     """cmd/kube-scheduler Run + scheduler.New analog: validated config in,
-    fully wired Scheduler out."""
+    fully wired Scheduler out. `extender_endpoints` maps extender url_prefix
+    to a callable-endpoint dict for in-process extenders."""
     from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.core.extender import SchedulerExtender
     cfg = cfg or SchedulerConfiguration()
     validate(cfg)
     pred_names, prio_weights, policy = resolve_algorithm(cfg)
     hard_weight = (policy.hard_pod_affinity_symmetric_weight
                    if policy.hard_pod_affinity_symmetric_weight is not None
                    else cfg.hard_pod_affinity_symmetric_weight)
+    extenders = [
+        SchedulerExtender(ec, endpoints=(extender_endpoints or {}).get(
+            ec.url_prefix))
+        for ec in policy.extenders]
     use_tpu = bool(cfg.feature_gates.get("TPUScoring")) \
         and tpu_kernel_weights(prio_weights) is not None \
-        and tpu_supports_predicates(pred_names)
+        and tpu_supports_predicates(pred_names) \
+        and not extenders
+    kw.setdefault("extenders", extenders)
     return Scheduler(
         store,
         scheduler_name=cfg.scheduler_name,

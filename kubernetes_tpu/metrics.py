@@ -1,0 +1,67 @@
+"""Metrics exposition — pkg/scheduler/metrics/metrics.go analog.
+
+Renders the scheduler's counters and queue gauges in Prometheus text
+exposition format (the /metrics endpoint payload, server.go:284-295).
+The metric names mirror the reference's set: schedule_attempts_total,
+binding totals, preemption counters, pending_pods by queue.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from kubernetes_tpu.scheduler import Scheduler
+
+PREFIX = "scheduler"
+
+
+def render_metrics(sched: "Scheduler") -> str:
+    """One scrape of the scheduler's metric families."""
+    m = sched.metrics
+    pending = sched.queue.pending_pods()
+    lines = [
+        f"# HELP {PREFIX}_schedule_attempts_total Number of attempts to schedule pods, by result.",
+        f"# TYPE {PREFIX}_schedule_attempts_total counter",
+    ]
+    for result, count in sorted(m.schedule_attempts.items()):
+        lines.append(
+            f'{PREFIX}_schedule_attempts_total{{result="{result}"}} {count}')
+    lines += [
+        f"# HELP {PREFIX}_binding_total Number of successful pod bindings.",
+        f"# TYPE {PREFIX}_binding_total counter",
+        f"{PREFIX}_binding_total {m.binding_count}",
+        f"# HELP {PREFIX}_total_preemption_attempts Total preemption attempts.",
+        f"# TYPE {PREFIX}_total_preemption_attempts counter",
+        f"{PREFIX}_total_preemption_attempts {m.preemption_attempts}",
+        f"# HELP {PREFIX}_pod_preemption_victims Number of preemption victims.",
+        f"# TYPE {PREFIX}_pod_preemption_victims counter",
+        f"{PREFIX}_pod_preemption_victims {m.preemption_victims}",
+        f"# HELP {PREFIX}_e2e_scheduling_duration_seconds_sum Sum of end-to-end scheduling latency.",
+        f"# TYPE {PREFIX}_e2e_scheduling_duration_seconds_sum counter",
+        f"{PREFIX}_e2e_scheduling_duration_seconds_sum {m.e2e_latency_sum:.6f}",
+        f"# HELP {PREFIX}_pending_pods Pending pods by queue.",
+        f"# TYPE {PREFIX}_pending_pods gauge",
+    ]
+    for queue_name in ("active", "backoff", "unschedulable"):
+        lines.append(
+            f'{PREFIX}_pending_pods{{queue="{queue_name}"}} '
+            f'{len(pending[queue_name])}')
+    lines += [
+        f"# HELP {PREFIX}_cache_nodes Nodes tracked by the scheduler cache.",
+        f"# TYPE {PREFIX}_cache_nodes gauge",
+        f"{PREFIX}_cache_nodes {sched.cache.node_count()}",
+        f"# HELP {PREFIX}_cache_pods Pods tracked by the scheduler cache.",
+        f"# TYPE {PREFIX}_cache_pods gauge",
+        f"{PREFIX}_cache_pods {sched.cache.pod_count()}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def reset_metrics(sched: "Scheduler") -> None:
+    """DELETE /metrics analog (metrics.Reset, metrics.go:242)."""
+    m = sched.metrics
+    m.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
+    m.binding_count = 0
+    m.preemption_attempts = 0
+    m.preemption_victims = 0
+    m.e2e_latency_sum = 0.0

@@ -29,14 +29,16 @@ class Victims:
     num_pdb_violations: int = 0
 
 
+def importance_key(p: Pod):
+    """Sort key for descending importance (reference:
+    pkg/scheduler/util.MoreImportantPod — higher priority first, ties broken
+    by earlier start time). The single source for victim ordering."""
+    start = p.start_time if p.start_time is not None else float("inf")
+    return (-p.priority, start)
+
+
 def more_important_pod(a: Pod, b: Pod) -> bool:
-    """Reference: pkg/scheduler/util.MoreImportantPod — higher priority wins;
-    ties broken by earlier start time."""
-    if a.priority != b.priority:
-        return a.priority > b.priority
-    a_start = a.start_time if a.start_time is not None else float("inf")
-    b_start = b.start_time if b.start_time is not None else float("inf")
-    return a_start < b_start
+    return importance_key(a) < importance_key(b)
 
 
 def pod_eligible_to_preempt_others(pod: Pod,
@@ -102,8 +104,8 @@ def select_victims_on_node(pod: Pod, node_info: NodeInfo,
     violating = pods_violating_pdbs(potential, pdbs)
     violating_set = {p.uid for p in violating}
     non_violating = [p for p in potential if p.uid not in violating_set]
-    violating.sort(key=_importance_key)
-    non_violating.sort(key=_importance_key)
+    violating.sort(key=importance_key)
+    non_violating.sort(key=importance_key)
     victims = Victims()
 
     def reprieve(p: Pod) -> bool:
@@ -121,12 +123,6 @@ def select_victims_on_node(pod: Pod, node_info: NodeInfo,
         if not reprieve(p):
             victims.pods.append(p)
     return victims
-
-
-def _importance_key(p: Pod):
-    # descending importance == ascending key
-    start = p.start_time if p.start_time is not None else float("inf")
-    return (-p.priority, start)
 
 
 def pick_one_node_for_preemption(
@@ -203,8 +199,10 @@ class Preemptor:
     """genericScheduler.Preempt (:310) against a snapshot."""
 
     def __init__(self,
-                 pdbs_fn: Callable[[], list[PodDisruptionBudget]] = lambda: []):
+                 pdbs_fn: Callable[[], list[PodDisruptionBudget]] = lambda: [],
+                 extenders: Optional[list] = None):
         self.pdbs_fn = pdbs_fn
+        self.extenders = extenders or []
 
     def preempt(self, pod: Pod, node_infos: dict[str, NodeInfo],
                 all_node_names: list[str],
@@ -254,6 +252,16 @@ class Preemptor:
             v = select_victims_on_node(pod, ni, fits_with_scratch, pdbs)
             if v is not None:
                 nodes_to_victims[name] = v
+        # extender preemption veto/trim (generic_scheduler.go:347)
+        for ext in self.extenders:
+            if not getattr(ext.config, "preempt_verb", ""):
+                continue
+            surviving = ext.process_preemption(
+                pod, {n: v.pods for n, v in nodes_to_victims.items()})
+            nodes_to_victims = {
+                n: Victims(pods=surviving[n],
+                           num_pdb_violations=nodes_to_victims[n].num_pdb_violations)
+                for n in surviving}
         chosen = pick_one_node_for_preemption(nodes_to_victims)
         if chosen is None:
             return PreemptionResult(None, [], [])

@@ -1,0 +1,145 @@
+"""scheduler_perf harness — density + benchmark matrix.
+
+Mirrors test/integration/scheduler_perf:
+- mustSetupScheduler (util.go:34): in-process store + scheduler, no kubelet.
+- TestSchedule100Node3KPods (scheduler_test.go:68): schedule P pods over N
+  hollow nodes, compute minimum observed QPS over 1s-equivalent windows;
+  fail < 30 pods/s, warn < 100 (scheduler_test.go:35-38).
+- BenchmarkScheduling matrices (scheduler_bench_test.go:39-131): plain /
+  PodAntiAffinity / PodAffinity / NodeAffinity workloads over
+  {nodes × existing pods} grids.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kubernetes_tpu.api.types import LABEL_HOSTNAME
+from kubernetes_tpu.models.hollow import (
+    NodeStrategy, PodStrategy, make_pods, populate_store,
+)
+from kubernetes_tpu.store.store import Store, PODS
+from kubernetes_tpu.scheduler import Scheduler
+
+MIN_QPS_THRESHOLD = 30      # scheduler_test.go:35 (fail)
+WARN_QPS_THRESHOLD = 100    # scheduler_test.go:38 (warn)
+
+
+@dataclass
+class PerfConfig:
+    nodes: int = 100
+    existing_pods: int = 0
+    pods: int = 3000
+    zones: int = 0
+    workload: str = "plain"     # plain | anti-affinity | affinity | node-affinity
+    use_tpu: bool = True
+    burst: int = 1024           # 0 = serial schedule_one loop
+    percentage_of_nodes_to_score: int = 100
+
+
+@dataclass
+class PerfResult:
+    scheduled: int
+    elapsed: float
+    throughput: float           # pods/s over the whole run
+    min_qps: float              # worst 1s-window rate (density metric)
+    attempts: dict = field(default_factory=dict)
+
+    @property
+    def passes_density_threshold(self) -> bool:
+        return self.min_qps >= MIN_QPS_THRESHOLD
+
+
+def _pod_strategy(cfg: PerfConfig, count: int, prefix: str) -> PodStrategy:
+    st = PodStrategy(count=count, name_prefix=prefix)
+    if cfg.workload == "anti-affinity":
+        st.anti_affinity_topology = LABEL_HOSTNAME
+    elif cfg.workload == "affinity":
+        st.affinity_topology = LABEL_HOSTNAME
+    elif cfg.workload == "node-affinity":
+        st.node_affinity_key = "perf-group"
+        st.node_affinity_values = ("a", "b")
+    elif cfg.workload != "plain":
+        raise ValueError(f"unknown workload {cfg.workload!r}")
+    return st
+
+
+def setup(cfg: PerfConfig) -> tuple[Store, Scheduler]:
+    """mustSetupScheduler analog."""
+    store = Store(watch_log_size=max(65536, 4 * (cfg.nodes + cfg.pods
+                                                 + cfg.existing_pods)))
+    node_st = NodeStrategy(count=cfg.nodes, zones=cfg.zones)
+    if cfg.workload == "node-affinity":
+        node_st.label_fracs = {"perf-group": ("a", 0.5)}
+    existing = ([_pod_strategy(cfg, cfg.existing_pods, "existing")]
+                if cfg.existing_pods else [])
+    populate_store(store, [node_st], existing)
+    sched = Scheduler(store, use_tpu=cfg.use_tpu,
+                      percentage_of_nodes_to_score=cfg.percentage_of_nodes_to_score)
+    sched.sync()
+    return store, sched
+
+
+def run(cfg: PerfConfig, warmup: int = 64) -> PerfResult:
+    store, sched = setup(cfg)
+    # warmup outside the timed window (jit compilation, informer sync)
+    if warmup:
+        for pod in make_pods(_pod_strategy(cfg, warmup, "warmup"), 0):
+            store.create(PODS, pod)
+        sched.pump()
+        _drain(sched, cfg)
+        sched.pump()
+    for pod in make_pods(_pod_strategy(cfg, cfg.pods, "measured"), 0):
+        store.create(PODS, pod)
+    sched.pump()
+    before = sched.metrics.schedule_attempts["scheduled"]
+    windows: list[tuple[float, int]] = []
+    t0 = time.perf_counter()
+    last_t, last_n = t0, before
+    while True:
+        n = _drain_step(sched, cfg)
+        now = time.perf_counter()
+        cur = sched.metrics.schedule_attempts["scheduled"]
+        if now - last_t >= 1.0:
+            windows.append((now - last_t, cur - last_n))
+            last_t, last_n = now, cur
+        if n == 0:
+            break
+    elapsed = time.perf_counter() - t0
+    sched.pump()
+    scheduled = sched.metrics.schedule_attempts["scheduled"] - before
+    throughput = scheduled / elapsed if elapsed > 0 else 0.0
+    if windows:
+        min_qps = min(count / dt for dt, count in windows if dt > 0)
+    else:
+        min_qps = throughput
+    return PerfResult(scheduled, elapsed, throughput, min_qps,
+                      dict(sched.metrics.schedule_attempts))
+
+
+def _drain_step(sched: Scheduler, cfg: PerfConfig) -> int:
+    if cfg.burst:
+        return sched.schedule_burst(max_pods=cfg.burst)
+    return 1 if sched.schedule_one(timeout=0.0) else 0
+
+
+def _drain(sched: Scheduler, cfg: PerfConfig) -> None:
+    while _drain_step(sched, cfg):
+        pass
+
+
+# the benchmark matrices (scheduler_bench_test.go:40-118)
+BENCHMARK_MATRIX = {
+    "plain": [(100, 0), (100, 1000), (1000, 0), (1000, 1000), (5000, 1000)],
+    "anti-affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
+    "affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
+    "node-affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
+}
+
+
+def run_benchmark_cell(workload: str, nodes: int, existing: int,
+                       pods: int = 1000, use_tpu: bool = True,
+                       burst: int = 1024) -> PerfResult:
+    return run(PerfConfig(nodes=nodes, existing_pods=existing, pods=pods,
+                          workload=workload, use_tpu=use_tpu, burst=burst))

@@ -62,7 +62,8 @@ class Scheduler:
                  plugins_enabled: Optional[list] = None,
                  plugin_args: Optional[dict] = None,
                  predicate_names: Optional[list] = None,
-                 priority_weights: Optional[dict] = None):
+                 priority_weights: Optional[dict] = None,
+                 extenders: Optional[list] = None):
         self.store = store
         self.name = scheduler_name
         self.clock = clock or RealClock()
@@ -80,6 +81,9 @@ class Scheduler:
         self._replicasets_fn = replicasets.list
         self._predicate_names = predicate_names
         self._priority_weights = priority_weights
+        self.extenders = extenders or []
+        self._extender_binder = next(
+            (e for e in self.extenders if e.is_binder), None)
         if algorithm is not None:
             self.algorithm = algorithm
         elif use_tpu:
@@ -103,6 +107,7 @@ class Scheduler:
                 percentage_of_nodes_to_score=percentage_of_nodes_to_score,
                 hard_pod_affinity_weight=hard_pod_affinity_weight,
                 nominated_pods_fn=self.queue.nominated.pods_for_node)
+            self.algorithm.extenders = self.extenders
         if priority_weights is not None:
             from kubernetes_tpu.factory import build_priority_configs
             self._priority_configs = build_priority_configs(
@@ -216,7 +221,11 @@ class Scheduler:
             return False
         if pod.deleted:
             return True
-        cycle = self.queue.scheduling_cycle
+        self._process_one(pod, self.queue.scheduling_cycle)
+        return True
+
+    def _process_one(self, pod: Pod, cycle: int) -> None:
+        """Schedule + assume + bind one already-popped pod."""
         start = self.clock.now()
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
@@ -228,7 +237,7 @@ class Scheduler:
             if not self.disable_preemption:
                 self._preempt(pod, err)
             self._record_failure(pod, cycle)
-            return True
+            return
         except Exception:
             self.metrics.observe("error")
             self._record_failure(pod, cycle)
@@ -239,16 +248,19 @@ class Scheduler:
         # Reserve point (scheduler.go:507)
         st = self.framework.run_reserve_plugins(ctx, assumed, result.suggested_host)
         if not st.is_success():
+            # release whatever earlier reserve plugins took (the v1alpha1
+            # reference skips this; later versions unreserve here too)
+            self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
             self._record_failure(pod, cycle)
-            return True
+            return
         try:
             self.cache.assume_pod(assumed)
         except Exception:
             self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
             self._record_failure(pod, cycle)
-            return True
+            return
         self.queue.nominated.delete(pod)
         # Permit may WAIT: when permit plugins exist, bind runs off the
         # scheduling thread like the reference's bind goroutine
@@ -263,7 +275,6 @@ class Scheduler:
         else:
             self._bind(assumed, result.suggested_host, pod, cycle, ctx)
         self.metrics.e2e_latency_sum += self.clock.now() - start
-        return True
 
     def wait_for_binds(self, timeout: float = 5.0) -> None:
         """Join outstanding async bind threads (test/shutdown helper)."""
@@ -306,7 +317,13 @@ class Scheduler:
             fail(st.code == FW_UNSCHEDULABLE)
             return
         try:
-            self.store.bind_pod(assumed.key, host)
+            if self._extender_binder is not None \
+                    and self._extender_binder.is_interested(assumed):
+                # extender-managed binding (factory.go GetBinder: a binder
+                # extender owns the write only for pods it manages)
+                self._extender_binder.bind(assumed, host)
+            else:
+                self.store.bind_pod(assumed.key, host)
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
             self.metrics.observe("scheduled")
@@ -332,7 +349,8 @@ class Scheduler:
             updated = self.store.get(PODS, pod.key)   # factory.go:732
         except NotFoundError:
             return
-        preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list)
+        preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list,
+                              extenders=self.extenders)
         predicate_set_fn = None
         if self._predicate_names is not None:
             from kubernetes_tpu.factory import build_predicate_set
@@ -367,9 +385,26 @@ class Scheduler:
                 pass
 
     # -- burst mode (TPU throughput path) -------------------------------------
+    def _pod_is_burstable(self, pod: Pod) -> bool:
+        """A pod may ride a device burst only when its per-node masks can't
+        be changed by in-burst placements: the scan folds resource deltas
+        into device state, but affinity terms, host ports, and
+        selector-spread counts are encoded host-side once per burst."""
+        from kubernetes_tpu.api.types import (
+            has_pod_affinity_terms, get_container_ports)
+        if has_pod_affinity_terms(pod):
+            return False
+        if get_container_ports(pod):
+            return False
+        from kubernetes_tpu.oracle.priorities import get_selectors
+        if get_selectors(pod, self._services_fn(), self._replicasets_fn()):
+            return False
+        return True
+
     def schedule_burst(self, max_pods: int = 1024) -> int:
-        """Drain up to max_pods from the queue and schedule them in one
-        device launch (TPU algorithm only). Returns pods bound."""
+        """Drain up to max_pods from the queue and schedule them with device
+        bursts where safe, serially otherwise — decisions identical to the
+        serial loop. Returns pods bound."""
         pods = []
         cycles = []
         while len(pods) < max_pods:
@@ -381,32 +416,41 @@ class Scheduler:
                 cycles.append(self.queue.scheduling_cycle)
         if not pods:
             return 0
-        if self.queue.nominated.has_any():
-            # nominated pods need the two-pass oracle path; drain serially,
-            # bounded to this burst, and report pods actually bound
-            for pod in pods:
-                self.queue.add(pod)
-            before = self.metrics.schedule_attempts["scheduled"]
-            for _ in range(len(pods)):
-                if not self.schedule_one(timeout=0.0):
-                    break
-            return self.metrics.schedule_attempts["scheduled"] - before
+        before = self.metrics.schedule_attempts["scheduled"]
+        can_burst = hasattr(self.algorithm, "schedule_burst")
+        i = 0
+        while i < len(pods):
+            # serial path for mask-stale pods and under active nominations
+            # (the two-pass ghost check lives on the oracle path)
+            if not can_burst or self.queue.nominated.has_any() \
+                    or not self._pod_is_burstable(pods[i]):
+                self._process_one(pods[i], cycles[i])
+                i += 1
+                continue
+            j = i
+            while j < len(pods) and not self.queue.nominated.has_any() \
+                    and self._pod_is_burstable(pods[j]):
+                j += 1
+            self._burst_segment(pods[i:j], cycles[i:j], max_pods)
+            i = j
+        return self.metrics.schedule_attempts["scheduled"] - before
+
+    def _burst_segment(self, pods: list[Pod], cycles: list[int],
+                       bucket: int) -> None:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
-        hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos, names,
-                                              bucket=max_pods)
-        bound = 0
+        self._last_names = names
+        hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
+                                              names, bucket=bucket)
         for pod, host, cycle in zip(pods, hosts, cycles):
             if host is None:
-                self.metrics.observe("unschedulable")
-                self._record_failure(pod, cycle)
+                # re-run serially for the failure reasons + preemption path
+                self._process_one(pod, cycle)
                 continue
             assumed = pod.clone()
             assumed.node_name = host
             self.cache.assume_pod(assumed)
             self._bind(assumed, host, pod, cycle)  # observes "scheduled"
-            bound += 1
-        return bound
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""

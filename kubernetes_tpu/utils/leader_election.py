@@ -1,0 +1,158 @@
+"""Leader election over the versioned store — active/passive HA.
+
+Mirrors client-go/tools/leaderelection (leaderelection.go:183) with a lease
+resourcelock (resourcelock/leaselock.go): candidates CAS a lease record
+through the store's optimistic concurrency; the holder renews before
+renew_deadline, others acquire after lease_duration of silence. The
+reference wires this at cmd/kube-scheduler/app/server.go:248-263.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from kubernetes_tpu.store.store import (
+    Store, LEASES, NotFoundError, ConflictError, AlreadyExistsError,
+)
+from kubernetes_tpu.utils.clock import Clock, RealClock
+
+
+@dataclass
+class Lease:
+    """resourcelock LeaderElectionRecord analog."""
+    name: str
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration: float = 15.0
+    leader_transitions: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "Lease":
+        import copy
+        return copy.copy(self)
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_name: str = "kube-scheduler"
+    identity: str = "candidate"
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+    on_started_leading: Optional[Callable[[], None]] = None
+    on_stopped_leading: Optional[Callable[[], None]] = None
+
+
+class LeaderElector:
+    def __init__(self, store: Store, config: LeaderElectionConfig,
+                 clock: Optional[Clock] = None):
+        self.store = store
+        self.config = config
+        self.clock = clock or RealClock()
+        self._leading = False
+        self._observed: Optional[Lease] = None
+        self._observed_at = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self._leading
+
+    # -- one acquisition/renewal attempt (leaderelection.go:287) -------------
+    def try_acquire_or_renew(self) -> bool:
+        now = self.clock.now()
+        cfg = self.config
+        new_record = Lease(
+            name=cfg.lock_name, holder=cfg.identity,
+            acquire_time=now, renew_time=now,
+            lease_duration=cfg.lease_duration)
+        try:
+            current = self.store.get(LEASES, cfg.lock_name)
+        except NotFoundError:
+            try:
+                self.store.create(LEASES, new_record)
+            except AlreadyExistsError:
+                return False
+            self._observe(new_record, now)
+            return True
+        # refresh observation clock on any record change
+        if self._observed is None or \
+                self._observed.resource_version != current.resource_version:
+            self._observe(current, now)
+        if current.holder != cfg.identity:
+            if self._observed_at + current.lease_duration > now and current.holder:
+                return False  # current leader still valid
+            new_record.acquire_time = now
+            new_record.leader_transitions = current.leader_transitions + 1
+        else:
+            new_record.acquire_time = current.acquire_time
+            new_record.leader_transitions = current.leader_transitions
+        try:
+            updated = self.store.update(LEASES, new_record,
+                                        expect_rv=current.resource_version)
+        except (ConflictError, NotFoundError):
+            return False
+        self._observe(updated, now)
+        return True
+
+    def _observe(self, record: Lease, now: float) -> None:
+        self._observed = record
+        self._observed_at = now
+
+    # -- run loop ------------------------------------------------------------
+    def step(self) -> bool:
+        """One election step; returns current leadership. Suitable for
+        deterministic test pumping as well as the background loop."""
+        got = self.try_acquire_or_renew()
+        if got and not self._leading:
+            self._leading = True
+            if self.config.on_started_leading:
+                self.config.on_started_leading()
+        elif not got and self._leading:
+            # failed to renew within deadline -> step down
+            self._leading = False
+            if self.config.on_stopped_leading:
+                self.config.on_stopped_leading()
+        return self._leading
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self.step()
+            self.clock.sleep(self.config.retry_period)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(target=self.run, daemon=True,
+                                            name=f"elector-{self.config.identity}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def release(self) -> None:
+        """Voluntarily give up the lease (leaderelection.go release)."""
+        if not self._leading:
+            return
+        try:
+            current = self.store.get(LEASES, self.config.lock_name)
+            if current.holder == self.config.identity:
+                current.holder = ""
+                current.renew_time = 0.0
+                self.store.update(LEASES, current,
+                                  expect_rv=current.resource_version)
+        except (NotFoundError, ConflictError):
+            pass
+        self._leading = False
+        if self.config.on_stopped_leading:
+            self.config.on_stopped_leading()

@@ -1,0 +1,43 @@
+"""Step tracing — the utiltrace analog.
+
+Mirrors k8s.io/utils/trace as used in the hot path
+(core/generic_scheduler.go:185-246): named steps with timestamps, logged
+only when the whole operation exceeds a threshold (the scheduler uses
+100ms per cycle).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("kubernetes_tpu")
+
+SLOW_CYCLE_THRESHOLD = 0.1  # 100ms (generic_scheduler.go:186)
+
+
+class Trace:
+    def __init__(self, name: str, threshold: float = SLOW_CYCLE_THRESHOLD):
+        self.name = name
+        self.threshold = threshold
+        self.start = time.perf_counter()
+        self.steps: list[tuple[str, float]] = []
+
+    def step(self, msg: str) -> None:
+        self.steps.append((msg, time.perf_counter()))
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.start
+
+    def log_if_long(self) -> bool:
+        """Emit the step timeline when the operation was slow. Returns
+        whether it logged."""
+        total = self.elapsed()
+        if total < self.threshold:
+            return False
+        lines = [f"Trace {self.name!r} (total {total * 1000:.1f}ms):"]
+        prev = self.start
+        for msg, t in self.steps:
+            lines.append(f"  +{(t - prev) * 1000:.1f}ms {msg}")
+            prev = t
+        log.warning("\n".join(lines))
+        return True

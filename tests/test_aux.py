@@ -1,0 +1,331 @@
+"""Tests for auxiliary subsystems: leader election, extenders, metrics
+exposition, cache debugger, tracing, CLI — mirroring
+client-go/tools/leaderelection tests, extender_test.go, and the debugger.
+"""
+import json
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Node, Container
+from kubernetes_tpu.apis.policy import ExtenderConfig
+from kubernetes_tpu.core.extender import SchedulerExtender, ExtenderError
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, PODS, NODES, LEASES
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.leader_election import (
+    LeaderElector, LeaderElectionConfig,
+)
+
+GI = 1024 ** 3
+
+
+def mknode(name, cpu=4000):
+    return Node(name=name, allocatable={"cpu": cpu, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, cpu=100):
+    return Pod(name=name, containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+class TestLeaderElection:
+    def test_single_candidate_acquires_and_renews(self):
+        clock = FakeClock()
+        store = Store()
+        started, stopped = [], []
+        el = LeaderElector(store, LeaderElectionConfig(
+            identity="a", on_started_leading=lambda: started.append(1),
+            on_stopped_leading=lambda: stopped.append(1)), clock=clock)
+        assert el.step()
+        assert el.is_leader and started == [1]
+        clock.step(5)
+        assert el.step()  # renews
+        assert stopped == []
+
+    def test_second_candidate_waits_then_takes_over(self):
+        clock = FakeClock()
+        store = Store()
+        a = LeaderElector(store, LeaderElectionConfig(
+            identity="a", lease_duration=15), clock=clock)
+        b = LeaderElector(store, LeaderElectionConfig(
+            identity="b", lease_duration=15), clock=clock)
+        assert a.step()
+        assert not b.step()           # a holds a fresh lease
+        clock.step(10)
+        assert a.step()               # renewal extends
+        assert not b.step()
+        clock.step(16)                # a goes silent past lease_duration
+        assert b.step()
+        assert b.is_leader
+        # a notices it lost on next attempt (CAS fails, then lease valid)
+        assert not a.step()
+        assert not a.is_leader
+
+    def test_release_hands_off_immediately(self):
+        clock = FakeClock()
+        store = Store()
+        a = LeaderElector(store, LeaderElectionConfig(identity="a"), clock=clock)
+        b = LeaderElector(store, LeaderElectionConfig(identity="b"), clock=clock)
+        assert a.step()
+        a.release()
+        assert not a.is_leader
+        assert b.step()               # empty holder -> immediate acquire
+
+
+class TestExtender:
+    def _cluster(self, extender):
+        store = Store()
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          extenders=[extender], clock=FakeClock())
+        sched.sync()
+        return store, sched
+
+    def test_filter_restricts_nodes(self):
+        calls = []
+
+        def filter_ep(payload):
+            calls.append(payload)
+            keep = [n for n in payload["nodes"] if n in ("n1", "n2")]
+            failed = {n: "ExtenderVetoed" for n in payload["nodes"]
+                      if n not in keep}
+            return {"nodeNames": keep, "failedNodes": failed}
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://f", filter_verb="filter"),
+            endpoints={"filter": filter_ep})
+        store, sched = self._cluster(ext)
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        sched.schedule_one(timeout=0.0)
+        sched.pump()
+        assert store.get(PODS, "default/p1").node_name in ("n1", "n2")
+        assert calls and set(calls[0]["nodes"]) == {"n0", "n1", "n2", "n3"}
+
+    def test_prioritize_steers_choice(self):
+        def prio_ep(payload):
+            return {"hostPriorityList": [
+                {"host": n, "score": 10 if n == "n3" else 0}
+                for n in payload["nodes"]]}
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://p", prioritize_verb="prioritize",
+                           weight=100),
+            endpoints={"prioritize": prio_ep})
+        store, sched = self._cluster(ext)
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        sched.schedule_one(timeout=0.0)
+        sched.pump()
+        assert store.get(PODS, "default/p1").node_name == "n3"
+
+    def test_ignorable_extender_failure_is_tolerated(self):
+        def broken(payload):
+            raise RuntimeError("down")
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://x", filter_verb="filter",
+                           ignorable=True),
+            endpoints={"filter": broken})
+        store, sched = self._cluster(ext)
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        sched.schedule_one(timeout=0.0)
+        sched.pump()
+        assert store.get(PODS, "default/p1").node_name  # scheduled anyway
+
+    def test_non_ignorable_failure_raises(self):
+        def broken(payload):
+            raise RuntimeError("down")
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://x", filter_verb="filter"),
+            endpoints={"filter": broken})
+        with pytest.raises(ExtenderError):
+            ext.filter(mkpod("p"), [mknode("n0")])
+
+    def test_binder_extender_owns_the_write(self):
+        bound = []
+
+        def bind_ep(payload):
+            bound.append((payload["pod"], payload["node"]))
+            store.bind_pod(payload["pod"], payload["node"])
+            return {}
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://b", bind_verb="bind"),
+            endpoints={"bind": bind_ep})
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          extenders=[ext], clock=FakeClock())
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        sched.schedule_one(timeout=0.0)
+        sched.pump()
+        assert bound == [("default/p1", "n0")]
+        assert store.get(PODS, "default/p1").node_name == "n0"
+
+
+class TestMetricsAndDebugger:
+    def test_metrics_exposition(self):
+        from kubernetes_tpu.metrics import render_metrics, reset_metrics
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        sched.schedule_one(timeout=0.0)
+        sched.pump()
+        text = render_metrics(sched)
+        assert 'scheduler_schedule_attempts_total{result="scheduled"} 1' in text
+        assert "scheduler_binding_total 1" in text
+        assert 'scheduler_pending_pods{queue="active"} 0' in text
+        assert "scheduler_cache_nodes 1" in text
+        reset_metrics(sched)
+        assert 'result="scheduled"} 0' in render_metrics(sched)
+
+    def test_cache_comparer_detects_drift(self):
+        from kubernetes_tpu.cache.debugger import CacheDebugger
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        dbg = CacheDebugger(sched.cache, sched.queue,
+                            sched.informers.informer(PODS),
+                            sched.informers.informer(NODES))
+        assert dbg.comparer.compare() == []
+        # inject drift: remove the node from the cache behind the informer's back
+        sched.cache.remove_node(mknode("n0"))
+        problems = dbg.comparer.compare()
+        assert any("in informer but not in cache" in p for p in problems)
+        dump = json.loads(dbg.dumper.dump_all())
+        assert "cache" in dump and "queue" in dump
+
+    def test_trace_logs_slow_cycles(self, caplog):
+        import logging
+        from kubernetes_tpu.utils.tracing import Trace
+        t = Trace("cycle", threshold=0.0)
+        t.step("filter")
+        t.step("score")
+        with caplog.at_level(logging.WARNING, logger="kubernetes_tpu"):
+            assert t.log_if_long()
+        assert "filter" in caplog.text and "score" in caplog.text
+        fast = Trace("cycle", threshold=10.0)
+        assert not fast.log_if_long()
+
+
+class TestCLI:
+    def test_once_mode_with_cluster_spec(self, tmp_path, capsys):
+        from kubernetes_tpu.cmd.scheduler import main
+        spec = {
+            "nodes": [{"count": 4, "zones": 2}],
+            "pending_pods": [{"count": 10, "name_prefix": "cli-pod"}],
+        }
+        path = tmp_path / "spec.json"
+        path.write_text(json.dumps(spec))
+        rc = main(["--cluster-spec", str(path), "--once",
+                   "--percentage-of-nodes-to-score", "100",
+                   "--feature-gates", "TPUScoring=false"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["scheduled"] == 10
+
+    def test_http_endpoints(self, tmp_path):
+        import urllib.request
+        from kubernetes_tpu.cmd.scheduler import serve_http, build_config
+        import argparse
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        from kubernetes_tpu.apis.config import SchedulerConfiguration
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = serve_http(sched, SchedulerConfiguration(), port)
+        try:
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz").read()
+            assert health == b"ok"
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "scheduler_cache_nodes 1" in metrics
+            configz = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/configz").read())
+            assert configz["scheduler_name"] == "default-scheduler"
+        finally:
+            server.shutdown()
+
+
+class TestReviewRegressions2:
+    def test_burst_with_oracle_algorithm_falls_back(self):
+        """--burst with TPUScoring=false must not crash (GenericScheduler has
+        no schedule_burst)."""
+        store = Store()
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        sched = Scheduler(store, use_tpu=False, percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        for j in range(6):
+            store.create(PODS, mkpod(f"p{j}"))
+        sched.pump()
+        total = 0
+        while True:
+            n = sched.schedule_burst(max_pods=4)
+            if n == 0:
+                break
+            total += n
+        sched.pump()
+        assert total == 6
+
+    def test_managed_resources_gate_binder(self):
+        """A binder extender with managed_resources only binds pods that
+        request one of them."""
+        bound = []
+
+        def bind_ep(payload):
+            bound.append(payload["pod"])
+            store.bind_pod(payload["pod"], payload["node"])
+            return {}
+
+        ext = SchedulerExtender(
+            ExtenderConfig(url_prefix="inproc://b", bind_verb="bind",
+                           managed_resources=("example.com/gpu",)),
+            endpoints={"bind": bind_ep})
+        store = Store()
+        store.create(NODES, Node(name="n0", allocatable={
+            "cpu": 4000, "memory": 32 * GI, "pods": 110, "example.com/gpu": 4}))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          extenders=[ext], clock=FakeClock())
+        sched.sync()
+        store.create(PODS, mkpod("plain"))
+        store.create(PODS, Pod(name="gpu", containers=(
+            Container.make(name="c", requests={"cpu": 100, "example.com/gpu": 1}),)))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert store.get(PODS, "default/plain").node_name == "n0"
+        assert store.get(PODS, "default/gpu").node_name == "n0"
+        assert bound == ["default/gpu"]  # only the managed pod went via extender
+
+    def test_reference_style_camelcase_extender_policy(self):
+        from kubernetes_tpu.apis.policy import Policy
+        p = Policy.from_dict({"extenders": [{
+            "urlPrefix": "http://e", "filterVerb": "filter",
+            "bindVerb": "bind", "nodeCacheCapable": True,
+            "managedResources": [{"name": "example.com/gpu"}]}]})
+        ec = p.extenders[0]
+        assert ec.url_prefix == "http://e"
+        assert ec.filter_verb == "filter"
+        assert ec.bind_verb == "bind"
+        assert ec.node_cache_capable is True
+        assert ec.managed_resources == ("example.com/gpu",)

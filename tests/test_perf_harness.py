@@ -1,0 +1,105 @@
+"""Perf-harness tests: small-scale versions of the scheduler_perf density
+test and benchmark matrix cells, asserting correctness of the harness (all
+pods scheduled, workload constraints respected) — timing is the bench's job.
+"""
+import pytest
+
+from kubernetes_tpu.models.hollow import (
+    NodeStrategy, PodStrategy, make_hollow_nodes, make_pods, populate_store,
+)
+from kubernetes_tpu.perf.harness import PerfConfig, run, setup
+from kubernetes_tpu.store.store import Store, PODS, NODES
+
+
+class TestHollowNodes:
+    def test_node_shapes_and_zones(self):
+        nodes = make_hollow_nodes(NodeStrategy(count=9, zones=3), seed=1)
+        assert len(nodes) == 9
+        zones = {n.labels["failure-domain.beta.kubernetes.io/zone"] for n in nodes}
+        assert zones == {"zone-0", "zone-1", "zone-2"}
+        assert all(n.allocatable["cpu"] == 4000 for n in nodes)
+        assert all(n.allocatable["pods"] == 110 for n in nodes)
+
+    def test_label_fractions_deterministic(self):
+        st = NodeStrategy(count=100, label_fracs={"disk": ("ssd", 0.5)})
+        a = make_hollow_nodes(st, seed=7)
+        b = make_hollow_nodes(st, seed=7)
+        assert [n.labels.get("disk") for n in a] == [n.labels.get("disk") for n in b]
+        frac = sum(1 for n in a if "disk" in n.labels) / 100
+        assert 0.3 < frac < 0.7
+
+    def test_populate_with_existing_pods(self):
+        store = Store()
+        n, p = populate_store(store, [NodeStrategy(count=5)],
+                              [PodStrategy(count=12, name_prefix="existing")])
+        assert (n, p) == (5, 12)
+        pods, _ = store.list(PODS)
+        assert all(pod.node_name for pod in pods)
+        hosts = {pod.node_name for pod in pods}
+        assert len(hosts) == 5  # round-robin spread
+
+
+@pytest.mark.parametrize("workload", ["plain", "anti-affinity", "node-affinity"])
+@pytest.mark.parametrize("use_tpu", [True, False])
+class TestPerfRuns:
+    def test_small_cell_schedules_everything(self, workload, use_tpu):
+        cfg = PerfConfig(nodes=20, existing_pods=10, pods=15, workload=workload,
+                         use_tpu=use_tpu, burst=16 if use_tpu else 0,
+                         zones=2)
+        result = run(cfg, warmup=4)
+        if workload == "anti-affinity":
+            # one pod per node max; 10 existing occupy 10 hosts' labels...
+            # existing pods share the same labels, so only nodes without an
+            # existing 'density' pod can take one
+            assert result.scheduled >= 5
+        else:
+            assert result.scheduled == 15
+        assert result.throughput > 0
+
+    def test_constraints_respected(self, workload, use_tpu):
+        cfg = PerfConfig(nodes=10, existing_pods=0, pods=8, workload=workload,
+                         use_tpu=use_tpu, burst=8 if use_tpu else 0)
+        store, sched = setup(cfg)
+        from kubernetes_tpu.models.hollow import make_pods as mp
+        from kubernetes_tpu.perf.harness import _pod_strategy, _drain
+        for pod in mp(_pod_strategy(cfg, cfg.pods, "w"), 0):
+            store.create(PODS, pod)
+        sched.pump()
+        _drain(sched, cfg)
+        sched.pump()
+        pods, _ = store.list(PODS)
+        placed = [p for p in pods if p.node_name]
+        if workload == "anti-affinity":
+            hosts = [p.node_name for p in placed]
+            assert len(hosts) == len(set(hosts))  # one per topology
+        if workload == "affinity":
+            assert len({p.node_name for p in placed}) == 1  # co-located
+        if workload == "node-affinity":
+            nodes = {n.name: n for n in store.list(NODES)[0]}
+            assert all(nodes[p.node_name].labels.get("perf-group") in ("a", "b")
+                       for p in placed)
+
+
+class TestBurstSerialEquivalence:
+    """Burst mode must produce byte-identical placements to the serial loop
+    even for workloads whose masks depend on in-burst placements (the shell
+    segments those onto the serial path)."""
+
+    @pytest.mark.parametrize("workload", ["plain", "anti-affinity", "affinity",
+                                          "node-affinity"])
+    def test_burst_equals_serial(self, workload):
+        from kubernetes_tpu.perf.harness import _pod_strategy, _drain
+
+        def go(burst):
+            cfg = PerfConfig(nodes=6, existing_pods=0, pods=10,
+                             workload=workload, use_tpu=True, burst=burst)
+            store, sched = setup(cfg)
+            for pod in make_pods(_pod_strategy(cfg, cfg.pods, "w"), 0):
+                store.create(PODS, pod)
+            sched.pump()
+            _drain(sched, cfg)
+            sched.pump()
+            pods, _ = store.list(PODS)
+            return sorted((p.name, p.node_name) for p in pods)
+
+        assert go(16) == go(0)
