@@ -275,6 +275,76 @@ class Container:
         return dict(self.requests)
 
 
+# ---------------------------------------------------------------------------
+# Volumes (pruned: the scheduler-relevant subset of v1.Volume / PV / PVC)
+# ---------------------------------------------------------------------------
+# volume plugins with per-node attach limits (predicates.go Max*VolumeCount)
+PLUGIN_EBS = "ebs"
+PLUGIN_GCE_PD = "gce-pd"
+PLUGIN_AZURE_DISK = "azure-disk"
+PLUGIN_CINDER = "cinder"
+PLUGIN_CSI = "csi"
+
+# reference defaults (volumeutil Default*VolumeLimit)
+DEFAULT_VOLUME_LIMITS = {
+    PLUGIN_EBS: 39,
+    PLUGIN_GCE_PD: 16,
+    PLUGIN_AZURE_DISK: 16,
+    PLUGIN_CINDER: 256,
+}
+
+
+@dataclass(frozen=True)
+class VolumeSource:
+    """Pruned v1.Volume: either a direct backing volume (plugin + id) or a
+    PVC reference."""
+    name: str
+    pvc: str = ""            # persistentVolumeClaim.claimName (same namespace)
+    plugin: str = ""         # direct volume plugin (PLUGIN_*)
+    volume_id: str = ""      # backing volume id for direct volumes
+    read_only: bool = False
+
+
+@dataclass
+class PersistentVolume:
+    """Pruned v1.PersistentVolume."""
+    name: str
+    plugin: str = ""
+    volume_id: str = ""
+    capacity: int = 0                       # bytes
+    labels: dict[str, str] = field(default_factory=dict)  # zone/region labels
+    storage_class: str = ""
+    claim_ref: str = ""                     # "namespace/name" when bound
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "PersistentVolume":
+        out = copy.copy(self)
+        out.labels = dict(self.labels)
+        return out
+
+
+@dataclass
+class PersistentVolumeClaim:
+    """Pruned v1.PersistentVolumeClaim."""
+    name: str
+    namespace: str = "default"
+    request: int = 0                        # bytes
+    storage_class: str = ""
+    volume_name: str = ""                   # bound PV name
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "PersistentVolumeClaim":
+        return copy.copy(self)
+
+
 _pod_uid_counter = itertools.count(1)
 
 
@@ -294,7 +364,7 @@ class Pod:
     init_containers: tuple[Container, ...] = ()
     priority: int = 0            # resolved PriorityClass value
     scheduler_name: str = "default-scheduler"
-    volumes: tuple[str, ...] = ()      # names of referenced PVCs (subset)
+    volumes: tuple[VolumeSource, ...] = ()
     # status
     nominated_node_name: str = ""
     phase: str = "Pending"

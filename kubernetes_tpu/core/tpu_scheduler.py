@@ -49,7 +49,8 @@ class TPUScheduler:
                  services_fn=lambda: [],
                  replicasets_fn=lambda: [],
                  collect_host_priority: bool = True,
-                 nominated=None):
+                 nominated=None,
+                 volume_listers=None, volume_binder=None):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.services_fn = services_fn
@@ -63,6 +64,8 @@ class TPUScheduler:
         # fall back to the oracle's two-pass fitting (podFitsOnNode :627) —
         # the device kernel doesn't model ghost pods yet
         self.nominated = nominated
+        self.volume_listers = volume_listers
+        self.volume_binder = volume_binder
         self._oracle = None
         self._oracle_cfgs = None
         self.last_index = 0
@@ -121,6 +124,10 @@ class TPUScheduler:
             "unsched_ok": f.unsched_ok if f.unsched_ok is not None else d["ones_bool"],
             "ports_ok": f.ports_ok if f.ports_ok is not None else d["ones_bool"],
             "host_ok": f.host_ok if f.host_ok is not None else d["ones_bool"],
+            "disk_ok": f.disk_ok if f.disk_ok is not None else d["ones_bool"],
+            "maxvol_ok": f.maxvol_ok if f.maxvol_ok is not None else d["ones_bool"],
+            "volbind_ok": f.volbind_ok if f.volbind_ok is not None else d["ones_bool"],
+            "volzone_ok": f.volzone_ok if f.volzone_ok is not None else d["ones_bool"],
             "interpod_code": f.interpod_code if f.interpod_code is not None else d["zeros_i8"],
             "node_aff_counts": f.node_aff_counts if f.node_aff_counts is not None else d["zeros_i64"],
             "taint_counts": f.taint_counts if f.taint_counts is not None else d["zeros_i64"],
@@ -154,6 +161,15 @@ class TPUScheduler:
             return [P.ERR_NODE_UNSCHEDULABLE]
         if code == K.FAIL_TAINTS:
             return [P.ERR_TAINTS_TOLERATIONS_NOT_MATCH]
+        if code == K.FAIL_DISK:
+            return ["NoDiskConflict"]
+        if code == K.FAIL_MAXVOL:
+            return ["MaxVolumeCount"]
+        if code in (K.FAIL_VOLBIND, K.FAIL_VOLZONE):
+            if f.volbind_reasons and idx in f.volbind_reasons:
+                return list(f.volbind_reasons[idx])
+            return (["VolumeBindingNoMatch"] if code == K.FAIL_VOLBIND
+                    else ["NoVolumeZoneConflict"])
         if code == K.FAIL_INTERPOD:
             ipa = int(f.interpod_code[idx]) if f.interpod_code is not None else 0
             if ipa == IPA_EXISTING_ANTI:
@@ -215,11 +231,13 @@ class TPUScheduler:
         if self.nominated is not None and self.nominated.has_any():
             o = self._oracle_fallback()
             o.last_index, o.last_node_index = self.last_index, self.last_node_index
-            funcs = None
-            if self.enabled_predicates is not None:
-                from kubernetes_tpu.factory import build_predicate_set
-                funcs = build_predicate_set(sorted(self.enabled_predicates),
-                                            node_infos)
+            from kubernetes_tpu.factory import (
+                build_predicate_set, DEFAULT_PREDICATE_NAMES)
+            funcs = build_predicate_set(
+                sorted(self.enabled_predicates) if self.enabled_predicates
+                else DEFAULT_PREDICATE_NAMES,
+                node_infos, volume_listers=self.volume_listers,
+                volume_binder=self.volume_binder)
             try:
                 return o.schedule(pod, node_infos, all_node_names,
                                   predicate_funcs=funcs,
@@ -231,7 +249,9 @@ class TPUScheduler:
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
-                         enabled=self.enabled_predicates)
+                         enabled=self.enabled_predicates,
+                         volume_listers=self.volume_listers,
+                         volume_binder=self.volume_binder)
         feats = enc.encode(pod)
         pod_in = self._pod_arrays(feats, b.n_pad)
         n = b.n_real
@@ -287,7 +307,9 @@ class TPUScheduler:
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
-                         enabled=self.enabled_predicates)
+                         enabled=self.enabled_predicates,
+                         volume_listers=self.volume_listers,
+                         volume_binder=self.volume_binder)
         per_pod = [self._pod_arrays(enc.encode(p), b.n_pad, upd_fields=True, pod=p)
                    for p in pods]
         # pad the burst to a power-of-two bucket so lax.scan compiles once

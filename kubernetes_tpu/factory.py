@@ -37,10 +37,14 @@ def register_fit_predicate(name: str, factory: Callable) -> None:
 
 
 def build_predicate_set(names: list[str],
-                        node_infos) -> dict[str, Callable]:
+                        node_infos,
+                        volume_listers=None,
+                        volume_binder=None) -> dict[str, Callable]:
     """CreateFromKeys predicate assembly: the named subset, evaluated in
     predicates.PREDICATE_ORDERING."""
-    base = preds.default_predicate_set(node_infos)
+    base = preds.default_predicate_set(node_infos,
+                                       volume_listers=volume_listers,
+                                       volume_binder=volume_binder)
     # keep the metadata-invalidation handle (not a predicate; preemption and
     # the nominated-ghost two-pass need it)
     out = {"_ipa_checker": base["_ipa_checker"]}

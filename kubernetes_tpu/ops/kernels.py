@@ -38,8 +38,12 @@ ZONE_WEIGHTING = 2.0 / 3.0
 FAIL_NONE = 0
 FAIL_UNSCHEDULABLE = 1
 FAIL_GENERAL = 2
-FAIL_TAINTS = 3
-FAIL_INTERPOD = 4
+FAIL_DISK = 3          # NoDiskConflict (ordering: before taints)
+FAIL_TAINTS = 4
+FAIL_MAXVOL = 5        # Max*VolumeCount family
+FAIL_VOLBIND = 6       # CheckVolumeBinding
+FAIL_VOLZONE = 7       # NoVolumeZoneConflict
+FAIL_INTERPOD = 8
 
 # general_bits layout (GeneralPredicates sub-failures, predicates.go:1112)
 BIT_PODS = 0
@@ -206,12 +210,23 @@ def _feasibility(nodes, pod):
     skip = pod["skip"]
     taints_fail = ~pod["taints_ok"]
     ipa_fail = pod["interpod_code"] > 0
+    disk_fail = ~pod["disk_ok"]
+    maxvol_fail = ~pod["maxvol_ok"]
+    volbind_fail = ~pod["volbind_ok"]
+    volzone_fail = ~pod["volzone_ok"]
 
+    # PREDICATE_ORDERING: unschedulable, general, disk, taints, max-volume,
+    # volume binding, volume zone, inter-pod affinity
     fail_first = jnp.where(
         unsched_fail, FAIL_UNSCHEDULABLE,
         jnp.where(general_fail, FAIL_GENERAL,
-                  jnp.where(taints_fail, FAIL_TAINTS,
-                            jnp.where(ipa_fail, FAIL_INTERPOD, FAIL_NONE))))
+                  jnp.where(disk_fail, FAIL_DISK,
+                            jnp.where(taints_fail, FAIL_TAINTS,
+                                      jnp.where(maxvol_fail, FAIL_MAXVOL,
+                                                jnp.where(volbind_fail, FAIL_VOLBIND,
+                                                          jnp.where(volzone_fail, FAIL_VOLZONE,
+                                                                    jnp.where(ipa_fail, FAIL_INTERPOD,
+                                                                              FAIL_NONE))))))))
     feasible = valid & (fail_first == FAIL_NONE) & ~skip
     return feasible, fail_first.astype(jnp.int8), bits
 

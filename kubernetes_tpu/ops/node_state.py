@@ -215,6 +215,11 @@ class PodFeatures:
     unsched_ok: Optional[np.ndarray] = None    # [N] bool
     ports_ok: Optional[np.ndarray] = None      # [N] bool
     host_ok: Optional[np.ndarray] = None       # [N] bool
+    disk_ok: Optional[np.ndarray] = None       # [N] bool (NoDiskConflict)
+    maxvol_ok: Optional[np.ndarray] = None     # [N] bool (Max*VolumeCount)
+    volbind_ok: Optional[np.ndarray] = None    # [N] bool (CheckVolumeBinding)
+    volzone_ok: Optional[np.ndarray] = None    # [N] bool (NoVolumeZoneConflict)
+    volbind_reasons: Optional[dict] = None     # node idx -> reasons (decode)
     interpod_code: Optional[np.ndarray] = None  # [N] i8 IPA_* codes
     # scalars requested by the pod but absent from every node's capacity:
     # they fail PodFitsResources on all nodes (reference: predicates.go:806)
@@ -240,11 +245,14 @@ class PodEncoder:
     def __init__(self, node_infos: dict[str, NodeInfo], batch: NodeBatch,
                  services=None, replicasets=None, total_num_nodes: Optional[int] = None,
                  hard_pod_affinity_weight: int = 1,
-                 enabled: Optional[set] = None):
+                 enabled: Optional[set] = None,
+                 volume_listers=None, volume_binder=None):
         self.node_infos = node_infos
         self.batch = batch
         # predicate names enabled by the provider/policy; None = all
         self.enabled = enabled
+        self.volume_listers = volume_listers
+        self.volume_binder = volume_binder
         self.services = services or []
         self.replicasets = replicasets or []
         self.total_num_nodes = total_num_nodes or max(1, batch.n_real)
@@ -333,6 +341,8 @@ class PodEncoder:
             if idx is not None:
                 m[idx] = True
             f.host_ok = m
+        if pod.volumes and self.volume_listers is not None:
+            self._encode_volumes(pod, f)
         has_own_terms = pod.affinity is not None and (
             pod.affinity.pod_affinity is not None
             or pod.affinity.pod_anti_affinity is not None)
@@ -350,6 +360,40 @@ class PodEncoder:
                     else:
                         codes[i] = IPA_OWN_ANTI
             f.interpod_code = codes
+
+    def _encode_volumes(self, pod: Pod, f: PodFeatures) -> None:
+        """Volume predicate masks, via the oracle implementations per node
+        (volumes are rare per pod; this path only runs when present)."""
+        from kubernetes_tpu.oracle import volumes as V
+        b = self.batch
+        listers = self.volume_listers
+        vol_preds = V.make_volume_predicates(listers, self.volume_binder)
+        reason_map: dict = {}
+
+        def mask(names: tuple) -> np.ndarray:
+            m = np.ones(b.n_pad, dtype=bool)
+            for i, ni in self._nodes():
+                ok_all = True
+                for name in names:
+                    if not self._on(name):
+                        continue
+                    ok, reasons = vol_preds[name](pod, ni)
+                    if not ok:
+                        ok_all = False
+                        reason_map.setdefault(i, []).extend(reasons)
+                        break
+                m[i] = ok_all
+            return m
+
+        if self._on("NoDiskConflict"):
+            f.disk_ok = mask(("NoDiskConflict",))
+        f.maxvol_ok = mask(("MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
+                            "MaxAzureDiskVolumeCount", "MaxCSIVolumeCountPred"))
+        if self._on("CheckVolumeBinding"):
+            f.volbind_ok = mask(("CheckVolumeBinding",))
+        if self._on("NoVolumeZoneConflict"):
+            f.volzone_ok = mask(("NoVolumeZoneConflict",))
+        f.volbind_reasons = reason_map
 
     # -- score inputs -------------------------------------------------------
     def _encode_scores(self, pod: Pod, f: PodFeatures) -> None:

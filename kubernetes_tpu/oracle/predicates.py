@@ -68,6 +68,11 @@ UNRESOLVABLE_FAILURES = {
     ERR_NODE_UNDER_MEMORY_PRESSURE,
     ERR_NODE_UNDER_DISK_PRESSURE,
     ERR_NODE_UNDER_PID_PRESSURE,
+    # volume placement can't be fixed by evicting pods
+    # (generic_scheduler.go:81-83)
+    "NoVolumeZoneConflict",
+    "VolumeNodeAffinityConflict",
+    "VolumeBindingNoMatch",
 }
 
 
@@ -368,7 +373,9 @@ class InterPodAffinityChecker:
 # Driver: run predicates in reference order with short-circuit
 # ---------------------------------------------------------------------------
 def default_predicate_set(node_infos: dict[str, NodeInfo],
-                          taint_nodes_by_condition: bool = True) -> dict[str, Callable]:
+                          taint_nodes_by_condition: bool = True,
+                          volume_listers=None,
+                          volume_binder=None) -> dict[str, Callable]:
     """The DefaultProvider predicate set (reference: defaults.go:40), keyed by
     name; evaluated in PREDICATE_ORDERING.
 
@@ -390,14 +397,15 @@ def default_predicate_set(node_infos: dict[str, NodeInfo],
         "GeneralPredicates": general_predicates,
         "PodToleratesNodeTaints": pod_tolerates_node_taints,
         "MatchInterPodAffinity": ipa.check,
-        "NoDiskConflict": always_fit,
-        "MaxEBSVolumeCount": always_fit,
-        "MaxGCEPDVolumeCount": always_fit,
-        "MaxAzureDiskVolumeCount": always_fit,
-        "MaxCSIVolumeCountPred": always_fit,
-        "CheckVolumeBinding": always_fit,
-        "NoVolumeZoneConflict": always_fit,
     }
+    if volume_listers is not None:
+        from kubernetes_tpu.oracle.volumes import make_volume_predicates
+        preds.update(make_volume_predicates(volume_listers, volume_binder))
+    else:
+        for name in ("NoDiskConflict", "MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
+                     "MaxAzureDiskVolumeCount", "MaxCSIVolumeCountPred",
+                     "CheckVolumeBinding", "NoVolumeZoneConflict"):
+            preds[name] = always_fit
     if taint_nodes_by_condition:
         preds["CheckNodeUnschedulable"] = check_node_unschedulable
     else:

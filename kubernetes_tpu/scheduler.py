@@ -22,8 +22,9 @@ from kubernetes_tpu.oracle.generic_scheduler import (
 )
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
 from kubernetes_tpu.store.store import (
-    Store, PODS, NODES, SERVICES, REPLICASETS, PDBS, NotFoundError,
+    Store, PODS, NODES, SERVICES, REPLICASETS, PDBS, PVS, PVCS, NotFoundError,
 )
+from kubernetes_tpu.oracle.volumes import VolumeListers, VolumeBinder
 from kubernetes_tpu.store.informer import InformerFactory
 from kubernetes_tpu.framework.v1alpha1 import (
     Framework, Registry, PluginContext, UNSCHEDULABLE as FW_UNSCHEDULABLE,
@@ -79,6 +80,11 @@ class Scheduler:
         replicasets = self.informers.informer(REPLICASETS)
         self._services_fn = services.list
         self._replicasets_fn = replicasets.list
+        # volume-aware scheduling (volumebinder bridge)
+        self.volume_listers = VolumeListers(
+            pvcs_fn=self.informers.informer(PVCS).list,
+            pvs_fn=self.informers.informer(PVS).list)
+        self.volume_binder = VolumeBinder(self.volume_listers, store=store)
         self._predicate_names = predicate_names
         self._priority_weights = priority_weights
         self.extenders = extenders or []
@@ -93,7 +99,9 @@ class Scheduler:
                 hard_pod_affinity_weight=hard_pod_affinity_weight,
                 services_fn=self._services_fn,
                 replicasets_fn=self._replicasets_fn,
-                nominated=self.queue.nominated)
+                nominated=self.queue.nominated,
+                volume_listers=self.volume_listers,
+                volume_binder=self.volume_binder)
             if priority_weights is not None:
                 from kubernetes_tpu.factory import tpu_kernel_weights
                 self.algorithm.weights = tpu_kernel_weights(priority_weights)
@@ -245,6 +253,10 @@ class Scheduler:
         assumed = pod.clone()
         assumed.node_name = result.suggested_host
         ctx = PluginContext()
+        if assumed.volumes:
+            node = self._snapshot.node_infos[result.suggested_host].node
+            reservations = self.volume_binder.assume_pod_volumes(assumed, node)
+            ctx.write("volume-reservations", reservations)
         # Reserve point (scheduler.go:507)
         st = self.framework.run_reserve_plugins(ctx, assumed, result.suggested_host)
         if not st.is_success():
@@ -284,11 +296,13 @@ class Scheduler:
 
     def _schedule(self, pod: Pod, names: list[str]) -> ScheduleResult:
         if isinstance(self.algorithm, GenericScheduler):
-            funcs = None
-            if self._predicate_names is not None:
-                from kubernetes_tpu.factory import build_predicate_set
-                funcs = build_predicate_set(self._predicate_names,
-                                            self._snapshot.node_infos)
+            from kubernetes_tpu.factory import (
+                build_predicate_set, DEFAULT_PREDICATE_NAMES)
+            funcs = build_predicate_set(
+                self._predicate_names or DEFAULT_PREDICATE_NAMES,
+                self._snapshot.node_infos,
+                volume_listers=self.volume_listers,
+                volume_binder=self.volume_binder)
             return self.algorithm.schedule(
                 pod, self._snapshot.node_infos, names,
                 predicate_funcs=funcs,
@@ -304,6 +318,11 @@ class Scheduler:
 
         def fail(unschedulable: bool) -> None:
             self.cache.forget_pod(assumed)
+            try:
+                self.volume_binder.forget_pod_volumes(
+                    ctx.read("volume-reservations"))
+            except KeyError:
+                pass
             self.framework.run_unreserve_plugins(ctx, assumed, host)
             self.metrics.observe("unschedulable" if unschedulable else "error")
             self._record_failure(orig, cycle)
@@ -317,6 +336,11 @@ class Scheduler:
             fail(st.code == FW_UNSCHEDULABLE)
             return
         try:
+            try:
+                self.volume_binder.bind_pod_volumes(
+                    ctx.read("volume-reservations"))
+            except KeyError:
+                pass
             if self._extender_binder is not None \
                     and self._extender_binder.is_interested(assumed):
                 # extender-managed binding (factory.go GetBinder: a binder
@@ -351,11 +375,12 @@ class Scheduler:
             return
         preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list,
                               extenders=self.extenders)
-        predicate_set_fn = None
-        if self._predicate_names is not None:
-            from kubernetes_tpu.factory import build_predicate_set
-            predicate_set_fn = lambda infos: build_predicate_set(
-                self._predicate_names, infos)
+        from kubernetes_tpu.factory import (
+            build_predicate_set, DEFAULT_PREDICATE_NAMES)
+        predicate_set_fn = lambda infos: build_predicate_set(
+            self._predicate_names or DEFAULT_PREDICATE_NAMES, infos,
+            volume_listers=self.volume_listers,
+            volume_binder=self.volume_binder)
         result = preemptor.preempt(
             updated, self._snapshot.node_infos,
             getattr(self, "_last_names", list(self._snapshot.node_infos)),
@@ -395,6 +420,8 @@ class Scheduler:
         if has_pod_affinity_terms(pod):
             return False
         if get_container_ports(pod):
+            return False
+        if pod.volumes:
             return False
         from kubernetes_tpu.oracle.priorities import get_selectors
         if get_selectors(pod, self._services_fn(), self._replicasets_fn()):

@@ -31,6 +31,8 @@ NODES = "nodes"
 SERVICES = "services"
 REPLICASETS = "replicasets"
 PDBS = "poddisruptionbudgets"
+PVS = "persistentvolumes"
+PVCS = "persistentvolumeclaims"
 LEASES = "leases"  # leader-election locks (resourcelock analog)
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches

@@ -1,0 +1,251 @@
+"""Volume scheduling tests — mirroring predicates_test.go volume cases and
+test/integration/scheduler/volume_binding_test.go.
+"""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, VolumeSource, PersistentVolume, PersistentVolumeClaim,
+    PLUGIN_EBS, PLUGIN_GCE_PD,
+    LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION,
+)
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.oracle import volumes as V
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, PODS, NODES, PVS, PVCS
+from kubernetes_tpu.utils.clock import FakeClock
+
+GI = 1024 ** 3
+
+
+def mknode(name, zone=None, **alloc):
+    labels = {}
+    if zone:
+        labels[LABEL_ZONE_FAILURE_DOMAIN] = zone
+        labels[LABEL_ZONE_REGION] = "r1"
+    allocatable = {"cpu": 4000, "memory": 32 * GI, "pods": 110}
+    allocatable.update(alloc)
+    return Node(name=name, labels=labels, allocatable=allocatable)
+
+
+def mkpod(name, volumes=(), cpu=100):
+    return Pod(name=name, volumes=tuple(volumes),
+               containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+def listers(pvcs=(), pvs=()):
+    return V.VolumeListers(pvcs_fn=lambda: list(pvcs), pvs_fn=lambda: list(pvs))
+
+
+class TestNoDiskConflict:
+    def test_same_ebs_volume_conflicts(self):
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(mkpod("existing", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_EBS, volume_id="vol-1")]))
+        pod = mkpod("new", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_EBS, volume_id="vol-1")])
+        ok, reasons = V.no_disk_conflict(pod, ni)
+        assert not ok and reasons == ["NoDiskConflict"]
+        other = mkpod("other", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_EBS, volume_id="vol-2")])
+        assert V.no_disk_conflict(other, ni)[0]
+
+    def test_gce_pd_read_only_sharing(self):
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(mkpod("existing", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_GCE_PD, volume_id="pd-1",
+                         read_only=True)]))
+        ro = mkpod("ro", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_GCE_PD, volume_id="pd-1",
+                         read_only=True)])
+        rw = mkpod("rw", volumes=[
+            VolumeSource(name="v", plugin=PLUGIN_GCE_PD, volume_id="pd-1")])
+        assert V.no_disk_conflict(ro, ni)[0]       # both read-only: ok
+        assert not V.no_disk_conflict(rw, ni)[0]   # writer conflicts
+
+
+class TestMaxVolumeCount:
+    def test_limit_enforced_counting_unique(self):
+        checker = V.MaxVolumeCountChecker(PLUGIN_EBS, listers(), max_volumes=2)
+        ni = NodeInfo(mknode("n1"))
+        ni.add_pod(mkpod("e1", volumes=[
+            VolumeSource(name="a", plugin=PLUGIN_EBS, volume_id="vol-a")]))
+        ni.add_pod(mkpod("e2", volumes=[
+            VolumeSource(name="b", plugin=PLUGIN_EBS, volume_id="vol-b")]))
+        # same volume as existing: no new unique -> fits
+        same = mkpod("same", volumes=[
+            VolumeSource(name="a", plugin=PLUGIN_EBS, volume_id="vol-a")])
+        assert checker.check(same, ni)[0]
+        new = mkpod("new", volumes=[
+            VolumeSource(name="c", plugin=PLUGIN_EBS, volume_id="vol-c")])
+        ok, reasons = checker.check(new, ni)
+        assert not ok and reasons == ["MaxVolumeCount"]
+
+    def test_pvc_backed_and_unbound_counting(self):
+        pvc_bound = PersistentVolumeClaim(name="c1", volume_name="pv1")
+        pv = PersistentVolume(name="pv1", plugin=PLUGIN_EBS, volume_id="vol-1")
+        pvc_unbound = PersistentVolumeClaim(name="c2")
+        lst = listers(pvcs=[pvc_bound, pvc_unbound], pvs=[pv])
+        checker = V.MaxVolumeCountChecker(PLUGIN_EBS, lst, max_volumes=1)
+        ni = NodeInfo(mknode("n1"))
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1"),
+                                  VolumeSource(name="w", pvc="c2")])
+        # bound resolves to vol-1; unbound counts pessimistically -> 2 > 1
+        assert not checker.check(pod, ni)[0]
+
+    def test_node_allocatable_limit_key(self):
+        lst = listers()
+        checker = V.MaxVolumeCountChecker(PLUGIN_EBS, lst)
+        node = mknode("n1", **{"attachable-volumes-ebs": 1})
+        ni = NodeInfo(node)
+        ni.add_pod(mkpod("e", volumes=[
+            VolumeSource(name="a", plugin=PLUGIN_EBS, volume_id="vol-a")]))
+        pod = mkpod("p", volumes=[
+            VolumeSource(name="b", plugin=PLUGIN_EBS, volume_id="vol-b")])
+        assert not checker.check(pod, ni)[0]
+
+
+class TestVolumeZone:
+    def test_zone_label_restricts_node(self):
+        pvc = PersistentVolumeClaim(name="c1", volume_name="pv1")
+        pv = PersistentVolume(name="pv1", labels={
+            LABEL_ZONE_FAILURE_DOMAIN: "zone-a", LABEL_ZONE_REGION: "r1"})
+        pred = V.make_volume_zone_predicate(listers(pvcs=[pvc], pvs=[pv]))
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1")])
+        ok_ni = NodeInfo(mknode("good", zone="zone-a"))
+        bad_ni = NodeInfo(mknode("bad", zone="zone-b"))
+        assert pred(pod, ok_ni)[0]
+        ok, reasons = pred(pod, bad_ni)
+        assert not ok and reasons == ["NoVolumeZoneConflict"]
+
+    def test_multi_zone_pv_label(self):
+        pvc = PersistentVolumeClaim(name="c1", volume_name="pv1")
+        pv = PersistentVolume(name="pv1", labels={
+            LABEL_ZONE_FAILURE_DOMAIN: "zone-a__zone-b"})
+        pred = V.make_volume_zone_predicate(listers(pvcs=[pvc], pvs=[pv]))
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1")])
+        assert pred(pod, NodeInfo(mknode("a", zone="zone-a")))[0]
+        assert pred(pod, NodeInfo(mknode("b", zone="zone-b")))[0]
+        assert not pred(pod, NodeInfo(mknode("c", zone="zone-c")))[0]
+
+
+class TestVolumeBinding:
+    def test_unbound_pvc_needs_matching_pv(self):
+        pvc = PersistentVolumeClaim(name="c1", request=5 * GI,
+                                    storage_class="standard")
+        pv_small = PersistentVolume(name="small", capacity=1 * GI,
+                                    storage_class="standard")
+        pv_big = PersistentVolume(name="big", capacity=10 * GI,
+                                  storage_class="standard")
+        binder = V.VolumeBinder(listers(pvcs=[pvc], pvs=[pv_small, pv_big]))
+        pred = binder.make_predicate()
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1")])
+        assert pred(pod, NodeInfo(mknode("n1")))[0]
+        # no fitting PV -> fail
+        binder2 = V.VolumeBinder(listers(pvcs=[pvc], pvs=[pv_small]))
+        ok, reasons = binder2.make_predicate()(pod, NodeInfo(mknode("n1")))
+        assert not ok and reasons == ["VolumeBindingNoMatch"]
+
+    def test_bound_pv_zone_restricts(self):
+        pvc = PersistentVolumeClaim(name="c1", volume_name="pv1")
+        pv = PersistentVolume(name="pv1", labels={
+            LABEL_ZONE_FAILURE_DOMAIN: "zone-a"})
+        binder = V.VolumeBinder(listers(pvcs=[pvc], pvs=[pv]))
+        pred = binder.make_predicate()
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1")])
+        assert pred(pod, NodeInfo(mknode("a", zone="zone-a")))[0]
+        ok, reasons = pred(pod, NodeInfo(mknode("b", zone="zone-b")))
+        assert not ok and reasons == ["VolumeNodeAffinityConflict"]
+
+    def test_assume_reserves_and_forget_releases(self):
+        pvc = PersistentVolumeClaim(name="c1", request=1 * GI,
+                                    storage_class="standard")
+        pv = PersistentVolume(name="pv1", capacity=2 * GI,
+                              storage_class="standard")
+        binder = V.VolumeBinder(listers(pvcs=[pvc], pvs=[pv]))
+        pod = mkpod("p", volumes=[VolumeSource(name="v", pvc="c1")])
+        node = mknode("n1")
+        res = binder.assume_pod_volumes(pod, node)
+        assert res == [("default/c1", "pv1")]
+        # reserved: a second pod with another unbound claim can't take pv1
+        pvc2 = PersistentVolumeClaim(name="c2", request=1 * GI,
+                                     storage_class="standard")
+        binder.listers = listers(pvcs=[pvc, pvc2], pvs=[pv])
+        pod2 = mkpod("p2", volumes=[VolumeSource(name="v", pvc="c2")])
+        assert not binder.make_predicate()(pod2, NodeInfo(node))[0]
+        binder.forget_pod_volumes(res)
+        assert binder.make_predicate()(pod2, NodeInfo(node))[0]
+
+
+class TestShellVolumeScheduling:
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_end_to_end_pvc_binding(self, use_tpu):
+        """Pod with an unbound PVC schedules onto a zone where a matching PV
+        exists; the PVC gets bound through the store on pod bind."""
+        store = Store()
+        store.create(NODES, mknode("n-a", zone="zone-a"))
+        store.create(NODES, mknode("n-b", zone="zone-b"))
+        store.create(PVCS, PersistentVolumeClaim(
+            name="claim", request=5 * GI, storage_class="standard"))
+        store.create(PVS, PersistentVolume(
+            name="pv-a", capacity=10 * GI, storage_class="standard",
+            labels={LABEL_ZONE_FAILURE_DOMAIN: "zone-a"}))
+        sched = Scheduler(store, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100, clock=FakeClock())
+        sched.sync()
+        store.create(PODS, mkpod("p", volumes=[
+            VolumeSource(name="data", pvc="claim")]))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        pod = store.get(PODS, "default/p")
+        assert pod.node_name == "n-a"      # only zone-a has a matching PV
+        assert store.get(PVCS, "default/claim").volume_name == "pv-a"
+        assert store.get(PVS, "pv-a").claim_ref == "default/claim"
+
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_disk_conflict_spreads_across_nodes(self, use_tpu):
+        store = Store()
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        sched = Scheduler(store, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100, clock=FakeClock())
+        sched.sync()
+        for j in range(3):
+            store.create(PODS, mkpod(f"p{j}", volumes=[
+                VolumeSource(name="v", plugin=PLUGIN_EBS, volume_id="vol-x")]))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        hosts = [store.get(PODS, f"default/p{j}").node_name for j in range(3)]
+        assert all(hosts)
+        assert len(set(hosts)) == 3  # same volume can't share a node
+
+    def test_tpu_oracle_parity_with_volumes(self):
+        def run(use_tpu):
+            store = Store()
+            for i in range(4):
+                store.create(NODES, mknode(f"n{i}",
+                                           zone=f"zone-{i % 2}"))
+            for k in range(3):
+                store.create(PVS, PersistentVolume(
+                    name=f"pv{k}", capacity=10 * GI, storage_class="std",
+                    labels={LABEL_ZONE_FAILURE_DOMAIN: f"zone-{k % 2}"}))
+                store.create(PVCS, PersistentVolumeClaim(
+                    name=f"c{k}", request=1 * GI, storage_class="std"))
+            sched = Scheduler(store, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100,
+                              clock=FakeClock())
+            sched.sync()
+            for j in range(6):
+                vols = ([VolumeSource(name="v", pvc=f"c{j % 3}")]
+                        if j % 2 == 0 else [])
+                store.create(PODS, mkpod(f"p{j}", volumes=vols))
+            sched.pump()
+            while sched.schedule_one(timeout=0.0):
+                pass
+            sched.pump()
+            return [store.get(PODS, f"default/p{j}").node_name
+                    for j in range(6)]
+        assert run(True) == run(False)
