@@ -306,6 +306,26 @@ _MUTABLE = ("req_cpu", "req_mem", "req_eph", "req_scalar",
             "nz_cpu", "nz_mem", "pod_count")
 
 
+def _fold_state(state, pod, sel, hit):
+    """Fold one decision's resource delta into the mutable node state.
+
+    Mirrors the cache's NodeInfo.AddPod aggregate update
+    (reference: nodeinfo/node_info.go:498) applied to the dense matrix.
+    """
+    idx = jnp.maximum(sel, 0)
+    delta = jnp.where(hit, 1, 0)
+    return {
+        "req_cpu": state["req_cpu"].at[idx].add(jnp.where(hit, pod["upd_cpu"], 0)),
+        "req_mem": state["req_mem"].at[idx].add(jnp.where(hit, pod["upd_mem"], 0)),
+        "req_eph": state["req_eph"].at[idx].add(jnp.where(hit, pod["upd_eph"], 0)),
+        "req_scalar": state["req_scalar"].at[idx].add(
+            jnp.where(hit, pod["upd_scalar"], jnp.zeros_like(pod["upd_scalar"]))),
+        "nz_cpu": state["nz_cpu"].at[idx].add(jnp.where(hit, pod["nz_cpu"], 0)),
+        "nz_mem": state["nz_mem"].at[idx].add(jnp.where(hit, pod["nz_mem"], 0)),
+        "pod_count": state["pod_count"].at[idx].add(delta),
+    }
+
+
 @partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
 def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
                         n_real, z_pad, weights_tuple):
@@ -318,18 +338,7 @@ def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
         out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights, z_pad)
         sel = out["selected"]
         hit = out["found"] > 0
-        idx = jnp.maximum(sel, 0)
-        delta = jnp.where(hit, 1, 0)
-        new_state = {
-            "req_cpu": state["req_cpu"].at[idx].add(jnp.where(hit, pod["upd_cpu"], 0)),
-            "req_mem": state["req_mem"].at[idx].add(jnp.where(hit, pod["upd_mem"], 0)),
-            "req_eph": state["req_eph"].at[idx].add(jnp.where(hit, pod["upd_eph"], 0)),
-            "req_scalar": state["req_scalar"].at[idx].add(
-                jnp.where(hit, pod["upd_scalar"], jnp.zeros_like(pod["upd_scalar"]))),
-            "nz_cpu": state["nz_cpu"].at[idx].add(jnp.where(hit, pod["nz_cpu"], 0)),
-            "nz_mem": state["nz_mem"].at[idx].add(jnp.where(hit, pod["nz_mem"], 0)),
-            "pod_count": state["pod_count"].at[idx].add(delta),
-        }
+        new_state = _fold_state(state, pod, sel, hit)
         return (new_state, out["next_last_index"], out["next_last_node_index"]), {
             "selected": sel,
             "found": out["found"],

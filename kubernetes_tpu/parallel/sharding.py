@@ -80,6 +80,19 @@ def shard_pod_arrays(mesh: Mesh, pod: dict) -> dict:
     return out
 
 
+def shard_pod_batch(mesh: Mesh, pods: dict) -> dict:
+    """device_put a stacked [B, ...] pod batch: per-node [B, N] arrays are
+    sharded along the node axis (axis 1); per-pod scalars replicate."""
+    batch_node = NamedSharding(mesh, P(None, NODE_AXIS))
+    out = {}
+    for k, v in pods.items():
+        if k in _POD_SHARDED:
+            out[k] = jax.device_put(v, batch_node)
+        else:
+            out[k] = jax.device_put(v, replicated(mesh))
+    return out
+
+
 def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
     """A jitted scheduling cycle whose heavy per-node phase stays sharded.
 
@@ -141,5 +154,54 @@ def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
             "next_last_index": (last_index + evaluated) % n_safe,
             "next_last_node_index": last_node_index + jnp.where(found > 1, 1, 0),
         }
+
+    return jax.jit(fn)
+
+
+def sharded_batch_fn(mesh: Mesh, z_pad: int, weights=None):
+    """The full scheduling *step* over the mesh: a `lax.scan` burst with the
+    node axis sharded and the complete mutable-state fold (kernels._MUTABLE —
+    req_cpu/mem/eph/scalar, nz_cpu/nz_mem, pod_count) constrained back onto
+    the node sharding every iteration.
+
+    This is the multi-chip twin of kernels.schedule_batch: each chip folds
+    the selected pod's deltas into its node rows; the per-node feasibility /
+    score vectors ride XLA collectives (all-gather over ICI) for the
+    replicated selection epilogue inside _cycle_core. Decisions are
+    bit-identical to the single-device scan (see tests/test_sharding.py).
+    """
+    weights_tuple = tuple(sorted((weights or K.DEFAULT_WEIGHTS).items()))
+    shard = node_sharding(mesh)
+    shard2 = node_sharding_2d(mesh)
+
+    def constrain(state):
+        return {
+            k: jax.lax.with_sharding_constraint(
+                v, shard2 if v.ndim == 2 else shard)
+            for k, v in state.items()
+        }
+
+    def fn(nodes, pods, last_index, last_node_index, num_to_find, n_real):
+        w = dict(weights_tuple)
+        static = {k: v for k, v in nodes.items() if k not in K._MUTABLE}
+
+        def step(carry, pod):
+            state, li, lni = carry
+            full = {**static, **state}
+            out = K._cycle_core(full, pod, li, lni, num_to_find, n_real, w, z_pad)
+            sel = out["selected"]
+            hit = out["found"] > 0
+            new_state = constrain(K._fold_state(state, pod, sel, hit))
+            return (new_state, out["next_last_index"], out["next_last_node_index"]), {
+                "selected": sel,
+                "found": out["found"],
+                "evaluated": out["evaluated"],
+                "max_score": out["max_score"],
+            }
+
+        init = (constrain({k: nodes[k] for k in K._MUTABLE}),
+                last_index, last_node_index)
+        (state, li, lni), outs = jax.lax.scan(step, init, pods)
+        return state, li, lni, outs
 
     return jax.jit(fn)

@@ -1,0 +1,193 @@
+"""Multi-chip sharding parity: the sharded kernels must make bit-identical
+decisions to the single-device kernels over the virtual 8-device CPU mesh
+(conftest forces xla_force_host_platform_device_count=8).
+
+Covers the north-star sharded path (SURVEY §2.3 last row): node axis split
+across the mesh, per-shard filter/score, all-gather, replicated select —
+single cycles, state folds between cycles, and the full lax.scan burst.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from kubernetes_tpu.api.types import Node, Pod, Container
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.ops.node_state import NodeStateEncoder, PodEncoder
+from kubernetes_tpu.ops import kernels as K
+from kubernetes_tpu.parallel import sharding as S
+from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+
+GI = 1024 ** 3
+MI = 1024 ** 2
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest should have forced 8 CPU devices"
+    return Mesh(np.asarray(devices[:8]), (S.NODE_AXIS,))
+
+
+def _cluster(n_nodes, seed=0, taints_on_some=False):
+    rng = np.random.RandomState(seed)
+    infos = {}
+    names = []
+    for i in range(n_nodes):
+        labels = {"failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
+                  "failure-domain.beta.kubernetes.io/region": "r1",
+                  "kubernetes.io/hostname": f"n{i}"}
+        node = Node(name=f"n{i}", labels=labels,
+                    allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
+        ni = NodeInfo(node)
+        infos[node.name] = ni
+        names.append(node.name)
+    for j in range(n_nodes * 2):
+        host = names[int(rng.randint(0, n_nodes))]
+        p = Pod(name=f"warm{j}", node_name=host,
+                containers=(Container.make(
+                    name="c",
+                    requests={"cpu": int(rng.choice([100, 500, 1000])),
+                              "memory": int(rng.choice([1, 2, 4])) * GI}),))
+        infos[host].add_pod(p)
+    return infos, names
+
+
+def _encode(infos, names, pods):
+    enc = NodeStateEncoder()
+    batch = enc.encode(infos, names)
+    sched = TPUScheduler(percentage_of_nodes_to_score=100)
+    pe = PodEncoder(infos, batch, total_num_nodes=len(names))
+    per_pod = [sched._pod_arrays(pe.encode(p), batch.n_pad,
+                                 upd_fields=True, pod=p) for p in pods]
+    stacked = {k: np.stack([pp[k] for pp in per_pod]) for k in per_pod[0]}
+    node_arrays = {k: np.asarray(v) for k, v in sched._node_arrays(batch).items()}
+    return node_arrays, per_pod, stacked, batch
+
+
+def _mk_pods(k, seed=1):
+    rng = np.random.RandomState(seed)
+    return [Pod(name=f"p{j}",
+                containers=(Container.make(
+                    name="c",
+                    requests={"cpu": int(rng.choice([100, 250, 500, 900])),
+                              "memory": int(rng.choice([1, 2, 3])) * GI}),))
+            for j in range(k)]
+
+
+CYCLE_KEYS = ("selected", "found", "evaluated", "max_score",
+              "next_last_index", "next_last_node_index")
+
+
+class TestShardedCycleParity:
+    @pytest.mark.parametrize("n_nodes,seed", [(17, 0), (64, 1), (100, 2)])
+    def test_cycle_matches_single_device(self, mesh, n_nodes, seed):
+        infos, names = _cluster(n_nodes, seed=seed)
+        pods = _mk_pods(1, seed=seed + 10)
+        node_arrays, per_pod, _, batch = _encode(infos, names, pods)
+        z_pad = 4
+        single = K.schedule_cycle(node_arrays, per_pod[0], 3, 1,
+                                  batch.n_real, batch.n_real, z_pad)
+        nodes_s = S.shard_node_arrays(mesh, node_arrays)
+        pod_s = S.shard_pod_arrays(mesh, per_pod[0])
+        fn = S.sharded_cycle_fn(mesh, z_pad=z_pad)
+        out = fn(nodes_s, pod_s,
+                 jnp.asarray(3, jnp.int64), jnp.asarray(1, jnp.int64),
+                 jnp.asarray(batch.n_real, jnp.int64),
+                 jnp.asarray(batch.n_real, jnp.int64))
+        for k in CYCLE_KEYS:
+            assert int(out[k]) == int(single[k]), k
+        np.testing.assert_array_equal(
+            np.asarray(out["total"]), np.asarray(single["total"]))
+        np.testing.assert_array_equal(
+            np.asarray(out["kept"]), np.asarray(single["kept"]))
+        np.testing.assert_array_equal(
+            np.asarray(out["feasible"]), np.asarray(single["feasible"]))
+
+    def test_partial_search_truncation(self, mesh):
+        """Adaptive partial search: num_to_find < feasible count."""
+        infos, names = _cluster(48, seed=3)
+        pods = _mk_pods(1, seed=30)
+        node_arrays, per_pod, _, batch = _encode(infos, names, pods)
+        z_pad = 4
+        single = K.schedule_cycle(node_arrays, per_pod[0], 11, 2,
+                                  10, batch.n_real, z_pad)
+        nodes_s = S.shard_node_arrays(mesh, node_arrays)
+        pod_s = S.shard_pod_arrays(mesh, per_pod[0])
+        fn = S.sharded_cycle_fn(mesh, z_pad=z_pad)
+        out = fn(nodes_s, pod_s,
+                 jnp.asarray(11, jnp.int64), jnp.asarray(2, jnp.int64),
+                 jnp.asarray(10, jnp.int64),
+                 jnp.asarray(batch.n_real, jnp.int64))
+        for k in CYCLE_KEYS:
+            assert int(out[k]) == int(single[k]), k
+
+
+class TestShardedBurstParity:
+    @pytest.mark.parametrize("n_nodes,n_burst,seed", [
+        (24, 8, 0), (64, 16, 1), (100, 32, 2)])
+    def test_burst_matches_single_device(self, mesh, n_nodes, n_burst, seed):
+        infos, names = _cluster(n_nodes, seed=seed)
+        pods = _mk_pods(n_burst, seed=seed + 20)
+        node_arrays, _, stacked, batch = _encode(infos, names, pods)
+        z_pad = 4
+        state1, li1, lni1, outs1 = K.schedule_batch(
+            node_arrays, stacked, 0, 0, batch.n_real, batch.n_real, z_pad)
+        nodes_s = S.shard_node_arrays(mesh, node_arrays)
+        pods_s = S.shard_pod_batch(mesh, stacked)
+        fn = S.sharded_batch_fn(mesh, z_pad=z_pad)
+        zero = jnp.asarray(0, jnp.int64)
+        state_s, li_s, lni_s, outs_s = fn(
+            nodes_s, pods_s, zero, zero,
+            jnp.asarray(batch.n_real, jnp.int64),
+            jnp.asarray(batch.n_real, jnp.int64))
+        np.testing.assert_array_equal(
+            np.asarray(outs_s["selected"]), np.asarray(outs1["selected"]))
+        np.testing.assert_array_equal(
+            np.asarray(outs_s["evaluated"]), np.asarray(outs1["evaluated"]))
+        np.testing.assert_array_equal(
+            np.asarray(outs_s["max_score"]), np.asarray(outs1["max_score"]))
+        assert int(li_s) == int(li1) and int(lni_s) == int(lni1)
+        for k in K._MUTABLE:
+            np.testing.assert_array_equal(
+                np.asarray(state_s[k]), np.asarray(state1[k]), err_msg=k)
+
+    def test_burst_fills_cluster(self, mesh):
+        """Saturation: pods keep landing until capacity runs out; the fold
+        must deplete sharded rows exactly like the single-device fold."""
+        infos, names = _cluster(8, seed=5)
+        # big pods: ~4 fit per node on cpu
+        pods = [Pod(name=f"big{j}",
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 900, "memory": GI}),))
+                for j in range(48)]
+        node_arrays, _, stacked, batch = _encode(infos, names, pods)
+        z_pad = 4
+        _, _, _, outs1 = K.schedule_batch(
+            node_arrays, stacked, 0, 0, batch.n_real, batch.n_real, z_pad)
+        nodes_s = S.shard_node_arrays(mesh, node_arrays)
+        pods_s = S.shard_pod_batch(mesh, stacked)
+        fn = S.sharded_batch_fn(mesh, z_pad=z_pad)
+        zero = jnp.asarray(0, jnp.int64)
+        _, _, _, outs_s = fn(nodes_s, pods_s, zero, zero,
+                             jnp.asarray(batch.n_real, jnp.int64),
+                             jnp.asarray(batch.n_real, jnp.int64))
+        sel1 = np.asarray(outs1["selected"])
+        sels = np.asarray(outs_s["selected"])
+        np.testing.assert_array_equal(sels, sel1)
+        assert (sel1 == -1).any(), "saturation case should reject some pods"
+
+
+class TestDryrunEntry:
+    def test_dryrun_multichip_runs(self):
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)
+
+    def test_entry_compiles(self):
+        import __graft_entry__
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        sel = int(out[0])
+        assert sel >= 0
