@@ -95,11 +95,12 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
 
     # RequestedToCapacityRatio with the default broken-linear shape
     # {0 -> 10, 100 -> 0} (requested_to_capacity_ratio.go:39): for that shape
-    # score(p) = 10 - p*10//100 where p = 100 - (cap-req)*100//cap
+    # score(p) = 10 + trunc((0-10)*p / 100); Go's int64 division truncates
+    # toward zero, so the (negative) numerator is divided as -(10p // 100)
     def rtcr_res(req, cap):
         p = jnp.where((cap == 0) | (req > cap), 100,
                       100 - (cap - req) * 100 // jnp.maximum(cap, 1))
-        return 10 + (0 - 10) * p // 100
+        return 10 - (10 * p) // 100
 
     rtcr_score = (rtcr_res(req_cpu, alloc_cpu) + rtcr_res(req_mem, alloc_mem)) // 2
 

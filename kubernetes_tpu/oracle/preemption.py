@@ -62,9 +62,9 @@ def nodes_where_preemption_might_help(
     reason (preempting pods can't fix a selector/taint mismatch)."""
     out = []
     for name in all_node_names:
-        reasons = failed_predicates.get(name)
-        if reasons is None:
-            continue  # node wasn't processed or fit — not a candidate
+        # a node absent from the failure map (e.g. extender-trimmed) counts
+        # as resolvable — the reference includes it (:1145-1151)
+        reasons = failed_predicates.get(name) or []
         if any(r in preds.UNRESOLVABLE_FAILURES for r in reasons):
             continue
         out.append(name)
@@ -214,7 +214,10 @@ class Preemptor:
         candidates = nodes_where_preemption_might_help(
             node_infos, all_node_names, fit_error.failed_predicates)
         if not candidates:
-            return PreemptionResult(None, [], [])
+            # preemption can't help anywhere: the pod's own stale nomination
+            # must be cleared (reference: generic_scheduler.go:330-333 returns
+            # []*v1.Pod{pod} as nominatedPodsToClear)
+            return PreemptionResult(None, [], [pod])
         pdbs = self.pdbs_fn()
 
         nodes_to_victims: dict[str, Victims] = {}

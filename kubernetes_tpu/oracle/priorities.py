@@ -82,14 +82,21 @@ def _fraction(req: int, cap: int) -> float:
 DEFAULT_RTCR_SHAPE: tuple[tuple[int, int], ...] = ((0, 10), (100, 0))
 
 
+def _trunc_div(a: int, b: int) -> int:
+    """Go int64 division truncates toward zero; Python // floors."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
 def broken_linear(shape: tuple[tuple[int, int], ...], p: int) -> int:
-    """Reference: buildBrokenLinearFunction :128 — integer segment interpolation."""
+    """Reference: buildBrokenLinearFunction :128 — integer segment
+    interpolation with Go's truncate-toward-zero division."""
     for i, (u, s) in enumerate(shape):
         if p <= u:
             if i == 0:
                 return shape[0][1]
             u0, s0 = shape[i - 1]
-            return s0 + (s - s0) * (p - u0) // (u - u0)
+            return s0 + _trunc_div((s - s0) * (p - u0), u - u0)
     return shape[-1][1]
 
 

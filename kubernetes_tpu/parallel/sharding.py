@@ -57,40 +57,38 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _put_by_keys(mesh: Mesh, arrays: dict, sharded_keys,
+                 sharded_spec: NamedSharding,
+                 sharded_2d_spec: NamedSharding | None = None) -> dict:
+    """device_put `arrays`: keys in `sharded_keys` get the node-axis spec
+    (2D keys their own spec when given); everything else replicates."""
+    repl = replicated(mesh)
+    out = {}
+    for k, v in arrays.items():
+        if sharded_2d_spec is not None and k in _SHARDED_2D:
+            out[k] = jax.device_put(v, sharded_2d_spec)
+        elif k in sharded_keys:
+            out[k] = jax.device_put(v, sharded_spec)
+        else:
+            out[k] = jax.device_put(v, repl)
+    return out
+
+
 def shard_node_arrays(mesh: Mesh, nodes: dict) -> dict:
     """device_put node arrays with the node axis split across the mesh."""
-    out = {}
-    for k, v in nodes.items():
-        if k in _SHARDED_2D:
-            out[k] = jax.device_put(v, node_sharding_2d(mesh))
-        elif k in _SHARDED_1D:
-            out[k] = jax.device_put(v, node_sharding(mesh))
-        else:
-            out[k] = jax.device_put(v, replicated(mesh))
-    return out
+    return _put_by_keys(mesh, nodes, _SHARDED_1D, node_sharding(mesh),
+                        node_sharding_2d(mesh))
 
 
 def shard_pod_arrays(mesh: Mesh, pod: dict) -> dict:
-    out = {}
-    for k, v in pod.items():
-        if k in _POD_SHARDED:
-            out[k] = jax.device_put(v, node_sharding(mesh))
-        else:
-            out[k] = jax.device_put(v, replicated(mesh))
-    return out
+    return _put_by_keys(mesh, pod, _POD_SHARDED, node_sharding(mesh))
 
 
 def shard_pod_batch(mesh: Mesh, pods: dict) -> dict:
     """device_put a stacked [B, ...] pod batch: per-node [B, N] arrays are
     sharded along the node axis (axis 1); per-pod scalars replicate."""
-    batch_node = NamedSharding(mesh, P(None, NODE_AXIS))
-    out = {}
-    for k, v in pods.items():
-        if k in _POD_SHARDED:
-            out[k] = jax.device_put(v, batch_node)
-        else:
-            out[k] = jax.device_put(v, replicated(mesh))
-    return out
+    return _put_by_keys(mesh, pods, _POD_SHARDED,
+                        NamedSharding(mesh, P(None, NODE_AXIS)))
 
 
 def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):

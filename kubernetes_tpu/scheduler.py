@@ -174,12 +174,25 @@ class Scheduler:
         self.queue.assigned_pod_updated(new)
 
     def _skip_pod_update(self, old: Pod, new: Pod) -> bool:
-        """Ignore self-inflicted updates on assumed pods
+        """Ignore self-inflicted updates on assumed pods — but only when the
+        diff is limited to resourceVersion / nodeName / status-ish fields;
+        real label/spec changes must reach the cache
         (reference: eventhandlers.go:275 skipPodUpdate)."""
         if not self.cache.is_assumed_pod(new):
             return False
-        # changes besides nominated-node/status are real
-        return old.node_name == new.node_name
+        assumed = self.cache.get_pod(new)
+        if assumed is None:
+            return False
+
+        def sanitize(p: Pod) -> Pod:
+            c = p.clone()
+            c.resource_version = 0
+            c.node_name = ""
+            c.nominated_node_name = ""
+            c.phase = "Pending"
+            return c
+
+        return sanitize(assumed) == sanitize(new)
 
     def _delete_pod_from_cache(self, pod: Pod) -> None:
         self.cache.remove_pod(pod)
@@ -386,22 +399,23 @@ class Scheduler:
             getattr(self, "_last_names", list(self._snapshot.node_infos)),
             err, nominated_pods_fn=self.queue.nominated.pods_for_node,
             predicate_set_fn=predicate_set_fn)
-        if result.node is None:
-            return
-        # in-memory nomination first (scheduler.go:310), then the API write
-        self.queue.nominated.add(updated, result.node.name)
-        try:
-            self.store.set_nominated_node_name(pod.key, result.node.name)
-        except NotFoundError:
-            self.queue.nominated.delete(updated)
-            return
-        for victim in result.victims:
+        if result.node is not None:
+            # in-memory nomination first (scheduler.go:310), then the API write
+            self.queue.nominated.add(updated, result.node.name)
             try:
-                self.store.delete(PODS, victim.key)
+                self.store.set_nominated_node_name(pod.key, result.node.name)
             except NotFoundError:
-                pass
-            self.metrics.preemption_victims += 1
-        # lower-priority pods lose their nomination (scheduler.go:321)
+                self.queue.nominated.delete(updated)
+                return
+            for victim in result.victims:
+                try:
+                    self.store.delete(PODS, victim.key)
+                except NotFoundError:
+                    pass
+                self.metrics.preemption_victims += 1
+        # nomination cleanup happens even when no node was found: Preempt may
+        # return the preemptor itself so its stale NominatedNodeName is
+        # removed (scheduler.go:329-339)
         for p in result.nominated_to_clear:
             self.queue.nominated.delete(p)
             try:
@@ -444,7 +458,13 @@ class Scheduler:
         if not pods:
             return 0
         before = self.metrics.schedule_attempts["scheduled"]
-        can_burst = hasattr(self.algorithm, "schedule_burst")
+        # the burst fold skips the per-pod Reserve/Permit/Prebind points, so
+        # any configured plugin forces the serial path (decisions and plugin
+        # side effects must not differ by path)
+        can_burst = (hasattr(self.algorithm, "schedule_burst")
+                     and not self.framework.reserve
+                     and not self.framework.permit
+                     and not self.framework.prebind)
         i = 0
         while i < len(pods):
             # serial path for mask-stale pods and under active nominations

@@ -89,12 +89,12 @@ class GatePermit(PermitPlugin):
         return Status(WAIT, ""), self.timeout
 
 
-def make_scheduler(store, plugins, args=None):
+def make_scheduler(store, plugins, args=None, **kw):
     reg = Registry()
     for p in plugins:
         reg.register(p.NAME, lambda _args, _handle, _p=p: _p)
     return Scheduler(store, percentage_of_nodes_to_score=100,
-                     plugin_registry=reg, clock=FakeClock())
+                     plugin_registry=reg, clock=FakeClock(), **kw)
 
 
 def run_all(sched):
@@ -189,3 +189,25 @@ class TestRegistry:
         ctx.delete("k")
         with pytest.raises(KeyError):
             ctx.read("k")
+
+
+class TestBurstPluginGate:
+    def test_burst_with_reserve_plugin_runs_reserve_per_pod(self):
+        """The device burst fold skips per-pod extension points, so a
+        configured Reserve plugin must force the serial path — plugin side
+        effects may not differ between burst and serial scheduling."""
+        store = Store()
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        res = RecordingReserve()
+        sched = make_scheduler(store, [res], use_tpu=True)
+        sched.sync()
+        for j in range(8):
+            store.create(PODS, mkpod(f"p{j}"))
+        sched.pump()
+        while sched.schedule_burst(max_pods=64):
+            pass
+        sched.wait_for_binds()
+        sched.pump()
+        assert sorted(n for n, _ in res.calls) == [f"p{j}" for j in range(8)]
+        assert all(store.get(PODS, f"default/p{j}").node_name for j in range(8))

@@ -373,6 +373,14 @@ class TestRTCR:
         assert prios.broken_linear(shape, 75) == 5
         assert prios.broken_linear(shape, 100) == 0
 
+    def test_trunc_toward_zero_matches_go(self):
+        # Go: 10 + (0-10)*55/100 = 10 + (-5) = 5; Python floor would give 4
+        assert prios.broken_linear(prios.DEFAULT_RTCR_SHAPE, 55) == 5
+        assert prios.broken_linear(prios.DEFAULT_RTCR_SHAPE, 99) == 1
+        assert prios._trunc_div(-550, 100) == -5
+        assert prios._trunc_div(550, 100) == 5
+        assert prios._trunc_div(550, -100) == -5
+
 
 class TestNodeAffinityPriority:
     def test_weights_and_normalize(self):

@@ -178,6 +178,26 @@ class TestPreemptor:
         result = Preemptor().preempt(pre, infos, ["n1"], ei.value)
         assert result.node is None
 
+    def test_no_candidate_nodes_clears_own_nomination(self):
+        """All failures unresolvable -> Preempt returns the preemptor itself
+        in nominated_to_clear (generic_scheduler.go:330-333)."""
+        nodes = [mknode("n1")]
+        infos = snapshot(nodes, {})
+        pre = mkpod("pre", cpu=1000, priority=100)
+        pre.nominated_node_name = "n1"
+        err = FitError(pre, 1, {"n1": [preds.ERR_NODE_SELECTOR_NOT_MATCH]})
+        result = Preemptor().preempt(pre, infos, ["n1"], err)
+        assert result.node is None
+        assert [p.name for p in result.nominated_to_clear] == ["pre"]
+
+    def test_missing_failure_entry_is_candidate(self):
+        """A node absent from the failure map is resolvable -> candidate
+        (generic_scheduler.go:1145-1151)."""
+        infos = snapshot([mknode("n1"), mknode("n2")], {})
+        failed = {"n1": [preds.ERR_TAINTS_TOLERATIONS_NOT_MATCH]}
+        out = nodes_where_preemption_might_help(infos, ["n1", "n2"], failed)
+        assert out == ["n2"]
+
 
 class TestNominatedTwoPass:
     def test_nominated_pod_reserves_capacity(self):
@@ -303,3 +323,28 @@ class TestDoublePreemptorCoordination:
         assert store.get(PODS, "default/urgent-a").node_name
         assert store.get(PODS, "default/urgent-b").node_name
         assert sched.metrics.preemption_victims == 2
+
+
+class TestStaleNominationCleanup:
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    def test_unhelpful_preemption_clears_nomination(self, use_tpu):
+        """A pod whose failure preemption can't fix (unresolvable selector
+        everywhere) must have its stale NominatedNodeName removed from the
+        store and queue (scheduler.go:329-339 + generic_scheduler.go:330)."""
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        sched = Scheduler(store, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        pre = mkpod("pre", cpu=100, priority=100)
+        pre.node_selector = {"disk": "ssd"}   # no node has this label
+        pre.nominated_node_name = "n1"        # stale from an earlier cycle
+        store.create(PODS, pre)
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert store.get(PODS, "default/pre").nominated_node_name == ""
+        assert not sched.queue.nominated.has_any()

@@ -21,7 +21,6 @@ from kubernetes_tpu.parallel import sharding as S
 from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
 
 GI = 1024 ** 3
-MI = 1024 ** 2
 
 
 @pytest.fixture(scope="module")
@@ -31,7 +30,7 @@ def mesh():
     return Mesh(np.asarray(devices[:8]), (S.NODE_AXIS,))
 
 
-def _cluster(n_nodes, seed=0, taints_on_some=False):
+def _cluster(n_nodes, seed=0):
     rng = np.random.RandomState(seed)
     infos = {}
     names = []

@@ -286,3 +286,30 @@ class TestBurstParity:
         tpu = TPUScheduler(percentage_of_nodes_to_score=50)
         got = tpu.schedule_burst(pods, tpu_infos, names)
         assert got == expected
+
+
+class TestKernelRTCR:
+    def test_rtcr_truncates_toward_zero(self):
+        """Go int64 division truncates toward zero: p=55 scores 5, not 4."""
+        from kubernetes_tpu.ops.node_state import NodeStateEncoder, PodEncoder
+        from kubernetes_tpu.ops import kernels as K
+        node = Node(name="n0", labels={LABEL_HOSTNAME: "n0"},
+                    allocatable={"cpu": 10000, "memory": 10000, "pods": 110})
+        infos = {"n0": NodeInfo(node)}
+        enc = NodeStateEncoder()
+        batch = enc.encode(infos, ["n0"])
+        pod = Pod(name="p", containers=(Container.make(
+            name="c", requests={"cpu": 5500, "memory": 5500}),))
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        feats = PodEncoder(infos, batch).encode(pod)
+        pod_in = tpu._pod_arrays(feats, batch.n_pad)
+        nodes = tpu._node_arrays(batch)
+        weights = {k: 0 for k in K.DEFAULT_WEIGHTS}
+        weights["rtcr"] = 1
+        out = K.schedule_cycle(nodes, pod_in, 0, 0, 1, 1, 4, weights=weights)
+        # p = 100 - (10000-5500)*100//10000 = 55 for both cpu and mem
+        # score = (5 + 5) // 2 = 5 (Go trunc), not 4 (Python floor)
+        assert int(np.asarray(out["total"])[0]) == 5
+        from kubernetes_tpu.oracle import priorities as prios
+        rtcr = prios.make_rtcr_map()
+        assert rtcr(pod, infos["n0"]) == 5
