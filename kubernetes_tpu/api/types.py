@@ -368,6 +368,7 @@ class Pod:
     # status
     nominated_node_name: str = ""
     phase: str = "Pending"
+    conditions: tuple["PodCondition", ...] = ()
     start_time: Optional[float] = None
     # controller owner reference (kind, name, uid) — read by
     # NodePreferAvoidPods priority and selector-spread listers
@@ -393,6 +394,51 @@ class Pod:
         out.labels = dict(self.labels)
         out.node_selector = dict(self.node_selector)
         return out
+
+
+@dataclass(frozen=True)
+class PodCondition:
+    """Pruned v1.PodCondition (the scheduler writes PodScheduled=False with
+    a reason/message on failure; reference: factory.go:715-726)."""
+    type: str       # "PodScheduled", ...
+    status: str     # "True" / "False" / "Unknown"
+    reason: str = ""
+    message: str = ""
+
+
+POD_SCHEDULED = "PodScheduled"
+CONDITION_TRUE = "True"
+CONDITION_FALSE = "False"
+# condition/event reasons (reference: v1.PodReasonUnschedulable,
+# core/generic_scheduler.go SchedulerError usage in scheduler.go:350)
+REASON_UNSCHEDULABLE = "Unschedulable"
+REASON_SCHEDULER_ERROR = "SchedulerError"
+
+
+@dataclass
+class EventRecord:
+    """Pruned v1.Event: the user-visible audit record the scheduler emits
+    (reference: record.EventRecorder calls, scheduler.go:268,325,433).
+    Aggregated by (object, reason, message) with a count like the
+    reference's event correlator."""
+    name: str
+    involved_kind: str          # "Pod", ...
+    involved_key: str           # namespace/name of the object
+    type: str                   # "Normal" / "Warning"
+    reason: str                 # "Scheduled", "FailedScheduling", "Preempted"
+    message: str = ""
+    count: int = 1
+    namespace: str = "default"
+    component: str = ""         # emitting component (v1.EventSource.Component)
+    # bookkeeping
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "EventRecord":
+        return copy.copy(self)
 
 
 @dataclass(frozen=True)

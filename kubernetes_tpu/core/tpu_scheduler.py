@@ -32,6 +32,7 @@ from kubernetes_tpu.ops.node_state import (
 )
 from kubernetes_tpu.ops import kernels as K
 
+import jax
 import jax.numpy as jnp
 
 
@@ -40,6 +41,18 @@ def _pad_pow2(n: int, minimum: int = 1) -> int:
     while c < n:
         c *= 2
     return c
+
+
+@jax.jit
+def _scatter_rows(dev: dict, rows, upd: dict) -> dict:
+    """Write generation-dirty rows into the device-resident node matrix —
+    the sparse delta upload of SURVEY §2.4 (mirror of the cache's
+    incremental snapshot walk, reference cache.go:210-246). One dispatch
+    for all fields."""
+    out = dict(dev)
+    for k, v in upd.items():
+        out[k] = dev[k].at[rows].set(v)
+    return out
 
 
 class TPUScheduler:
@@ -71,43 +84,55 @@ class TPUScheduler:
         self.last_index = 0
         self.last_node_index = 0
         self.encoder = NodeStateEncoder()
-        self._defaults_cache: dict = {}
-
-    # -- device input assembly ----------------------------------------------
-    def _node_arrays(self, b: NodeBatch) -> dict:
-        return {
-            "valid": jnp.asarray(b.valid),
-            "alloc_cpu": jnp.asarray(b.alloc_cpu),
-            "alloc_mem": jnp.asarray(b.alloc_mem),
-            "alloc_eph": jnp.asarray(b.alloc_eph),
-            "allowed_pods": jnp.asarray(b.allowed_pods),
-            "req_cpu": jnp.asarray(b.req_cpu),
-            "req_mem": jnp.asarray(b.req_mem),
-            "req_eph": jnp.asarray(b.req_eph),
-            "nz_cpu": jnp.asarray(b.nz_cpu),
-            "nz_mem": jnp.asarray(b.nz_mem),
-            "pod_count": jnp.asarray(b.pod_count),
-            "alloc_scalar": jnp.asarray(b.alloc_scalar),
-            "req_scalar": jnp.asarray(b.req_scalar),
-            "zone_id": jnp.asarray(b.zone_id),
+        # device-resident node matrix: full upload on rebuild, dirty-row
+        # scatter otherwise (SURVEY §2.4 delta uploader)
+        self._dev_nodes: Optional[dict] = None
+        self._dev_key = None
+        # inert per-pod fields are shape [1] and broadcast in the kernel —
+        # the common case uploads ~nothing (vs [N] per field per pod)
+        self._defaults = {
+            "ones_bool": np.ones(1, dtype=bool),
+            "zeros_i64": np.zeros(1, dtype=np.int64),
+            "zeros_i8": np.zeros(1, dtype=np.int8),
+            "zeros_bool": np.zeros(1, dtype=bool),
+            "tens_i64": np.full(1, 10, dtype=np.int64),
         }
 
-    def _defaults(self, n_pad: int):
-        d = self._defaults_cache.get(n_pad)
-        if d is None:
-            d = {
-                "ones_bool": np.ones(n_pad, dtype=bool),
-                "zeros_i64": np.zeros(n_pad, dtype=np.int64),
-                "zeros_i8": np.zeros(n_pad, dtype=np.int8),
-                "zeros_bool": np.zeros(n_pad, dtype=bool),
-                "tens_i64": np.full(n_pad, 10, dtype=np.int64),
-            }
-            self._defaults_cache[n_pad] = d
-        return d
+    # -- device input assembly ----------------------------------------------
+    _NODE_FIELDS = ("valid", "alloc_cpu", "alloc_mem", "alloc_eph",
+                    "allowed_pods", "req_cpu", "req_mem", "req_eph",
+                    "nz_cpu", "nz_mem", "pod_count", "alloc_scalar",
+                    "req_scalar", "zone_id")
+
+    def _node_arrays(self, b: NodeBatch) -> dict:
+        """Device node matrix, kept resident across cycles; only rows the
+        encoder marked generation-dirty are re-uploaded."""
+        key = (b.n_pad, len(b.scalar_names), id(b))
+        if self._dev_nodes is None or self._dev_key != key or b.dirty_rows is None:
+            self._dev_nodes = {k: jnp.asarray(getattr(b, k))
+                               for k in self._NODE_FIELDS}
+            self._dev_key = key
+            b.dirty_rows = []   # host state fully mirrored; start tracking
+            return self._dev_nodes
+        if b.dirty_rows:
+            # dedupe, then pad the row list to a power-of-two bucket
+            # (duplicate writes of identical values are harmless) so the
+            # scatter compiles per bucket, not per row count
+            rows = np.asarray(sorted(set(b.dirty_rows)), dtype=np.int32)
+            bucket = _pad_pow2(len(rows), 16)
+            rows = np.concatenate(
+                [rows, np.full(bucket - len(rows), rows[0], dtype=np.int32)])
+            upd = {k: getattr(b, k)[rows] for k in self._NODE_FIELDS}
+            self._dev_nodes = _scatter_rows(self._dev_nodes, rows, upd)
+            b.dirty_rows = []
+        return self._dev_nodes
 
     def _pod_arrays(self, f: PodFeatures, n_pad: int,
                     upd_fields: bool = False, pod: Optional[Pod] = None) -> dict:
-        d = self._defaults(n_pad)
+        """Dense device inputs for one pod. Feature fields the pod doesn't
+        exercise stay shape [1] (kernel broadcasts them) — `n_pad` is only
+        the target for fields the encoder actually materialized."""
+        d = self._defaults
         out = {
             "req_cpu": np.int64(f.req_cpu),
             "req_mem": np.int64(f.req_mem),
@@ -151,6 +176,23 @@ class TPUScheduler:
                 "upd_eph": np.int64(upd.ephemeral_storage),
                 "upd_scalar": upd_scalar,
             })
+        return out
+
+    @staticmethod
+    def _stack_pods(per_pod: list[dict]) -> dict:
+        """Stack per-pod dicts to [B, ...] arrays. A field that is inert
+        ([1]-shaped) for every pod stays [B, 1] — the scan broadcasts it —
+        so plain pods upload O(B) data, not O(B*N)."""
+        out = {}
+        for k in per_pod[0]:
+            vals = [pp[k] for pp in per_pod]
+            shapes = {np.shape(v) for v in vals}
+            if len(shapes) > 1:
+                # mixed inert/dense: broadcast the inert ones up
+                target = max(shapes, key=len) if len({len(s) for s in shapes}) > 1 \
+                    else max(shapes)
+                vals = [np.broadcast_to(v, target) for v in vals]
+            out[k] = np.stack(vals)
         return out
 
     # -- reason decoding -----------------------------------------------------
@@ -319,7 +361,7 @@ class TPUScheduler:
             pad = dict(per_pod[-1])
             pad["skip"] = np.bool_(True)
             per_pod.extend([pad] * (bucket - len(per_pod)))
-        stacked = {k: np.stack([pp[k] for pp in per_pod]) for k in per_pod[0]}
+        stacked = self._stack_pods(per_pod)
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)

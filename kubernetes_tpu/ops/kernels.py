@@ -75,107 +75,154 @@ def _i64(x):
     return jnp.asarray(x, dtype=jnp.int64)
 
 
+def _inert(arr) -> bool:
+    """True when a per-node pod field was left at its default: the encoder
+    emits shape-(1,) arrays for features the pod/cluster doesn't exercise
+    (tpu_scheduler._pod_arrays), so whole priority/predicate families can be
+    skipped at *trace* time — the shape is static."""
+    return arr.ndim >= 1 and arr.shape[-1] == 1
+
+
 def _fit_scores(nodes, pod, kept, weights, z_pad):
-    """All default priorities, masked-normalized over `kept`. Returns total[N] i64."""
+    """Enabled priorities, masked-normalized over `kept`. Returns total[N] i64.
+
+    Zero-weight priorities and inert (default-valued, shape-[1]) pod fields
+    are skipped at trace time: a plain-pod burst compiles down to
+    LeastRequested + BalancedAllocation + integer constants — int64 division
+    and f64 emulation on the MXU-less VPU path are the cost drivers, so ops
+    that provably contribute a constant are folded into one scalar."""
     alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
     req_cpu = pod["nz_cpu"] + nodes["nz_cpu"]
     req_mem = pod["nz_mem"] + nodes["nz_mem"]
 
-    def least(req, cap):
-        ok = (cap > 0) & (req <= cap)
-        return jnp.where(ok, (cap - req) * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+    total = jnp.zeros(nodes["valid"].shape, dtype=jnp.int64)
+    const = 0   # python-int accumulator for provably-constant scores
 
-    least_score = (least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) // 2
+    if weights["least_requested"]:
+        def least(req, cap):
+            ok = (cap > 0) & (req <= cap)
+            return jnp.where(ok, (cap - req) * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+        total = total + weights["least_requested"] * (
+            (least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) // 2)
 
-    def most(req, cap):
-        ok = (cap > 0) & (req <= cap)
-        return jnp.where(ok, req * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+    if weights["most_requested"]:
+        def most(req, cap):
+            ok = (cap > 0) & (req <= cap)
+            return jnp.where(ok, req * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
+        total = total + weights["most_requested"] * (
+            (most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem)) // 2)
 
-    most_score = (most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem)) // 2
+    if weights["rtcr"]:
+        # RequestedToCapacityRatio, default broken-linear shape {0->10,100->0}
+        # (requested_to_capacity_ratio.go:39): score(p) = 10 + trunc(-10p/100);
+        # Go int64 division truncates toward zero -> -(10p // 100) for p >= 0
+        def rtcr_res(req, cap):
+            p = jnp.where((cap == 0) | (req > cap), 100,
+                          100 - (cap - req) * 100 // jnp.maximum(cap, 1))
+            return 10 - (10 * p) // 100
+        total = total + weights["rtcr"] * (
+            (rtcr_res(req_cpu, alloc_cpu) + rtcr_res(req_mem, alloc_mem)) // 2)
 
-    # RequestedToCapacityRatio with the default broken-linear shape
-    # {0 -> 10, 100 -> 0} (requested_to_capacity_ratio.go:39): for that shape
-    # score(p) = 10 + trunc((0-10)*p / 100); Go's int64 division truncates
-    # toward zero, so the (negative) numerator is divided as -(10p // 100)
-    def rtcr_res(req, cap):
-        p = jnp.where((cap == 0) | (req > cap), 100,
-                      100 - (cap - req) * 100 // jnp.maximum(cap, 1))
-        return 10 - (10 * p) // 100
+    if weights["balanced"]:
+        cpu_f = jnp.where(alloc_cpu == 0, 1.0, req_cpu / alloc_cpu)
+        mem_f = jnp.where(alloc_mem == 0, 1.0, req_mem / alloc_mem)
+        balanced = jnp.where(
+            (cpu_f >= 1.0) | (mem_f >= 1.0), 0,
+            ((1.0 - jnp.abs(cpu_f - mem_f)) * float(MAX_PRIORITY)).astype(jnp.int64))
+        total = total + weights["balanced"] * balanced
 
-    rtcr_score = (rtcr_res(req_cpu, alloc_cpu) + rtcr_res(req_mem, alloc_mem)) // 2
+    if weights["node_affinity"]:
+        na = pod["node_aff_counts"]
+        if _inert(na):
+            pass   # all counts 0 -> normalized score 0 everywhere
+        else:
+            # NodeAffinity: NormalizeReduce(10, reverse=False) over kept
+            na_max = jnp.max(jnp.where(kept, na, 0))
+            total = total + weights["node_affinity"] * jnp.where(
+                na_max == 0, na, MAX_PRIORITY * na // jnp.maximum(na_max, 1))
 
-    cpu_f = jnp.where(alloc_cpu == 0, 1.0, req_cpu / alloc_cpu)
-    mem_f = jnp.where(alloc_mem == 0, 1.0, req_mem / alloc_mem)
-    balanced = jnp.where(
-        (cpu_f >= 1.0) | (mem_f >= 1.0), 0,
-        ((1.0 - jnp.abs(cpu_f - mem_f)) * float(MAX_PRIORITY)).astype(jnp.int64))
+    if weights["taint_toleration"]:
+        tt = pod["taint_counts"]
+        if _inert(tt):
+            const += weights["taint_toleration"] * MAX_PRIORITY
+        else:
+            # TaintToleration: NormalizeReduce(10, reverse=True) over kept
+            tt_max = jnp.max(jnp.where(kept, tt, 0))
+            total = total + weights["taint_toleration"] * jnp.where(
+                tt_max == 0, MAX_PRIORITY,
+                MAX_PRIORITY - MAX_PRIORITY * tt // jnp.maximum(tt_max, 1))
 
-    # NodeAffinity: NormalizeReduce(10, reverse=False) over kept
-    na = pod["node_aff_counts"]
-    na_max = jnp.max(jnp.where(kept, na, 0))
-    node_aff = jnp.where(na_max == 0, na, MAX_PRIORITY * na // jnp.maximum(na_max, 1))
+    if weights["selector_spread"]:
+        sc = pod["spread_counts"]
+        if _inert(sc):
+            # all counts 0 -> node and zone fractions are both max -> 10
+            const += weights["selector_spread"] * MAX_PRIORITY
+        else:
+            # SelectorSpread: node + zone blend (selector_spreading.go:99)
+            zone_id = nodes["zone_id"]
+            max_by_node = jnp.max(jnp.where(kept, sc, 0))
+            f = jnp.where(max_by_node > 0,
+                          float(MAX_PRIORITY) * ((max_by_node - sc)
+                                                 / jnp.maximum(max_by_node, 1)),
+                          float(MAX_PRIORITY))
+            in_zone = kept & (zone_id > 0)
+            zone_counts = jnp.zeros(z_pad, dtype=jnp.int64).at[zone_id].add(
+                jnp.where(in_zone, sc, 0))
+            zone_present = jnp.zeros(z_pad, dtype=bool).at[zone_id].max(in_zone)
+            have_zones = jnp.any(in_zone)
+            max_by_zone = jnp.max(jnp.where(zone_present, zone_counts, 0))
+            zc = zone_counts[zone_id]
+            zs = jnp.where(max_by_zone > 0,
+                           float(MAX_PRIORITY) * ((max_by_zone - zc)
+                                                  / jnp.maximum(max_by_zone, 1)),
+                           float(MAX_PRIORITY))
+            f = jnp.where(have_zones & (zone_id > 0),
+                          f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zs, f)
+            total = total + weights["selector_spread"] * f.astype(jnp.int64)
 
-    # TaintToleration: NormalizeReduce(10, reverse=True) over kept
-    tt = pod["taint_counts"]
-    tt_max = jnp.max(jnp.where(kept, tt, 0))
-    taint_tol = jnp.where(
-        tt_max == 0, MAX_PRIORITY,
-        MAX_PRIORITY - MAX_PRIORITY * tt // jnp.maximum(tt_max, 1))
+    if weights["interpod"]:
+        ic = pod["interpod_counts"]
+        tracked = pod["interpod_tracked"]
+        if _inert(ic) and _inert(tracked):
+            pass   # nothing tracked -> 0 everywhere
+        else:
+            # InterPodAffinity preferred: min-max over kept∩tracked
+            sel = kept & tracked
+            ic_max = jnp.maximum(
+                jnp.max(jnp.where(sel, ic, jnp.iinfo(jnp.int64).min)), 0)
+            ic_min = jnp.minimum(
+                jnp.min(jnp.where(sel, ic, jnp.iinfo(jnp.int64).max)), 0)
+            diff = ic_max - ic_min
+            total = total + weights["interpod"] * jnp.where(
+                (diff > 0) & tracked,
+                (float(MAX_PRIORITY) * ((ic - ic_min)
+                                        / jnp.maximum(diff, 1))).astype(jnp.int64),
+                0)
 
-    # SelectorSpread: node + zone blend (selector_spreading.go:99)
-    sc = pod["spread_counts"]
-    zone_id = nodes["zone_id"]
-    max_by_node = jnp.max(jnp.where(kept, sc, 0))
-    f = jnp.where(max_by_node > 0,
-                  float(MAX_PRIORITY) * ((max_by_node - sc) / jnp.maximum(max_by_node, 1)),
-                  float(MAX_PRIORITY))
-    in_zone = kept & (zone_id > 0)
-    zone_counts = jnp.zeros(z_pad, dtype=jnp.int64).at[zone_id].add(
-        jnp.where(in_zone, sc, 0))
-    zone_present = jnp.zeros(z_pad, dtype=bool).at[zone_id].max(in_zone)
-    have_zones = jnp.any(in_zone)
-    max_by_zone = jnp.max(jnp.where(zone_present, zone_counts, 0))
-    zc = zone_counts[zone_id]
-    zs = jnp.where(max_by_zone > 0,
-                   float(MAX_PRIORITY) * ((max_by_zone - zc) / jnp.maximum(max_by_zone, 1)),
-                   float(MAX_PRIORITY))
-    f = jnp.where(have_zones & (zone_id > 0),
-                  f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zs, f)
-    spread = f.astype(jnp.int64)
+    if weights["image_locality"]:
+        s = pod["image_sums"]
+        if _inert(s):
+            pass   # sum 0 -> clip to IMAGE_MIN -> score 0
+        else:
+            # ImageLocality (image_locality.go:42)
+            sc = jnp.clip(s, IMAGE_MIN, IMAGE_MAX)
+            total = total + weights["image_locality"] * (
+                MAX_PRIORITY * (sc - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN))
 
-    # InterPodAffinity preferred: min-max over kept∩tracked, 0 in the fold
-    ic = pod["interpod_counts"]
-    tracked = pod["interpod_tracked"]
-    sel = kept & tracked
-    ic_max = jnp.maximum(jnp.max(jnp.where(sel, ic, jnp.iinfo(jnp.int64).min)), 0)
-    ic_min = jnp.minimum(jnp.min(jnp.where(sel, ic, jnp.iinfo(jnp.int64).max)), 0)
-    diff = ic_max - ic_min
-    interpod = jnp.where(
-        (diff > 0) & tracked,
-        (float(MAX_PRIORITY) * ((ic - ic_min) / jnp.maximum(diff, 1))).astype(jnp.int64),
-        0)
+    if weights["prefer_avoid"]:
+        pa = pod["prefer_avoid"]
+        if _inert(pa):
+            const += weights["prefer_avoid"] * MAX_PRIORITY
+        else:
+            total = total + weights["prefer_avoid"] * pa
 
-    # ImageLocality (image_locality.go:42)
-    s = jnp.clip(pod["image_sums"], IMAGE_MIN, IMAGE_MAX)
-    image = MAX_PRIORITY * (s - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN)
-
-    total = (
-        weights["selector_spread"] * spread
-        + weights["interpod"] * interpod
-        + weights["least_requested"] * least_score
-        + weights["most_requested"] * most_score
-        + weights["rtcr"] * rtcr_score
-        + weights["balanced"] * balanced
-        + weights["prefer_avoid"] * pod["prefer_avoid"]
-        + weights["node_affinity"] * node_aff
-        + weights["taint_toleration"] * taint_tol
-        + weights["image_locality"] * image
-    )
-    return total
+    return total + const
 
 
 def _feasibility(nodes, pod):
-    """Returns (feasible[N], fail_first[N] i8, general_bits[N] i64)."""
+    """Returns (feasible[N], fail_first[N] i8, general_bits[N] i64).
+
+    Inert (shape-[1], default all-pass) mask families drop out at trace time."""
     valid = nodes["valid"]
     # GeneralPredicates: resources
     bits = jnp.zeros(valid.shape, dtype=jnp.int64)
@@ -201,74 +248,93 @@ def _feasibility(nodes, pod):
     bits |= scalar_bits
     bits |= jnp.where(check_res & pod["unknown_scalar"],
                       _i64(1) << BIT_UNKNOWN_SCALAR, 0)
-    bits |= jnp.where(~pod["host_ok"], 1 << BIT_HOST, 0)
-    bits |= jnp.where(~pod["ports_ok"], 1 << BIT_PORTS, 0)
-    bits |= jnp.where(~pod["sel_ok"], 1 << BIT_SELECTOR, 0)
+    if not _inert(pod["host_ok"]):
+        bits |= jnp.where(~pod["host_ok"], 1 << BIT_HOST, 0)
+    if not _inert(pod["ports_ok"]):
+        bits |= jnp.where(~pod["ports_ok"], 1 << BIT_PORTS, 0)
+    if not _inert(pod["sel_ok"]):
+        bits |= jnp.where(~pod["sel_ok"], 1 << BIT_SELECTOR, 0)
 
     general_fail = bits != 0
-    unsched_fail = ~pod["unsched_ok"]
     # padding entries in a burst bucket: infeasible everywhere, no state fold
     skip = pod["skip"]
-    taints_fail = ~pod["taints_ok"]
-    ipa_fail = pod["interpod_code"] > 0
-    disk_fail = ~pod["disk_ok"]
-    maxvol_fail = ~pod["maxvol_ok"]
-    volbind_fail = ~pod["volbind_ok"]
-    volzone_fail = ~pod["volzone_ok"]
 
     # PREDICATE_ORDERING: unschedulable, general, disk, taints, max-volume,
-    # volume binding, volume zone, inter-pod affinity
-    fail_first = jnp.where(
-        unsched_fail, FAIL_UNSCHEDULABLE,
-        jnp.where(general_fail, FAIL_GENERAL,
-                  jnp.where(disk_fail, FAIL_DISK,
-                            jnp.where(taints_fail, FAIL_TAINTS,
-                                      jnp.where(maxvol_fail, FAIL_MAXVOL,
-                                                jnp.where(volbind_fail, FAIL_VOLBIND,
-                                                          jnp.where(volzone_fail, FAIL_VOLZONE,
-                                                                    jnp.where(ipa_fail, FAIL_INTERPOD,
-                                                                              FAIL_NONE))))))))
+    # volume binding, volume zone, inter-pod affinity. Built lowest-priority
+    # first; each later overwrite wins, so the result is the FIRST failing
+    # predicate in the ordering. Inert families emit no ops.
+    fail_first = FAIL_NONE
+    for mask_key, code in (("interpod_code", FAIL_INTERPOD),
+                           ("volzone_ok", FAIL_VOLZONE),
+                           ("volbind_ok", FAIL_VOLBIND),
+                           ("maxvol_ok", FAIL_MAXVOL),
+                           ("taints_ok", FAIL_TAINTS),
+                           ("disk_ok", FAIL_DISK)):
+        field = pod[mask_key]
+        if _inert(field):
+            continue
+        failed = (field > 0) if mask_key == "interpod_code" else ~field
+        fail_first = jnp.where(failed, code, fail_first)
+    fail_first = jnp.where(general_fail, FAIL_GENERAL, fail_first)
+    if not _inert(pod["unsched_ok"]):
+        fail_first = jnp.where(~pod["unsched_ok"], FAIL_UNSCHEDULABLE, fail_first)
     feasible = valid & (fail_first == FAIL_NONE) & ~skip
     return feasible, fail_first.astype(jnp.int8), bits
 
 
 def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
                 weights, z_pad):
+    """One fused cycle. The reference's sequential walk from last_index
+    (generic_scheduler.go:486,519) is emulated WITHOUT materializing the
+    rotation permutation: for natural index j, its 1-based rank in rotation
+    order among feasible nodes is S[j]-pre (j >= li) or F-pre+S[j] (j < li),
+    where S is the natural-order feasibility cumsum, pre = S[li-1], F = S[-1]
+    — no gathers, int32 counters (TPU has no native int64)."""
     n_pad = nodes["valid"].shape[0]
-    i = jnp.arange(n_pad, dtype=jnp.int64)
-    in_range = i < n_real
+    i32 = jnp.int32
+    i = jnp.arange(n_pad, dtype=i32)
+    nr = jnp.asarray(n_real, i32)
+    li = jnp.asarray(last_index, i32)
+    ntf = jnp.asarray(num_to_find, i32)
+    in_range = i < nr
     n_safe = jnp.maximum(n_real, 1)
-    perm = (last_index + i) % n_safe          # rotation order positions
 
     feasible, fail_first, general_bits = _feasibility(nodes, pod)
+    feas = feasible & in_range
 
-    feas_rot = feasible[perm] & in_range
-    cum = jnp.cumsum(feas_rot.astype(jnp.int64))
-    total_feasible = cum[-1]
-    keep_rot = feas_rot & (cum <= num_to_find)
-    found = jnp.minimum(total_feasible, num_to_find)
-    reached = total_feasible >= num_to_find
-    stop_pos = jnp.argmax(cum >= num_to_find)  # first rotation index reaching the quota
-    evaluated = jnp.where(reached, stop_pos + 1, n_real)
+    S = jnp.cumsum(feas.astype(i32))
+    F = S[-1]                                   # total feasible
+    pre = jnp.where(li > 0, S[jnp.maximum(li - 1, 0)], 0)
+    after = i >= li
+    rank = jnp.where(after, S - pre, F - pre + S)   # rotation rank at feasible j
+    kept = feas & (rank <= ntf)
+    found = jnp.minimum(F, ntf)
+    reached = F >= ntf
+    # the node where the sequential walk stops: unique feasible j with
+    # rank == num_to_find; evaluated = its rotation position + 1
+    jstar = jnp.argmax(kept & (rank == ntf)).astype(i32)
+    stop_pos = jnp.where(jstar >= li, jstar - li, nr - li + jstar)
+    evaluated = jnp.where(reached, stop_pos + 1, nr)
     # a skip (bucket-padding) pod consumes no rotation state
-    evaluated = jnp.where(pod["skip"], 0, evaluated)
-
-    kept = jnp.zeros(n_pad, dtype=bool).at[perm].max(keep_rot)
+    evaluated = jnp.where(pod["skip"], 0, evaluated).astype(jnp.int64)
 
     total = _fit_scores(nodes, pod, kept, weights, z_pad)
 
-    total_rot = jnp.where(keep_rot, total[perm], jnp.iinfo(jnp.int64).min)
-    max_score = jnp.max(total_rot)
-    is_tie = keep_rot & (total_rot == max_score)
-    num_ties = jnp.maximum(jnp.sum(is_tie.astype(jnp.int64)), 1)
-    k = last_node_index % num_ties
-    tie_rank = jnp.cumsum(is_tie.astype(jnp.int64))
-    sel_pos = jnp.argmax(is_tie & (tie_rank == k + 1))
-    selected = jnp.where(found > 0, perm[sel_pos], -1)
+    tmask = jnp.where(kept, total, jnp.iinfo(jnp.int64).min)
+    max_score = jnp.max(tmask)
+    is_tie = kept & (tmask == max_score)
+    num_ties = jnp.maximum(jnp.sum(is_tie.astype(i32)), 1)
+    # round-robin k-th tie in rotation order (selectHost :286-295)
+    k = (last_node_index % num_ties.astype(jnp.int64)).astype(i32)
+    T = jnp.cumsum(is_tie.astype(i32))
+    preT = jnp.where(li > 0, T[jnp.maximum(li - 1, 0)], 0)
+    trank = jnp.where(after, T - preT, T[-1] - preT + T)
+    sel = jnp.argmax(is_tie & (trank == k + 1)).astype(jnp.int64)
+    selected = jnp.where(found > 0, sel, -1)
 
     return {
         "selected": selected,
-        "found": found,
+        "found": found.astype(jnp.int64),
         "evaluated": evaluated,
         "max_score": jnp.where(found > 0, max_score, 0),
         "total": total,

@@ -67,6 +67,9 @@ class NodeBatch:
     alloc_scalar: np.ndarray           # [N,S] i64
     req_scalar: np.ndarray             # [N,S] i64
     zone_id: np.ndarray                # [N] i32 (0 = no zone)
+    # rows rewritten by the latest encode(); None = full rebuild. Consumed by
+    # the device mirror to upload only generation-dirty rows (SURVEY §2.4).
+    dirty_rows: Optional[list] = None
 
 
 class NodeStateEncoder:
@@ -119,12 +122,20 @@ class NodeStateEncoder:
             self._batch = b
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
         zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
+        dirty = []
         for i, name in enumerate(node_order):
             ni = node_infos[name]
             if self._generations.get(name) == ni.generation:
                 continue
             self._generations[name] = ni.generation
             self._write_row(b, i, ni, scalar_idx, zone_idx)
+            dirty.append(i)
+        # accumulate until the device mirror consumes (resets) the list;
+        # None = full re-upload required
+        if rebuild:
+            b.dirty_rows = None
+        elif b.dirty_rows is not None:
+            b.dirty_rows.extend(dirty)
         return b
 
     def _fresh(self, node_order: list[str], n_real: int, n_pad: int, s: int) -> NodeBatch:
@@ -183,6 +194,8 @@ class NodeStateEncoder:
         b.nz_cpu[i] += ncpu
         b.nz_mem[i] += nmem
         b.pod_count[i] += 1
+        if b.dirty_rows is not None:
+            b.dirty_rows.append(i)
 
 
 # ---------------------------------------------------------------------------

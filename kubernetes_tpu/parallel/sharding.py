@@ -16,6 +16,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import kubernetes_tpu.ops  # noqa: F401  (x64)
@@ -63,11 +64,15 @@ def _put_by_keys(mesh: Mesh, arrays: dict, sharded_keys,
     """device_put `arrays`: keys in `sharded_keys` get the node-axis spec
     (2D keys their own spec when given); everything else replicates."""
     repl = replicated(mesh)
+    n_dev = mesh.devices.size
     out = {}
     for k, v in arrays.items():
+        # inert [*, 1] broadcast fields can't split over the node axis —
+        # they replicate (the kernel broadcasts them per shard)
+        splittable = np.shape(v)[-1] % n_dev == 0 if np.ndim(v) else False
         if sharded_2d_spec is not None and k in _SHARDED_2D:
             out[k] = jax.device_put(v, sharded_2d_spec)
-        elif k in sharded_keys:
+        elif k in sharded_keys and splittable:
             out[k] = jax.device_put(v, sharded_spec)
         else:
             out[k] = jax.device_put(v, repl)
@@ -91,67 +96,38 @@ def shard_pod_batch(mesh: Mesh, pods: dict) -> dict:
                         NamedSharding(mesh, P(None, NODE_AXIS)))
 
 
-def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
-    """A jitted scheduling cycle whose heavy per-node phase stays sharded.
+def _constrain_nodes(mesh: Mesh, nodes: dict) -> dict:
+    """Pin node arrays to the node-axis sharding inside jit."""
+    shard = node_sharding(mesh)
+    shard2 = node_sharding_2d(mesh)
+    n_dev = mesh.devices.size
+    out = {}
+    for k, v in nodes.items():
+        if k in _SHARDED_2D:
+            out[k] = jax.lax.with_sharding_constraint(v, shard2)
+        elif k in _SHARDED_1D and v.shape[-1] % n_dev == 0:
+            out[k] = jax.lax.with_sharding_constraint(v, shard)
+        else:
+            out[k] = v
+    return out
 
-    Feasibility and scoring are computed under a node-axis sharding
-    constraint (each chip handles its rows); the [N] feasible/total vectors
-    are then gathered (XLA all-gather over ICI) for the replicated selection
-    epilogue. Returns fn(nodes, pod, last_index, last_node_index,
-    num_to_find, n_real) -> outputs dict.
+
+def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
+    """A jitted scheduling cycle with the node axis sharded across the mesh.
+
+    The per-node phases (feasibility, scores) are constrained to the node
+    sharding so each chip evaluates its rows; GSPMD inserts the collectives
+    (the feasibility cumsum and score reductions become all-gathers/psums
+    over ICI) and the tiny scalar selection epilogue replicates. Decisions
+    are bit-identical to the single-device kernel (tests/test_sharding.py).
+    Returns fn(nodes, pod, last_index, last_node_index, num_to_find, n_real).
     """
     weights_tuple = tuple(sorted((weights or K.DEFAULT_WEIGHTS).items()))
-    shard = node_sharding(mesh)
-    repl = replicated(mesh)
 
     def fn(nodes, pod, last_index, last_node_index, num_to_find, n_real):
-        w = dict(weights_tuple)
-        # per-node phase: keep it sharded
-        feasible, fail_first, general_bits = K._feasibility(nodes, pod)
-        feasible = jax.lax.with_sharding_constraint(feasible, shard)
-        # scores need the kept mask, which needs the global rotation cumsum
-        # — gather the tiny feasibility vector first
-        feasible_g = jax.lax.with_sharding_constraint(feasible, repl)
-        n_pad = feasible_g.shape[0]
-        i = jnp.arange(n_pad, dtype=jnp.int64)
-        in_range = i < n_real
-        n_safe = jnp.maximum(n_real, 1)
-        perm = (last_index + i) % n_safe
-        feas_rot = feasible_g[perm] & in_range
-        cum = jnp.cumsum(feas_rot.astype(jnp.int64))
-        total_feasible = cum[-1]
-        keep_rot = feas_rot & (cum <= num_to_find)
-        found = jnp.minimum(total_feasible, num_to_find)
-        reached = total_feasible >= num_to_find
-        stop_pos = jnp.argmax(cum >= num_to_find)
-        evaluated = jnp.where(reached, stop_pos + 1, n_real)
-        kept = jnp.zeros(n_pad, dtype=bool).at[perm].max(keep_rot)
-        # scoring back under the node-axis sharding
-        kept_sharded = jax.lax.with_sharding_constraint(kept, shard)
-        total = K._fit_scores(nodes, pod, kept_sharded, w, z_pad)
-        total_g = jax.lax.with_sharding_constraint(total, repl)
-        # replicated selection epilogue
-        total_rot = jnp.where(keep_rot, total_g[perm], jnp.iinfo(jnp.int64).min)
-        max_score = jnp.max(total_rot)
-        is_tie = keep_rot & (total_rot == max_score)
-        num_ties = jnp.maximum(jnp.sum(is_tie.astype(jnp.int64)), 1)
-        k = last_node_index % num_ties
-        tie_rank = jnp.cumsum(is_tie.astype(jnp.int64))
-        sel_pos = jnp.argmax(is_tie & (tie_rank == k + 1))
-        selected = jnp.where(found > 0, perm[sel_pos], -1)
-        return {
-            "selected": selected,
-            "found": found,
-            "evaluated": evaluated,
-            "max_score": jnp.where(found > 0, max_score, 0),
-            "total": total_g,
-            "kept": kept,
-            "feasible": feasible_g,
-            "fail_first": fail_first,
-            "general_bits": general_bits,
-            "next_last_index": (last_index + evaluated) % n_safe,
-            "next_last_node_index": last_node_index + jnp.where(found > 1, 1, 0),
-        }
+        nodes = _constrain_nodes(mesh, nodes)
+        return K._cycle_core(nodes, pod, last_index, last_node_index,
+                             num_to_find, n_real, dict(weights_tuple), z_pad)
 
     return jax.jit(fn)
 
@@ -181,6 +157,7 @@ def sharded_batch_fn(mesh: Mesh, z_pad: int, weights=None):
 
     def fn(nodes, pods, last_index, last_node_index, num_to_find, n_real):
         w = dict(weights_tuple)
+        nodes = _constrain_nodes(mesh, nodes)
         static = {k: v for k, v in nodes.items() if k not in K._MUTABLE}
 
         def step(carry, pod):

@@ -189,9 +189,30 @@ class PriorityQueue:
                     wait = min(wait, remaining)
                 self._cond.wait(wait)
 
+    @staticmethod
+    def _is_pod_updated(old: Optional[Pod], new: Pod) -> bool:
+        """Reference: :412 isPodUpdated — resourceVersion and the whole
+        status are stripped before comparing, so the scheduler's own
+        condition/nomination writes don't clear an unschedulable pod's
+        backoff (they'd otherwise hot-loop it through the scheduler)."""
+        if old is None:
+            return True
+
+        def strip(p: Pod) -> Pod:
+            c = p.clone()
+            c.resource_version = 0
+            c.nominated_node_name = ""
+            c.phase = "Pending"
+            c.conditions = ()
+            c.node_name = ""
+            return c
+
+        return strip(old) != strip(new)
+
     def update(self, old: Optional[Pod], new: Pod) -> None:
-        """Reference: :430 — refresh in place; an update to an unschedulable
-        pod's spec moves it back to active."""
+        """Reference: :430 — refresh in place; a *spec* update to an
+        unschedulable pod moves it back to active (status-only updates just
+        refresh the stored object)."""
         with self._cond:
             self.nominated.update(old or new, new)
             if new.key in self._active:
@@ -204,10 +225,15 @@ class PriorityQueue:
                                               expiry=expiry))
                 return
             if new.key in self._unschedulable:
-                del self._unschedulable[new.key]
-                self._backoff.clear(new.key)
-                self._active.add(_QueuedPod(new, self.clock.now(), next(self._seq)))
-                self._cond.notify()
+                if self._is_pod_updated(old, new):
+                    del self._unschedulable[new.key]
+                    self._backoff.clear(new.key)
+                    self._active.add(_QueuedPod(new, self.clock.now(), next(self._seq)))
+                    self._cond.notify()
+                else:
+                    q = self._unschedulable[new.key]
+                    self._unschedulable[new.key] = _QueuedPod(
+                        new, q.timestamp, next(self._seq), expiry=q.expiry)
                 return
             self.add(new)
 

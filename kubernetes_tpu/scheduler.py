@@ -15,7 +15,11 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from kubernetes_tpu.api.types import Pod, Node
+from kubernetes_tpu.api.types import (
+    Pod, Node, PodCondition, POD_SCHEDULED, CONDITION_FALSE,
+    REASON_UNSCHEDULABLE, REASON_SCHEDULER_ERROR,
+)
+from kubernetes_tpu.store.record import EventRecorder, NORMAL, WARNING
 from kubernetes_tpu.cache.cache import SchedulerCache, Snapshot
 from kubernetes_tpu.oracle.generic_scheduler import (
     GenericScheduler, FitError, ScheduleResult, default_priority_configs,
@@ -67,6 +71,7 @@ class Scheduler:
                  extenders: Optional[list] = None):
         self.store = store
         self.name = scheduler_name
+        self.recorder = EventRecorder(store, component=scheduler_name)
         self.clock = clock or RealClock()
         self.cache = SchedulerCache(clock=self.clock)
         self.queue = PriorityQueue(clock=self.clock)
@@ -241,6 +246,9 @@ class Scheduler:
         if pod is None:
             return False
         if pod.deleted:
+            # reference: scheduler.go:447 skip-deleting-pod event
+            self.recorder.pod_event(pod, WARNING, "FailedScheduling",
+                                    f"skip schedule deleting pod: {pod.key}")
             return True
         self._process_one(pod, self.queue.scheduling_cycle)
         return True
@@ -257,11 +265,11 @@ class Scheduler:
             self.metrics.observe("unschedulable")
             if not self.disable_preemption:
                 self._preempt(pod, err)
-            self._record_failure(pod, cycle)
+            self._record_failure(pod, cycle, REASON_UNSCHEDULABLE, str(err))
             return
-        except Exception:
+        except Exception as err:
             self.metrics.observe("error")
-            self._record_failure(pod, cycle)
+            self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
             raise
         assumed = pod.clone()
         assumed.node_name = result.suggested_host
@@ -277,14 +285,14 @@ class Scheduler:
             # reference skips this; later versions unreserve here too)
             self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
-            self._record_failure(pod, cycle)
+            self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, st.message)
             return
         try:
             self.cache.assume_pod(assumed)
-        except Exception:
+        except Exception as err:
             self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
-            self._record_failure(pod, cycle)
+            self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
             return
         self.queue.nominated.delete(pod)
         # Permit may WAIT: when permit plugins exist, bind runs off the
@@ -329,7 +337,7 @@ class Scheduler:
         ForgetPod + Unreserve + re-queue."""
         ctx = ctx or PluginContext()
 
-        def fail(unschedulable: bool) -> None:
+        def fail(unschedulable: bool, message: str = "") -> None:
             self.cache.forget_pod(assumed)
             try:
                 self.volume_binder.forget_pod_volumes(
@@ -338,15 +346,18 @@ class Scheduler:
                 pass
             self.framework.run_unreserve_plugins(ctx, assumed, host)
             self.metrics.observe("unschedulable" if unschedulable else "error")
-            self._record_failure(orig, cycle)
+            self._record_failure(
+                orig, cycle,
+                REASON_UNSCHEDULABLE if unschedulable else REASON_SCHEDULER_ERROR,
+                message)
 
         st = self.framework.run_permit_plugins(ctx, assumed, host)
         if not st.is_success():
-            fail(st.code == FW_UNSCHEDULABLE)
+            fail(st.code == FW_UNSCHEDULABLE, st.message)
             return
         st = self.framework.run_prebind_plugins(ctx, assumed, host)
         if not st.is_success():
-            fail(st.code == FW_UNSCHEDULABLE)
+            fail(st.code == FW_UNSCHEDULABLE, st.message)
             return
         try:
             try:
@@ -364,11 +375,20 @@ class Scheduler:
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
             self.metrics.observe("scheduled")
-        except Exception:
-            fail(False)
+            # user-visible audit record (scheduler.go:433)
+            self.recorder.pod_event(
+                assumed, NORMAL, "Scheduled",
+                f"Successfully assigned {assumed.key} to {host}")
+        except Exception as err:
+            fail(False, str(err))
 
-    def _record_failure(self, pod: Pod, cycle: int) -> None:
-        """Reference: factory.go:643 MakeDefaultErrorFunc."""
+    def _record_failure(self, pod: Pod, cycle: int,
+                        reason: str = REASON_SCHEDULER_ERROR,
+                        message: str = "") -> None:
+        """Reference: scheduler.go:266 recordSchedulingFailure — re-queue
+        (factory.go:643 MakeDefaultErrorFunc), emit a FailedScheduling
+        event, and write the PodScheduled=False condition so the failure is
+        visible to store watchers (factory.go:715)."""
         try:
             current = self.store.get(PODS, pod.key)
         except NotFoundError:
@@ -377,6 +397,14 @@ class Scheduler:
         if current.node_name:
             return
         self.queue.add_unschedulable_if_not_present(current, cycle)
+        self.recorder.pod_event(pod, WARNING, "FailedScheduling",
+                                message or reason)
+        try:
+            self.store.update_pod_condition(pod.key, PodCondition(
+                type=POD_SCHEDULED, status=CONDITION_FALSE,
+                reason=reason, message=message))
+        except NotFoundError:
+            pass
 
     # -- preemption (reference: scheduler.go:292 preempt) ----------------------
     def _preempt(self, pod: Pod, err: FitError) -> None:
@@ -405,6 +433,8 @@ class Scheduler:
             try:
                 self.store.set_nominated_node_name(pod.key, result.node.name)
             except NotFoundError:
+                # matches the reference's early error return, which also
+                # skips the nominated_to_clear loop (scheduler.go:313-318)
                 self.queue.nominated.delete(updated)
                 return
             for victim in result.victims:
@@ -413,6 +443,10 @@ class Scheduler:
                 except NotFoundError:
                     pass
                 self.metrics.preemption_victims += 1
+                # victim audit record (scheduler.go:325)
+                self.recorder.pod_event(
+                    victim, NORMAL, "Preempted",
+                    f"by {updated.key} on node {result.node.name}")
         # nomination cleanup happens even when no node was found: Preempt may
         # return the preemptor itself so its stale NominatedNodeName is
         # removed (scheduler.go:329-339)
@@ -452,9 +486,14 @@ class Scheduler:
             pod = self.queue.pop(timeout=0.0)
             if pod is None:
                 break
-            if not pod.deleted:
-                pods.append(pod)
-                cycles.append(self.queue.scheduling_cycle)
+            if pod.deleted:
+                # same audit record as the serial path (scheduler.go:447)
+                self.recorder.pod_event(
+                    pod, WARNING, "FailedScheduling",
+                    f"skip schedule deleting pod: {pod.key}")
+                continue
+            pods.append(pod)
+            cycles.append(self.queue.scheduling_cycle)
         if not pods:
             return 0
         before = self.metrics.schedule_attempts["scheduled"]

@@ -1,0 +1,58 @@
+"""Event recorder: the user-visible audit trail.
+
+Analog of client-go/tools/record.EventRecorder + its correlator: events are
+aggregated by (involved object, type, reason, message) with a count, and
+written through the store so any watcher (tests, CLI, controllers) sees them
+— the reference's recorder posts to the events API the same way
+(reference: pkg/scheduler/scheduler.go:268,325,433 call sites).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from kubernetes_tpu.api.types import EventRecord
+from kubernetes_tpu.store.store import Store, EVENTS, NotFoundError
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+_seq = itertools.count(1)
+
+
+class EventRecorder:
+    def __init__(self, store: Store, component: str = "default-scheduler"):
+        self.store = store
+        self.component = component
+        self._lock = threading.Lock()
+        # correlation cache: aggregation key -> stored event key
+        self._known: dict[tuple, str] = {}
+
+    def event(self, involved_kind: str, involved_key: str, etype: str,
+              reason: str, message: str) -> None:
+        agg = (self.component, involved_kind, involved_key, etype, reason,
+               message)
+        with self._lock:
+            existing = self._known.get(agg)
+            if existing is not None:
+                def bump(ev):
+                    ev.count += 1
+                    return ev
+                try:
+                    self.store.guaranteed_update(EVENTS, existing, bump)
+                    return
+                except NotFoundError:
+                    pass   # expired/cleaned: fall through to re-create
+            namespace, _, name = involved_key.partition("/")
+            rec = EventRecord(
+                name=f"{name or involved_key}.{next(_seq):x}",
+                namespace=namespace if name else "default",
+                involved_kind=involved_kind, involved_key=involved_key,
+                type=etype, reason=reason, message=message,
+                component=self.component)
+            self.store.create(EVENTS, rec)
+            self._known[agg] = rec.key
+
+    # convenience mirrors of the reference call sites
+    def pod_event(self, pod, etype: str, reason: str, message: str) -> None:
+        self.event("Pod", pod.key, etype, reason, message)

@@ -34,6 +34,7 @@ PDBS = "poddisruptionbudgets"
 PVS = "persistentvolumes"
 PVCS = "persistentvolumeclaims"
 LEASES = "leases"  # leader-election locks (resourcelock analog)
+EVENTS = "events"  # user-visible audit records (record.EventRecorder analog)
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
 
@@ -179,12 +180,17 @@ class Store:
             return _clone(stored)
 
     def guaranteed_update(self, kind: str, key: str,
-                          mutate: Callable[[Any], Any]) -> Any:
-        """Read-modify-write retry loop (reference: GuaranteedUpdate)."""
+                          mutate: Callable[[Any], Any],
+                          allow_skip: bool = False) -> Any:
+        """Read-modify-write retry loop (reference: GuaranteedUpdate).
+        With allow_skip, a mutate returning None means "no change" and the
+        current object is returned without a write."""
         while True:
             current = self.get(kind, key)
             rv = current.resource_version
             updated = mutate(current)
+            if allow_skip and updated is None:
+                return current
             try:
                 return self.update(kind, updated, expect_rv=rv)
             except ConflictError:
@@ -213,6 +219,26 @@ class Store:
             pod.nominated_node_name = node_name
             return pod
         return self.guaranteed_update(PODS, pod_key, mutate)
+
+    def update_pod_condition(self, pod_key: str, condition) -> Any:
+        """UpdateStatus analog for one condition (reference: factory.go:715
+        podConditionUpdater + podutil.UpdatePodCondition): replace the
+        condition of the same type if it changed, append if absent; no-op
+        write is skipped entirely."""
+        def mutate(pod):
+            conds = list(pod.conditions)
+            for i, c in enumerate(conds):
+                if c.type == condition.type:
+                    if c == condition:
+                        return None   # unchanged -> no write
+                    conds[i] = condition
+                    break
+            else:
+                conds.append(condition)
+            pod.conditions = tuple(conds)
+            return pod
+        return self.guaranteed_update(PODS, pod_key, mutate,
+                                      allow_skip=True)
 
     # -- watch --------------------------------------------------------------
     def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:

@@ -381,11 +381,20 @@ class TestPriorityQueue:
         q.add(mkpod("p1"))
         q.delete(mkpod("p1"))
         assert q.num_pending() == 0
-        # update of an unschedulable pod reactivates it
+        # a spec update of an unschedulable pod reactivates it; a
+        # status-only (or no-op) update must NOT (reference isPodUpdated,
+        # scheduling_queue.go:412 — it strips status before comparing)
         q.add(mkpod("p2"))
         pod = q.pop()
         q.add_unschedulable_if_not_present(pod, q.scheduling_cycle)
-        q.update(pod, pod)
+        noop = pod.clone()
+        noop.resource_version += 1
+        noop.nominated_node_name = "somewhere"
+        q.update(pod, noop)
+        assert q.pop(timeout=0.01) is None
+        changed = pod.clone()
+        changed.labels = {"new": "label"}
+        q.update(pod, changed)
         assert q.pop(timeout=0.01).name == "p2"
 
     def test_nominated_pods(self):

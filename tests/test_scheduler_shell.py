@@ -144,3 +144,145 @@ class TestBurstMode:
             return [store.get(PODS, f"default/p{j}").node_name for j in range(30)]
 
         assert run("burst") == run("serial")
+
+
+class TestFailureObservability:
+    """Reference: recordSchedulingFailure (scheduler.go:266) writes the
+    PodScheduled=False condition + a FailedScheduling event; bind success
+    emits Scheduled (scheduler.go:433); victims get Preempted (:325)."""
+
+    def test_unschedulable_pod_gets_condition_and_event(self, make_sched):
+        from kubernetes_tpu.api.types import (
+            POD_SCHEDULED, CONDITION_FALSE, REASON_UNSCHEDULABLE)
+        from kubernetes_tpu.store.store import EVENTS
+        store = Store()
+        store.create(NODES, mknode("small", cpu=100))
+        sched = make_sched(store)
+        sched.sync()
+        store.create(PODS, mkpod("big", cpu="2"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        pod = store.get(PODS, "default/big")
+        conds = [c for c in pod.conditions if c.type == POD_SCHEDULED]
+        assert len(conds) == 1
+        assert conds[0].status == CONDITION_FALSE
+        assert conds[0].reason == REASON_UNSCHEDULABLE
+        assert "0/1 nodes available" in conds[0].message
+        events, _ = store.list(EVENTS)
+        failed = [e for e in events if e.reason == "FailedScheduling"
+                  and e.involved_key == "default/big"]
+        assert failed and failed[0].type == "Warning"
+
+    def test_repeat_failure_aggregates_event_count(self, make_sched):
+        from kubernetes_tpu.store.store import EVENTS
+        from kubernetes_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        store = Store()
+        store.create(NODES, mknode("small", cpu=100))
+        sched = make_sched(store, clock=clock)
+        sched.sync()
+        store.create(PODS, mkpod("big", cpu="2"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        # ride out the backoff, then fail again
+        clock.step(11.0)
+        sched.queue.move_all_to_active()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        events, _ = store.list(EVENTS)
+        failed = [e for e in events if e.reason == "FailedScheduling"
+                  and e.involved_key == "default/big"]
+        assert len(failed) == 1
+        assert failed[0].count == 2
+
+    def test_bind_emits_scheduled_event(self, make_sched):
+        from kubernetes_tpu.store.store import EVENTS
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        sched = make_sched(store)
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        events, _ = store.list(EVENTS)
+        sched_evs = [e for e in events if e.reason == "Scheduled"]
+        assert len(sched_evs) == 1
+        assert "default/p1" in sched_evs[0].message
+        assert sched_evs[0].type == "Normal"
+
+    def test_condition_cleared_pod_still_schedulable_later(self, make_sched):
+        """The False condition is replaced by nothing on success (the
+        scheduler never writes True — kubelet's job); binding must still
+        work after a failure."""
+        from kubernetes_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        store = Store()
+        store.create(NODES, mknode("small", cpu=100, pods=1))
+        sched = make_sched(store, clock=clock)
+        sched.sync()
+        store.create(PODS, mkpod("big", cpu="2"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        store.create(NODES, mknode("huge", cpu=8000))
+        sched.pump()
+        clock.step(1.1)   # ride out the retry backoff
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert store.get(PODS, "default/big").node_name == "huge"
+
+
+class TestPreemptedEvent:
+    def test_victims_get_preempted_event(self):
+        from kubernetes_tpu.store.store import EVENTS
+        store = Store()
+        store.create(NODES, mknode("n1", cpu=2000))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100)
+        sched.sync()
+        victim = mkpod("victim", cpu="2")
+        store.create(PODS, victim)
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert store.get(PODS, "default/victim").node_name == "n1"
+        pre = mkpod("pre", cpu="2")
+        pre.priority = 100
+        store.create(PODS, pre)
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        events, _ = store.list(EVENTS)
+        preempted = [e for e in events if e.reason == "Preempted"]
+        assert len(preempted) == 1
+        assert preempted[0].involved_key == "default/victim"
+        assert "default/pre" in preempted[0].message
+
+
+class TestSelfInflictedUpdates:
+    def test_condition_write_does_not_clear_backoff(self, make_sched):
+        """The scheduler's own PodScheduled=False status write must not
+        requeue the just-failed pod (reference isPodUpdated strips status,
+        scheduling_queue.go:412); otherwise failures hot-loop with backoff
+        permanently defeated."""
+        from kubernetes_tpu.utils.clock import FakeClock
+        clock = FakeClock()
+        store = Store()
+        store.create(NODES, mknode("small", cpu=100))
+        sched = make_sched(store, clock=clock)
+        sched.sync()
+        store.create(PODS, mkpod("big", cpu="2"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        # deliver the scheduler's own condition/nomination writes
+        sched.pump()
+        # without stepping the clock, the pod must stay unschedulable:
+        # a pop must NOT return it
+        assert sched.queue.pop(timeout=0.0) is None
+        assert sched.queue.num_pending() == 1
