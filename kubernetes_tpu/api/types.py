@@ -259,20 +259,26 @@ class ContainerPort:
 class Container:
     name: str = ""
     image: str = ""
-    # resource requests; missing keys mean "not specified"
+    # resource requests/limits; missing keys mean "not specified"
     requests: tuple[tuple[str, int], ...] = ()
+    limits: tuple[tuple[str, int], ...] = ()
     ports: tuple[ContainerPort, ...] = ()
 
     @staticmethod
     def make(name: str = "", image: str = "",
              requests: dict[str, int] | None = None,
+             limits: dict[str, int] | None = None,
              ports: Iterable[ContainerPort] = ()) -> "Container":
         return Container(name=name, image=image,
                          requests=tuple(sorted((requests or {}).items())),
+                         limits=tuple(sorted((limits or {}).items())),
                          ports=tuple(ports))
 
     def requests_dict(self) -> dict[str, int]:
         return dict(self.requests)
+
+    def limits_dict(self) -> dict[str, int]:
+        return dict(self.limits)
 
 
 # ---------------------------------------------------------------------------
@@ -596,6 +602,17 @@ def get_resource_request(pod: Pod) -> ResourceAgg:
         r.add_requests(c.requests_dict())
     for c in pod.init_containers:
         r.set_max(c.requests_dict())
+    return r
+
+
+def get_resource_limits(pod: Pod) -> ResourceAgg:
+    """Reference: priorities/resource_limits.go:93 getResourceLimits — sum
+    container limits, then elementwise max with each init container."""
+    r = ResourceAgg()
+    for c in pod.containers:
+        r.add_requests(c.limits_dict())
+    for c in pod.init_containers:
+        r.set_max(c.limits_dict())
     return r
 
 

@@ -301,29 +301,44 @@ class TPUScheduler:
         z_pad = _pad_pow2(len(b.zone_names), 4)
         out = K.schedule_cycle(nodes, pod_in, self.last_index, self.last_node_index,
                                num_to_find, n, z_pad, weights=self.weights)
-        found = int(out["found"])
-        evaluated = int(out["evaluated"])
+        # ONE device->host fetch for everything the decision needs: each
+        # separate readback pays a full dispatch round trip (ruinous over a
+        # tunneled device), so the scalars and per-node vectors come back
+        # together
+        fetch = {"selected": out["selected"], "found": out["found"],
+                 "evaluated": out["evaluated"],
+                 "next_last_index": out["next_last_index"],
+                 "next_last_node_index": out["next_last_node_index"]}
+        need_vectors = self.collect_host_priority
+        if need_vectors:
+            fetch.update(kept=out["kept"], total=out["total"],
+                         fail_first=out["fail_first"],
+                         general_bits=out["general_bits"])
+        h = jax.device_get(fetch)
+        found = int(h["found"])
+        evaluated = int(h["evaluated"])
         start = self.last_index
-        self.last_index = int(out["next_last_index"])
+        self.last_index = int(h["next_last_index"])
         if found == 0:
-            fail_first = np.asarray(out["fail_first"])
-            general_bits = np.asarray(out["general_bits"])
+            if need_vectors:
+                fail_first, general_bits = h["fail_first"], h["general_bits"]
+            else:
+                fail_first, general_bits = jax.device_get(
+                    (out["fail_first"], out["general_bits"]))
             failed = {}
             for pos in range(evaluated):
                 idx = (start + pos) % n
                 failed[b.names[idx]] = self._decode_reasons(
                     b, feats, idx, fail_first, general_bits)
             raise FitError(pod, n, failed)
-        self.last_node_index = int(out["next_last_node_index"])
-        sel = int(out["selected"])
+        self.last_node_index = int(h["next_last_node_index"])
+        sel = int(h["selected"])
         host = b.names[sel]
         host_priority = []
         failed = {}
-        if self.collect_host_priority:
-            kept = np.asarray(out["kept"])
-            total = np.asarray(out["total"])
-            fail_first = np.asarray(out["fail_first"])
-            general_bits = np.asarray(out["general_bits"])
+        if need_vectors:
+            kept, total = h["kept"], h["total"]
+            fail_first, general_bits = h["fail_first"], h["general_bits"]
             for pos in range(evaluated):
                 idx = (start + pos) % n
                 if kept[idx]:

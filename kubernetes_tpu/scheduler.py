@@ -106,7 +106,12 @@ class Scheduler:
                 replicasets_fn=self._replicasets_fn,
                 nominated=self.queue.nominated,
                 volume_listers=self.volume_listers,
-                volume_binder=self.volume_binder)
+                volume_binder=self.volume_binder,
+                # the shell only consumes the suggested host + failure
+                # reasons; skipping the per-node score readback saves a
+                # full-vector transfer every cycle (extenders, which do read
+                # host_priority, run on the oracle path)
+                collect_host_priority=False)
             if priority_weights is not None:
                 from kubernetes_tpu.factory import tpu_kernel_weights
                 self.algorithm.weights = tpu_kernel_weights(priority_weights)
