@@ -294,10 +294,13 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     i32 = jnp.int32
     i = jnp.arange(n_pad, dtype=i32)
     nr = jnp.asarray(n_real, i32)
-    li = jnp.asarray(last_index, i32)
+    n_safe = jnp.maximum(n_real, 1)
+    # last_index persists across cycles while the cluster may shrink; the
+    # oracle's walk is modulo n (generic_scheduler.py:148), so clamp the
+    # rotation origin before use or ranks go negative after node removals
+    li = jnp.asarray(last_index % n_safe, i32)
     ntf = jnp.asarray(num_to_find, i32)
     in_range = i < nr
-    n_safe = jnp.maximum(n_real, 1)
 
     feasible, fail_first, general_bits = _feasibility(nodes, pod)
     feas = feasible & in_range

@@ -199,12 +199,14 @@ class PriorityQueue:
             return True
 
         def strip(p: Pod) -> Pod:
+            # exactly the status-equivalent fields plus resourceVersion; a
+            # *spec* nodeName change must still count as an update
             c = p.clone()
             c.resource_version = 0
             c.nominated_node_name = ""
             c.phase = "Pending"
             c.conditions = ()
-            c.node_name = ""
+            c.start_time = None
             return c
 
         return strip(old) != strip(new)

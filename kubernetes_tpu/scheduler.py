@@ -95,6 +95,20 @@ class Scheduler:
         self.extenders = extenders or []
         self._extender_binder = next(
             (e for e in self.extenders if e.is_binder), None)
+        decision_extenders = [
+            e for e in self.extenders
+            if e.config.filter_verb or e.config.prioritize_verb
+            or e.config.preempt_verb]
+        if use_tpu and decision_extenders and algorithm is None:
+            # decision-affecting extenders need per-node host_priority and
+            # HTTP round trips the device path doesn't model; silently
+            # ignoring them would change decisions, so route scheduling
+            # through the oracle instead (bind-only extenders keep the TPU
+            # path: binding already goes through _extender_binder)
+            import warnings
+            warnings.warn("filter/prioritize extenders configured: scheduling "
+                          "runs on the oracle path, not the TPU kernel path")
+            use_tpu = False
         if algorithm is not None:
             self.algorithm = algorithm
         elif use_tpu:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from collections import OrderedDict
 
 from kubernetes_tpu.api.types import EventRecord
 from kubernetes_tpu.store.store import Store, EVENTS, NotFoundError
@@ -19,14 +20,21 @@ WARNING = "Warning"
 
 _seq = itertools.count(1)
 
+# correlation cache bound (the reference correlator is an LRU with TTL,
+# client-go/tools/record/events_cache.go); keys include per-pod messages, so
+# an unbounded map grows one entry per pod ever scheduled
+MAX_CORRELATION_ENTRIES = 4096
+
 
 class EventRecorder:
-    def __init__(self, store: Store, component: str = "default-scheduler"):
+    def __init__(self, store: Store, component: str = "default-scheduler",
+                 max_entries: int = MAX_CORRELATION_ENTRIES):
         self.store = store
         self.component = component
         self._lock = threading.Lock()
-        # correlation cache: aggregation key -> stored event key
-        self._known: dict[tuple, str] = {}
+        # correlation cache: aggregation key -> stored event key (LRU)
+        self._known: OrderedDict[tuple, str] = OrderedDict()
+        self._max_entries = max_entries
 
     def event(self, involved_kind: str, involved_key: str, etype: str,
               reason: str, message: str) -> None:
@@ -35,6 +43,7 @@ class EventRecorder:
         with self._lock:
             existing = self._known.get(agg)
             if existing is not None:
+                self._known.move_to_end(agg)
                 def bump(ev):
                     ev.count += 1
                     return ev
@@ -52,6 +61,8 @@ class EventRecorder:
                 component=self.component)
             self.store.create(EVENTS, rec)
             self._known[agg] = rec.key
+            while len(self._known) > self._max_entries:
+                self._known.popitem(last=False)
 
     # convenience mirrors of the reference call sites
     def pod_event(self, pod, etype: str, reason: str, message: str) -> None:

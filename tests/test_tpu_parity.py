@@ -240,6 +240,57 @@ class TestFeatureParity:
         run_parity_sequence(rng, nodes, pods, services=services)
 
 
+class TestClusterShrink:
+    def test_last_index_survives_node_removals(self):
+        """last_index persists across cycles; after removals shrink the
+        cluster below it, the rotation origin must wrap modulo n like the
+        oracle's walk (generic_scheduler.py:148) — regression for the
+        gather-free rank math assuming last_index < n_real."""
+        rng = random.Random(97)
+        nodes = make_cluster(rng, 7)
+        node_infos = {n.name: NodeInfo(n) for n in nodes}
+        names = [n.name for n in nodes]
+        oracle = GenericScheduler(percentage_of_nodes_to_score=100)
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        # advance rotation state well past the post-shrink node count
+        for j in range(5):
+            pod = make_pod(rng, j)
+            o = oracle.schedule(pod, node_infos, names)
+            t = tpu.schedule(pod, node_infos, names)
+            assert o.suggested_host == t.suggested_host
+            placed = copy.deepcopy(pod)
+            placed.node_name = o.suggested_host
+            node_infos[o.suggested_host].add_pod(placed)
+        assert oracle.last_index == tpu.last_index
+        # pin the rotation origin past the post-shrink node count (the warm-up
+        # stream may leave it anywhere); both walks must then wrap modulo n
+        oracle.last_index = tpu.last_index = 5
+        oracle.last_node_index = tpu.last_node_index = 3
+        keep = names[:2]
+        shrunk = {k: node_infos[k] for k in keep}
+        for j in range(5, 11):
+            pod = make_pod(rng, j)
+            o_err = t_err = o = t = None
+            try:
+                o = oracle.schedule(pod, shrunk, keep)
+            except FitError as e:
+                o_err = e
+            try:
+                t = tpu.schedule(pod, shrunk, keep)
+            except FitError as e:
+                t_err = e
+            assert (o_err is None) == (t_err is None)
+            if o is None:
+                continue
+            assert o.suggested_host == t.suggested_host
+            assert o.evaluated_nodes == t.evaluated_nodes
+            assert t.evaluated_nodes >= 0
+            assert o.host_priority == t.host_priority
+            placed = copy.deepcopy(pod)
+            placed.node_name = o.suggested_host
+            shrunk[o.suggested_host].add_pod(placed)
+
+
 class TestBurstParity:
     def test_burst_matches_serial_oracle(self):
         rng = random.Random(41)
