@@ -232,6 +232,13 @@ class SchedulerCache:
         with self._lock:
             return len(self._nodes)
 
+    def node_generation(self, name: str) -> Optional[int]:
+        """Current generation of one node's NodeInfo (None when absent);
+        lets the TPU mirror sync after self-inflicted mutations."""
+        with self._lock:
+            item = self._nodes.get(name)
+            return item.info.generation if item is not None else None
+
     # -- snapshot -----------------------------------------------------------
     def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
         """Incremental clone of changed nodes (reference: cache.go:210).

@@ -97,6 +97,18 @@ class TPUScheduler:
             "zeros_bool": np.zeros(1, dtype=bool),
             "tens_i64": np.full(1, 10, dtype=np.int64),
         }
+        # shared scalar singletons: identical-by-identity inputs let
+        # _stack_pods broadcast instead of stacking B python objects
+        self._true = np.bool_(True)
+        self._false = np.bool_(False)
+        self._zero_i64 = np.int64(0)
+        self._zero_scalars: dict[int, np.ndarray] = {}
+
+    def _shared_zero_scalar(self, n: int) -> np.ndarray:
+        arr = self._zero_scalars.get(n)
+        if arr is None:
+            arr = self._zero_scalars[n] = np.zeros(n, dtype=np.int64)
+        return arr
 
     # -- device input assembly ----------------------------------------------
     _NODE_FIELDS = ("valid", "alloc_cpu", "alloc_mem", "alloc_eph",
@@ -134,14 +146,15 @@ class TPUScheduler:
         the target for fields the encoder actually materialized."""
         d = self._defaults
         out = {
-            "req_cpu": np.int64(f.req_cpu),
-            "req_mem": np.int64(f.req_mem),
-            "req_eph": np.int64(f.req_eph),
-            "req_scalar": f.req_scalar,
-            "has_request": np.bool_(f.has_request),
-            "unknown_scalar": np.bool_(bool(f.unknown_scalars)),
-            "skip": np.bool_(False),
-            "check_resources": np.bool_(self.check_resources),
+            "req_cpu": self._zero_i64 if f.req_cpu == 0 else np.int64(f.req_cpu),
+            "req_mem": self._zero_i64 if f.req_mem == 0 else np.int64(f.req_mem),
+            "req_eph": self._zero_i64 if f.req_eph == 0 else np.int64(f.req_eph),
+            "req_scalar": (f.req_scalar if f.req_scalar.any()
+                           else self._shared_zero_scalar(len(f.req_scalar))),
+            "has_request": self._true if f.has_request else self._false,
+            "unknown_scalar": self._true if f.unknown_scalars else self._false,
+            "skip": self._false,
+            "check_resources": self._true if self.check_resources else self._false,
             "nz_cpu": np.int64(f.nz_cpu),
             "nz_mem": np.int64(f.nz_mem),
             "sel_ok": f.sel_ok if f.sel_ok is not None else d["ones_bool"],
@@ -167,13 +180,17 @@ class TPUScheduler:
             # calculate_resource; reference: node_info.go:578)
             from kubernetes_tpu.cache.node_info import calculate_resource
             upd = calculate_resource(pod)
-            upd_scalar = np.zeros_like(f.req_scalar)
-            for name, q in upd.scalar.items():
-                upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
+            if upd.scalar:
+                upd_scalar = np.zeros_like(f.req_scalar)
+                for name, q in upd.scalar.items():
+                    upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
+            else:
+                upd_scalar = self._shared_zero_scalar(len(f.req_scalar))
             out.update({
-                "upd_cpu": np.int64(upd.milli_cpu),
-                "upd_mem": np.int64(upd.memory),
-                "upd_eph": np.int64(upd.ephemeral_storage),
+                "upd_cpu": self._zero_i64 if upd.milli_cpu == 0 else np.int64(upd.milli_cpu),
+                "upd_mem": self._zero_i64 if upd.memory == 0 else np.int64(upd.memory),
+                "upd_eph": self._zero_i64 if upd.ephemeral_storage == 0
+                           else np.int64(upd.ephemeral_storage),
                 "upd_scalar": upd_scalar,
             })
         return out
@@ -182,10 +199,16 @@ class TPUScheduler:
     def _stack_pods(per_pod: list[dict]) -> dict:
         """Stack per-pod dicts to [B, ...] arrays. A field that is inert
         ([1]-shaped) for every pod stays [B, 1] — the scan broadcasts it —
-        so plain pods upload O(B) data, not O(B*N)."""
+        so plain pods upload O(B) data, not O(B*N). Fields holding the SAME
+        object for every pod (the shared inert defaults / scalar singletons)
+        are broadcast views, not B-element stacks."""
         out = {}
         for k in per_pod[0]:
             vals = [pp[k] for pp in per_pod]
+            v0 = vals[0]
+            if all(v is v0 for v in vals):
+                out[k] = np.broadcast_to(v0, (len(vals),) + np.shape(v0))
+                continue
             shapes = {np.shape(v) for v in vals}
             if len(shapes) > 1:
                 # mixed inert/dense: broadcast the inert ones up
@@ -352,12 +375,55 @@ class TPUScheduler:
         return ScheduleResult(host, evaluated, found, host_priority, failed)
 
     # -- burst path ----------------------------------------------------------
+    _FEATURE_FIELDS = ("sel_ok", "taints_ok", "unsched_ok", "ports_ok",
+                       "host_ok", "disk_ok", "maxvol_ok", "volbind_ok",
+                       "volzone_ok", "interpod_code", "node_aff_counts",
+                       "taint_counts", "spread_counts", "interpod_counts",
+                       "interpod_tracked", "image_sums", "prefer_avoid")
+
+    def _uniform_class(self, pods: list[Pod], feats: list) -> Optional[dict]:
+        """When every pod is feature-inert and value-identical in requests
+        and fold deltas, return the shared class scalars; else None."""
+        from kubernetes_tpu.cache.node_info import calculate_resource
+        key0 = None
+        cls = None
+        for p, f in zip(pods, feats):
+            if f.unknown_scalars:
+                return None
+            for field in self._FEATURE_FIELDS:
+                if getattr(f, field) is not None:
+                    return None
+            upd = calculate_resource(p)
+            key = (f.req_cpu, f.req_mem, f.req_eph, f.req_scalar.tobytes(),
+                   f.nz_cpu, f.nz_mem, f.has_request, upd.milli_cpu,
+                   upd.memory, upd.ephemeral_storage,
+                   tuple(sorted(upd.scalar.items())))
+            if key0 is None:
+                key0 = key
+                upd_scalar = np.zeros_like(f.req_scalar)
+                for name, q in upd.scalar.items():
+                    upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
+                cls = {"req_cpu": f.req_cpu, "req_mem": f.req_mem,
+                       "req_eph": f.req_eph, "req_scalar": f.req_scalar,
+                       "nz_cpu": f.nz_cpu, "nz_mem": f.nz_mem,
+                       "upd_cpu": upd.milli_cpu, "upd_mem": upd.memory,
+                       "upd_eph": upd.ephemeral_storage,
+                       "upd_scalar": upd_scalar,
+                       "has_request": f.has_request}
+            elif key != key0:
+                return None
+        return cls
+
     def schedule_burst(self, pods: list[Pod], node_infos: dict[str, NodeInfo],
                        all_node_names: list[str],
                        bucket: Optional[int] = None) -> list[Optional[str]]:
         """Schedule `pods` against one snapshot; returns per-pod host (or
         None when unschedulable). Decisions are serially equivalent to
-        calling schedule() per pod with cache assumes in between."""
+        calling schedule() per pod with cache assumes in between.
+
+        The folded state persists on device: the caller MUST apply the
+        returned placements to its cache (as the scheduler shell does via
+        assume + note_burst_assumed) before the next cycle."""
         if not all_node_names or not pods:
             return [None] * len(pods)
         b = self.encoder.encode(node_infos, all_node_names)
@@ -367,26 +433,54 @@ class TPUScheduler:
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
                          volume_binder=self.volume_binder)
-        per_pod = [self._pod_arrays(enc.encode(p), b.n_pad, upd_fields=True, pod=p)
-                   for p in pods]
-        # pad the burst to a power-of-two bucket so lax.scan compiles once
-        # per bucket instead of once per burst length
-        bucket = _pad_pow2(bucket if bucket else len(per_pod), 16)
-        if len(per_pod) < bucket:
-            pad = dict(per_pod[-1])
-            pad["skip"] = np.bool_(True)
-            per_pod.extend([pad] * (bucket - len(per_pod)))
-        stacked = self._stack_pods(per_pod)
+        feats = [enc.encode(p) for p in pods]
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
+        bucket = _pad_pow2(bucket if bucket else len(pods), 16)
+        cls = None
+        if num_to_find >= n and self.last_index == 0:
+            cls = self._uniform_class(pods, feats)
+        if cls is not None:
+            # fast scan: carried int32 scores, single-row rescore, packed
+            # fold, no rotation-rank math (full scan keeps last_index fixed)
+            skip = np.zeros(bucket, dtype=bool)
+            skip[len(pods):] = True
+            rows, lni, selected = K.schedule_batch_uniform(
+                nodes, cls, skip, self.last_node_index, n,
+                self.check_resources, weights=self.weights)
+            self._dev_nodes = {**self._dev_nodes, **rows}
+            self.last_node_index = int(lni)
+            sel = np.asarray(selected)[: len(pods)].tolist()
+            return [b.names[s] if s >= 0 else None for s in sel]
+        per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
+                   for p, f in zip(pods, feats)]
+        # pad the burst to a power-of-two bucket so lax.scan compiles once
+        # per bucket instead of once per burst length
+        if len(per_pod) < bucket:
+            pad = dict(per_pod[-1])
+            pad["skip"] = self._true
+            per_pod.extend([pad] * (bucket - len(per_pod)))
+        stacked = self._stack_pods(per_pod)
         z_pad = _pad_pow2(len(b.zone_names), 4)
         state, li, lni, outs = K.schedule_batch(
             nodes, stacked, self.last_index, self.last_node_index, num_to_find, n,
             z_pad, weights=self.weights)
+        # persist the folds: the device-resident matrix is authoritative for
+        # rows the scan mutated (the host mirror catches up via
+        # note_burst_assumed; external changes still arrive via dirty rows)
+        self._dev_nodes = {**self._dev_nodes, **state}
         self.last_index = int(li)
         self.last_node_index = int(lni)
-        selected = np.asarray(outs["selected"])[: len(pods)]
-        # sync the host mirror with the on-device folds so the next encode()
-        # doesn't resurrect stale rows: the caller is expected to apply the
-        # same assumes to the cache, after which encode() rewrites those rows.
-        return [b.names[int(s)] if int(s) >= 0 else None for s in selected]
+        selected = np.asarray(outs["selected"])[: len(pods)].tolist()
+        return [b.names[s] if s >= 0 else None for s in selected]
+
+    def note_burst_assumed(self, pod: Pod, host: str, generation: int) -> None:
+        """Post-burst bookkeeping for one placed pod: fold the same delta
+        the device scan applied into the host numpy mirror and sync the
+        encoder's generation map to the cache's post-assume generation, so
+        the next encode() neither re-encodes nor re-uploads the row."""
+        b = self.encoder._batch
+        if b is None or host not in b.index:
+            return
+        self.encoder.note_assumed(b, host, pod, generation=generation,
+                                  mark_dirty=False)

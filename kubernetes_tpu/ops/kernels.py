@@ -83,20 +83,13 @@ def _inert(arr) -> bool:
     return arr.ndim >= 1 and arr.shape[-1] == 1
 
 
-def _fit_scores(nodes, pod, kept, weights, z_pad):
-    """Enabled priorities, masked-normalized over `kept`. Returns total[N] i64.
-
-    Zero-weight priorities and inert (default-valued, shape-[1]) pod fields
-    are skipped at trace time: a plain-pod burst compiles down to
-    LeastRequested + BalancedAllocation + integer constants — int64 division
-    and f64 emulation on the MXU-less VPU path are the cost drivers, so ops
-    that provably contribute a constant are folded into one scalar."""
-    alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
-    req_cpu = pod["nz_cpu"] + nodes["nz_cpu"]
-    req_mem = pod["nz_mem"] + nodes["nz_mem"]
-
-    total = jnp.zeros(nodes["valid"].shape, dtype=jnp.int64)
-    const = 0   # python-int accumulator for provably-constant scores
+def _local_total(weights, req_cpu, req_mem, alloc_cpu, alloc_mem):
+    """The four row-local resource priorities (least/most/RTCR/balanced),
+    exact integer/float formulas. `req_*` is pod-nonzero + node-nonzero.
+    Works elementwise on [N] vectors and on single-row scalars — both the
+    full-cycle kernel and the uniform-burst incremental rescore call this,
+    so the two paths cannot drift."""
+    total = jnp.zeros_like(alloc_cpu)
 
     if weights["least_requested"]:
         def least(req, cap):
@@ -130,6 +123,25 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
             (cpu_f >= 1.0) | (mem_f >= 1.0), 0,
             ((1.0 - jnp.abs(cpu_f - mem_f)) * float(MAX_PRIORITY)).astype(jnp.int64))
         total = total + weights["balanced"] * balanced
+
+    return total
+
+
+def _fit_scores(nodes, pod, kept, weights, z_pad):
+    """Enabled priorities, masked-normalized over `kept`. Returns total[N] i64.
+
+    Zero-weight priorities and inert (default-valued, shape-[1]) pod fields
+    are skipped at trace time: a plain-pod burst compiles down to
+    LeastRequested + BalancedAllocation + integer constants — int64 division
+    and f64 emulation on the MXU-less VPU path are the cost drivers, so ops
+    that provably contribute a constant are folded into one scalar."""
+    alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
+    req_cpu = pod["nz_cpu"] + nodes["nz_cpu"]
+    req_mem = pod["nz_mem"] + nodes["nz_mem"]
+
+    const = 0   # python-int accumulator for provably-constant scores
+    total = jnp.zeros(nodes["valid"].shape, dtype=jnp.int64) + _local_total(
+        weights, req_cpu, req_mem, alloc_cpu, alloc_mem)
 
     if weights["node_affinity"]:
         na = pod["node_aff_counts"]
@@ -429,3 +441,133 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     return _schedule_batch_jit(
         nodes, pods, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
         _i64(n_real), z_pad, weights_tuple)
+
+
+# ---------------------------------------------------------------------------
+# Uniform-class burst: every pod in the burst shares one feature class
+# ---------------------------------------------------------------------------
+# The throughput workloads (ReplicaSet scale-ups; the scheduler_perf plain
+# matrix) enqueue thousands of identical pods. For those the generic scan
+# wastes its per-step budget recomputing scores that only changed on ONE row
+# (the previous fold target) and re-deriving rotation ranks that provably
+# don't move at percentageOfNodesToScore=100 (evaluated == n every cycle, so
+# last_index is a fixed point; selectHost's tie walk from li=0 is the natural
+# cumsum order). This kernel exploits both: scores are carried in int32 and
+# rescored for a single row per step via the SAME _local_total formulas, and
+# the feasibility mask is a handful of compares against a packed [R,N] state
+# folded with one scatter. Failure *reasons* are not computed — the shell
+# re-runs unschedulable pods through the serial path, which reports them.
+#
+# Eligibility (checked by the caller): num_to_find >= n_real, last_index == 0,
+# every per-pod feature inert, and all pods value-identical in requests and
+# fold deltas. Decisions are bit-identical to the generic scan: row-local
+# scores shift all nodes equally when constant families (inert taint/spread/
+# prefer-avoid) are dropped, so argmax and the round-robin tie walk match.
+
+@partial(jax.jit, static_argnames=("weights_tuple", "flags"))
+def _schedule_batch_uniform_jit(nodes, cls, skip, last_node_index, n_real,
+                                weights_tuple, flags):
+    weights = dict(weights_tuple)
+    check_res, has_req, carry_eph, static_eph, carried_s, static_s = flags
+    i32 = jnp.int32
+    n_pad = nodes["valid"].shape[0]
+    in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
+    ok = nodes["valid"] & in_range
+    alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
+    allowed = nodes["allowed_pods"]
+    if check_res and has_req:
+        # resource families whose node-side state cannot change in-burst
+        # (fold delta zero) collapse to a static mask
+        if static_eph:
+            ok &= ~(nodes["alloc_eph"] < cls["req_eph"] + nodes["req_eph"])
+        for s in static_s:
+            ok &= ~(nodes["alloc_scalar"][:, s]
+                    < cls["req_scalar"][s] + nodes["req_scalar"][:, s])
+
+    rows = [nodes["req_cpu"], nodes["req_mem"], nodes["nz_cpu"],
+            nodes["nz_mem"], nodes["pod_count"]]
+    delta = [cls["upd_cpu"], cls["upd_mem"], cls["nz_cpu"], cls["nz_mem"], 1]
+    ieph = None
+    if carry_eph:
+        ieph = len(rows)
+        rows.append(nodes["req_eph"])
+        delta.append(cls["upd_eph"])
+    isc0 = len(rows)
+    for s in carried_s:
+        rows.append(nodes["req_scalar"][:, s])
+        delta.append(cls["upd_scalar"][s])
+    st0 = jnp.stack(rows)
+    delta_vec = jnp.stack([jnp.asarray(d, jnp.int64) for d in delta])
+    I32_MIN = jnp.int32(-2**31)
+
+    tot0 = _local_total(weights, cls["nz_cpu"] + st0[2], cls["nz_mem"] + st0[3],
+                        alloc_cpu, alloc_mem).astype(i32)
+
+    def step(carry, skip_t):
+        st, tot, lni = carry
+        feas = ok & ~skip_t
+        if check_res:
+            feas &= st[4] + 1 <= allowed
+            if has_req:
+                feas &= (alloc_cpu >= cls["req_cpu"] + st[0]) \
+                    & (alloc_mem >= cls["req_mem"] + st[1])
+                if carry_eph:
+                    feas &= nodes["alloc_eph"] >= cls["req_eph"] + st[ieph]
+                for j, s in enumerate(carried_s):
+                    feas &= nodes["alloc_scalar"][:, s] \
+                        >= cls["req_scalar"][s] + st[isc0 + j]
+        tm = jnp.where(feas, tot, I32_MIN)
+        mx = jnp.max(tm)
+        tie = feas & (tm == mx)
+        T = jnp.cumsum(tie.astype(i32))
+        nt = jnp.maximum(T[n_pad - 1], 1)
+        F = jnp.sum(feas.astype(i32))
+        k = (lni % nt.astype(jnp.int64)).astype(i32)
+        sel = jnp.argmax(tie & (T == k + 1)).astype(i32)
+        hit = F > 0
+        st = st.at[:, sel].add(jnp.where(hit, delta_vec, 0))
+        # rescore just the folded row (identical formulas -> no drift; when
+        # no fold happened the recompute writes back the existing value)
+        row = st[:, sel]
+        new_tot = _local_total(weights, cls["nz_cpu"] + row[2],
+                               cls["nz_mem"] + row[3],
+                               alloc_cpu[sel], alloc_mem[sel])
+        tot = tot.at[sel].set(new_tot.astype(i32))
+        lni = lni + jnp.where(F > 1, 1, 0)
+        return (st, tot, lni), jnp.where(hit, sel, -1)
+
+    init = (st0, tot0, jnp.asarray(last_node_index, jnp.int64))
+    (st, _tot, lni), selected = jax.lax.scan(step, init, skip)
+
+    out_rows = {"req_cpu": st[0], "req_mem": st[1], "nz_cpu": st[2],
+                "nz_mem": st[3], "pod_count": st[4]}
+    if carry_eph:
+        out_rows["req_eph"] = st[ieph]
+    if carried_s:
+        rs = nodes["req_scalar"]
+        for j, s in enumerate(carried_s):
+            rs = rs.at[:, s].set(st[isc0 + j])
+        out_rows["req_scalar"] = rs
+    return out_rows, lni, selected
+
+
+def schedule_batch_uniform(nodes, cls, skip, last_node_index, n_real,
+                           check_resources, weights=None):
+    """Uniform-class burst (see block comment above). `cls` holds the shared
+    per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
+    upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
+    (folded_state_rows, last_node_index, selected[B])."""
+    weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    has_req = bool(cls.pop("has_request"))
+    carry_eph = bool(cls["upd_eph"] != 0)
+    static_eph = bool(not carry_eph and cls["req_eph"] != 0)
+    carried_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
+                      if cls["upd_scalar"][s] != 0)
+    static_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
+                     if cls["req_scalar"][s] != 0 and cls["upd_scalar"][s] == 0)
+    flags = (bool(check_resources), has_req, carry_eph, static_eph,
+             carried_s, static_s)
+    cls = {k: jnp.asarray(v, jnp.int64) for k, v in cls.items()}
+    return _schedule_batch_uniform_jit(
+        nodes, cls, skip, _i64(last_node_index), _i64(n_real),
+        weights_tuple, flags)

@@ -123,13 +123,17 @@ class NodeStateEncoder:
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
         zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
         dirty = []
+        gens = self._generations
         for i, name in enumerate(node_order):
             ni = node_infos[name]
-            if self._generations.get(name) == ni.generation:
+            if gens.get(name) == ni.generation:
                 continue
-            self._generations[name] = ni.generation
-            self._write_row(b, i, ni, scalar_idx, zone_idx)
-            dirty.append(i)
+            gens[name] = ni.generation
+            # value-compare: a generation bump with identical aggregates
+            # (assume→confirm, status-only updates, folds already applied on
+            # device) must not trigger a device re-upload
+            if self._write_row(b, i, ni, scalar_idx, zone_idx):
+                dirty.append(i)
         # accumulate until the device mirror consumes (resets) the list;
         # None = full re-upload required
         if rebuild:
@@ -158,43 +162,74 @@ class NodeStateEncoder:
         return b
 
     def _write_row(self, b: NodeBatch, i: int, ni: NodeInfo,
-                   scalar_idx: dict[str, int], zone_idx: dict[str, int]) -> None:
-        b.alloc_cpu[i] = ni.allocatable.milli_cpu
-        b.alloc_mem[i] = ni.allocatable.memory
-        b.alloc_eph[i] = ni.allocatable.ephemeral_storage
-        b.allowed_pods[i] = ni.allocatable.allowed_pod_number
-        b.req_cpu[i] = ni.requested.milli_cpu
-        b.req_mem[i] = ni.requested.memory
-        b.req_eph[i] = ni.requested.ephemeral_storage
-        b.nz_cpu[i] = ni.nonzero_cpu
-        b.nz_mem[i] = ni.nonzero_mem
-        b.pod_count[i] = len(ni.pods)
-        b.alloc_scalar[i, :] = 0
-        b.req_scalar[i, :] = 0
-        for name, q in ni.allocatable.scalar.items():
-            b.alloc_scalar[i, scalar_idx[name]] = q
-        for name, q in ni.requested.scalar.items():
-            b.req_scalar[i, scalar_idx[name]] = q
-        if ni.node is not None:
-            b.zone_id[i] = zone_idx[get_zone_key(ni.node)]
+                   scalar_idx: dict[str, int], zone_idx: dict[str, int]) -> bool:
+        """Write one mirror row from its NodeInfo; returns True when any
+        device-visible value actually changed."""
+        changed = False
 
-    def note_assumed(self, b: NodeBatch, node_name: str, pod: Pod) -> None:
-        """Apply an assume to the host mirror without a full re-encode.
-        Keeps `_generations` in sync with the cache's post-assume generation
-        so the next encode() skips the row unless it changed again."""
+        def setf(arr, val):
+            nonlocal changed
+            if arr[i] != val:
+                arr[i] = val
+                changed = True
+
+        setf(b.alloc_cpu, ni.allocatable.milli_cpu)
+        setf(b.alloc_mem, ni.allocatable.memory)
+        setf(b.alloc_eph, ni.allocatable.ephemeral_storage)
+        setf(b.allowed_pods, ni.allocatable.allowed_pod_number)
+        setf(b.req_cpu, ni.requested.milli_cpu)
+        setf(b.req_mem, ni.requested.memory)
+        setf(b.req_eph, ni.requested.ephemeral_storage)
+        setf(b.nz_cpu, ni.nonzero_cpu)
+        setf(b.nz_mem, ni.nonzero_mem)
+        setf(b.pod_count, len(ni.pods))
+        s = b.alloc_scalar.shape[1]
+        new_alloc = np.zeros(s, dtype=np.int64)
+        for name, q in ni.allocatable.scalar.items():
+            new_alloc[scalar_idx[name]] = q
+        if not np.array_equal(b.alloc_scalar[i], new_alloc):
+            b.alloc_scalar[i] = new_alloc
+            changed = True
+        new_req = np.zeros(s, dtype=np.int64)
+        for name, q in ni.requested.scalar.items():
+            new_req[scalar_idx[name]] = q
+        if not np.array_equal(b.req_scalar[i], new_req):
+            b.req_scalar[i] = new_req
+            changed = True
+        if ni.node is not None:
+            setf(b.zone_id, zone_idx[get_zone_key(ni.node)])
+        return changed
+
+    def note_assumed(self, b: NodeBatch, node_name: str, pod: Pod,
+                     generation: Optional[int] = None,
+                     mark_dirty: bool = True) -> None:
+        """Apply an assume to the host mirror without a full re-encode,
+        matching NodeInfo.add_pod's aggregate update (calculate_resource —
+        regular containers only — NOT the predicate-side GetResourceRequest
+        which maxes in init containers; reference: node_info.go:578).
+
+        With `generation`, syncs `_generations` to the cache's post-assume
+        generation; with mark_dirty=False the row is NOT queued for device
+        upload — callers use that when the device already folded the same
+        delta in-scan (the burst path), making the resident matrix
+        authoritative."""
+        from kubernetes_tpu.cache.node_info import calculate_resource
         i = b.index[node_name]
-        req = get_resource_request(pod)
+        req = calculate_resource(pod)
         b.req_cpu[i] += req.milli_cpu
         b.req_mem[i] += req.memory
         b.req_eph[i] += req.ephemeral_storage
-        scalar_idx = {name: j for j, name in enumerate(b.scalar_names)}
-        for name, q in req.scalar.items():
-            b.req_scalar[i, scalar_idx[name]] += q
+        if req.scalar:
+            scalar_idx = {name: j for j, name in enumerate(b.scalar_names)}
+            for name, q in req.scalar.items():
+                b.req_scalar[i, scalar_idx[name]] += q
         ncpu, nmem = get_pod_nonzero_requests(pod)
         b.nz_cpu[i] += ncpu
         b.nz_mem[i] += nmem
         b.pod_count[i] += 1
-        if b.dirty_rows is not None:
+        if generation is not None:
+            self._generations[node_name] = generation
+        if mark_dirty and b.dirty_rows is not None:
             b.dirty_rows.append(i)
 
 

@@ -477,11 +477,13 @@ class Scheduler:
                 pass
 
     # -- burst mode (TPU throughput path) -------------------------------------
-    def _pod_is_burstable(self, pod: Pod) -> bool:
+    def _pod_is_burstable(self, pod: Pod, services=None, replicasets=None) -> bool:
         """A pod may ride a device burst only when its per-node masks can't
         be changed by in-burst placements: the scan folds resource deltas
         into device state, but affinity terms, host ports, and
-        selector-spread counts are encoded host-side once per burst."""
+        selector-spread counts are encoded host-side once per burst.
+        `services`/`replicasets` are passed in so a burst lists them once,
+        not once per pod."""
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports)
         if has_pod_affinity_terms(pod):
@@ -491,7 +493,9 @@ class Scheduler:
         if pod.volumes:
             return False
         from kubernetes_tpu.oracle.priorities import get_selectors
-        if get_selectors(pod, self._services_fn(), self._replicasets_fn()):
+        if get_selectors(pod,
+                         self._services_fn() if services is None else services,
+                         self._replicasets_fn() if replicasets is None else replicasets):
             return False
         return True
 
@@ -523,18 +527,20 @@ class Scheduler:
                      and not self.framework.reserve
                      and not self.framework.permit
                      and not self.framework.prebind)
+        services = self._services_fn()
+        replicasets = self._replicasets_fn()
         i = 0
         while i < len(pods):
             # serial path for mask-stale pods and under active nominations
             # (the two-pass ghost check lives on the oracle path)
             if not can_burst or self.queue.nominated.has_any() \
-                    or not self._pod_is_burstable(pods[i]):
+                    or not self._pod_is_burstable(pods[i], services, replicasets):
                 self._process_one(pods[i], cycles[i])
                 i += 1
                 continue
             j = i
             while j < len(pods) and not self.queue.nominated.has_any() \
-                    and self._pod_is_burstable(pods[j]):
+                    and self._pod_is_burstable(pods[j], services, replicasets):
                 j += 1
             self._burst_segment(pods[i:j], cycles[i:j], max_pods)
             i = j
@@ -547,6 +553,7 @@ class Scheduler:
         self._last_names = names
         hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
                                               names, bucket=bucket)
+        note = getattr(self.algorithm, "note_burst_assumed", None)
         for pod, host, cycle in zip(pods, hosts, cycles):
             if host is None:
                 # re-run serially for the failure reasons + preemption path
@@ -555,6 +562,12 @@ class Scheduler:
             assumed = pod.clone()
             assumed.node_name = host
             self.cache.assume_pod(assumed)
+            if note is not None:
+                # the device scan already folded this delta: sync the host
+                # mirror + generation map so the next encode() skips the row
+                gen = self.cache.node_generation(host)
+                if gen is not None:
+                    note(assumed, host, gen)
             self._bind(assumed, host, pod, cycle)  # observes "scheduled"
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
