@@ -8,6 +8,8 @@ axis of the device matrix.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 from kubernetes_tpu.api.types import Node, get_zone_key
 
 
@@ -19,6 +21,7 @@ class NodeTree:
         self._last_index: dict[str, int] = {}   # per-zone cursor
         self._exhausted: set[str] = set()
         self.num_nodes = 0
+        self._rotation_cache: Optional[list[int]] = None  # keyed by membership
 
     def add_node(self, node: Node) -> None:
         zone = get_zone_key(node)
@@ -32,6 +35,7 @@ class NodeTree:
             return
         names.append(node.name)
         self.num_nodes += 1
+        self._rotation_cache = None
 
     def remove_node(self, node: Node) -> None:
         zone = get_zone_key(node)
@@ -40,6 +44,7 @@ class NodeTree:
             return
         names.remove(node.name)
         self.num_nodes -= 1
+        self._rotation_cache = None
         if not names:
             del self._tree[zone]
             self._zones.remove(zone)
@@ -80,3 +85,72 @@ class NodeTree:
     def list_names(self) -> list[str]:
         """One full interleaved enumeration — the per-cycle node order."""
         return [self.next() for _ in range(self.num_nodes)]
+
+    # -- rotation structure (device-burst support) ---------------------------
+    # A full enumeration's order is determined entirely by the zone index it
+    # starts from (cursors reset lazily at the first next() of each
+    # enumeration), so there are at most len(zones) distinct per-cycle
+    # orders. Burst kernels replay the per-cycle rotation from these.
+
+    def _simulate(self, start: int) -> tuple[list[str], int]:
+        """Order + end zone-index of one full enumeration starting at zone
+        index `start` with fresh cursors (exact mirror of next())."""
+        if not self._zones:
+            return [], 0
+        z = len(self._zones)
+        cursor = {zone: 0 for zone in self._zones}
+        exhausted: set[str] = set()
+        zi = start
+        names: list[str] = []
+        while len(names) < self.num_nodes:
+            zone = self._zones[zi]
+            zi = (zi + 1) % z
+            if zone in exhausted:
+                continue
+            idx = cursor[zone]
+            nodes = self._tree[zone]
+            if idx >= len(nodes) - 1:
+                exhausted.add(zone)
+            if idx < len(nodes):
+                cursor[zone] = idx + 1
+                names.append(nodes[idx])
+        return names, zi
+
+    def rotation_map(self) -> list[int]:
+        """next_start[r]: the zone index the enumeration AFTER one starting
+        at r begins from. next_start[r] == r for all r iff the per-cycle
+        order is stable (e.g. equal-size zones). Cached until membership
+        changes — burst segments consult this on every launch."""
+        if self._rotation_cache is None:
+            self._rotation_cache = [
+                self._simulate(r)[1] for r in range(max(len(self._zones), 1))]
+        return self._rotation_cache
+
+    def order_for_start(self, start: int) -> list[str]:
+        return self._simulate(start)[0]
+
+    @property
+    def zone_index(self) -> int:
+        return self._zone_index
+
+    def advance_enumerations(self, count: int) -> None:
+        """Fast-forward the tree as if `count` more full enumerations ran.
+        Valid only in the post-enumeration state (i.e. after at least one
+        full list_names()), where cursors/exhausted are already at their
+        end-of-enumeration values and only the zone index walks."""
+        if not self._zones or count <= 0:
+            return
+        nxt = self.rotation_map()
+        r = self._zone_index
+        seen: dict[int, int] = {}
+        walk: list[int] = []
+        # the walk over <= z states enters a cycle; close the form
+        while count > 0 and r not in seen:
+            seen[r] = len(walk)
+            walk.append(r)
+            r = nxt[r]
+            count -= 1
+        if count > 0:
+            cycle = walk[seen[r]:]
+            r = cycle[count % len(cycle)] if cycle else r
+        self._zone_index = r

@@ -63,7 +63,8 @@ class TPUScheduler:
                  replicasets_fn=lambda: [],
                  collect_host_priority: bool = True,
                  nominated=None,
-                 volume_listers=None, volume_binder=None):
+                 volume_listers=None, volume_binder=None,
+                 node_tree=None):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.services_fn = services_fn
@@ -79,6 +80,10 @@ class TPUScheduler:
         self.nominated = nominated
         self.volume_listers = volume_listers
         self.volume_binder = volume_binder
+        # NodeTree handle: burst decisions must replay the per-cycle
+        # zone-interleaved enumeration rotation (node_tree.py rotation_map);
+        # None = callers that feed a fixed name order (tests, sharded twin)
+        self.node_tree = node_tree
         self._oracle = None
         self._oracle_cfgs = None
         self.last_index = 0
@@ -414,12 +419,66 @@ class TPUScheduler:
                 return None
         return cls
 
+    def _burst_rotation(self, b: NodeBatch, n_pods: int):
+        """Per-cycle enumeration orders for a burst: pod 0 rides the device
+        axis (the list_names() enumeration the shell just consumed); pod
+        i >= 1 rides the order starting at the tree's current zone index
+        walked i-1 steps through rotation_map. Returns None when every
+        in-burst cycle provably repeats the axis order (equal-size zones,
+        single zone, or no tree — the common large-cluster case)."""
+        tree = self.node_tree
+        if tree is None or len(tree._zones) <= 1:
+            return None
+        nxt = tree.rotation_map()
+        r = tree.zone_index
+        length = n_pods + K.K_BATCH
+        n_pad = b.n_pad
+        perm_rows = [np.concatenate([
+            np.arange(b.n_real, dtype=np.int32),
+            np.full(n_pad + 1 - b.n_real, n_pad, dtype=np.int32)])]
+        id_of_r: dict[int, int] = {}
+
+        def order_id(rr: int) -> int:
+            iid = id_of_r.get(rr)
+            if iid is None:
+                names = tree.order_for_start(rr)
+                row = np.fromiter((b.index[nm] for nm in names), np.int32,
+                                  len(names))
+                if np.array_equal(row, perm_rows[0][: len(names)]):
+                    iid = 0
+                else:
+                    perm_rows.append(np.concatenate([
+                        row, np.full(n_pad + 1 - len(names), n_pad,
+                                     dtype=np.int32)]))
+                    iid = len(perm_rows) - 1
+                id_of_r[rr] = iid
+            return iid
+
+        seq = np.zeros(length, dtype=np.int32)
+        if nxt[r] == r:
+            # fixed-point walk: every cycle >= 1 repeats P_r — either the
+            # axis itself (stable: no rotation machinery at all) or one
+            # other order (constant seq, no per-cycle walk to build)
+            iid = order_id(r)
+            if iid == 0:
+                return None
+            seq[1:] = iid
+        else:
+            for i in range(1, length):
+                seq[i] = order_id(r)
+                r = nxt[r]
+            if not seq.any():
+                return None
+        return np.stack(perm_rows), seq
+
     def schedule_burst(self, pods: list[Pod], node_infos: dict[str, NodeInfo],
                        all_node_names: list[str],
-                       bucket: Optional[int] = None) -> list[Optional[str]]:
+                       bucket: Optional[int] = None) -> Optional[list[Optional[str]]]:
         """Schedule `pods` against one snapshot; returns per-pod host (or
         None when unschedulable). Decisions are serially equivalent to
-        calling schedule() per pod with cache assumes in between.
+        calling schedule() per pod with cache assumes in between. Returns
+        None (whole-burst refusal) when burst semantics can't be made
+        serial-equivalent here — the shell then runs the pods serially.
 
         The folded state persists on device: the caller MUST apply the
         returned placements to its cache (as the scheduler shell does via
@@ -441,17 +500,34 @@ class TPUScheduler:
         if num_to_find >= n and self.last_index == 0:
             cls = self._uniform_class(pods, feats)
         if cls is not None:
-            # fast scan: carried int32 scores, single-row rescore, packed
-            # fold, no rotation-rank math (full scan keeps last_index fixed)
-            skip = np.zeros(bucket, dtype=bool)
-            skip[len(pods):] = True
-            rows, lni, selected = K.schedule_batch_uniform(
-                nodes, cls, skip, self.last_node_index, n,
-                self.check_resources, weights=self.weights)
-            self._dev_nodes = {**self._dev_nodes, **rows}
-            self.last_node_index = int(lni)
-            sel = np.asarray(selected)[: len(pods)].tolist()
+            # K-pods-per-pass kernel: dynamic pod count (one compile for any
+            # burst size), carried int32 scores, consecutive-tie-rank batch
+            # resolution with exact prefix validation (kernels.py K_BATCH)
+            rotation = self._burst_rotation(b, len(pods))
+            sel: list[int] = []
+            for lo in range(0, len(pods), K.B_CAP):
+                chunk = min(K.B_CAP, len(pods) - lo)
+                rot = rotation
+                if rotation is not None:
+                    win = np.empty(K.B_CAP + K.K_BATCH, dtype=np.int32)
+                    piece = rotation[1][lo: lo + len(win)]
+                    win[: len(piece)] = piece
+                    win[len(piece):] = piece[-1] if len(piece) else 0
+                    rot = (rotation[0], win)
+                rows, packed = K.schedule_batch_uniform(
+                    nodes, dict(cls), chunk, self.last_node_index, n,
+                    self.check_resources, weights=self.weights, rotation=rot)
+                self._dev_nodes = {**self._dev_nodes, **rows}
+                nodes = self._dev_nodes
+                h = np.asarray(packed)   # ONE fetch: selections + lni delta
+                self.last_node_index += int(h[K.B_CAP])
+                sel.extend(h[:chunk].tolist())
             return [b.names[s] if s >= 0 else None for s in sel]
+        if self._burst_rotation(b, len(pods)) is not None:
+            # the generic scan folds against ONE node order; under an
+            # unstable per-cycle rotation its tie-breaks would diverge from
+            # the serial walk — refuse, the shell runs these pods serially
+            return None
         per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
                    for p, f in zip(pods, feats)]
         # pad the burst to a power-of-two bucket so lax.scan compiles once

@@ -447,34 +447,52 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
 # Uniform-class burst: every pod in the burst shares one feature class
 # ---------------------------------------------------------------------------
 # The throughput workloads (ReplicaSet scale-ups; the scheduler_perf plain
-# matrix) enqueue thousands of identical pods. For those the generic scan
-# wastes its per-step budget recomputing scores that only changed on ONE row
-# (the previous fold target) and re-deriving rotation ranks that provably
-# don't move at percentageOfNodesToScore=100 (evaluated == n every cycle, so
-# last_index is a fixed point; selectHost's tie walk from li=0 is the natural
-# cumsum order). This kernel exploits both: scores are carried in int32 and
-# rescored for a single row per step via the SAME _local_total formulas, and
-# the feasibility mask is a handful of compares against a packed [R,N] state
-# folded with one scatter. Failure *reasons* are not computed — the shell
-# re-runs unschedulable pods through the serial path, which reports them.
+# matrix) enqueue thousands of identical pods. For those, per-pod O(N) work
+# is provably wasted: at percentageOfNodesToScore=100 with last_index == 0,
+# selectHost's round-robin tie walk (generic_scheduler.go:286-295) assigns
+# CONSECUTIVE pods to CONSECUTIVE tie ranks — `ix = lastNodeIndex % len(ties)`
+# with lastNodeIndex incrementing by 1 — for as long as the tie set itself
+# does not change. A node leaves the tie set only when a fold crosses one of
+# the integer-truncation boundaries of the score formulas (every ~4th pod on
+# a node at the scheduler_perf shape), so in the common regime the tie set is
+# stable across hundreds of consecutive decisions.
+#
+# This kernel therefore schedules K pods per O(N) pass: one feasibility +
+# tie-cumsum sweep, then K consecutive tie ranks resolved with a vectorized
+# searchsorted, K fold deltas scattered to (provably distinct) rows, and an
+# EXACT validity check — each selected node's post-fold score must still
+# equal the max and the row must stay feasible, i.e. the tie set is unchanged
+# for every later pod in the batch. The longest valid prefix is accepted
+# (always >= 1: pod 0's decision depends only on the batch-start state), the
+# rest retry in the next iteration, so the worst case degrades to one pod per
+# pass — the old behavior — and decisions stay bit-identical to the serial
+# scan in all cases. Failure *reasons* are not computed — the shell re-runs
+# unschedulable pods through the serial path, which reports them.
+#
+# The pod count is a DYNAMIC operand of a single lax.while_loop: one compile
+# serves every burst size (no bucket padding, no trailing-segment waste).
 #
 # Eligibility (checked by the caller): num_to_find >= n_real, last_index == 0,
 # every per-pod feature inert, and all pods value-identical in requests and
-# fold deltas. Decisions are bit-identical to the generic scan: row-local
-# scores shift all nodes equally when constant families (inert taint/spread/
-# prefer-avoid) are dropped, so argmax and the round-robin tie walk match.
+# fold deltas. Row-local scores shift all nodes equally when constant
+# families (inert taint/spread/prefer-avoid) are dropped, so argmax and the
+# round-robin tie walk match the generic kernel.
 
-@partial(jax.jit, static_argnames=("weights_tuple", "flags"))
-def _schedule_batch_uniform_jit(nodes, cls, skip, last_node_index, n_real,
-                                weights_tuple, flags):
+K_BATCH = 512        # pods resolved per O(N) pass (static)
+B_CAP = 16384        # output-buffer capacity (static); callers chunk above it
+
+
+@partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap", "k_batch",
+                                   "rotate"))
+def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
+                                perm, oid_seq, weights_tuple, flags, b_cap,
+                                k_batch, rotate):
     weights = dict(weights_tuple)
     check_res, has_req, carry_eph, static_eph, carried_s, static_s = flags
     i32 = jnp.int32
     n_pad = nodes["valid"].shape[0]
     in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
     ok = nodes["valid"] & in_range
-    alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
-    allowed = nodes["allowed_pods"]
     if check_res and has_req:
         # resource families whose node-side state cannot change in-burst
         # (fold delta zero) collapse to a static mask
@@ -483,6 +501,15 @@ def _schedule_batch_uniform_jit(nodes, cls, skip, last_node_index, n_real,
         for s in static_s:
             ok &= ~(nodes["alloc_scalar"][:, s]
                     < cls["req_scalar"][s] + nodes["req_scalar"][:, s])
+
+    # one scratch column at index n_pad: inactive scatter/gather lanes park
+    # there so active lanes (distinct by construction) never collide
+    def pad1(v):
+        return jnp.concatenate([v, jnp.zeros(1, v.dtype)])
+    ok = pad1(ok)
+    alloc_cpu, alloc_mem = pad1(nodes["alloc_cpu"]), pad1(nodes["alloc_mem"])
+    allowed = pad1(nodes["allowed_pods"])
+    alloc_eph = pad1(nodes["alloc_eph"])
 
     rows = [nodes["req_cpu"], nodes["req_mem"], nodes["nz_cpu"],
             nodes["nz_mem"], nodes["pod_count"]]
@@ -493,70 +520,158 @@ def _schedule_batch_uniform_jit(nodes, cls, skip, last_node_index, n_real,
         rows.append(nodes["req_eph"])
         delta.append(cls["upd_eph"])
     isc0 = len(rows)
+    alloc_sc = []
     for s in carried_s:
         rows.append(nodes["req_scalar"][:, s])
         delta.append(cls["upd_scalar"][s])
-    st0 = jnp.stack(rows)
+        alloc_sc.append(pad1(nodes["alloc_scalar"][:, s]))
+    st0 = jnp.stack([pad1(r) for r in rows])
     delta_vec = jnp.stack([jnp.asarray(d, jnp.int64) for d in delta])
     I32_MIN = jnp.int32(-2**31)
 
     tot0 = _local_total(weights, cls["nz_cpu"] + st0[2], cls["nz_mem"] + st0[3],
                         alloc_cpu, alloc_mem).astype(i32)
+    jlane = jnp.arange(k_batch, dtype=i32)
+    B = jnp.asarray(n_pods, i32)
 
-    def step(carry, skip_t):
-        st, tot, lni = carry
-        feas = ok & ~skip_t
+    def resource_fit(rowvals, idx):
+        """PodFitsResources for the incoming pod against row state `rowvals`
+        ([R] or [R, K]) at node(s) `idx` — shared by the sweep and the
+        post-fold stays check so the two cannot drift."""
+        fit = ok[idx] if idx is not None else ok
+        a_cpu = alloc_cpu[idx] if idx is not None else alloc_cpu
+        a_mem = alloc_mem[idx] if idx is not None else alloc_mem
+        a_pods = allowed[idx] if idx is not None else allowed
         if check_res:
-            feas &= st[4] + 1 <= allowed
+            fit &= rowvals[4] + 1 <= a_pods
             if has_req:
-                feas &= (alloc_cpu >= cls["req_cpu"] + st[0]) \
-                    & (alloc_mem >= cls["req_mem"] + st[1])
+                fit &= (a_cpu >= cls["req_cpu"] + rowvals[0]) \
+                    & (a_mem >= cls["req_mem"] + rowvals[1])
                 if carry_eph:
-                    feas &= nodes["alloc_eph"] >= cls["req_eph"] + st[ieph]
-                for j, s in enumerate(carried_s):
-                    feas &= nodes["alloc_scalar"][:, s] \
-                        >= cls["req_scalar"][s] + st[isc0 + j]
+                    a_eph = alloc_eph[idx] if idx is not None else alloc_eph
+                    fit &= a_eph >= cls["req_eph"] + rowvals[ieph]
+                for jj, s in enumerate(carried_s):
+                    a_s = alloc_sc[jj][idx] if idx is not None else alloc_sc[jj]
+                    fit &= a_s >= cls["req_scalar"][s] + rowvals[isc0 + jj]
+        return fit
+
+    def body(carry):
+        st, tot, lni, done, out = carry
+        feas = resource_fit(st, None)
         tm = jnp.where(feas, tot, I32_MIN)
         mx = jnp.max(tm)
         tie = feas & (tm == mx)
-        T = jnp.cumsum(tie.astype(i32))
-        nt = jnp.maximum(T[n_pad - 1], 1)
-        F = jnp.sum(feas.astype(i32))
-        k = (lni % nt.astype(jnp.int64)).astype(i32)
-        sel = jnp.argmax(tie & (T == k + 1)).astype(i32)
-        hit = F > 0
-        st = st.at[:, sel].add(jnp.where(hit, delta_vec, 0))
-        # rescore just the folded row (identical formulas -> no drift; when
-        # no fold happened the recompute writes back the existing value)
-        row = st[:, sel]
-        new_tot = _local_total(weights, cls["nz_cpu"] + row[2],
-                               cls["nz_mem"] + row[3],
-                               alloc_cpu[sel], alloc_mem[sel])
-        tot = tot.at[sel].set(new_tot.astype(i32))
-        lni = lni + jnp.where(F > 1, 1, 0)
-        return (st, tot, lni), jnp.where(hit, sel, -1)
+        T = jnp.sum(tie, dtype=i32)
+        F = jnp.sum(feas, dtype=i32)
+        remaining = B - done
+        # batch size this pass: the multi-pod fast path needs >= 2 ties (a
+        # single-tie fold can change num_ties, shifting the modulo walk) and
+        # F > 1 (so lastNodeIndex advances exactly 1 per pod); F == 0 means
+        # every remaining pod is equally unschedulable -> emit-all -1
+        kbig = (T >= 2) & (F > 1)
+        m = jnp.where(F == 0, jnp.minimum(remaining, k_batch),
+                      jnp.where(kbig,
+                                jnp.minimum(jnp.minimum(remaining, k_batch), T),
+                                1))
+        active = (jlane < m) & (F > 0)
+        pos = ((lni + jlane.astype(jnp.int64))
+               % jnp.maximum(T, 1).astype(jnp.int64)).astype(i32)
+        if not rotate:
+            # stable per-cycle order == the device axis: tie rank -> node via
+            # one cumsum (consecutive ranks mod T are distinct while m <= T,
+            # so active lanes never collide)
+            C = jnp.cumsum(tie.astype(i32))
+            selq = jnp.searchsorted(C, pos + 1, method="compare_all").astype(i32)
+            sel = jnp.where(active, selq, n_pad)
+        else:
+            # per-cycle rotated orders: lane j ranks ties in the order of ITS
+            # cycle (done + j), one of the <= L distinct zone-interleaved
+            # enumerations in `perm` (NodeTree.order_for_start)
+            oid = jax.lax.dynamic_slice(oid_seq, (done,), (k_batch,))
+            tie_perm = tie[perm]                     # [L, N1]
+            C_all = jnp.cumsum(tie_perm.astype(i32), axis=1)
+            crows = C_all[oid]                       # [K, N1]
+            posp = jnp.sum(crows < (pos + 1)[:, None], axis=1, dtype=i32)
+            selq = perm[oid, jnp.minimum(posp, n_pad)]
+            sel = jnp.where(active, selq, n_pad)
+        rows_sel = st[:, sel]
+        rows_after = rows_sel + delta_vec[:, None]
+        new_tot = _local_total(
+            weights, cls["nz_cpu"] + rows_after[2], cls["nz_mem"] + rows_after[3],
+            alloc_cpu[sel], alloc_mem[sel]).astype(i32)
+        # serial equivalence: pod j > 0 sees the batch-start tie set only if
+        # every earlier fold left its node AT max score and feasible
+        stays = (new_tot == mx) & resource_fit(rows_after, sel)
+        fail = (~stays) & active
+        first_bad = jnp.where(jnp.any(fail), jnp.argmax(fail).astype(i32),
+                              jnp.int32(k_batch))
+        v = jnp.where(F == 0, m, jnp.minimum(first_bad + 1, m))
+        if rotate:
+            # distinct ranks under DIFFERENT orders can name the same node;
+            # the second fold would see stale state — cut the batch before
+            # the first duplicate (it retries next pass)
+            owner = jnp.full(n_pad + 1, k_batch, i32).at[sel].min(
+                jnp.where(active, jlane, k_batch))
+            dup = active & (owner[sel] != jlane)
+            first_dup = jnp.where(jnp.any(dup), jnp.argmax(dup).astype(i32),
+                                  jnp.int32(k_batch))
+            v = jnp.minimum(v, first_dup)
+            # F==0 emits no selections, so the dup cut (which needs F>0
+            # lanes) cannot zero it: active is all-False there and v stays m
+            v = jnp.where(F == 0, m, jnp.maximum(v, 1))
+        accept = active & (jlane < v)
+        st = st.at[:, sel].add(
+            jnp.where(accept[None, :], delta_vec[:, None], 0))
+        # route non-accepted lanes to the scratch column: under rotation a
+        # rejected lane's sel may DUPLICATE an accepted lane's node, and a
+        # duplicate .set would clobber the accepted score write
+        selw = jnp.where(accept, sel, n_pad)
+        tot = tot.at[selw].set(new_tot)
+        emit = jnp.where((jlane < v) & (F > 0), sel, -1)
+        out = jax.lax.dynamic_update_slice(out, emit, (done,))
+        lni = lni + jnp.where(F > 1, v, 0).astype(jnp.int64)
+        return st, tot, lni, done + v, out
 
-    init = (st0, tot0, jnp.asarray(last_node_index, jnp.int64))
-    (st, _tot, lni), selected = jax.lax.scan(step, init, skip)
+    out0 = jnp.full(b_cap + k_batch, -1, i32)
+    lni0 = jnp.asarray(last_node_index, jnp.int64)
+    st, tot, lni, done, out = jax.lax.while_loop(
+        lambda c: c[3] < B, body, (st0, tot0, lni0, jnp.int32(0), out0))
+    # pack the lastNodeIndex advance into the selection buffer so the caller
+    # fetches ONE array — each separate device->host read pays a full
+    # dispatch round trip (~100ms over a tunneled device)
+    out = out.at[b_cap].set((lni - lni0).astype(i32))
 
-    out_rows = {"req_cpu": st[0], "req_mem": st[1], "nz_cpu": st[2],
-                "nz_mem": st[3], "pod_count": st[4]}
+    unpad = lambda v: v[:n_pad]
+    out_rows = {"req_cpu": unpad(st[0]), "req_mem": unpad(st[1]),
+                "nz_cpu": unpad(st[2]), "nz_mem": unpad(st[3]),
+                "pod_count": unpad(st[4])}
     if carry_eph:
-        out_rows["req_eph"] = st[ieph]
+        out_rows["req_eph"] = unpad(st[ieph])
     if carried_s:
         rs = nodes["req_scalar"]
-        for j, s in enumerate(carried_s):
-            rs = rs.at[:, s].set(st[isc0 + j])
+        for jj, s in enumerate(carried_s):
+            rs = rs.at[:, s].set(unpad(st[isc0 + jj]))
         out_rows["req_scalar"] = rs
-    return out_rows, lni, selected
+    return out_rows, out[: b_cap + 1]
 
 
-def schedule_batch_uniform(nodes, cls, skip, last_node_index, n_real,
-                           check_resources, weights=None):
+def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
+                           check_resources, weights=None, rotation=None):
     """Uniform-class burst (see block comment above). `cls` holds the shared
     per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
     upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
-    (folded_state_rows, last_node_index, selected[B])."""
+    (folded_state_rows, packed[B_CAP+1]) where packed[:n_pods] are per-pod
+    node indices (-1 = unschedulable) and packed[B_CAP] is the
+    lastNodeIndex advance — one array, one host fetch. `n_pods` must be
+    <= B_CAP; chunk larger bursts.
+
+    `rotation` = None when the per-cycle NodeTree enumeration is stable and
+    equals the device axis; otherwise (perm[L, n_pad+1] int32 — the <= L
+    distinct per-cycle orders as axis indices, scratch-padded — and
+    oid_seq[B_CAP + K_BATCH] int32 — cycle t's order id, t counted from this
+    burst's first pod)."""
+    if n_pods > B_CAP:
+        raise ValueError(f"uniform burst of {n_pods} exceeds B_CAP={B_CAP}")
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     has_req = bool(cls.pop("has_request"))
     carry_eph = bool(cls["upd_eph"] != 0)
@@ -568,6 +683,13 @@ def schedule_batch_uniform(nodes, cls, skip, last_node_index, n_real,
     flags = (bool(check_resources), has_req, carry_eph, static_eph,
              carried_s, static_s)
     cls = {k: jnp.asarray(v, jnp.int64) for k, v in cls.items()}
+    if rotation is None:
+        perm = jnp.zeros((1, 1), jnp.int32)      # unused placeholder
+        oid_seq = jnp.zeros(1, jnp.int32)
+    else:
+        perm, oid_seq = (jnp.asarray(rotation[0], jnp.int32),
+                         jnp.asarray(rotation[1], jnp.int32))
     return _schedule_batch_uniform_jit(
-        nodes, cls, skip, _i64(last_node_index), _i64(n_real),
-        weights_tuple, flags)
+        nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
+        perm, oid_seq, weights_tuple, flags, B_CAP, K_BATCH,
+        rotation is not None)

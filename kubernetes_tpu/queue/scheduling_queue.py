@@ -189,6 +189,21 @@ class PriorityQueue:
                     wait = min(wait, remaining)
                 self._cond.wait(wait)
 
+    def pop_burst(self, limit: int) -> list[tuple[Pod, int]]:
+        """Drain up to `limit` ready pods under one lock acquisition —
+        (pod, scheduling_cycle) pairs, cycle numbering identical to `limit`
+        successive pop() calls. Non-blocking; the burst shell's drain loop."""
+        with self._cond:
+            self._flush_locked()
+            out: list[tuple[Pod, int]] = []
+            while len(out) < limit:
+                q = self._active.pop()
+                if q is None:
+                    break
+                self._scheduling_cycle += 1
+                out.append((q.pod, self._scheduling_cycle))
+            return out
+
     @staticmethod
     def _is_pod_updated(old: Optional[Pod], new: Pod) -> bool:
         """Reference: :412 isPodUpdated — resourceVersion and the whole

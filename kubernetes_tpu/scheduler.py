@@ -121,6 +121,7 @@ class Scheduler:
                 nominated=self.queue.nominated,
                 volume_listers=self.volume_listers,
                 volume_binder=self.volume_binder,
+                node_tree=self.cache.node_tree,
                 # the shell only consumes the suggested host + failure
                 # reasons; skipping the per-node score readback saves a
                 # full-vector transfer every cycle (extenders, which do read
@@ -272,11 +273,15 @@ class Scheduler:
         self._process_one(pod, self.queue.scheduling_cycle)
         return True
 
-    def _process_one(self, pod: Pod, cycle: int) -> None:
-        """Schedule + assume + bind one already-popped pod."""
+    def _process_one(self, pod: Pod, cycle: int,
+                     names: Optional[list[str]] = None) -> None:
+        """Schedule + assume + bind one already-popped pod. `names` reuses an
+        already-consumed NodeTree enumeration (burst bookkeeping) instead of
+        consuming a fresh one."""
         start = self.clock.now()
         self._snapshot = self.cache.update_snapshot(self._snapshot)
-        names = self.cache.node_tree.list_names()
+        if names is None:
+            names = self.cache.node_tree.list_names()
         self._last_names = names
         try:
             result = self._schedule(pod, names)
@@ -505,10 +510,7 @@ class Scheduler:
         serial loop. Returns pods bound."""
         pods = []
         cycles = []
-        while len(pods) < max_pods:
-            pod = self.queue.pop(timeout=0.0)
-            if pod is None:
-                break
+        for pod, cycle in self.queue.pop_burst(max_pods):
             if pod.deleted:
                 # same audit record as the serial path (scheduler.go:447)
                 self.recorder.pod_event(
@@ -516,7 +518,7 @@ class Scheduler:
                     f"skip schedule deleting pod: {pod.key}")
                 continue
             pods.append(pod)
-            cycles.append(self.queue.scheduling_cycle)
+            cycles.append(cycle)
         if not pods:
             return 0
         before = self.metrics.schedule_attempts["scheduled"]
@@ -553,11 +555,23 @@ class Scheduler:
         self._last_names = names
         hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
                                               names, bucket=bucket)
+        if hosts is None:
+            # the algorithm refused the whole burst (it can't reproduce the
+            # serial walk for this cluster/workload) — run pods one by one;
+            # pod 0 rides the enumeration list_names() above already consumed
+            # so every pod sees exactly its serial-loop node order
+            for i, (pod, cycle) in enumerate(zip(pods, cycles)):
+                self._process_one(pod, cycle, names=names if i == 0 else None)
+            return
         note = getattr(self.algorithm, "note_burst_assumed", None)
         for pod, host, cycle in zip(pods, hosts, cycles):
             if host is None:
-                # re-run serially for the failure reasons + preemption path
-                self._process_one(pod, cycle)
+                # re-run serially for the failure reasons + preemption path.
+                # Reuse the segment's enumeration: an unschedulable verdict
+                # is order-independent (F == 0 in the kernel's cycle), and a
+                # fresh list_names() here would drift the tree's zone index
+                # past what `len(pods)` serial cycles consume
+                self._process_one(pod, cycle, names=names)
                 continue
             assumed = pod.clone()
             assumed.node_name = host
@@ -569,6 +583,10 @@ class Scheduler:
                 if gen is not None:
                     note(assumed, host, gen)
             self._bind(assumed, host, pod, cycle)  # observes "scheduled"
+        # serial semantics consume one NodeTree enumeration per pod; the
+        # kernel modeled cycles 0..len(pods)-1 but only pod 0's enumeration
+        # was actually consumed — fast-forward the rest
+        self.cache.node_tree.advance_enumerations(len(pods) - 1)
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""

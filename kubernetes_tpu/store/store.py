@@ -9,9 +9,12 @@ and resumable watch streams with a bounded event log (reference:
 storage/cacher/cacher.go:217 watch cache; etcd3/watcher.go:99).
 
 Objects are the pruned dataclasses from `kubernetes_tpu.api.types`. The
-store snapshots (deep-copies) objects on write and on read so no caller can
-mutate shared state — the stand-in for the reference's serialize/deserialize
-boundary.
+store snapshots objects ON WRITE (so a caller mutating its argument after
+create/update cannot corrupt stored state) and ON READ via get/list — the
+stand-in for the reference's serialize/deserialize boundary. Watch events
+and create/update RETURN VALUES alias that write snapshot: they are
+read-only by convention — consumers that mutate (cache, queue, scheduler)
+clone() first, exactly as API clients deserialize their own copy.
 """
 from __future__ import annotations
 
@@ -159,8 +162,13 @@ class Store:
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
-            self._emit(Event(ADDED, kind, _clone(stored), self._rv))
-            return _clone(stored)
+            # one snapshot serves the bucket, the event log, and the return
+            # value: the store NEVER mutates a stored object in place (every
+            # write replaces the bucket entry), and consumers receive store
+            # objects read-only — anything that mutates must clone() first,
+            # which every caller (cache, queue, scheduler) already does
+            self._emit(Event(ADDED, kind, stored, self._rv))
+            return stored
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
         with self._lock:
@@ -176,8 +184,8 @@ class Store:
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
-            self._emit(Event(MODIFIED, kind, _clone(stored), self._rv))
-            return _clone(stored)
+            self._emit(Event(MODIFIED, kind, stored, self._rv))  # see create()
+            return stored
 
     def guaranteed_update(self, kind: str, key: str,
                           mutate: Callable[[Any], Any],

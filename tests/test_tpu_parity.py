@@ -364,3 +364,104 @@ class TestKernelRTCR:
         from kubernetes_tpu.oracle import priorities as prios
         rtcr = prios.make_rtcr_map()
         assert rtcr(pod, infos["n0"]) == 5
+
+
+class TestZoneRotationParity:
+    """The NodeTree's zone-interleaved enumeration ROTATES between cycles
+    when zone sizes are uneven (node_tree.py rotation_map): selectHost tie
+    ranks land on different nodes each cycle. Burst decisions must replay
+    that per-cycle rotation (kernels.py rotate branch), including the
+    saturation tail where pods become unschedulable mid-burst."""
+
+    @pytest.mark.parametrize("n_nodes,n_pods,cap", [
+        (7, 70, 4000),      # uneven zones (3,2,2) + unschedulable tail
+        (13, 40, 2000),     # uneven zones, all placed
+        (3, 40, 16000),     # tiny cluster, deep stacking
+    ])
+    def test_burst_matches_oracle_under_rotation(self, n_nodes, n_pods, cap):
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        GI = 1024 ** 3
+        MI = 1024 ** 2
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={"failure-domain.beta.kubernetes.io/zone": f"z{i % 3}",
+                            LABEL_HOSTNAME: f"n{i}"},
+                    allocatable={"cpu": cap, "memory": 8 * GI, "pods": 110}))
+            return s
+
+        def make_pods(s):
+            for j in range(n_pods):
+                s.create(PODS, Pod(name=f"p{j}", labels={"app": "x"},
+                                   containers=(Container.make(
+                                       name="c",
+                                       requests={"cpu": 450,
+                                                 "memory": 700 * MI}),)))
+
+        s1, s2 = build(), build()
+        tpu = Scheduler(s1, use_tpu=True, percentage_of_nodes_to_score=100)
+        ora = Scheduler(s2, use_tpu=False, percentage_of_nodes_to_score=100)
+        tpu.sync()
+        ora.sync()
+        make_pods(s1)
+        make_pods(s2)
+        tpu.pump()
+        ora.pump()
+        while tpu.schedule_burst(max_pods=64):
+            pass
+        while ora.schedule_one(timeout=0.0):
+            pass
+        tpu.pump()
+        ora.pump()
+        b1 = {p.key: p.node_name for p in s1.list(PODS)[0]}
+        b2 = {p.key: p.node_name for p in s2.list(PODS)[0]}
+        assert b1 == b2
+        assert tpu.algorithm.last_node_index == ora.algorithm.last_node_index
+
+    def test_refusal_path_matches_oracle_under_rotation(self):
+        """Non-uniform pods on an uneven-zone cluster make schedule_burst
+        refuse the whole burst; the serial fallback must consume exactly one
+        NodeTree enumeration per pod (pod 0 reuses the segment's)."""
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        GI = 1024 ** 3
+        MI = 1024 ** 2
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(7):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={"failure-domain.beta.kubernetes.io/zone": f"z{i % 3}",
+                            LABEL_HOSTNAME: f"n{i}"},
+                    allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+            return s
+
+        def make_pods(s):
+            for j in range(12):
+                s.create(PODS, Pod(name=f"p{j}", containers=(Container.make(
+                    name="c", requests={"cpu": 450 if j % 2 == 0 else 300,
+                                        "memory": 700 * MI}),)))
+
+        s1, s2 = build(), build()
+        tpu = Scheduler(s1, use_tpu=True, percentage_of_nodes_to_score=100)
+        ora = Scheduler(s2, use_tpu=False, percentage_of_nodes_to_score=100)
+        tpu.sync()
+        ora.sync()
+        make_pods(s1)
+        make_pods(s2)
+        tpu.pump()
+        ora.pump()
+        while tpu.schedule_burst(max_pods=64):
+            pass
+        while ora.schedule_one(timeout=0.0):
+            pass
+        tpu.pump()
+        ora.pump()
+        b1 = {p.key: p.node_name for p in s1.list(PODS)[0]}
+        b2 = {p.key: p.node_name for p in s2.list(PODS)[0]}
+        assert b1 == b2
