@@ -64,7 +64,8 @@ class TPUScheduler:
                  collect_host_priority: bool = True,
                  nominated=None,
                  volume_listers=None, volume_binder=None,
-                 node_tree=None):
+                 node_tree=None,
+                 serial_path: str = "device"):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.services_fn = services_fn
@@ -88,6 +89,13 @@ class TPUScheduler:
         self._oracle_cfgs = None
         self.last_index = 0
         self.last_node_index = 0
+        # single-pod path policy: "device" (kernel always — the parity-test
+        # configuration), "host" (twin always), "adaptive" (measure both,
+        # use the faster; the production shell's choice)
+        self.serial_path = serial_path
+        self._lat_ora: Optional[float] = None
+        self._lat_dev: Optional[float] = None
+        self._serial_cycles = 0
         self.encoder = NodeStateEncoder()
         # device-resident node matrix: full upload on rebuild, dirty-row
         # scatter otherwise (SURVEY §2.4 delta uploader)
@@ -294,27 +302,77 @@ class TPUScheduler:
         return self._oracle
 
     # -- single-pod cycle ----------------------------------------------------
+    # Adaptive path selection: a synchronous single-pod decision on the
+    # device costs a full dispatch+readback round trip (~100ms over a
+    # tunneled chip, microseconds locally), while the host twin costs
+    # O(nodes) Python. Neither dominates universally, so schedule() measures
+    # both and keeps using the faster — decisions are identical either way
+    # (the twin is the parity referee). The device is probed only once the
+    # twin's cycle exceeds _DEVICE_PROBE_MS, so small clusters never pay a
+    # speculative round trip; the slower path is re-probed periodically so a
+    # changed cluster size or link can flip the choice back.
+    _DEVICE_PROBE_MS = 30.0
+    _REPROBE_EVERY = 1024
+
+    def _schedule_host_twin(self, pod: Pod, node_infos: dict[str, NodeInfo],
+                            all_node_names: list[str]) -> ScheduleResult:
+        o = self._oracle_fallback()
+        o.last_index, o.last_node_index = self.last_index, self.last_node_index
+        from kubernetes_tpu.factory import (
+            build_predicate_set, DEFAULT_PREDICATE_NAMES)
+        funcs = build_predicate_set(
+            sorted(self.enabled_predicates) if self.enabled_predicates
+            else DEFAULT_PREDICATE_NAMES,
+            node_infos, volume_listers=self.volume_listers,
+            volume_binder=self.volume_binder)
+        try:
+            return o.schedule(pod, node_infos, all_node_names,
+                              predicate_funcs=funcs,
+                              priority_configs=self._oracle_cfgs)
+        finally:
+            self.last_index = o.last_index
+            self.last_node_index = o.last_node_index
+
+    def _serial_pick_host_twin(self) -> bool:
+        ora, dev = self._lat_ora, self._lat_dev
+        if ora is None:
+            return True                      # first cycle: host twin
+        if ora < self._DEVICE_PROBE_MS / 1e3:
+            return True                      # twin fast enough; don't probe
+        if dev is None:
+            return False                     # twin is slow: probe the device
+        if self._serial_cycles % self._REPROBE_EVERY == 0:
+            return ora >= dev                # re-probe the losing path
+        return ora < dev
+
     def schedule(self, pod: Pod, node_infos: dict[str, NodeInfo],
                  all_node_names: list[str]) -> ScheduleResult:
         if not all_node_names:
             raise FitError(pod, 0, {})
+        self._serial_cycles += 1
         if self.nominated is not None and self.nominated.has_any():
-            o = self._oracle_fallback()
-            o.last_index, o.last_node_index = self.last_index, self.last_node_index
-            from kubernetes_tpu.factory import (
-                build_predicate_set, DEFAULT_PREDICATE_NAMES)
-            funcs = build_predicate_set(
-                sorted(self.enabled_predicates) if self.enabled_predicates
-                else DEFAULT_PREDICATE_NAMES,
-                node_infos, volume_listers=self.volume_listers,
-                volume_binder=self.volume_binder)
-            try:
-                return o.schedule(pod, node_infos, all_node_names,
-                                  predicate_funcs=funcs,
-                                  priority_configs=self._oracle_cfgs)
-            finally:
-                self.last_index = o.last_index
-                self.last_node_index = o.last_node_index
+            use_twin = True     # two-pass ghost-pod fitting lives on the twin
+        elif self.serial_path == "adaptive":
+            use_twin = self._serial_pick_host_twin()
+        else:
+            use_twin = self.serial_path == "host"
+        import time as _time
+        t0 = _time.perf_counter()
+        try:
+            if use_twin:
+                return self._schedule_host_twin(pod, node_infos, all_node_names)
+            return self._schedule_device(pod, node_infos, all_node_names)
+        finally:
+            dt = _time.perf_counter() - t0
+            if use_twin:
+                self._lat_ora = dt if self._lat_ora is None \
+                    else 0.7 * self._lat_ora + 0.3 * dt
+            else:
+                self._lat_dev = dt if self._lat_dev is None \
+                    else 0.7 * self._lat_dev + 0.3 * dt
+
+    def _schedule_device(self, pod: Pod, node_infos: dict[str, NodeInfo],
+                         all_node_names: list[str]) -> ScheduleResult:
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),

@@ -15,7 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
-from kubernetes_tpu.api.types import LABEL_HOSTNAME
+from kubernetes_tpu.api.types import LABEL_HOSTNAME, LABEL_ZONE_FAILURE_DOMAIN
 from kubernetes_tpu.models.hollow import (
     NodeStrategy, PodStrategy, make_pods, populate_store,
 )
@@ -54,9 +54,15 @@ class PerfResult:
 def _pod_strategy(cfg: PerfConfig, count: int, prefix: str) -> PodStrategy:
     st = PodStrategy(count=count, name_prefix=prefix)
     if cfg.workload == "anti-affinity":
+        # makeBasePodWithPodAntiAffinity: hostname topology
+        # (scheduler_bench_test.go:151)
         st.anti_affinity_topology = LABEL_HOSTNAME
     elif cfg.workload == "affinity":
-        st.affinity_topology = LABEL_HOSTNAME
+        # makeBasePodWithPodAffinity: ZONE topology with every node labeled
+        # zone1 (scheduler_bench_test.go:175, NewLabelNodePrepareStrategy
+        # :100) — co-location is per zone, so the workload never saturates a
+        # single node the way a hostname topology would
+        st.affinity_topology = LABEL_ZONE_FAILURE_DOMAIN
     elif cfg.workload == "node-affinity":
         st.node_affinity_key = "perf-group"
         st.node_affinity_values = ("a", "b")
@@ -72,6 +78,10 @@ def setup(cfg: PerfConfig) -> tuple[Store, Scheduler]:
     node_st = NodeStrategy(count=cfg.nodes, zones=cfg.zones)
     if cfg.workload == "node-affinity":
         node_st.label_fracs = {"perf-group": ("a", 0.5)}
+    elif cfg.workload == "affinity" and not cfg.zones:
+        # reference: NewLabelNodePrepareStrategy(LabelZoneFailureDomain,
+        # "zone1") — one zone spanning the whole cluster
+        node_st.zones = 1
     existing = ([_pod_strategy(cfg, cfg.existing_pods, "existing")]
                 if cfg.existing_pods else [])
     populate_store(store, [node_st], existing)

@@ -122,6 +122,10 @@ class Scheduler:
                 volume_listers=self.volume_listers,
                 volume_binder=self.volume_binder,
                 node_tree=self.cache.node_tree,
+                # single-pod cycles pick host-twin vs device by measured
+                # latency (a tunneled chip's dispatch RTT dwarfs small-N
+                # host scoring; decisions are identical either way)
+                serial_path="adaptive",
                 # the shell only consumes the suggested host + failure
                 # reasons; skipping the per-node score readback saves a
                 # full-vector transfer every cycle (extenders, which do read
