@@ -444,38 +444,124 @@ class TPUScheduler:
                        "taint_counts", "spread_counts", "interpod_counts",
                        "interpod_tracked", "image_sums", "prefer_avoid")
 
-    def _uniform_class(self, pods: list[Pod], feats: list) -> Optional[dict]:
-        """When every pod is feature-inert and value-identical in requests
-        and fold deltas, return the shared class scalars; else None."""
+    # per-node mask fields that CANNOT change from in-burst placements —
+    # they depend on node labels/taints/spec and pre-burst pods only
+    _STATIC_MASKS = ("sel_ok", "taints_ok", "unsched_ok", "host_ok",
+                     "ports_ok")
+    # score/filter families the uniform kernel does not model at all
+    _INERT_REQUIRED = ("disk_ok", "maxvol_ok", "volbind_ok", "volzone_ok",
+                       "node_aff_counts", "taint_counts", "spread_counts",
+                       "image_sums", "prefer_avoid")
+
+    @staticmethod
+    def _class_signature(pod: Pod):
+        """Spec fields that determine a pod's device features against a fixed
+        snapshot — equal signatures imply identical encoder output."""
+        return (pod.namespace, tuple(sorted(pod.labels.items())),
+                tuple(sorted(pod.node_selector.items())), pod.affinity,
+                pod.tolerations, pod.node_name, pod.containers,
+                pod.init_containers)
+
+    def _uniform_class(self, p0: Pod, f0, b: NodeBatch,
+                       node_infos: dict[str, NodeInfo]) -> Optional[tuple]:
+        """Eligibility + class extraction for a burst of pods spec-identical
+        to `p0` (the caller verified signatures): when the feature
+        interactions are expressible as (static per-node mask, optional
+        self-node ban), return (cls_scalars, extra_ok, ban); else None.
+
+        Mirrors the eligibility contract in kernels.py: static families
+        merge into extra_ok; in-burst interactions must reduce to each
+        placement banning its own node (host ports / self-matching hostname
+        anti-affinity); score families must be provably uniform across
+        valid nodes so they cancel out of the tie structure."""
         from kubernetes_tpu.cache.node_info import calculate_resource
-        key0 = None
-        cls = None
-        for p, f in zip(pods, feats):
-            if f.unknown_scalars:
+        from kubernetes_tpu.api.types import (
+            get_container_ports, LABEL_HOSTNAME)
+        if f0.unknown_scalars:
+            return None
+        upd = calculate_resource(p0)
+        upd_scalar = np.zeros_like(f0.req_scalar)
+        for name, q in upd.scalar.items():
+            upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
+        cls = {"req_cpu": f0.req_cpu, "req_mem": f0.req_mem,
+               "req_eph": f0.req_eph, "req_scalar": f0.req_scalar,
+               "nz_cpu": f0.nz_cpu, "nz_mem": f0.nz_mem,
+               "upd_cpu": upd.milli_cpu, "upd_mem": upd.memory,
+               "upd_eph": upd.ephemeral_storage,
+               "upd_scalar": upd_scalar,
+               "has_request": f0.has_request}
+        for field in self._INERT_REQUIRED:
+            if getattr(f0, field) is not None:
                 return None
-            for field in self._FEATURE_FIELDS:
-                if getattr(f, field) is not None:
-                    return None
-            upd = calculate_resource(p)
-            key = (f.req_cpu, f.req_mem, f.req_eph, f.req_scalar.tobytes(),
-                   f.nz_cpu, f.nz_mem, f.has_request, upd.milli_cpu,
-                   upd.memory, upd.ephemeral_storage,
-                   tuple(sorted(upd.scalar.items())))
-            if key0 is None:
-                key0 = key
-                upd_scalar = np.zeros_like(f.req_scalar)
-                for name, q in upd.scalar.items():
-                    upd_scalar[list(self.encoder._scalar_vocab).index(name)] = q
-                cls = {"req_cpu": f.req_cpu, "req_mem": f.req_mem,
-                       "req_eph": f.req_eph, "req_scalar": f.req_scalar,
-                       "nz_cpu": f.nz_cpu, "nz_mem": f.nz_mem,
-                       "upd_cpu": upd.milli_cpu, "upd_mem": upd.memory,
-                       "upd_eph": upd.ephemeral_storage,
-                       "upd_scalar": upd_scalar,
-                       "has_request": f.has_request}
-            elif key != key0:
+        nreal = b.n_real
+        # interpod scores must be a constant shift: every valid node tracked
+        # and equal counts -> min-max normalizes to 0 everywhere, and stays
+        # 0 as in-burst placements (no preferred terms, symmetric hard
+        # affinity over a single topology group) add uniformly
+        if f0.interpod_counts is not None or f0.interpod_tracked is not None:
+            tr, ic = f0.interpod_tracked, f0.interpod_counts
+            if tr is None or not bool(np.all(tr[:nreal])):
                 return None
-        return cls
+            if ic is None or (nreal and int(np.ptp(ic[:nreal])) != 0):
+                return None
+        extra: Optional[np.ndarray] = None
+
+        def and_mask(m) -> None:
+            nonlocal extra
+            if m is not None:
+                mm = np.asarray(m, dtype=bool)
+                if mm.shape[0] != b.n_pad:      # inert [1] fields
+                    return
+                extra = mm.copy() if extra is None else (extra & mm)
+
+        for field in self._STATIC_MASKS:
+            and_mask(getattr(f0, field))
+        if f0.interpod_code is not None:
+            and_mask(f0.interpod_code == 0)
+        ban = bool(get_container_ports(p0))   # identical host ports conflict
+        a = p0.affinity
+        if a is not None and (a.pod_affinity is not None
+                              or a.pod_anti_affinity is not None):
+            pa, paa = a.pod_affinity, a.pod_anti_affinity
+            if (pa and pa.preferred) or (paa and paa.preferred):
+                return None
+
+            def self_match(term) -> bool:
+                if term.namespaces and p0.namespace not in term.namespaces:
+                    return False
+                return term.label_selector is not None \
+                    and term.label_selector.matches(p0.labels)
+
+            ban_anti = False
+            for term in (paa.required if paa else ()):
+                if self_match(term):
+                    # in-burst placements ban their topology group; the
+                    # node-ban fold is exact only for singleton groups
+                    if term.topology_key != LABEL_HOSTNAME:
+                        return None
+                    ban_anti = True
+            for term in (pa.required if pa else ()):
+                if self_match(term):
+                    # placements add matches in their group; feasibility
+                    # stays at the static base only when every valid node
+                    # is in ONE group (then it is all-pass after bootstrap)
+                    vals = set()
+                    for i in range(nreal):
+                        node = node_infos[b.names[i]].node
+                        vals.add(None if node is None
+                                 else node.labels.get(term.topology_key))
+                    if len(vals) != 1 or None in vals:
+                        return None
+            if ban_anti:
+                hosts = set()
+                for i in range(nreal):
+                    node = node_infos[b.names[i]].node
+                    h = None if node is None else node.labels.get(LABEL_HOSTNAME)
+                    if h is None or h in hosts:
+                        return None       # hostname groups must be singleton
+                    hosts.add(h)
+                ban = True
+        return cls, extra, ban
 
     def _burst_rotation(self, b: NodeBatch, n_pods: int):
         """Per-cycle enumeration orders for a burst: pod 0 rides the device
@@ -550,17 +636,25 @@ class TPUScheduler:
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
                          volume_binder=self.volume_binder)
-        feats = [enc.encode(p) for p in pods]
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
-        cls = None
+        uniform = None
+        feats: Optional[list] = None
         if num_to_find >= n and self.last_index == 0:
-            cls = self._uniform_class(pods, feats)
-        if cls is not None:
+            # spec-identical pods produce identical encoder output against a
+            # fixed snapshot, so the uniform path encodes ONE pod — per-pod
+            # feature encoding (IPA topology counting in particular) is the
+            # dominant host cost for affinity bursts
+            sig0 = self._class_signature(pods[0])
+            if all(self._class_signature(p) == sig0 for p in pods[1:]):
+                uniform = self._uniform_class(pods[0], enc.encode(pods[0]),
+                                              b, node_infos)
+        if uniform is not None:
             # K-pods-per-pass kernel: dynamic pod count (one compile for any
             # burst size), carried int32 scores, consecutive-tie-rank batch
             # resolution with exact prefix validation (kernels.py K_BATCH)
+            cls, extra_ok, ban = uniform
             rotation = self._burst_rotation(b, len(pods))
             sel: list[int] = []
             for lo in range(0, len(pods), K.B_CAP):
@@ -574,18 +668,29 @@ class TPUScheduler:
                     rot = (rotation[0], win)
                 rows, packed = K.schedule_batch_uniform(
                     nodes, dict(cls), chunk, self.last_node_index, n,
-                    self.check_resources, weights=self.weights, rotation=rot)
+                    self.check_resources, weights=self.weights, rotation=rot,
+                    extra_ok=extra_ok, ban=ban)
                 self._dev_nodes = {**self._dev_nodes, **rows}
                 nodes = self._dev_nodes
                 h = np.asarray(packed)   # ONE fetch: selections + lni delta
                 self.last_node_index += int(h[K.B_CAP])
                 sel.extend(h[:chunk].tolist())
             return [b.names[s] if s >= 0 else None for s in sel]
+        from kubernetes_tpu.api.types import (
+            has_pod_affinity_terms, get_container_ports)
+        if any(has_pod_affinity_terms(p) or get_container_ports(p)
+               for p in pods):
+            # the generic scan encodes per-node masks ONCE per burst; pods
+            # whose masks depend on in-burst placements (affinity/ports)
+            # are only safe on the uniform path above — refuse, the shell
+            # runs them serially
+            return None
         if self._burst_rotation(b, len(pods)) is not None:
             # the generic scan folds against ONE node order; under an
             # unstable per-cycle rotation its tie-breaks would diverge from
             # the serial walk — refuse, the shell runs these pods serially
             return None
+        feats = [enc.encode(p) for p in pods]
         per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
                    for p, f in zip(pods, feats)]
         # pad the burst to a power-of-two bucket so lax.scan compiles once

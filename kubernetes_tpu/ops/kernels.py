@@ -457,25 +457,40 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
 # a node at the scheduler_perf shape), so in the common regime the tie set is
 # stable across hundreds of consecutive decisions.
 #
-# This kernel therefore schedules K pods per O(N) pass: one feasibility +
-# tie-cumsum sweep, then K consecutive tie ranks resolved with a vectorized
-# searchsorted, K fold deltas scattered to (provably distinct) rows, and an
-# EXACT validity check — each selected node's post-fold score must still
-# equal the max and the row must stay feasible, i.e. the tie set is unchanged
-# for every later pod in the batch. The longest valid prefix is accepted
-# (always >= 1: pod 0's decision depends only on the batch-start state), the
-# rest retry in the next iteration, so the worst case degrades to one pod per
-# pass — the old behavior — and decisions stay bit-identical to the serial
-# scan in all cases. Failure *reasons* are not computed — the shell re-runs
-# unschedulable pods through the serial path, which reports them.
+# This kernel therefore schedules K pods per O(N) pass in one of two batch
+# modes, chosen each pass by probing lane 0's post-fold state:
+#
+# - STAY: while every fold leaves its node AT max score and feasible, the
+#   tie set is constant and consecutive pods take consecutive tie ranks
+#   (lni+j mod T). Validated per lane; cut at the first leaver.
+# - ELIM: while every fold REMOVES its node from the tie set (score drops
+#   below max, or the placement bans the node — host-port conflicts and
+#   self-matching hostname anti-affinity), the serial walk's shrinking
+#   modulo `(lni+i) mod (T-i)` resolves to ORIGINAL tie ranks lni+2i for as
+#   long as lni+i < T-i (quotient-0 prefix) and found_i = F-i stays > 1.
+#   Validated per lane; cut at the first stayer.
+#
+# Ranks resolve with a vectorized searchsorted, K fold deltas scatter to
+# (provably distinct) rows, and the longest valid prefix is accepted (always
+# >= 1: pod 0's decision depends only on the pass-start state); the rest
+# retry next pass, so the worst case degrades to one pod per pass and
+# decisions stay bit-identical to the serial scan in all cases. Failure
+# *reasons* are not computed — the shell re-runs unschedulable pods through
+# the serial path, which reports them.
 #
 # The pod count is a DYNAMIC operand of a single lax.while_loop: one compile
 # serves every burst size (no bucket padding, no trailing-segment waste).
 #
-# Eligibility (checked by the caller): num_to_find >= n_real, last_index == 0,
-# every per-pod feature inert, and all pods value-identical in requests and
-# fold deltas. Row-local scores shift all nodes equally when constant
-# families (inert taint/spread/prefer-avoid) are dropped, so argmax and the
+# Eligibility (checked by the caller, tpu_scheduler._uniform_class): pods
+# value-identical in requests, fold deltas, labels, and affinity/port specs;
+# num_to_find >= n_real and last_index == 0. Per-node masks that cannot
+# change in-burst (node selector/affinity, taints, unschedulable, hostname,
+# existing-pod affinity state) merge into the static `extra_ok`; in-burst
+# interactions reduce to the banned-node fold (`ban`: each placement bans
+# its own node for the rest of the burst — exact for identical pods with
+# host ports or self-matching hostname anti-affinity). Row-local scores
+# shift all nodes equally when constant families (inert taint/spread/
+# prefer-avoid, constant interpod counts) are dropped, so argmax and the
 # round-robin tie walk match the generic kernel.
 
 K_BATCH = 512        # pods resolved per O(N) pass (static)
@@ -483,16 +498,20 @@ B_CAP = 16384        # output-buffer capacity (static); callers chunk above it
 
 
 @partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap", "k_batch",
-                                   "rotate"))
+                                   "rotate", "ban", "has_extra"))
 def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
-                                perm, oid_seq, weights_tuple, flags, b_cap,
-                                k_batch, rotate):
+                                perm, oid_seq, extra_ok, weights_tuple, flags,
+                                b_cap, k_batch, rotate, ban, has_extra):
     weights = dict(weights_tuple)
     check_res, has_req, carry_eph, static_eph, carried_s, static_s = flags
     i32 = jnp.int32
     n_pad = nodes["valid"].shape[0]
     in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
     ok = nodes["valid"] & in_range
+    if has_extra:
+        # static per-node masks: node selector/affinity, taints,
+        # unschedulable, hostname, existing-pod (anti-)affinity state
+        ok &= extra_ok
     if check_res and has_req:
         # resource families whose node-side state cannot change in-burst
         # (fold delta zero) collapse to a static mask
@@ -555,54 +574,96 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
                     fit &= a_s >= cls["req_scalar"][s] + rowvals[isc0 + jj]
         return fit
 
+    def lane_fit(rowvals, idx):
+        """Post-fold score + feasibility of selected rows — shared by the
+        lane-0 probe and the batch validation."""
+        nt = _local_total(
+            weights, cls["nz_cpu"] + rowvals[2], cls["nz_mem"] + rowvals[3],
+            alloc_cpu[idx], alloc_mem[idx]).astype(i32)
+        return nt, resource_fit(rowvals, idx)
+
     def body(carry):
-        st, tot, lni, done, out = carry
+        st, tot, banned, lni, done, out = carry
         feas = resource_fit(st, None)
+        if ban:
+            feas &= ~banned
         tm = jnp.where(feas, tot, I32_MIN)
         mx = jnp.max(tm)
         tie = feas & (tm == mx)
         T = jnp.sum(tie, dtype=i32)
         F = jnp.sum(feas, dtype=i32)
+        T64 = T.astype(jnp.int64)
         remaining = B - done
-        # batch size this pass: the multi-pod fast path needs >= 2 ties (a
-        # single-tie fold can change num_ties, shifting the modulo walk) and
-        # F > 1 (so lastNodeIndex advances exactly 1 per pod); F == 0 means
-        # every remaining pod is equally unschedulable -> emit-all -1
+        # the multi-pod paths need >= 2 ties (a single-tie fold can change
+        # num_ties, shifting the modulo walk) and F > 1 (so lastNodeIndex
+        # advances exactly 1 per pod); F == 0 means every remaining pod is
+        # equally unschedulable -> emit-all -1
         kbig = (T >= 2) & (F > 1)
+        if rotate:
+            oid = jax.lax.dynamic_slice(oid_seq, (done,), (k_batch,))
+            tie_perm = tie[perm]                     # [L, N1]
+            C_all = jnp.cumsum(tie_perm.astype(i32), axis=1)
+        else:
+            C = jnp.cumsum(tie.astype(i32))
+
+        # -- lane-0 probe: pick STAY vs ELIM batching (identical position
+        # formula at lane 0, so the probe is mode-neutral)
+        if ban:
+            elim = kbig        # a placement always bans its own node
+        else:
+            pos0 = (lni % jnp.maximum(T64, 1)).astype(i32)
+            if rotate:
+                c0 = C_all[oid[0]]
+                p0 = jnp.sum(c0 < pos0 + 1, dtype=i32)
+                sel0 = perm[oid[0], jnp.minimum(p0, n_pad)]
+            else:
+                sel0 = jnp.searchsorted(C, pos0 + 1,
+                                        method="compare_all").astype(i32)
+            nt0, fit0 = lane_fit(st[:, sel0] + delta_vec, sel0)
+            elim = ((nt0 != mx) | ~fit0) & kbig
+
+        m_stay = jnp.minimum(jnp.minimum(remaining, k_batch), T)
+        # ELIM quotient-0 prefix: lni + i < T - i, i.e. m <= (T - lni + 1)/2;
+        # bans shrink F, so m <= F - 1 keeps found_i > 1 for every lane
+        max_elim = jnp.maximum(((T64 - lni + 1) // 2).astype(i32), 1)
+        m_elim = jnp.minimum(jnp.minimum(remaining, k_batch),
+                             jnp.minimum(max_elim, jnp.maximum(F - 1, 1)))
+        if rotate:
+            # the original-rank formula assumes ONE tie order; per-cycle
+            # rotated orders fall back to exact single steps
+            m_elim = jnp.minimum(m_elim, 1)
         m = jnp.where(F == 0, jnp.minimum(remaining, k_batch),
-                      jnp.where(kbig,
-                                jnp.minimum(jnp.minimum(remaining, k_batch), T),
-                                1))
+                      jnp.where(elim, m_elim,
+                                jnp.where(kbig, m_stay, 1)))
         active = (jlane < m) & (F > 0)
-        pos = ((lni + jlane.astype(jnp.int64))
-               % jnp.maximum(T, 1).astype(jnp.int64)).astype(i32)
+        j64 = jlane.astype(jnp.int64)
+        pos_stay = ((lni + j64) % jnp.maximum(T64, 1)).astype(i32)
+        pos_elim = jnp.minimum(lni + 2 * j64,
+                               jnp.maximum(T64 - 1, 0)).astype(i32)
+        pos = jnp.where(elim & (m > 1), pos_elim, pos_stay)
         if not rotate:
             # stable per-cycle order == the device axis: tie rank -> node via
-            # one cumsum (consecutive ranks mod T are distinct while m <= T,
-            # so active lanes never collide)
-            C = jnp.cumsum(tie.astype(i32))
+            # one cumsum (positions are distinct for the chosen mode's valid
+            # prefix, so active lanes never collide)
             selq = jnp.searchsorted(C, pos + 1, method="compare_all").astype(i32)
             sel = jnp.where(active, selq, n_pad)
         else:
             # per-cycle rotated orders: lane j ranks ties in the order of ITS
             # cycle (done + j), one of the <= L distinct zone-interleaved
             # enumerations in `perm` (NodeTree.order_for_start)
-            oid = jax.lax.dynamic_slice(oid_seq, (done,), (k_batch,))
-            tie_perm = tie[perm]                     # [L, N1]
-            C_all = jnp.cumsum(tie_perm.astype(i32), axis=1)
             crows = C_all[oid]                       # [K, N1]
             posp = jnp.sum(crows < (pos + 1)[:, None], axis=1, dtype=i32)
             selq = perm[oid, jnp.minimum(posp, n_pad)]
             sel = jnp.where(active, selq, n_pad)
-        rows_sel = st[:, sel]
-        rows_after = rows_sel + delta_vec[:, None]
-        new_tot = _local_total(
-            weights, cls["nz_cpu"] + rows_after[2], cls["nz_mem"] + rows_after[3],
-            alloc_cpu[sel], alloc_mem[sel]).astype(i32)
-        # serial equivalence: pod j > 0 sees the batch-start tie set only if
-        # every earlier fold left its node AT max score and feasible
-        stays = (new_tot == mx) & resource_fit(rows_after, sel)
-        fail = (~stays) & active
+        rows_after = st[:, sel] + delta_vec[:, None]
+        new_tot, fit_after = lane_fit(rows_after, sel)
+        # serial equivalence per lane: STAY needs every earlier fold to leave
+        # its node AT max score and feasible (tie set unchanged); ELIM needs
+        # every earlier fold to REMOVE its node (rank formula). Either way
+        # the first offender's own decision is still exact -> cut after it.
+        leaves = jnp.ones_like(fit_after) if ban \
+            else ((new_tot != mx) | ~fit_after)
+        fail = jnp.where(elim, ~leaves, leaves) & active
         first_bad = jnp.where(jnp.any(fail), jnp.argmax(fail).astype(i32),
                               jnp.int32(k_batch))
         v = jnp.where(F == 0, m, jnp.minimum(first_bad + 1, m))
@@ -627,15 +688,18 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
         # duplicate .set would clobber the accepted score write
         selw = jnp.where(accept, sel, n_pad)
         tot = tot.at[selw].set(new_tot)
+        if ban:
+            banned = banned.at[selw].max(accept)
         emit = jnp.where((jlane < v) & (F > 0), sel, -1)
         out = jax.lax.dynamic_update_slice(out, emit, (done,))
         lni = lni + jnp.where(F > 1, v, 0).astype(jnp.int64)
-        return st, tot, lni, done + v, out
+        return st, tot, banned, lni, done + v, out
 
     out0 = jnp.full(b_cap + k_batch, -1, i32)
     lni0 = jnp.asarray(last_node_index, jnp.int64)
-    st, tot, lni, done, out = jax.lax.while_loop(
-        lambda c: c[3] < B, body, (st0, tot0, lni0, jnp.int32(0), out0))
+    banned0 = jnp.zeros(n_pad + 1, dtype=bool)
+    st, tot, _banned, lni, done, out = jax.lax.while_loop(
+        lambda c: c[4] < B, body, (st0, tot0, banned0, lni0, jnp.int32(0), out0))
     # pack the lastNodeIndex advance into the selection buffer so the caller
     # fetches ONE array — each separate device->host read pays a full
     # dispatch round trip (~100ms over a tunneled device)
@@ -656,7 +720,8 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
 
 
 def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
-                           check_resources, weights=None, rotation=None):
+                           check_resources, weights=None, rotation=None,
+                           extra_ok=None, ban=False):
     """Uniform-class burst (see block comment above). `cls` holds the shared
     per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
     upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
@@ -669,7 +734,12 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     equals the device axis; otherwise (perm[L, n_pad+1] int32 — the <= L
     distinct per-cycle orders as axis indices, scratch-padded — and
     oid_seq[B_CAP + K_BATCH] int32 — cycle t's order id, t counted from this
-    burst's first pod)."""
+    burst's first pod).
+
+    `extra_ok` [n_pad] bool merges burst-static per-node masks into
+    feasibility; `ban=True` makes every placement ban its own node for the
+    rest of the burst (identical pods with host ports / self-matching
+    hostname anti-affinity)."""
     if n_pods > B_CAP:
         raise ValueError(f"uniform burst of {n_pods} exceeds B_CAP={B_CAP}")
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
@@ -689,7 +759,10 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     else:
         perm, oid_seq = (jnp.asarray(rotation[0], jnp.int32),
                          jnp.asarray(rotation[1], jnp.int32))
+    has_extra = extra_ok is not None
+    extra = jnp.asarray(extra_ok, bool) if has_extra \
+        else jnp.zeros(1, dtype=bool)
     return _schedule_batch_uniform_jit(
         nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
-        perm, oid_seq, weights_tuple, flags, B_CAP, K_BATCH,
-        rotation is not None)
+        perm, oid_seq, extra, weights_tuple, flags, B_CAP, K_BATCH,
+        rotation is not None, bool(ban), has_extra)

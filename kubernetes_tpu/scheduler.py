@@ -487,18 +487,12 @@ class Scheduler:
 
     # -- burst mode (TPU throughput path) -------------------------------------
     def _pod_is_burstable(self, pod: Pod, services=None, replicasets=None) -> bool:
-        """A pod may ride a device burst only when its per-node masks can't
-        be changed by in-burst placements: the scan folds resource deltas
-        into device state, but affinity terms, host ports, and
-        selector-spread counts are encoded host-side once per burst.
-        `services`/`replicasets` are passed in so a burst lists them once,
-        not once per pod."""
-        from kubernetes_tpu.api.types import (
-            has_pod_affinity_terms, get_container_ports)
-        if has_pod_affinity_terms(pod):
-            return False
-        if get_container_ports(pod):
-            return False
+        """A pod may ride a device burst unless its per-node state depends on
+        in-burst placements in ways no burst kernel models yet: volume
+        binding and selector-spread counts. Affinity/port pods are admitted
+        — the uniform kernel folds their interactions (self-node bans) and
+        refuses anything it can't replay exactly. `services`/`replicasets`
+        are passed in so a burst lists them once, not once per pod."""
         if pod.volumes:
             return False
         from kubernetes_tpu.oracle.priorities import get_selectors
@@ -507,6 +501,19 @@ class Scheduler:
                          self._replicasets_fn() if replicasets is None else replicasets):
             return False
         return True
+
+    @staticmethod
+    def _burst_class(pod: Pod):
+        """Segmentation key: pods with in-burst-dynamic features (affinity /
+        host ports) burst only with spec-identical peers (the uniform
+        kernel's contract); plain pods share one generic segment even when
+        heterogeneous."""
+        from kubernetes_tpu.api.types import (
+            has_pod_affinity_terms, get_container_ports)
+        if has_pod_affinity_terms(pod) or get_container_ports(pod):
+            from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+            return TPUScheduler._class_signature(pod)
+        return "plain"
 
     def schedule_burst(self, max_pods: int = 1024) -> int:
         """Drain up to max_pods from the queue and schedule them with device
@@ -544,9 +551,11 @@ class Scheduler:
                 self._process_one(pods[i], cycles[i])
                 i += 1
                 continue
+            seg_class = self._burst_class(pods[i])
             j = i
             while j < len(pods) and not self.queue.nominated.has_any() \
-                    and self._pod_is_burstable(pods[j], services, replicasets):
+                    and self._pod_is_burstable(pods[j], services, replicasets) \
+                    and self._burst_class(pods[j]) == seg_class:
                 j += 1
             self._burst_segment(pods[i:j], cycles[i:j], max_pods)
             i = j

@@ -465,3 +465,79 @@ class TestZoneRotationParity:
         b1 = {p.key: p.node_name for p in s1.list(PODS)[0]}
         b2 = {p.key: p.node_name for p in s2.list(PODS)[0]}
         assert b1 == b2
+
+
+class TestBanElimBurstParity:
+    """The uniform kernel's banned-node fold + ELIM batching (self-matching
+    hostname anti-affinity, host-port conflicts) must match the oracle
+    exactly, including saturation where pods outnumber viable nodes."""
+
+    def _run_pair(self, n_nodes, strategy_kwargs, n_pods, zones=3):
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.models.hollow import PodStrategy, make_pods
+        GI = 1024 ** 3
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                labels = {LABEL_HOSTNAME: f"n{i}"}
+                if zones:
+                    labels["failure-domain.beta.kubernetes.io/zone"] = \
+                        f"z{i % zones}"
+                s.create(NODES, Node(
+                    name=f"n{i}", labels=labels,
+                    allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+            return s
+
+        st = PodStrategy(count=n_pods, **strategy_kwargs)
+        bindings = []
+        for use_tpu in (True, False):
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            for pod in make_pods(st, 0):
+                s.create(PODS, pod)
+            sched.pump()
+            if use_tpu:
+                while sched.schedule_burst(max_pods=256):
+                    pass
+            else:
+                while sched.schedule_one(timeout=0.0):
+                    pass
+            sched.pump()
+            bindings.append({p.key: p.node_name for p in s.list(PODS)[0]})
+        assert bindings[0] == bindings[1]
+        return bindings[0]
+
+    def test_anti_affinity_saturation(self):
+        # 11 nodes, 30 pods: 11 place (one per host), 19 go unschedulable
+        got = self._run_pair(11, dict(
+            anti_affinity_topology=LABEL_HOSTNAME,
+            labels={"name": "test", "color": "green"}), 30)
+        placed = [v for v in got.values() if v]
+        assert len(placed) == 11
+        assert len(set(placed)) == 11
+
+    def test_host_ports_saturation(self):
+        got = self._run_pair(9, dict(host_port=8080), 20)
+        placed = [v for v in got.values() if v]
+        assert len(placed) == 9
+        assert len(set(placed)) == 9
+
+    def test_zone_affinity_colocation(self):
+        # single zone spanning the cluster (reference PodAffinity shape)
+        got = self._run_pair(10, dict(
+            affinity_topology="failure-domain.beta.kubernetes.io/zone",
+            labels={"foo": ""}), 25, zones=1)
+        placed = [v for v in got.values() if v]
+        assert len(placed) == 25
+
+    def test_anti_affinity_uneven_zone_rotation(self):
+        # uneven zones force per-cycle rotation + ELIM single-step fallback
+        got = self._run_pair(7, dict(
+            anti_affinity_topology=LABEL_HOSTNAME,
+            labels={"name": "test", "color": "green"}), 12)
+        placed = [v for v in got.values() if v]
+        assert len(placed) == 7
