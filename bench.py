@@ -42,7 +42,18 @@ def make_pods(store, n_pods: int, start: int = 0):
                 name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
 
 
-def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int) -> dict:
+def measure_oracle(n_nodes: int, n_pods: int) -> float:
+    """Measured pods/s of the pure-Python oracle at the same node count.
+    The oracle's per-pod cost is O(nodes) and flat in pod count (each cycle
+    filters+scores the whole cluster), so a small pod sample measures the
+    same per-cycle cost the full run would — `oracle_pods_sampled` records
+    the sample size."""
+    r = run_bench(n_nodes, n_pods, "oracle", 0, compare=False)
+    return r["value"]
+
+
+def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
+              compare: bool = True) -> dict:
     from kubernetes_tpu.store.store import Store
     from kubernetes_tpu.scheduler import Scheduler
 
@@ -80,12 +91,23 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int) -> dict:
     sched.pump()  # confirm bindings
 
     throughput = bound / elapsed if elapsed > 0 else 0.0
-    return {
+    result = {
         "metric": f"sched_throughput_{n_nodes}n_{n_pods}p_{mode}",
         "value": round(throughput, 1),
         "unit": "pods/s",
         "vs_baseline": round(throughput / 100.0, 2),
     }
+    if compare and mode != "oracle":
+        # measured same-node-count oracle ratio next to the fixed 100 pods/s
+        # "healthy default scheduler" mark (the oracle's per-pod cost is flat
+        # in pod count; sample a small burst of pods at full cluster size)
+        sample = min(n_pods, 100)
+        oracle = measure_oracle(n_nodes, sample)
+        result["oracle_measured"] = oracle
+        result["oracle_pods_sampled"] = sample
+        result["vs_measured_oracle"] = (round(throughput / oracle, 2)
+                                        if oracle > 0 else None)
+    return result
 
 
 def main():
@@ -93,9 +115,10 @@ def main():
     ap.add_argument("--nodes", type=int, default=15000)
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--mode", choices=["burst", "serial", "oracle"], default="burst")
-    # big buckets amortize the fixed per-launch cost (dispatch + tunnel RTT);
-    # all bursts pad to this bucket so the scan compiles exactly once
-    ap.add_argument("--burst", type=int, default=4096)
+    # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
+    # the uniform kernel's pod count is dynamic, so no padding waste at any
+    # size — the cap is kernels.B_CAP per launch
+    ap.add_argument("--burst", type=int, default=10000)
     args = ap.parse_args()
     result = run_bench(args.nodes, args.pods, args.mode, args.burst)
     print(json.dumps(result))
