@@ -17,6 +17,10 @@ MAX_WEIGHT = (1 << 31) // MAX_PRIORITY  # api/validation: weight*MaxPriority mus
 @dataclass
 class PredicatePolicy:
     name: str
+    # custom-predicate arguments (api/types.go:90 PredicateArgument):
+    # {"serviceAffinity": {"labels": [...]}} or
+    # {"labelsPresence": {"labels": [...], "presence": bool}}
+    argument: Optional[dict] = None
 
 
 @dataclass
@@ -60,7 +64,8 @@ class Policy:
 
         p = Policy()
         for pd in d.get("predicates", []):
-            p.predicates.append(PredicatePolicy(name=pd["name"]))
+            p.predicates.append(PredicatePolicy(
+                name=pd["name"], argument=pd.get("argument")))
         for pr in d.get("priorities", []):
             p.priorities.append(PriorityPolicy(
                 name=pr["name"], weight=pr.get("weight", 1)))

@@ -108,6 +108,12 @@ class NodeInfo:
         self.allocatable = ResourceAgg()
         self.taints: tuple = ()
         self.image_states: dict[str, ImageStateSummary] = {}
+        # per-cycle transient volume counts, written by the Max*VolumeCount
+        # predicates under the BalanceAttachedNodeVolumes gate and read by
+        # balanced-allocation's variance scorer (reference: node_info.go
+        # TransientInfo; predicates.go:517-521)
+        self.transient_allocatable_volumes: Optional[int] = None
+        self.transient_requested_volumes: Optional[int] = None
         self.generation = next_generation()
         if node is not None:
             self.set_node(node)

@@ -324,7 +324,8 @@ class TPUScheduler:
             sorted(self.enabled_predicates) if self.enabled_predicates
             else DEFAULT_PREDICATE_NAMES,
             node_infos, volume_listers=self.volume_listers,
-            volume_binder=self.volume_binder)
+            volume_binder=self.volume_binder,
+            services_fn=self.services_fn)
         try:
             return o.schedule(pod, node_infos, all_node_names,
                               predicate_funcs=funcs,

@@ -32,14 +32,37 @@ _EXTRA_PREDICATES: dict[str, Callable] = {}
 
 
 def register_fit_predicate(name: str, factory: Callable) -> None:
-    """plugins.go:106 RegisterFitPredicate — `factory(node_infos) -> fn`."""
+    """plugins.go:106 RegisterFitPredicate —
+    `factory(node_infos, services_fn) -> fn`."""
     _EXTRA_PREDICATES[name] = factory
+
+
+def register_custom_fit_predicate(policy_pred) -> bool:
+    """plugins.go:204 RegisterCustomFitPredicate — map a Policy predicate
+    with an argument onto its checker. Returns True when registered."""
+    arg = policy_pred.argument or {}
+    if "labelsPresence" in arg:
+        spec = arg["labelsPresence"]
+        register_fit_predicate(
+            policy_pred.name,
+            lambda ni, sf, _s=spec: preds.make_node_label_presence(
+                _s.get("labels", []), bool(_s.get("presence", True))))
+        return True
+    if "serviceAffinity" in arg:
+        spec = arg["serviceAffinity"]
+        register_fit_predicate(
+            policy_pred.name,
+            lambda ni, sf, _s=spec: preds.make_service_affinity(
+                _s.get("labels", []), ni, sf))
+        return True
+    return False
 
 
 def build_predicate_set(names: list[str],
                         node_infos,
                         volume_listers=None,
-                        volume_binder=None) -> dict[str, Callable]:
+                        volume_binder=None,
+                        services_fn: Callable = lambda: []) -> dict[str, Callable]:
     """CreateFromKeys predicate assembly: the named subset, evaluated in
     predicates.PREDICATE_ORDERING."""
     base = preds.default_predicate_set(node_infos,
@@ -52,7 +75,7 @@ def build_predicate_set(names: list[str],
         if name in base:
             out[name] = base[name]
         elif name in _EXTRA_PREDICATES:
-            out[name] = _EXTRA_PREDICATES[name](node_infos)
+            out[name] = _EXTRA_PREDICATES[name](node_infos, services_fn)
         elif name in ("PodFitsResources", "PodFitsHostPorts", "MatchNodeSelector",
                       "HostName"):
             out[name] = {
@@ -123,6 +146,8 @@ def build_priority_configs(name_weights: dict[str, int],
             "BalancedResourceAllocation", w, map_fn=prios.balanced_allocation_map),
         "NodePreferAvoidPodsPriority": lambda w: PriorityConfig(
             "NodePreferAvoidPodsPriority", w, map_fn=prios.node_prefer_avoid_pods_map),
+        "ResourceLimitsPriority": lambda w: PriorityConfig(
+            "ResourceLimitsPriority", w, map_fn=prios.resource_limits_map),
         "NodeAffinityPriority": lambda w: PriorityConfig(
             "NodeAffinityPriority", w, map_fn=prios.node_affinity_map,
             reduce_fn=lambda s: prios.normalize_reduce(prios.MAX_PRIORITY, False, s)),
@@ -254,7 +279,12 @@ def create_scheduler(store, cfg: Optional[SchedulerConfiguration] = None,
     from kubernetes_tpu.core.extender import SchedulerExtender
     cfg = cfg or SchedulerConfiguration()
     validate(cfg)
+    from kubernetes_tpu.utils import features
+    features.set_gates(cfg.feature_gates)
     pred_names, prio_weights, policy = resolve_algorithm(cfg)
+    for pd in policy.predicates:
+        if pd.argument:
+            register_custom_fit_predicate(pd)
     hard_weight = (policy.hard_pod_affinity_symmetric_weight
                    if policy.hard_pod_affinity_symmetric_weight is not None
                    else cfg.hard_pod_affinity_symmetric_weight)

@@ -34,6 +34,8 @@ ERR_POD_AFFINITY_NOT_MATCH = "PodAffinityNotMatch"
 ERR_POD_AFFINITY_RULES_NOT_MATCH = "PodAffinityRulesNotMatch"
 ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH = "PodAntiAffinityRulesNotMatch"
 ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH = "ExistingPodsAntiAffinityRulesNotMatch"
+ERR_NODE_LABEL_PRESENCE_VIOLATED = "NodeLabelPresenceViolated"
+ERR_SERVICE_AFFINITY_VIOLATED = "CheckServiceAffinity"
 
 
 def insufficient_resource(resource: str) -> str:
@@ -370,6 +372,77 @@ class InterPodAffinityChecker:
 
 
 # ---------------------------------------------------------------------------
+# Policy-configured predicates (factory.go:204 RegisterCustomFitPredicate)
+# ---------------------------------------------------------------------------
+def make_node_label_presence(labels: list[str], presence: bool) -> Callable:
+    """Reference: predicates.go:943 CheckNodeLabelPresence — all the listed
+    labels must exist on the node (presence=True) or none may
+    (presence=False), regardless of value."""
+    labels = list(labels)
+
+    def check_node_label_presence(pod: Pod, node_info: NodeInfo
+                                  ) -> tuple[bool, list[str]]:
+        node = node_info.node
+        if node is None:
+            return False, []
+        for label in labels:
+            exists = label in node.labels
+            if (exists and not presence) or (not exists and presence):
+                return False, [ERR_NODE_LABEL_PRESENCE_VIOLATED]
+        return True, []
+
+    return check_node_label_presence
+
+
+def make_service_affinity(labels: list[str],
+                          node_infos: dict[str, NodeInfo],
+                          services_fn: Callable) -> Callable:
+    """Reference: predicates.go:1030 checkServiceAffinity — pods of the same
+    service co-locate on nodes agreeing on the listed label values. Missing
+    constraints are reverse-engineered: if the pod's nodeSelector doesn't pin
+    a listed label and some already-scheduled pod of the same service exists,
+    that pod's NODE supplies the missing values (metadata producer
+    predicates.go:970: services selecting the pod + same-namespace pods
+    matching the pod's own labels)."""
+    labels = list(labels)
+
+    def check_service_affinity(pod: Pod, node_info: NodeInfo
+                               ) -> tuple[bool, list[str]]:
+        node = node_info.node
+        if node is None:
+            return False, []
+        # metadata: services selecting this pod; same-namespace pods whose
+        # labels are a superset of this pod's labels
+        services = [s for s in services_fn()
+                    if s.namespace == pod.namespace and s.selector
+                    and all(pod.labels.get(k) == v
+                            for k, v in s.selector.items())]
+        matching = [p for ni in node_infos.values() for p in ni.pods
+                    if p.namespace == pod.namespace
+                    and all(p.labels.get(k) == v
+                            for k, v in pod.labels.items())]
+        # FilterOutPods (node_info.go:656): keep pods not on this node (and
+        # this-node pods present in the NodeInfo, which ours always are)
+        this = node.name
+        filtered = [p for p in matching
+                    if p.node_name != this or any(q is p for q in node_info.pods)]
+        affinity_labels = {l: pod.node_selector[l] for l in labels
+                           if l in pod.node_selector}
+        if len(labels) > len(affinity_labels) and services and filtered:
+            first_ni = node_infos.get(filtered[0].node_name)
+            if first_ni is not None and first_ni.node is not None:
+                src = first_ni.node.labels
+                for l in labels:
+                    if l not in affinity_labels and l in src:
+                        affinity_labels[l] = src[l]
+        if all(node.labels.get(k) == v for k, v in affinity_labels.items()):
+            return True, []
+        return False, [ERR_SERVICE_AFFINITY_VIOLATED]
+
+    return check_service_affinity
+
+
+# ---------------------------------------------------------------------------
 # Driver: run predicates in reference order with short-circuit
 # ---------------------------------------------------------------------------
 def default_predicate_set(node_infos: dict[str, NodeInfo],
@@ -403,7 +476,8 @@ def default_predicate_set(node_infos: dict[str, NodeInfo],
         preds.update(make_volume_predicates(volume_listers, volume_binder))
     else:
         for name in ("NoDiskConflict", "MaxEBSVolumeCount", "MaxGCEPDVolumeCount",
-                     "MaxAzureDiskVolumeCount", "MaxCSIVolumeCountPred",
+                     "MaxAzureDiskVolumeCount", "MaxCinderVolumeCount",
+                     "MaxCSIVolumeCountPred",
                      "CheckVolumeBinding", "NoVolumeZoneConflict"):
             preds[name] = always_fit
     if taint_nodes_by_condition:

@@ -62,14 +62,45 @@ def most_requested_map(pod: Pod, ni: NodeInfo) -> int:
 
 def balanced_allocation_map(pod: Pod, ni: NodeInfo) -> int:
     """Reference: balanced_resource_allocation.go:41 — float64 fractions,
-    int64 truncation of (1-|cpuF-memF|)*10."""
+    int64 truncation of (1-|cpuF-memF|)*10. Under the
+    BalanceAttachedNodeVolumes gate with per-cycle transient volume counts
+    (written by the Max*VolumeCount predicates), the three-fraction variance
+    form applies instead (balanced_resource_allocation.go:44-58)."""
     cpu, mem = _pod_plus_node_nonzero(pod, ni)
     cpu_frac = _fraction(cpu, ni.allocatable.milli_cpu)
     mem_frac = _fraction(mem, ni.allocatable.memory)
+    from kubernetes_tpu.utils import features
+    if features.enabled("BalanceAttachedNodeVolumes") \
+            and ni.transient_allocatable_volumes is not None \
+            and ni.transient_allocatable_volumes > 0:
+        vol_frac = (ni.transient_requested_volumes
+                    / ni.transient_allocatable_volumes)
+        if cpu_frac >= 1 or mem_frac >= 1 or vol_frac >= 1:
+            return 0
+        mean = (cpu_frac + mem_frac + vol_frac) / 3.0
+        variance = ((cpu_frac - mean) ** 2 + (mem_frac - mean) ** 2
+                    + (vol_frac - mean) ** 2) / 3.0
+        return int((1 - variance) * float(MAX_PRIORITY))
     if cpu_frac >= 1 or mem_frac >= 1:
         return 0
     diff = abs(cpu_frac - mem_frac)
     return int((1 - diff) * float(MAX_PRIORITY))
+
+
+def resource_limits_map(pod: Pod, ni: NodeInfo) -> int:
+    """Reference: resource_limits.go:36 ResourceLimitsPriorityMap — score 1
+    when the node's allocatable satisfies the pod's cpu OR memory limit
+    (tie-break nudge toward nodes that can honor limits), else 0."""
+    from kubernetes_tpu.api.types import get_resource_limits
+    limits = get_resource_limits(pod)
+    alloc = ni.allocatable
+
+    def compute(limit: int, allocatable: int) -> int:
+        return 1 if limit != 0 and allocatable != 0 and limit <= allocatable \
+            else 0
+
+    return 1 if (compute(limits.milli_cpu, alloc.milli_cpu) == 1
+                 or compute(limits.memory, alloc.memory) == 1) else 0
 
 
 def _fraction(req: int, cap: int) -> float:

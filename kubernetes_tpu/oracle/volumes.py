@@ -129,7 +129,16 @@ class MaxVolumeCountChecker:
         existing: set = set()
         for ep in node_info.pods:
             self._filter(ep, existing)
-        if len(existing | new) > self._limit(node_info.node):
+        limit = self._limit(node_info.node)
+        num_existing = len(existing)
+        num_new = len(new - existing)
+        from kubernetes_tpu.utils import features
+        if features.enabled("BalanceAttachedNodeVolumes"):
+            # transient per-cycle counts the balanced-allocation volume
+            # variance reads (reference: predicates.go:517-521)
+            node_info.transient_allocatable_volumes = limit - num_existing
+            node_info.transient_requested_volumes = num_new
+        if num_existing + num_new > limit:
             return False, [ERR_MAX_VOLUME_COUNT]
         return True, []
 
@@ -286,6 +295,7 @@ def make_volume_predicates(listers: VolumeListers,
         "MaxEBSVolumeCount": MaxVolumeCountChecker(PLUGIN_EBS, listers).check,
         "MaxGCEPDVolumeCount": MaxVolumeCountChecker(PLUGIN_GCE_PD, listers).check,
         "MaxAzureDiskVolumeCount": MaxVolumeCountChecker(PLUGIN_AZURE_DISK, listers).check,
+        "MaxCinderVolumeCount": MaxVolumeCountChecker(PLUGIN_CINDER, listers).check,
         "MaxCSIVolumeCountPred": MaxVolumeCountChecker(PLUGIN_CSI, listers).check,
         "NoVolumeZoneConflict": make_volume_zone_predicate(listers),
         "CheckVolumeBinding": binder.make_predicate(),

@@ -351,7 +351,8 @@ class Scheduler:
                 self._predicate_names or DEFAULT_PREDICATE_NAMES,
                 self._snapshot.node_infos,
                 volume_listers=self.volume_listers,
-                volume_binder=self.volume_binder)
+                volume_binder=self.volume_binder,
+                services_fn=self._services_fn)
             return self.algorithm.schedule(
                 pod, self._snapshot.node_infos, names,
                 predicate_funcs=funcs,
@@ -449,7 +450,8 @@ class Scheduler:
         predicate_set_fn = lambda infos: build_predicate_set(
             self._predicate_names or DEFAULT_PREDICATE_NAMES, infos,
             volume_listers=self.volume_listers,
-            volume_binder=self.volume_binder)
+            volume_binder=self.volume_binder,
+            services_fn=self._services_fn)
         result = preemptor.preempt(
             updated, self._snapshot.node_infos,
             getattr(self, "_last_names", list(self._snapshot.node_infos)),

@@ -524,3 +524,213 @@ class TestSchedule:
         result = g.schedule(mkpod("p", cpu=100), infos, ["n0", "n1"])
         assert result.suggested_host == "n0"
         assert result.feasible_nodes == 1
+
+
+class TestCheckNodeLabelPresence:
+    """Reference: predicates.go:943 — label existence regardless of value."""
+
+    def test_presence_true_requires_all(self):
+        check = preds.make_node_label_presence(["region", "zone"], True)
+        ok, _ = check(mkpod("p"), NodeInfo(mknode(
+            "n0", labels={"region": "r1", "zone": "z1"})))
+        assert ok
+        ok, reasons = check(mkpod("p"), NodeInfo(mknode(
+            "n1", labels={"region": "r1"})))
+        assert not ok
+        assert reasons == [preds.ERR_NODE_LABEL_PRESENCE_VIOLATED]
+
+    def test_presence_false_rejects_any(self):
+        check = preds.make_node_label_presence(["retiring"], False)
+        ok, _ = check(mkpod("p"), NodeInfo(mknode("n0", labels={})))
+        assert ok
+        ok, reasons = check(mkpod("p"), NodeInfo(mknode(
+            "n1", labels={"retiring": "2026-01-01"})))
+        assert not ok
+        assert reasons == [preds.ERR_NODE_LABEL_PRESENCE_VIOLATED]
+
+
+class TestServiceAffinity:
+    """Reference: predicates.go:1030 — reverse-engineered selector from
+    already-scheduled service peers."""
+
+    def _setup(self):
+        from kubernetes_tpu.api.types import Service
+        n0 = mknode("n0", labels={"region": "r1"})
+        n1 = mknode("n1", labels={"region": "r2"})
+        peer = mkpod("peer", labels={"app": "db"})
+        infos = snapshot([n0, n1], {"n0": [peer]})
+        services = [Service(name="db", selector={"app": "db"})]
+        return infos, services
+
+    def test_backfills_from_scheduled_peer(self):
+        infos, services = self._setup()
+        check = preds.make_service_affinity(["region"], infos,
+                                            lambda: services)
+        pod = mkpod("p", labels={"app": "db"})
+        ok, _ = check(pod, infos["n0"])      # same region as the peer
+        assert ok
+        ok, reasons = check(pod, infos["n1"])
+        assert not ok
+        assert reasons == [preds.ERR_SERVICE_AFFINITY_VIOLATED]
+
+    def test_node_selector_pins_constraint(self):
+        infos, services = self._setup()
+        check = preds.make_service_affinity(["region"], infos,
+                                            lambda: services)
+        pod = mkpod("p", labels={"app": "db"},
+                    node_selector={"region": "r2"})
+        ok, _ = check(pod, infos["n1"])      # explicit selector wins
+        assert ok
+
+    def test_no_peers_no_constraint(self):
+        n0 = mknode("n0", labels={"region": "r1"})
+        infos = snapshot([n0])
+        check = preds.make_service_affinity(["region"], infos, lambda: [])
+        ok, _ = check(mkpod("p", labels={"app": "db"}), infos["n0"])
+        assert ok
+
+
+class TestMaxCinderVolumeCount:
+    def test_limit_enforced(self):
+        from kubernetes_tpu.api.types import VolumeSource, PLUGIN_CINDER
+        from kubernetes_tpu.oracle.volumes import (
+            MaxVolumeCountChecker, VolumeListers)
+        checker = MaxVolumeCountChecker(
+            PLUGIN_CINDER, VolumeListers(lambda: [], lambda: []),
+            max_volumes=2)
+        existing = mkpod("e", volumes=(
+            VolumeSource(name="v1", plugin=PLUGIN_CINDER, volume_id="a"),
+            VolumeSource(name="v2", plugin=PLUGIN_CINDER, volume_id="b")))
+        ni = NodeInfo(mknode("n0"))
+        existing.node_name = "n0"
+        ni.add_pod(existing)
+        pod = mkpod("p", volumes=(
+            VolumeSource(name="v3", plugin=PLUGIN_CINDER, volume_id="c"),))
+        ok, reasons = checker.check(pod, ni)
+        assert not ok and reasons == ["MaxVolumeCount"]
+        # re-using an attached volume stays within the limit
+        pod2 = mkpod("p2", volumes=(
+            VolumeSource(name="v3", plugin=PLUGIN_CINDER, volume_id="a"),))
+        ok, _ = checker.check(pod2, ni)
+        assert ok
+
+    def test_registered_in_default_family(self):
+        from kubernetes_tpu.oracle.volumes import (
+            make_volume_predicates, VolumeListers)
+        fam = make_volume_predicates(VolumeListers(lambda: [], lambda: []))
+        assert "MaxCinderVolumeCount" in fam
+
+
+class TestResourceLimitsPriority:
+    """Reference: resource_limits.go — 1 when cpu OR memory limit fits."""
+
+    def _pod_with_limits(self, cpu=0, mem=0):
+        return Pod(name="p", containers=(Container.make(
+            name="c", limits={k: v for k, v in
+                              (("cpu", cpu), ("memory", mem)) if v}),))
+
+    def test_scores(self):
+        ni = NodeInfo(mknode("n0", cpu=2000, mem=4 * 1024**3))
+        assert prios.resource_limits_map(
+            self._pod_with_limits(cpu=1000), ni) == 1
+        assert prios.resource_limits_map(
+            self._pod_with_limits(cpu=3000), ni) == 0
+        # memory fits even though cpu does not -> still 1
+        assert prios.resource_limits_map(
+            self._pod_with_limits(cpu=3000, mem=1024**3), ni) == 1
+        # no limits specified -> 0
+        assert prios.resource_limits_map(self._pod_with_limits(), ni) == 0
+
+    def test_wired_into_registry(self):
+        from kubernetes_tpu.factory import build_priority_configs
+        cfgs = build_priority_configs({"ResourceLimitsPriority": 2})
+        assert cfgs[0].name == "ResourceLimitsPriority"
+        assert cfgs[0].weight == 2
+
+
+class TestBalancedAllocationVolumeVariance:
+    """Reference: balanced_resource_allocation.go:44-58, gated by
+    BalanceAttachedNodeVolumes."""
+
+    def test_variance_formula(self):
+        from kubernetes_tpu.utils import features
+        ni = NodeInfo(mknode("n0", cpu=4000, mem=4 * 1024**3))
+        ni.transient_allocatable_volumes = 10
+        ni.transient_requested_volumes = 5
+        pod = mkpod("p", cpu=1000, mem=1024**3)
+        # gate off: two-fraction diff formula
+        features.reset()
+        base = prios.balanced_allocation_map(pod, ni)
+        cpu_f = mem_f = 0.25
+        assert base == int((1 - abs(cpu_f - mem_f)) * 10)
+        # gate on: three-fraction variance
+        features.set_gates({"BalanceAttachedNodeVolumes": True})
+        try:
+            vol_f = 0.5
+            mean = (cpu_f + mem_f + vol_f) / 3
+            var = ((cpu_f - mean) ** 2 + (mem_f - mean) ** 2
+                   + (vol_f - mean) ** 2) / 3
+            assert prios.balanced_allocation_map(pod, ni) == int((1 - var) * 10)
+        finally:
+            features.reset()
+
+    def test_volume_predicate_writes_transient(self):
+        from kubernetes_tpu.utils import features
+        from kubernetes_tpu.api.types import VolumeSource, PLUGIN_EBS
+        from kubernetes_tpu.oracle.volumes import (
+            MaxVolumeCountChecker, VolumeListers)
+        checker = MaxVolumeCountChecker(
+            PLUGIN_EBS, VolumeListers(lambda: [], lambda: []), max_volumes=39)
+        ni = NodeInfo(mknode("n0"))
+        pod = mkpod("p", volumes=(
+            VolumeSource(name="v", plugin=PLUGIN_EBS, volume_id="x"),))
+        features.set_gates({"BalanceAttachedNodeVolumes": True})
+        try:
+            ok, _ = checker.check(pod, ni)
+            assert ok
+            assert ni.transient_allocatable_volumes == 39
+            assert ni.transient_requested_volumes == 1
+        finally:
+            features.reset()
+
+
+class TestPolicyCustomPredicates:
+    """RegisterCustomFitPredicate via Policy arguments (plugins.go:204)."""
+
+    def test_policy_argument_round_trip(self):
+        from kubernetes_tpu.apis.policy import Policy
+        p = Policy.from_dict({"predicates": [
+            {"name": "RegionAffinity",
+             "argument": {"serviceAffinity": {"labels": ["region"]}}},
+            {"name": "NoRetiring",
+             "argument": {"labelsPresence": {"labels": ["retiring"],
+                                             "presence": False}}},
+        ]})
+        assert p.predicates[0].argument["serviceAffinity"]["labels"] == ["region"]
+
+    def test_custom_predicates_schedulable(self):
+        # the walk iterates the FIXED ordering (generic_scheduler.go:635 over
+        # predicates.Ordering()), so policy predicates run only under the
+        # canonical names the ordering reserves for them
+        from kubernetes_tpu.apis.policy import Policy
+        from kubernetes_tpu.factory import (
+            register_custom_fit_predicate, build_predicate_set)
+        pol = Policy.from_dict({"predicates": [
+            {"name": "CheckNodeLabelPresence",
+             "argument": {"labelsPresence": {"labels": ["retiring"],
+                                             "presence": False}}},
+            {"name": "GeneralPredicates"},
+        ]})
+        for pd in pol.predicates:
+            if pd.argument:
+                assert register_custom_fit_predicate(pd)
+        infos = snapshot([mknode("n0", labels={"retiring": "soon"}),
+                          mknode("n1")])
+        funcs = build_predicate_set(
+            ["CheckNodeLabelPresence", "GeneralPredicates"], infos)
+        g = GenericScheduler(percentage_of_nodes_to_score=100)
+        res = g.schedule(mkpod("p", cpu=100), infos, ["n0", "n1"],
+                         predicate_funcs=funcs)
+        assert res.suggested_host == "n1"
+        assert res.failed_predicates["n0"] == [
+            preds.ERR_NODE_LABEL_PRESENCE_VIOLATED]
