@@ -529,6 +529,7 @@ class ReplicaSet:
     name: str
     namespace: str = "default"
     selector: Optional[LabelSelector] = None
+    replicas: int = 1            # spec.replicas (PDB expected-scale source)
     resource_version: int = 0
 
     @property
@@ -541,7 +542,16 @@ class PodDisruptionBudget:
     name: str
     namespace: str = "default"
     selector: Optional[LabelSelector] = None
+    # spec: exactly one of min_available / max_unavailable; int or "N%"
+    # (policy/v1beta1 PodDisruptionBudgetSpec). Both None = no reconcile
+    # (tests that pin disruptions_allowed literals keep working).
+    min_available: Optional[object] = None
+    max_unavailable: Optional[object] = None
+    # status (reconciled by controllers.disruption from pod state)
     disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
     resource_version: int = 0
 
     @property

@@ -1,0 +1,48 @@
+"""Controller manager — the kube-controller-manager shell.
+
+Mirror of cmd/kube-controller-manager/app/controllermanager.go:372
+(NewControllerInitializers + StartControllers): owns controller instances
+over one store, syncs their informers, and drives reconciliation. The
+reference runs 31 loops; this hosts the ones implemented so far and is the
+registration point for the rest.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from kubernetes_tpu.store.store import Store
+from kubernetes_tpu.controllers.disruption import DisruptionController
+
+# name -> constructor(store) (NewControllerInitializers analog)
+CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
+    "disruption": DisruptionController,
+}
+
+
+class ControllerManager:
+    def __init__(self, store: Store,
+                 enabled: Optional[list[str]] = None):
+        names = list(CONTROLLER_INITIALIZERS) if enabled is None else enabled
+        self.controllers = {
+            name: CONTROLLER_INITIALIZERS[name](store) for name in names}
+        self._stop = threading.Event()
+
+    def sync(self) -> None:
+        for c in self.controllers.values():
+            c.sync()
+
+    def pump(self) -> int:
+        return sum(c.pump() for c in self.controllers.values())
+
+    def run(self, interval: float = 0.05,
+            stop_after: Optional[Callable[[], bool]] = None) -> None:
+        """Reconcile loop; call from a thread."""
+        while not self._stop.is_set():
+            self.pump()
+            if stop_after is not None and stop_after():
+                return
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()

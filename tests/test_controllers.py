@@ -1,0 +1,177 @@
+"""Disruption controller: PDB status reconciliation + PDB-aware preemption
+reading live status (reference: pkg/controller/disruption/disruption.go)."""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, LabelSelector, PodDisruptionBudget, ReplicaSet,
+    PodCondition,
+)
+from kubernetes_tpu.cache.node_info import NodeInfo
+from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.controllers.manager import ControllerManager
+from kubernetes_tpu.store.store import Store, PODS, NODES, PDBS, REPLICASETS
+
+GI = 1024 ** 3
+
+
+def sel(**labels):
+    return LabelSelector(match_labels=tuple(labels.items()))
+
+
+def bound_pod(name, node, labels=None, owner=None, priority=0, cpu=100):
+    return Pod(name=name, node_name=node, labels=labels or {},
+               owner_ref=owner, priority=priority,
+               containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+class TestPDBStatusMath:
+    def _reconcile(self, store):
+        dc = DisruptionController(store)
+        dc.sync()
+        return store
+
+    def test_min_available_int(self):
+        store = Store()
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available=2))
+        for i in range(3):
+            store.create(PODS, bound_pod(f"p{i}", f"n{i}", {"app": "db"}))
+        self._reconcile(store)
+        pdb = store.get(PDBS, "default/b")
+        assert (pdb.expected_pods, pdb.current_healthy,
+                pdb.desired_healthy, pdb.disruptions_allowed) == (3, 3, 2, 1)
+
+    def test_min_available_percent_uses_controller_scale(self):
+        store = Store()
+        store.create(REPLICASETS, ReplicaSet(
+            name="rs", selector=sel(app="db"), replicas=4))
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available="50%"))
+        for i in range(3):   # only 3 of the expected 4 exist
+            store.create(PODS, bound_pod(
+                f"p{i}", f"n{i}", {"app": "db"}, owner=("ReplicaSet", "rs", "u1")))
+        self._reconcile(store)
+        pdb = store.get(PDBS, "default/b")
+        # expected = scale 4; desired = ceil(50% of 4) = 2; healthy = 3
+        assert (pdb.expected_pods, pdb.current_healthy,
+                pdb.desired_healthy, pdb.disruptions_allowed) == (4, 3, 2, 1)
+
+    def test_max_unavailable(self):
+        store = Store()
+        store.create(REPLICASETS, ReplicaSet(
+            name="rs", selector=sel(app="db"), replicas=5))
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), max_unavailable=1))
+        for i in range(5):
+            store.create(PODS, bound_pod(
+                f"p{i}", f"n{i}", {"app": "db"}, owner=("ReplicaSet", "rs", "u1")))
+        self._reconcile(store)
+        pdb = store.get(PDBS, "default/b")
+        assert (pdb.expected_pods, pdb.desired_healthy,
+                pdb.disruptions_allowed) == (5, 4, 1)
+
+    def test_percent_scale_without_controller_fails_closed(self):
+        store = Store()
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available="50%",
+            disruptions_allowed=7))
+        store.create(PODS, bound_pod("p0", "n0", {"app": "db"}))  # no owner
+        self._reconcile(store)
+        assert store.get(PDBS, "default/b").disruptions_allowed == 0
+
+    def test_unready_pod_not_healthy(self):
+        store = Store()
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available=1))
+        p = bound_pod("p0", "n0", {"app": "db"})
+        p.conditions = (PodCondition(type="Ready", status="False"),)
+        store.create(PODS, p)
+        store.create(PODS, bound_pod("p1", "n1", {"app": "db"}))
+        self._reconcile(store)
+        pdb = store.get(PDBS, "default/b")
+        assert (pdb.current_healthy, pdb.disruptions_allowed) == (1, 0)
+
+    def test_specless_pdb_untouched(self):
+        store = Store()
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), disruptions_allowed=3))
+        self._reconcile(store)
+        assert store.get(PDBS, "default/b").disruptions_allowed == 3
+
+    def test_pod_events_retrigger(self):
+        store = Store()
+        dc = DisruptionController(store)
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available=2))
+        for i in range(3):
+            store.create(PODS, bound_pod(f"p{i}", f"n{i}", {"app": "db"}))
+        dc.sync()
+        assert store.get(PDBS, "default/b").disruptions_allowed == 1
+        store.delete(PODS, "default/p2")
+        dc.pump()
+        pdb = store.get(PDBS, "default/b")
+        assert (pdb.current_healthy, pdb.disruptions_allowed) == (2, 0)
+        # no-op pumps settle (status writes don't loop the controller)
+        assert dc.pump() <= 1 and dc.pump() == 0
+
+
+class TestPreemptionFollowsLiveStatus:
+    """VERDICT round-3 #6 done-condition: PDB status changes mid-stream and
+    the preemption victim choice follows."""
+
+    def test_victim_choice_tracks_reconciled_pdb(self):
+        from kubernetes_tpu.oracle.preemption import Preemptor
+        from kubernetes_tpu.oracle.generic_scheduler import FitError
+        from kubernetes_tpu.factory import build_predicate_set
+
+        store = Store()
+        mgr = ControllerManager(store)
+        store.create(PDBS, PodDisruptionBudget(
+            name="db-budget", selector=sel(app="db"), min_available=2))
+        # victims: vA (priority 1, PDB-covered) on nA; vB (priority 2) on nB
+        va = bound_pod("va", "nA", {"app": "db"}, priority=1, cpu=1000)
+        vb = bound_pod("vb", "nB", {"app": "web"}, priority=2, cpu=1000)
+        extra = [bound_pod(f"db{i}", "nC", {"app": "db"}, cpu=10)
+                 for i in range(2)]
+        for p in (va, vb, *extra):
+            store.create(PODS, p)
+        mgr.sync()
+        assert store.get(PDBS, "default/db-budget").disruptions_allowed == 1
+
+        def infos():
+            out = {}
+            for n in ("nA", "nB", "nC"):
+                out[n] = NodeInfo(Node(
+                    name=n, allocatable={"cpu": 1000 if n != "nC" else 4000,
+                                         "memory": 8 * GI, "pods": 110}))
+            for p in store.list(PODS)[0]:
+                if p.node_name in out:
+                    out[p.node_name].add_pod(p)
+            return out
+
+        incoming = Pod(name="hi", priority=10, containers=(
+            Container.make(name="c", requests={"cpu": 1000}),))
+        err = FitError(incoming, 2, {
+            "nA": ["InsufficientResource:cpu"],
+            "nB": ["InsufficientResource:cpu"]})
+
+        def preempt_once():
+            pre = Preemptor(pdbs_fn=lambda: store.list(PDBS)[0])
+            ni = infos()
+            return pre.preempt(
+                incoming, ni, ["nA", "nB"], err,
+                predicate_set_fn=lambda i: build_predicate_set(
+                    ["GeneralPredicates"], i))
+
+        # allowed=1: evicting va violates nothing; va's lower priority wins
+        # the minHighestVictimPriority criterion
+        r1 = preempt_once()
+        assert r1.node is not None and r1.node.name == "nA"
+
+        # a covered pod disappears -> allowed drops to 0 -> va now counts as
+        # a PDB violation and the choice flips to nB
+        store.delete(PODS, "default/db0")
+        mgr.pump()
+        assert store.get(PDBS, "default/db-budget").disruptions_allowed == 0
+        r2 = preempt_once()
+        assert r2.node is not None and r2.node.name == "nB"
