@@ -714,6 +714,116 @@ class TPUScheduler:
         selected = np.asarray(outs["selected"])[: len(pods)].tolist()
         return [b.names[s] if s >= 0 else None for s in selected]
 
+    # -- device preemption ---------------------------------------------------
+    def preempt(self, pod: Pod, node_infos: dict[str, NodeInfo],
+                all_node_names: list[str], fit_error, pdbs: list):
+        """Device victim scan (kernels.preemption_scan): one launch replaces
+        the reference's 16-goroutine fan-out over candidate nodes
+        (generic_scheduler.go:966). Returns a PreemptionResult with
+        decisions identical to the oracle Preemptor, or None when this
+        preemption isn't expressible as resources + static masks (the
+        caller falls back to the oracle).
+
+        Eligible when: no active nominations, the incoming pod is
+        resource-only (no affinity/ports/volumes/extended resources), and
+        no pod in the cluster carries (anti-)affinity terms (so victim
+        removal cannot change any mask, only free resources)."""
+        from kubernetes_tpu.oracle.preemption import (
+            pod_eligible_to_preempt_others, nodes_where_preemption_might_help,
+            pods_violating_pdbs, importance_key, PreemptionResult)
+        from kubernetes_tpu.api.types import (
+            has_pod_affinity_terms, get_container_ports, get_resource_request)
+        from kubernetes_tpu.cache.node_info import calculate_resource
+        if not all_node_names:
+            return None
+        if self.nominated is not None and self.nominated.has_any():
+            return None
+        if has_pod_affinity_terms(pod) or get_container_ports(pod) \
+                or pod.volumes:
+            return None
+        req = get_resource_request(pod)
+        if req.scalar:
+            return None
+        if any(ni.pods_with_affinity for ni in node_infos.values()):
+            return None
+        if not pod_eligible_to_preempt_others(pod, node_infos):
+            return PreemptionResult(None, [], [])
+        candidates = nodes_where_preemption_might_help(
+            node_infos, all_node_names, fit_error.failed_predicates)
+        if not candidates:
+            # preemption can't help anywhere: clear the pod's own stale
+            # nomination (generic_scheduler.go:330-333)
+            return PreemptionResult(None, [], [pod])
+        b = self.encoder.encode(node_infos, all_node_names)
+        nodes = self._node_arrays(b)
+        P = K.PREEMPT_P
+        n_pad = b.n_pad
+        vcpu = np.zeros((n_pad, P), np.int64)
+        vmem = np.zeros((n_pad, P), np.int64)
+        veph = np.zeros((n_pad, P), np.int64)
+        vprio = np.zeros((n_pad, P), np.int64)
+        vstart = np.full((n_pad, P), np.inf, np.float64)
+        vvalid = np.zeros((n_pad, P), bool)
+        vviol = np.zeros((n_pad, P), bool)
+        slots: dict[str, list[Pod]] = {}
+        for name in candidates:
+            ni = node_infos[name]
+            pots = [p for p in ni.pods if p.priority < pod.priority]
+            if len(pots) > P:
+                return None
+            violating = {p.uid for p in pods_violating_pdbs(pots, pdbs)}
+            # the reprieve processing order: PDB-violating first, each group
+            # by descending importance (preemption.py select_victims_on_node)
+            pots.sort(key=lambda p: (0 if p.uid in violating else 1,
+                                     importance_key(p)))
+            i = b.index[name]
+            for j, p in enumerate(pots):
+                r = calculate_resource(p)
+                if r.scalar:
+                    return None
+                vcpu[i, j] = r.milli_cpu
+                vmem[i, j] = r.memory
+                veph[i, j] = r.ephemeral_storage
+                vprio[i, j] = p.priority
+                if p.start_time is not None:
+                    vstart[i, j] = p.start_time
+                vvalid[i, j] = True
+                vviol[i, j] = p.uid in violating
+            slots[name] = pots
+        enc = PodEncoder(node_infos, b, self.services_fn(),
+                         self.replicasets_fn(),
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                         enabled=self.enabled_predicates,
+                         volume_listers=self.volume_listers,
+                         volume_binder=self.volume_binder)
+        f = enc.encode(pod)
+        if f.unknown_scalars:
+            return None
+        feas = np.zeros(n_pad, bool)
+        order_rank = np.full(n_pad, 1 << 30, np.int64)
+        for order, name in enumerate(candidates):
+            i = b.index[name]
+            feas[i] = True
+            order_rank[i] = order
+        for mask in (f.sel_ok, f.taints_ok, f.unsched_ok, f.host_ok):
+            if mask is not None:
+                feas &= np.asarray(mask, bool)
+        vic = {"cpu": vcpu, "mem": vmem, "eph": veph, "prio": vprio,
+               "start": vstart, "valid": vvalid, "violating": vviol}
+        pod_in = {"req_cpu": np.int64(req.milli_cpu),
+                  "req_mem": np.int64(req.memory),
+                  "req_eph": np.int64(req.ephemeral_storage)}
+        out = np.asarray(K.preemption_scan(
+            nodes, vic, pod_in, feas, order_rank, b.n_real,
+            self.check_resources, f.has_request))
+        winner = int(out[0])
+        if winner < 0:
+            return PreemptionResult(None, [], [])
+        name = b.names[winner]
+        flags = out[3:].astype(bool)
+        victims = [p for j, p in enumerate(slots[name]) if flags[j]]
+        return PreemptionResult(node_infos[name].node, victims, [])
+
     def note_burst_assumed(self, pod: Pod, host: str, generation: int) -> None:
         """Post-burst bookkeeping for one placed pod: fold the same delta
         the device scan applied into the host numpy mirror and sync the

@@ -766,3 +766,128 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
         nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
         perm, oid_seq, extra, weights_tuple, flags, B_CAP, K_BATCH,
         rotation is not None, bool(ban), has_extra)
+
+
+# ---------------------------------------------------------------------------
+# Device preemption: vmapped victim selection + node pick
+# ---------------------------------------------------------------------------
+# Mirror of selectNodesForPreemption/selectVictimsOnNode/pickOneNode
+# (generic_scheduler.go:966,1054,837). The reference fans victim selection
+# out over 16 goroutines; here every candidate node runs at once:
+#
+#   1. remove ALL lower-priority pods per node, check the incoming pod fits
+#   2. reprieve loop: victims arrive ALREADY SORTED by the host into the
+#      reference's processing order (PDB-violating first, each group by
+#      descending importance = priority desc, start asc); a lax.scan re-adds
+#      one per step and keeps it iff the pod still fits
+#   3. per-node aggregates feed the staged 5-criteria pick: fewest PDB
+#      violations -> lowest FIRST-victim priority (the reference reads
+#      Pods[0], :876) -> smallest sum of (priority + 2^31) -> fewest victims
+#      -> latest earliest-start among the highest-priority victims -> first
+#      in candidate order.
+#
+# Eligibility (host-checked): the fit that matters is resources + static
+# masks only — no affinity/ports/volumes on the incoming pod or any
+# potential victim, no active nominations. Anything else runs the oracle.
+
+PREEMPT_P = 128    # victim slots per node (>= AllowedPodNumber cap of 110)
+
+
+@partial(jax.jit, static_argnames=("check_res", "has_req"))
+def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
+                         check_res, has_req):
+    i32, i64, f64 = jnp.int32, jnp.int64, jnp.float64
+    n_pad = nodes["alloc_cpu"].shape[0]
+    in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
+    valid_v = vic["valid"]                          # [N, P]
+    nvic_all = jnp.sum(valid_v, axis=1, dtype=i64)
+    base_cpu = nodes["req_cpu"] - jnp.sum(
+        jnp.where(valid_v, vic["cpu"], 0), axis=1)
+    base_mem = nodes["req_mem"] - jnp.sum(
+        jnp.where(valid_v, vic["mem"], 0), axis=1)
+    base_eph = nodes["req_eph"] - jnp.sum(
+        jnp.where(valid_v, vic["eph"], 0), axis=1)
+    base_cnt = nodes["pod_count"] - nvic_all
+
+    def fits(rc, rm, re, pc):
+        f = jnp.ones(n_pad, dtype=bool)
+        if check_res:
+            f &= pc + 1 <= nodes["allowed_pods"]
+            if has_req:
+                f &= (nodes["alloc_cpu"] >= pod["req_cpu"] + rc) \
+                    & (nodes["alloc_mem"] >= pod["req_mem"] + rm) \
+                    & (nodes["alloc_eph"] >= pod["req_eph"] + re)
+        return f
+
+    feas0 = feas_static & in_range & fits(base_cpu, base_mem, base_eph,
+                                          base_cnt)
+
+    def step(carry, xs):
+        rc, rm, re, pc = carry
+        vcpu, vmem, veph, vval = xs
+        nrc, nrm, nre = rc + vcpu, rm + vmem, re + veph
+        npc = pc + jnp.where(vval, 1, 0)
+        keep = fits(nrc, nrm, nre, npc) & vval & feas0
+        return ((jnp.where(keep, nrc, rc), jnp.where(keep, nrm, rm),
+                 jnp.where(keep, nre, re), jnp.where(keep, npc, pc)),
+                vval & ~keep)
+
+    xs = (vic["cpu"].T, vic["mem"].T, vic["eph"].T, valid_v.T)   # [P, N]
+    _carry, victim_t = jax.lax.scan(
+        step, (base_cpu, base_mem, base_eph, base_cnt), xs)
+    victims = victim_t.T & feas0[:, None]            # [N, P]
+
+    nv = jnp.sum(victims, axis=1, dtype=i64)
+    viol_ct = jnp.sum(victims & vic["violating"], axis=1, dtype=i64)
+    first_idx = jnp.argmax(victims, axis=1)
+    first_prio = jnp.take_along_axis(
+        vic["prio"], first_idx[:, None], axis=1)[:, 0]
+    sum_prio = jnp.sum(
+        jnp.where(victims, vic["prio"] + (1 << 31), 0), axis=1)
+    I64_MIN = jnp.iinfo(i64).min
+    high = jnp.max(jnp.where(victims, vic["prio"], I64_MIN), axis=1)
+    INF = jnp.asarray(jnp.inf, f64)
+    earliest_high = jnp.min(
+        jnp.where(victims & (vic["prio"] == high[:, None]),
+                  vic["start"], INF), axis=1)
+
+    # -- pickOneNodeForPreemption (:837) --------------------------------
+    any_cand = jnp.any(feas0)
+    zerov = feas0 & (nv == 0)
+    rank = jnp.asarray(order_rank, i64)
+    BIGR = jnp.asarray(1 << 60, i64)
+
+    def argmin_rank(mask):
+        return jnp.argmin(jnp.where(mask, rank, BIGR)).astype(i32)
+
+    m = feas0
+    for crit in (viol_ct.astype(f64),
+                 first_prio.astype(f64),
+                 sum_prio.astype(f64),
+                 nv.astype(f64),
+                 -earliest_high):
+        # +-inf criteria are fine: IEEE inf == inf keeps the equality
+        # matching exact (None start times read as +inf, :176-180)
+        best = jnp.min(jnp.where(m, crit, INF))
+        m &= jnp.where(m, crit, INF) == best
+    winner = jnp.where(jnp.any(zerov), argmin_rank(zerov), argmin_rank(m))
+    winner = jnp.where(any_cand, winner, -1)
+
+    w = jnp.maximum(winner, 0)
+    out = jnp.concatenate([
+        jnp.stack([winner.astype(i32),
+                   nv[w].astype(i32), viol_ct[w].astype(i32)]),
+        victims[w].astype(i32)])
+    return out
+
+
+def preemption_scan(nodes, vic, pod, feas_static, order_rank, n_real,
+                    check_resources, has_request):
+    """One launch over all candidate nodes. `vic` arrays are [N, P] with
+    victims pre-sorted into processing order per node. Returns packed i32
+    [3 + P]: winner node index (-1 = no candidate), its victim count and
+    PDB-violation count, then the winner's per-slot victim flags (aligned
+    to the sorted order the host supplied)."""
+    return _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank,
+                                _i64(n_real), bool(check_resources),
+                                bool(has_request))

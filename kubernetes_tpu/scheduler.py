@@ -443,20 +443,30 @@ class Scheduler:
             updated = self.store.get(PODS, pod.key)   # factory.go:732
         except NotFoundError:
             return
-        preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list,
-                              extenders=self.extenders)
-        from kubernetes_tpu.factory import (
-            build_predicate_set, DEFAULT_PREDICATE_NAMES)
-        predicate_set_fn = lambda infos: build_predicate_set(
-            self._predicate_names or DEFAULT_PREDICATE_NAMES, infos,
-            volume_listers=self.volume_listers,
-            volume_binder=self.volume_binder,
-            services_fn=self._services_fn)
-        result = preemptor.preempt(
-            updated, self._snapshot.node_infos,
-            getattr(self, "_last_names", list(self._snapshot.node_infos)),
-            err, nominated_pods_fn=self.queue.nominated.pods_for_node,
-            predicate_set_fn=predicate_set_fn)
+        names = getattr(self, "_last_names", list(self._snapshot.node_infos))
+        result = None
+        if not any(getattr(e.config, "preempt_verb", "")
+                   for e in self.extenders) \
+                and hasattr(self.algorithm, "preempt"):
+            # device victim scan: one launch over all candidate nodes
+            # (oracle-identical decisions; None = not expressible on device)
+            result = self.algorithm.preempt(
+                updated, self._snapshot.node_infos, names, err,
+                self.informers.informer(PDBS).list())
+        if result is None:
+            preemptor = Preemptor(pdbs_fn=self.informers.informer(PDBS).list,
+                                  extenders=self.extenders)
+            from kubernetes_tpu.factory import (
+                build_predicate_set, DEFAULT_PREDICATE_NAMES)
+            predicate_set_fn = lambda infos: build_predicate_set(
+                self._predicate_names or DEFAULT_PREDICATE_NAMES, infos,
+                volume_listers=self.volume_listers,
+                volume_binder=self.volume_binder,
+                services_fn=self._services_fn)
+            result = preemptor.preempt(
+                updated, self._snapshot.node_infos, names,
+                err, nominated_pods_fn=self.queue.nominated.pods_for_node,
+                predicate_set_fn=predicate_set_fn)
         if result.node is not None:
             # in-memory nomination first (scheduler.go:310), then the API write
             self.queue.nominated.add(updated, result.node.name)

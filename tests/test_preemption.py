@@ -348,3 +348,94 @@ class TestStaleNominationCleanup:
         sched.pump()
         assert store.get(PODS, "default/pre").nominated_node_name == ""
         assert not sched.queue.nominated.has_any()
+
+
+class TestDevicePreemptionParity:
+    """kernels.preemption_scan vs the oracle Preemptor: identical chosen
+    node and victim sets on resource-only workloads (VERDICT round-3 #4)."""
+
+    def _compare(self, infos, names, incoming, pdbs, seed_msg=""):
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        err = FitError(incoming, len(names), {
+            n: ["InsufficientResource:cpu"] for n in names})
+        oracle = Preemptor(pdbs_fn=lambda: pdbs).preempt(
+            incoming, infos, names, err)
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        dev = tpu.preempt(incoming, infos, names, err, pdbs)
+        assert dev is not None, f"device path refused eligible case {seed_msg}"
+        o_node = oracle.node.name if oracle.node else None
+        d_node = dev.node.name if dev.node else None
+        assert d_node == o_node, seed_msg
+        assert sorted(p.key for p in dev.victims) == \
+            sorted(p.key for p in oracle.victims), seed_msg
+        return dev
+
+    def test_basic_pick_and_victims(self):
+        nodes = [mknode("n0", cpu=2000), mknode("n1", cpu=2000),
+                 mknode("n2", cpu=2000)]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("a0", cpu=1000, priority=5),
+                   mkpod("a1", cpu=1000, priority=1)],
+            "n1": [mkpod("b0", cpu=2000, priority=3)],
+            "n2": [mkpod("c0", cpu=1000, priority=2),
+                   mkpod("c1", cpu=1000, priority=2)],
+        })
+        incoming = mkpod("hi", cpu=1500, priority=10)
+        dev = self._compare(infos, ["n0", "n1", "n2"], incoming, [])
+        assert dev.node is not None
+
+    def test_pdb_violations_steer_choice(self):
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        pdbs = [PodDisruptionBudget(name="b", selector=sel,
+                                    disruptions_allowed=0)]
+        nodes = [mknode("n0", cpu=1000), mknode("n1", cpu=1000)]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("v0", cpu=1000, priority=1, labels={"app": "db"})],
+            "n1": [mkpod("v1", cpu=1000, priority=2)],
+        })
+        incoming = mkpod("hi", cpu=1000, priority=10)
+        dev = self._compare(infos, ["n0", "n1"], incoming, pdbs)
+        assert dev.node.name == "n1"   # n0's victim violates the PDB
+
+    def test_refuses_affinity_world(self):
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        from kubernetes_tpu.api.types import (
+            Affinity, PodAntiAffinity, PodAffinityTerm, LABEL_HOSTNAME)
+        nodes = [mknode("n0", cpu=1000)]
+        victim = mkpod("v", cpu=1000, priority=1, labels={"a": "b"})
+        victim.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=(PodAffinityTerm(
+                label_selector=LabelSelector(match_labels=(("a", "b"),)),
+                topology_key=LABEL_HOSTNAME),)))
+        infos = snapshot(nodes, {"n0": [victim]})
+        incoming = mkpod("hi", cpu=1000, priority=10)
+        err = FitError(incoming, 1, {"n0": ["InsufficientResource:cpu"]})
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        assert tpu.preempt(incoming, infos, ["n0"], err, []) is None
+
+    def test_randomized_parity(self):
+        import random
+        rng = random.Random(20260730)
+        for trial in range(12):
+            n_nodes = rng.randint(2, 8)
+            nodes = [mknode(f"n{i}", cpu=rng.choice([1000, 2000, 4000]))
+                     for i in range(n_nodes)]
+            by_node = {}
+            uid = 0
+            for n in nodes:
+                pods = []
+                for _ in range(rng.randint(0, 5)):
+                    uid += 1
+                    pods.append(mkpod(
+                        f"p{uid}", cpu=rng.choice([200, 500, 1000]),
+                        priority=rng.randint(0, 6),
+                        labels={"app": rng.choice(["db", "web", "etc"])},
+                        start=rng.choice([None, float(rng.randint(1, 100))])))
+                by_node[n.name] = pods
+            infos = snapshot(nodes, by_node)
+            pdbs = [PodDisruptionBudget(
+                name="b", selector=LabelSelector(match_labels=(("app", "db"),)),
+                disruptions_allowed=rng.randint(0, 2))]
+            incoming = mkpod("hi", cpu=rng.choice([1000, 1500]), priority=7)
+            self._compare(infos, [n.name for n in nodes], incoming, pdbs,
+                          seed_msg=f"trial={trial}")
