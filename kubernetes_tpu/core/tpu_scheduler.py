@@ -65,7 +65,8 @@ class TPUScheduler:
                  nominated=None,
                  volume_listers=None, volume_binder=None,
                  node_tree=None,
-                 serial_path: str = "device"):
+                 serial_path: str = "device",
+                 mesh=None):
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.services_fn = services_fn
@@ -96,6 +97,21 @@ class TPUScheduler:
         self._lat_ora: Optional[float] = None
         self._lat_dev: Optional[float] = None
         self._serial_cycles = 0
+        # multi-chip mode: node axis sharded over a jax.sharding.Mesh
+        # (parallel/sharding.py — per-shard filter/score, ICI all-gather,
+        # replicated select). mesh="auto" builds one over every visible
+        # device; None stays single-chip. Cycles and generic-scan bursts run
+        # sharded; the uniform K-batch kernel stays single-chip (its
+        # while-loop epilogue is scalar-bound, not node-bound).
+        if mesh == "auto":
+            import jax as _jax
+            mesh = None
+            if len(_jax.devices()) > 1:
+                from kubernetes_tpu.parallel import sharding as S
+                mesh = S.make_mesh()
+        self.mesh = mesh
+        self._sharded_cycle = None
+        self._sharded_batch = None
         self.encoder = NodeStateEncoder()
         # device-resident node matrix: full upload on rebuild, dirty-row
         # scatter otherwise (SURVEY §2.4 delta uploader)
@@ -131,11 +147,16 @@ class TPUScheduler:
 
     def _node_arrays(self, b: NodeBatch) -> dict:
         """Device node matrix, kept resident across cycles; only rows the
-        encoder marked generation-dirty are re-uploaded."""
+        encoder marked generation-dirty are re-uploaded. In mesh mode the
+        node axis is split across the chips at upload time."""
         key = (b.n_pad, len(b.scalar_names), id(b))
         if self._dev_nodes is None or self._dev_key != key or b.dirty_rows is None:
-            self._dev_nodes = {k: jnp.asarray(getattr(b, k))
-                               for k in self._NODE_FIELDS}
+            host = {k: np.asarray(getattr(b, k)) for k in self._NODE_FIELDS}
+            if self.mesh is not None:
+                from kubernetes_tpu.parallel import sharding as S
+                self._dev_nodes = S.shard_node_arrays(self.mesh, host)
+            else:
+                self._dev_nodes = {k: jnp.asarray(v) for k, v in host.items()}
             self._dev_key = key
             b.dirty_rows = []   # host state fully mirrored; start tracking
             return self._dev_nodes
@@ -386,8 +407,22 @@ class TPUScheduler:
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
-        out = K.schedule_cycle(nodes, pod_in, self.last_index, self.last_node_index,
-                               num_to_find, n, z_pad, weights=self.weights)
+        if self.mesh is not None:
+            # node axis split over the chips; collectives ride ICI and the
+            # select epilogue replicates (parallel/sharding.py)
+            from kubernetes_tpu.parallel import sharding as S
+            if self._sharded_cycle is None or self._sharded_cycle[0] != z_pad:
+                self._sharded_cycle = (z_pad, S.sharded_cycle_fn(
+                    self.mesh, z_pad=z_pad, weights=self.weights))
+            pod_sharded = S.shard_pod_arrays(self.mesh, pod_in)
+            out = self._sharded_cycle[1](
+                nodes, pod_sharded,
+                K._i64(self.last_index), K._i64(self.last_node_index),
+                K._i64(num_to_find), K._i64(n))
+        else:
+            out = K.schedule_cycle(nodes, pod_in, self.last_index,
+                                   self.last_node_index,
+                                   num_to_find, n, z_pad, weights=self.weights)
         # ONE device->host fetch for everything the decision needs: each
         # separate readback pays a full dispatch round trip (ruinous over a
         # tunneled device), so the scalars and per-node vectors come back
@@ -642,7 +677,7 @@ class TPUScheduler:
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
         uniform = None
         feats: Optional[list] = None
-        if num_to_find >= n and self.last_index == 0:
+        if self.mesh is None and num_to_find >= n and self.last_index == 0:
             # spec-identical pods produce identical encoder output against a
             # fixed snapshot, so the uniform path encodes ONE pod — per-pod
             # feature encoding (IPA topology counting in particular) is the
@@ -702,9 +737,19 @@ class TPUScheduler:
             per_pod.extend([pad] * (bucket - len(per_pod)))
         stacked = self._stack_pods(per_pod)
         z_pad = _pad_pow2(len(b.zone_names), 4)
-        state, li, lni, outs = K.schedule_batch(
-            nodes, stacked, self.last_index, self.last_node_index, num_to_find, n,
-            z_pad, weights=self.weights)
+        if self.mesh is not None:
+            from kubernetes_tpu.parallel import sharding as S
+            if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
+                self._sharded_batch = (z_pad, S.sharded_batch_fn(
+                    self.mesh, z_pad=z_pad, weights=self.weights))
+            pods_sharded = S.shard_pod_batch(self.mesh, stacked)
+            state, li, lni, outs = self._sharded_batch[1](
+                nodes, pods_sharded, K._i64(self.last_index),
+                K._i64(self.last_node_index), K._i64(num_to_find), K._i64(n))
+        else:
+            state, li, lni, outs = K.schedule_batch(
+                nodes, stacked, self.last_index, self.last_node_index,
+                num_to_find, n, z_pad, weights=self.weights)
         # persist the folds: the device-resident matrix is authoritative for
         # rows the scan mutated (the host mirror catches up via
         # note_burst_assumed; external changes still arrive via dirty rows)

@@ -297,6 +297,9 @@ def create_scheduler(store, cfg: Optional[SchedulerConfiguration] = None,
         and tpu_supports_predicates(pred_names) \
         and not extenders
     kw.setdefault("extenders", extenders)
+    # production wiring shards the node axis across every visible chip;
+    # direct Scheduler construction stays single-chip unless asked
+    kw.setdefault("mesh", "auto")
     return Scheduler(
         store,
         scheduler_name=cfg.scheduler_name,

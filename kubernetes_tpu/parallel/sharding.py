@@ -43,7 +43,7 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(jax.numpy.array(devices).reshape(-1), (NODE_AXIS,))
+    return Mesh(np.asarray(devices).reshape(-1), (NODE_AXIS,))
 
 
 def node_sharding(mesh: Mesh) -> NamedSharding:

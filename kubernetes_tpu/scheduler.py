@@ -68,7 +68,8 @@ class Scheduler:
                  plugin_args: Optional[dict] = None,
                  predicate_names: Optional[list] = None,
                  priority_weights: Optional[dict] = None,
-                 extenders: Optional[list] = None):
+                 extenders: Optional[list] = None,
+                 mesh=None):
         self.store = store
         self.name = scheduler_name
         self.recorder = EventRecorder(store, component=scheduler_name)
@@ -126,6 +127,9 @@ class Scheduler:
                 # latency (a tunneled chip's dispatch RTT dwarfs small-N
                 # host scoring; decisions are identical either way)
                 serial_path="adaptive",
+                # "auto" shards the node axis over every visible chip
+                # (parallel/sharding.py); the factory/CLI path opts in
+                mesh=mesh,
                 # the shell only consumes the suggested host + failure
                 # reasons; skipping the per-node score readback saves a
                 # full-vector transfer every cycle (extenders, which do read

@@ -190,3 +190,58 @@ class TestDryrunEntry:
         out = jax.jit(fn)(*args)
         sel = int(out[0])
         assert sel >= 0
+
+
+class TestMeshPipelineDenseFeatures:
+    """The real store->queue->cache->burst pipeline in mesh mode, with pods
+    whose _POD_SHARDED mask fields are DENSE (node selectors -> sel_ok[N],
+    taints -> taints_ok[N]/taint_counts[N]) — not the inert [1] broadcasts
+    (VERDICT round-3 #5)."""
+
+    def _pipeline(self, mesh):
+        from kubernetes_tpu.api.types import (
+            Node, Pod, Container, Taint, Toleration, NO_SCHEDULE)
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        GI = 1024 ** 3
+        store = Store(watch_log_size=65536)
+        for i in range(32):
+            taints = (Taint(key="dedicated", value="x", effect=NO_SCHEDULE),) \
+                if i % 4 == 0 else ()
+            store.create(NODES, Node(
+                name=f"n{i}",
+                labels={"failure-domain.beta.kubernetes.io/zone":
+                        f"z{i % 4}",
+                        "perf-group": "a" if i % 2 == 0 else "b"},
+                taints=taints,
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100, mesh=mesh)
+        sched.sync()
+        for j in range(12):
+            kw = {}
+            if j % 3 == 0:
+                kw["node_selector"] = {"perf-group": "a"}
+            if j % 3 == 1:
+                kw["tolerations"] = (Toleration(
+                    key="dedicated", value="x", effect=NO_SCHEDULE),)
+            store.create(PODS, Pod(
+                name=f"p{j}", labels={"app": "x"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": 100 + 100 * (j % 2),
+                                        "memory": GI}),), **kw))
+        sched.pump()
+        while sched.schedule_burst(max_pods=16):
+            pass
+        sched.pump()
+        return {p.key: p.node_name for p in store.list(PODS)[0]}
+
+    def test_mesh_burst_matches_single_device(self):
+        import jax
+        from kubernetes_tpu.parallel import sharding as S
+        assert len(jax.devices()) >= 8, "conftest provisions 8 CPU devices"
+        mesh = S.make_mesh(8)
+        sharded = self._pipeline(mesh)
+        single = self._pipeline(None)
+        assert sharded == single
+        assert sum(1 for v in sharded.values() if v) == 12
