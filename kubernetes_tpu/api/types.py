@@ -210,6 +210,9 @@ class Toleration:
     op: str = TOLERATION_OP_EQUAL
     value: str = ""
     effect: str = ""  # empty → matches all effects
+    # None → tolerate forever; N → evictable N seconds after the NoExecute
+    # taint lands (read by the node-lifecycle taint manager)
+    toleration_seconds: Optional[float] = None
 
     def tolerates(self, taint: Taint) -> bool:
         """Reference: k8s.io/api/core/v1/toleration.go ToleratesTaint."""

@@ -124,6 +124,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--once", action="store_true",
                     help="drain the queue once and exit (bench/CI mode)")
     ap.add_argument("--burst", type=int, default=0)
+    ap.add_argument("--profile-dir",
+                    help="write a jax.profiler trace (kernel timelines, "
+                         "transfers) covering the scheduling loop — the "
+                         "EnableProfiling/pprof analog (server.go:301)")
     args = ap.parse_args(argv)
 
     cfg = build_config(args)
@@ -133,6 +137,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     sched = create_scheduler(store, cfg)
     sched.sync()
     server = serve_http(sched, cfg, args.port) if args.port else None
+    profiler = None
+    if args.profile_dir:
+        from kubernetes_tpu.utils.tracing import Profiler
+        profiler = Profiler(args.profile_dir)
+        profiler.start()
 
     def run_loop():
         sched.pump()
@@ -175,6 +184,8 @@ def main(argv: Optional[list[str]] = None) -> int:
     else:
         run_loop()
 
+    if profiler is not None:
+        profiler.stop()
     if args.once:
         attempts = sched.metrics.schedule_attempts
         print(json.dumps({"scheduled": attempts["scheduled"],

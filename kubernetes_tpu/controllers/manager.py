@@ -13,10 +13,14 @@ from typing import Callable, Optional
 
 from kubernetes_tpu.store.store import Store
 from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
+from kubernetes_tpu.controllers.podgc import PodGCController
 
 # name -> constructor(store) (NewControllerInitializers analog)
 CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "disruption": DisruptionController,
+    "nodelifecycle": NodeLifecycleController,
+    "podgc": PodGCController,
 }
 
 

@@ -1,0 +1,177 @@
+"""Node lifecycle controller — failure detection, condition taints, taint
+eviction.
+
+Mirror of pkg/controller/nodelifecycle (node_lifecycle_controller.go with
+TaintBasedEvictions + TaintNodesByCondition on, the v1.15 default stance
+the scheduler's predicate set assumes):
+
+- condition -> taint sync: a node whose Ready condition is False gets the
+  `node.kubernetes.io/not-ready` NoSchedule + NoExecute taints; Unknown gets
+  `node.kubernetes.io/unreachable`; a Ready node has both removed
+  (nodelifecycle/scheduler/... taintToleratedBySelector; controller
+  doNoScheduleTaintingPass / doNoExecuteTaintingPass).
+- taint eviction (NoExecuteTaintManager): pods on a node carrying a
+  NoExecute taint they do not tolerate are deleted. Pods tolerating it with
+  a bounded tolerationSeconds are deleted once the taint has been in place
+  that long (checked per pump against the injected clock).
+
+Heartbeat/grace-period machinery is out of scope: with no kubelet, Ready
+transitions arrive as explicit condition updates through the store (the
+hollow-node generator and tests flip them), and this controller reacts.
+"""
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+from kubernetes_tpu.api.types import (
+    Node, Pod, Taint, NO_SCHEDULE, NO_EXECUTE,
+)
+from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.store.record import EventRecorder, NORMAL
+from kubernetes_tpu.store.store import Store, PODS, NODES, NotFoundError
+from kubernetes_tpu.utils.clock import Clock, RealClock
+
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+_LIFECYCLE_KEYS = (TAINT_NOT_READY, TAINT_UNREACHABLE)
+
+
+def _ready_status(node: Node) -> str:
+    for c in node.conditions:
+        if c.type == "Ready":
+            return c.status
+    return "True"   # no condition reported = treated schedulable
+
+
+def _wanted_taints(node: Node) -> tuple[Taint, ...]:
+    status = _ready_status(node)
+    if status == "False":
+        return (Taint(key=TAINT_NOT_READY, effect=NO_SCHEDULE),
+                Taint(key=TAINT_NOT_READY, effect=NO_EXECUTE))
+    if status == "Unknown":
+        return (Taint(key=TAINT_UNREACHABLE, effect=NO_SCHEDULE),
+                Taint(key=TAINT_UNREACHABLE, effect=NO_EXECUTE))
+    return ()
+
+
+class NodeLifecycleController:
+    def __init__(self, store: Store, clock: Optional[Clock] = None):
+        self.store = store
+        self.clock = clock or RealClock()
+        self.recorder = EventRecorder(store, component="controllermanager")
+        self.informers = InformerFactory(store)
+        self._dirty_nodes: set[str] = set()
+        # node -> NoExecute taint keys -> time first observed (for bounded
+        # tolerationSeconds eviction)
+        self._noexec_since: dict[str, dict[str, float]] = {}
+        nodes = self.informers.informer(NODES)
+        nodes.add_event_handler(
+            on_add=lambda n: self._dirty_nodes.add(n.name),
+            on_update=lambda o, n: self._dirty_nodes.add(n.name),
+            on_delete=lambda n: (self._dirty_nodes.discard(n.name),
+                                 self._noexec_since.pop(n.name, None)))
+        pods = self.informers.informer(PODS)
+        pods.add_event_handler(
+            on_add=lambda p: p.node_name and self._dirty_nodes.add(p.node_name),
+            on_update=lambda o, n: n.node_name
+            and self._dirty_nodes.add(n.node_name),
+            on_delete=lambda p: None)
+
+    def sync(self) -> None:
+        self.informers.sync_all()
+        for n in self.informers.informer(NODES).list():
+            self._dirty_nodes.add(n.name)
+        self.reconcile_dirty()
+
+    def pump(self) -> int:
+        self.informers.pump_all()
+        # bounded-toleration evictions fire on time, not on events
+        for name in list(self._noexec_since):
+            self._dirty_nodes.add(name)
+        return self.reconcile_dirty()
+
+    def reconcile_dirty(self) -> int:
+        n = 0
+        while self._dirty_nodes:
+            name = self._dirty_nodes.pop()
+            try:
+                node = self.store.get(NODES, name)
+            except NotFoundError:
+                self._noexec_since.pop(name, None)
+                continue
+            self._sync_taints(node)
+            n += 1
+        return n
+
+    # -- condition -> taint (doNoSchedule/doNoExecuteTaintingPass) ----------
+    def _sync_taints(self, node: Node) -> None:
+        wanted = _wanted_taints(node)
+        kept = tuple(t for t in node.taints if t.key not in _LIFECYCLE_KEYS)
+        new = kept + wanted
+        if tuple(sorted(new, key=repr)) != tuple(sorted(node.taints, key=repr)):
+            def mutate(cur):
+                cur.taints = tuple(
+                    t for t in cur.taints
+                    if t.key not in _LIFECYCLE_KEYS) + wanted
+                return cur
+            try:
+                node = self.store.guaranteed_update(NODES, node.name, mutate)
+            except NotFoundError:
+                return
+            if wanted:
+                self.recorder.event(
+                    "Node", node.name, NORMAL, "NodeNotReady" if
+                    _ready_status(node) == "False" else "NodeNotReachable",
+                    f"Node {node.name} tainted {wanted[0].key}")
+        self._evict_for_noexecute(node)
+
+    # -- NoExecute taint manager --------------------------------------------
+    def _evict_for_noexecute(self, node: Node) -> None:
+        noexec = [t for t in node.taints if t.effect == NO_EXECUTE]
+        since = self._noexec_since.setdefault(node.name, {})
+        now = self.clock.now()
+        live = set()
+        for t in noexec:
+            live.add(t.key)
+            since.setdefault(t.key, now)
+        for k in list(since):
+            if k not in live:
+                del since[k]
+        if not noexec:
+            if not since:
+                self._noexec_since.pop(node.name, None)
+            return
+        pods, _rv = self.store.list(PODS)
+        for pod in pods:
+            if pod.node_name != node.name or pod.deleted:
+                continue
+            deadline = self._eviction_deadline(pod, noexec, since)
+            if deadline is None or deadline > now:
+                continue
+            try:
+                self.store.delete(PODS, pod.key)
+            except NotFoundError:
+                continue
+            self.recorder.pod_event(
+                pod, NORMAL, "TaintManagerEviction",
+                f"Deleting pod {pod.key} from node {node.name}")
+
+    @staticmethod
+    def _eviction_deadline(pod: Pod, noexec: list[Taint],
+                           since: dict[str, float]) -> Optional[float]:
+        """Earliest time the pod must go; None = tolerates forever.
+        Reference: NoExecuteTaintManager processPodOnNode — a pod must
+        tolerate EVERY NoExecute taint; the usable toleration window is the
+        minimum tolerationSeconds across them."""
+        deadline = None
+        for t in noexec:
+            tols = [tol for tol in pod.tolerations if tol.tolerates(t)]
+            if not tols:
+                return 0.0          # evict now
+            secs = [tol.toleration_seconds for tol in tols
+                    if getattr(tol, "toleration_seconds", None) is not None]
+            if secs:
+                d = since.get(t.key, 0.0) + min(secs)
+                deadline = d if deadline is None else min(deadline, d)
+        return deadline

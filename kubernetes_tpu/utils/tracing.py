@@ -15,6 +15,48 @@ log = logging.getLogger("kubernetes_tpu")
 SLOW_CYCLE_THRESHOLD = 0.1  # 100ms (generic_scheduler.go:186)
 
 
+class Profiler:
+    """Device-level profiling — the pprof-endpoint analog.
+
+    The reference wires pprof HTTP handlers behind EnableProfiling
+    (cmd/kube-scheduler/app/server.go:301-305, DebuggingConfiguration in
+    apis/config/types.go:70); the TPU equivalent is a jax.profiler trace
+    session writing TensorBoard/XPlane dumps (kernel timelines, HLO cost
+    breakdowns, host<->device transfers) to a directory. Use either as a
+    session (`start()`/`stop()`, the CLI flag path) or as a context manager
+    around a region (`with Profiler(dir).span("burst"): ...`)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._active = False
+
+    def start(self) -> None:
+        import jax
+        if not self._active:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def stop(self) -> None:
+        import jax
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.warning("profiler trace written to %s", self.log_dir)
+
+    def span(self, name: str):
+        """Annotated sub-region (shows as a named range in the trace)."""
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
 class Trace:
     def __init__(self, name: str, threshold: float = SLOW_CYCLE_THRESHOLD):
         self.name = name

@@ -126,6 +126,10 @@ class TestPreemptionFollowsLiveStatus:
 
         store = Store()
         mgr = ControllerManager(store)
+        for n in ("nA", "nB", "nC"):   # podgc reaps pods on absent nodes
+            store.create(NODES, Node(
+                name=n, allocatable={"cpu": 1000 if n != "nC" else 4000,
+                                     "memory": 8 * GI, "pods": 110}))
         store.create(PDBS, PodDisruptionBudget(
             name="db-budget", selector=sel(app="db"), min_available=2))
         # victims: vA (priority 1, PDB-covered) on nA; vB (priority 2) on nB
@@ -175,3 +179,100 @@ class TestPreemptionFollowsLiveStatus:
         assert store.get(PDBS, "default/db-budget").disruptions_allowed == 0
         r2 = preempt_once()
         assert r2.node is not None and r2.node.name == "nB"
+
+
+class TestNodeLifecycle:
+    """Condition->taint sync + NoExecute eviction (pkg/controller/
+    nodelifecycle with TaintBasedEvictions/TaintNodesByCondition on)."""
+
+    def _store(self):
+        from kubernetes_tpu.api.types import Node, NodeCondition
+        store = Store()
+        store.create(NODES, Node(
+            name="n0", allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110},
+            conditions=(NodeCondition(type="Ready", status="True"),)))
+        return store
+
+    def test_not_ready_gets_taints_and_back(self):
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            NodeLifecycleController, TAINT_NOT_READY)
+        from kubernetes_tpu.api.types import NodeCondition
+        store = self._store()
+        c = NodeLifecycleController(store)
+        c.sync()
+        assert store.get(NODES, "n0").taints == ()
+
+        def flip(status):
+            def mutate(n):
+                n.conditions = (NodeCondition(type="Ready", status=status),)
+                return n
+            store.guaranteed_update(NODES, "n0", mutate)
+
+        flip("False")
+        c.pump()
+        taints = store.get(NODES, "n0").taints
+        assert {t.key for t in taints} == {TAINT_NOT_READY}
+        assert {t.effect for t in taints} == {"NoSchedule", "NoExecute"}
+        flip("True")
+        c.pump()
+        assert store.get(NODES, "n0").taints == ()
+
+    def test_unreachable_evicts_intolerant_pods(self):
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            NodeLifecycleController, TAINT_UNREACHABLE)
+        from kubernetes_tpu.api.types import (
+            NodeCondition, Toleration, TOLERATION_OP_EXISTS)
+        from kubernetes_tpu.utils.clock import FakeClock
+        store = self._store()
+        clock = FakeClock(1000.0)
+        c = NodeLifecycleController(store, clock=clock)
+        tol_forever = Toleration(key=TAINT_UNREACHABLE,
+                                 op=TOLERATION_OP_EXISTS, effect="NoExecute")
+        tol_5s = Toleration(key=TAINT_UNREACHABLE, op=TOLERATION_OP_EXISTS,
+                            effect="NoExecute", toleration_seconds=5)
+        store.create(PODS, bound_pod("doomed", "n0"))
+        p2 = bound_pod("tolerant", "n0")
+        p2.tolerations = (tol_forever,)
+        store.create(PODS, p2)
+        p3 = bound_pod("bounded", "n0")
+        p3.tolerations = (tol_5s,)
+        store.create(PODS, p3)
+        c.sync()
+
+        def mutate(n):
+            n.conditions = (NodeCondition(type="Ready", status="Unknown"),)
+            return n
+        store.guaranteed_update(NODES, "n0", mutate)
+        c.pump()
+        keys = {p.key for p in store.list(PODS)[0]}
+        assert "default/doomed" not in keys       # evicted immediately
+        assert {"default/tolerant", "default/bounded"} <= keys
+        clock.step(6)
+        c.pump()
+        keys = {p.key for p in store.list(PODS)[0]}
+        assert "default/bounded" not in keys      # tolerationSeconds expired
+        assert "default/tolerant" in keys
+
+
+class TestPodGC:
+    def test_three_sweeps(self):
+        from kubernetes_tpu.controllers.podgc import PodGCController
+        from kubernetes_tpu.api.types import Node
+        store = Store()
+        store.create(NODES, Node(name="n0", allocatable={"cpu": 1}))
+        # orphaned (node gone)
+        store.create(PODS, bound_pod("orphan", "ghost-node"))
+        # terminating, never scheduled
+        t = Pod(name="terminating")
+        t.deleted = True
+        store.create(PODS, t)
+        # terminated beyond threshold=1 (older one goes)
+        for i, ts in ((0, 5.0), (1, 9.0)):
+            p = bound_pod(f"done{i}", "n0")
+            p.phase = "Succeeded"
+            p.creation_timestamp = ts
+            store.create(PODS, p)
+        gc = PodGCController(store, terminated_pod_threshold=1)
+        gc.sync()
+        keys = {p.key for p in store.list(PODS)[0]}
+        assert keys == {"default/done1"}
