@@ -382,6 +382,7 @@ class Pod:
     containers: tuple[Container, ...] = ()
     init_containers: tuple[Container, ...] = ()
     priority: int = 0            # resolved PriorityClass value
+    priority_class_name: str = ""   # resolved by the priority admission plugin
     scheduler_name: str = "default-scheduler"
     volumes: tuple[VolumeSource, ...] = ()
     # status
@@ -560,6 +561,26 @@ class PodDisruptionBudget:
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class PriorityClass:
+    """Pruned scheduling.k8s.io/v1beta1 PriorityClass — resolved into
+    pod.priority by the priority admission plugin
+    (plugin/pkg/admission/priority; the scheduler reads the resolved value
+    via util.GetPodPriority)."""
+    name: str
+    value: int = 0
+    global_default: bool = False
+    description: str = ""
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "PriorityClass":
+        return _shallow(self)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,56 @@
+"""Admission chain — plugin/pkg/admission analog.
+
+Mutating/validating plugins run on every apiserver write before the store
+commit (the reference chains 20+ plugins in the generic apiserver's
+handler stack). Implemented plugins:
+
+- PriorityAdmission (plugin/pkg/admission/priority): resolves
+  pod.priority_class_name to the PriorityClass value (or the cluster's
+  global default when unset), writing pod.priority — the field preemption
+  orders by. Unknown class names are rejected.
+- TaintNodesByCondition-style defaulting is NOT admission here (the
+  node-lifecycle controller owns taints).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from kubernetes_tpu.store.store import Store, PODS, PRIORITYCLASSES
+
+
+class AdmissionError(Exception):
+    """Write rejected (HTTP 422 at the REST boundary)."""
+
+
+class PriorityAdmission:
+    """plugin/pkg/admission/priority: Admit on pod create."""
+
+    kind = PODS
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        classes, _rv = store.list(PRIORITYCLASSES)
+        if obj.priority_class_name:
+            for pc in classes:
+                if pc.name == obj.priority_class_name:
+                    obj.priority = pc.value
+                    return obj
+            raise AdmissionError(
+                f"no PriorityClass with name {obj.priority_class_name} was found")
+        for pc in classes:
+            if pc.global_default:
+                obj.priority = pc.value
+                obj.priority_class_name = pc.name
+                return obj
+        return obj   # resolved priority 0 (the reference's default)
+
+
+class AdmissionChain:
+    def __init__(self, plugins: Optional[list] = None):
+        self.plugins = plugins if plugins is not None else [PriorityAdmission()]
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        for p in self.plugins:
+            obj = p.admit(kind, obj, store)
+        return obj

@@ -1,0 +1,250 @@
+"""REST API server over the store — the kube-apiserver surface.
+
+The reference's apiserver is REST + watch over etcd
+(staging/src/k8s.io/apiserver; pkg/master installs core/v1 at /api/v1).
+This serves the same contract over the in-memory store:
+
+  GET    /healthz | /readyz | /version
+  GET    /api/v1/{kind}                 -> {"kind","items","resourceVersion"}
+  GET    /api/v1/{kind}?watch=true&resourceVersion=N
+                                        -> chunked JSON-lines event stream
+  GET    /api/v1/{kind}/{key...}        -> object
+  POST   /api/v1/{kind}                 -> admission chain -> create (201)
+  PUT    /api/v1/{kind}/{key...}        -> update (409 on rv conflict)
+  DELETE /api/v1/{kind}/{key...}        -> deleted object
+  POST   /api/v1/pods/{ns}/{name}/binding  {"node": "..."}
+                                        -> bind (the scheduler's write verb,
+                                           factory.go:710)
+
+Namespaced kinds key as {namespace}/{name}; cluster-scoped (nodes, PVs,
+priorityclasses) as {name}. Watch streams resume from resourceVersion and
+end with a 410-Gone error line when the log window expired (the client
+re-lists, exactly like the reference's Reflector).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+from kubernetes_tpu.api import serde
+from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
+from kubernetes_tpu.store.store import (
+    Store, PODS, AlreadyExistsError, ConflictError, NotFoundError,
+    ExpiredError,
+)
+
+API_PREFIX = "/api/v1"
+
+
+def make_handler(store: Store, admission: AdmissionChain):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):   # quiet
+            pass
+
+        # -- helpers --------------------------------------------------------
+        def _send(self, code: int, payload, chunked: bool = False) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, reason: str, message: str) -> None:
+            self._send(code, {"kind": "Status", "status": "Failure",
+                              "reason": reason, "message": message,
+                              "code": code})
+
+        def _route(self):
+            u = urlparse(self.path)
+            q = parse_qs(u.query)
+            parts = [p for p in u.path.split("/") if p]
+            return u.path, parts, q
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n) or b"{}")
+
+        # -- verbs ----------------------------------------------------------
+        def do_GET(self):
+            path, parts, q = self._route()
+            if path in ("/healthz", "/readyz", "/livez"):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+                return
+            if path == "/version":
+                self._send(200, {"gitVersion": "v0.3.0-kubernetes-tpu"})
+                return
+            if len(parts) < 3 or "/".join(parts[:2]) != "api/v1":
+                self._error(404, "NotFound", path)
+                return
+            kind = parts[2]
+            if kind not in serde.KIND_TYPES:
+                self._error(404, "NotFound", f"unknown resource {kind}")
+                return
+            if len(parts) == 3:
+                if q.get("watch", ["false"])[0] == "true":
+                    self._watch(kind, q)
+                    return
+                objs, rv = store.list(kind)
+                self._send(200, {"kind": kind, "resourceVersion": rv,
+                                 "items": [serde.to_dict(o) for o in objs]})
+                return
+            key = "/".join(parts[3:])
+            try:
+                self._send(200, serde.to_dict(store.get(kind, key)))
+            except NotFoundError:
+                self._error(404, "NotFound", f"{kind}/{key}")
+
+        def _watch(self, kind: str, q) -> None:
+            since = q.get("resourceVersion", [None])[0]
+            try:
+                w = store.watch(kind,
+                                int(since) if since is not None else None)
+            except ExpiredError as e:
+                self._error(410, "Expired", str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def emit(line: bytes) -> bool:
+                try:
+                    self.wfile.write(hex(len(line))[2:].encode() + b"\r\n"
+                                     + line + b"\r\n")
+                    self.wfile.flush()
+                    return True
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return False
+            try:
+                while True:
+                    ev = w.next(timeout=0.5)
+                    if ev is None:
+                        # blank-line keep-alive (an empty chunk would be the
+                        # stream terminator); readers skip empty lines
+                        if not emit(b"\n"):
+                            break
+                        continue
+                    line = json.dumps({
+                        "type": ev.type, "resourceVersion": ev.resource_version,
+                        "object": serde.to_dict(ev.obj)}).encode() + b"\n"
+                    if not emit(line):
+                        break
+            finally:
+                w.stop()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass
+                self.close_connection = True
+
+        def do_POST(self):
+            path, parts, q = self._route()
+            # binding subresource: POST /api/v1/pods/{ns}/{name}/binding
+            if len(parts) == 6 and parts[2] == PODS and parts[5] == "binding":
+                key = f"{parts[3]}/{parts[4]}"
+                node = self._body().get("node", "")
+                try:
+                    store.bind_pod(key, node)
+                except NotFoundError:
+                    self._error(404, "NotFound", key)
+                    return
+                self._send(201, {"kind": "Status", "status": "Success"})
+                return
+            if len(parts) != 3 or parts[2] not in serde.KIND_TYPES:
+                self._error(404, "NotFound", path)
+                return
+            kind = parts[2]
+            try:
+                obj = serde.from_dict(kind, self._body())
+                obj = admission.admit(kind, obj, store)
+                created = store.create(kind, obj)
+            except AdmissionError as e:
+                self._error(422, "Invalid", str(e))
+                return
+            except AlreadyExistsError as e:
+                self._error(409, "AlreadyExists", str(e))
+                return
+            except (TypeError, ValueError, KeyError) as e:
+                self._error(400, "BadRequest", str(e))
+                return
+            self._send(201, serde.to_dict(created))
+
+        def do_PUT(self):
+            path, parts, q = self._route()
+            if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
+                self._error(404, "NotFound", path)
+                return
+            kind = parts[2]
+            try:
+                obj = serde.from_dict(kind, self._body())
+                expect = obj.resource_version or None
+                updated = store.update(kind, obj, expect_rv=expect)
+            except NotFoundError as e:
+                self._error(404, "NotFound", str(e))
+                return
+            except ConflictError as e:
+                self._error(409, "Conflict", str(e))
+                return
+            except (TypeError, ValueError, KeyError) as e:
+                self._error(400, "BadRequest", str(e))
+                return
+            self._send(200, serde.to_dict(updated))
+
+        def do_DELETE(self):
+            path, parts, q = self._route()
+            if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
+                self._error(404, "NotFound", path)
+                return
+            kind = parts[2]
+            key = "/".join(parts[3:])
+            try:
+                gone = store.delete(kind, key)
+            except NotFoundError:
+                self._error(404, "NotFound", f"{kind}/{key}")
+                return
+            self._send(200, serde.to_dict(gone))
+
+    return Handler
+
+
+class APIServer:
+    """In-process apiserver: `with APIServer(store) as srv: srv.url`."""
+
+    def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 0,
+                 admission: AdmissionChain | None = None):
+        self.store = store
+        self.admission = admission or AdmissionChain()
+        self._httpd = ThreadingHTTPServer(
+            (host, port), make_handler(store, self.admission))
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

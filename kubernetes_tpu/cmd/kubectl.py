@@ -1,0 +1,241 @@
+"""kubectl analog — the CLI user tool over the REST apiserver.
+
+Mirrors the pkg/kubectl verbs the scheduler ecosystem exercises
+(cmd/kubectl; cli-runtime): talks HTTP to the apiserver (never the store
+directly — process boundary preserved), prints get tables and describe
+blocks (with the object's Events), applies JSON manifests, deletes, and
+runs the node maintenance verbs (cordon/uncordon/drain — drain evicts by
+deletion, like the reference's --disable-eviction mode).
+
+  kubectl-tpu --server URL get pods [-o json|wide] [--watch]
+  kubectl-tpu get pods default/p0 | nodes n0
+  kubectl-tpu describe pods default/p0
+  kubectl-tpu create -f manifest.json      (one object or {"items": [...]})
+  kubectl-tpu delete pods default/p0
+  kubectl-tpu cordon n0 | uncordon n0 | drain n0
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Optional
+
+DEFAULT_SERVER = "http://127.0.0.1:8001"
+
+
+class APIError(SystemExit):
+    pass
+
+
+def _req(server: str, method: str, path: str, body: Optional[dict] = None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(server + path, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            status = json.loads(e.read())
+            msg = status.get("message", str(e))
+        except Exception:
+            msg = str(e)
+        print(f"Error from server ({e.code}): {msg}", file=sys.stderr)
+        raise APIError(1)
+
+
+def _columns(kind: str, obj: dict) -> list[tuple[str, str]]:
+    name = obj.get("name", "")
+    ns = obj.get("namespace")
+    cols = [("NAMESPACE", ns)] if ns else []
+    cols.append(("NAME", name))
+    if kind == "pods":
+        phase = obj.get("phase", "")
+        node = obj.get("node_name", "") or "<none>"
+        cols += [("STATUS", phase), ("NODE", node),
+                 ("PRIORITY", str(obj.get("priority", 0)))]
+    elif kind == "nodes":
+        ready = "Ready"
+        for c in obj.get("conditions", []):
+            if c.get("type") == "Ready" and c.get("status") != "True":
+                ready = "NotReady"
+        if obj.get("unschedulable"):
+            ready += ",SchedulingDisabled"
+        cols += [("STATUS", ready),
+                 ("TAINTS", str(len(obj.get("taints", []))))]
+    elif kind == "events":
+        cols += [("TYPE", obj.get("type", "")),
+                 ("REASON", obj.get("reason", "")),
+                 ("OBJECT", obj.get("involved_key", "")),
+                 ("COUNT", str(obj.get("count", 1))),
+                 ("MESSAGE", obj.get("message", "")[:60])]
+    elif kind == "poddisruptionbudgets":
+        cols += [("MIN-AVAILABLE", str(obj.get("min_available"))),
+                 ("ALLOWED-DISRUPTIONS",
+                  str(obj.get("disruptions_allowed", 0)))]
+    return cols
+
+
+def _print_table(kind: str, objs: list[dict]) -> None:
+    if not objs:
+        print("No resources found.")
+        return
+    rows = [_columns(kind, o) for o in objs]
+    headers = [h for h, _ in rows[0]]
+    widths = [max(len(headers[i]), *(len(r[i][1]) for r in rows)) + 2
+              for i in range(len(headers))]
+    print("".join(h.ljust(w) for h, w in zip(headers, widths)).rstrip())
+    for r in rows:
+        print("".join(v.ljust(w) for (_h, v), w in zip(r, widths)).rstrip())
+
+
+def cmd_get(args) -> int:
+    if args.name:
+        obj = _req(args.server, "GET", f"/api/v1/{args.kind}/{args.name}")
+        if args.output == "json":
+            print(json.dumps(obj, indent=2))
+        else:
+            _print_table(args.kind, [obj])
+        return 0
+    if args.watch:
+        import urllib.request as u
+        with u.urlopen(f"{args.server}/api/v1/{args.kind}?watch=true") as resp:
+            for raw in resp:
+                line = raw.strip()
+                if line:
+                    ev = json.loads(line)
+                    print(ev["type"], json.dumps(ev["object"]))
+        return 0
+    body = _req(args.server, "GET", f"/api/v1/{args.kind}")
+    if args.output == "json":
+        print(json.dumps(body, indent=2))
+    else:
+        _print_table(args.kind, body.get("items", []))
+    return 0
+
+
+def cmd_describe(args) -> int:
+    obj = _req(args.server, "GET", f"/api/v1/{args.kind}/{args.name}")
+
+    def walk(d: Any, indent: int = 0) -> None:
+        pad = " " * indent
+        if isinstance(d, dict):
+            for k, v in d.items():
+                if isinstance(v, (dict, list)) and v:
+                    print(f"{pad}{k}:")
+                    walk(v, indent + 2)
+                else:
+                    print(f"{pad}{k}: {v}")
+        elif isinstance(d, list):
+            for v in d:
+                walk(v, indent)
+        else:
+            print(f"{pad}{d}")
+    walk(obj)
+    # events for the object, like kubectl describe's Events: block
+    key = obj.get("namespace", "") and \
+        f"{obj['namespace']}/{obj['name']}" or obj.get("name", "")
+    evs = _req(args.server, "GET", "/api/v1/events").get("items", [])
+    mine = [e for e in evs if e.get("involved_key") == key]
+    if mine:
+        print("events:")
+        for e in mine:
+            print(f"  {e['type']}\t{e['reason']}\tx{e.get('count', 1)}\t"
+                  f"{e['message']}")
+    return 0
+
+
+def cmd_create(args) -> int:
+    with open(args.filename) as f:
+        manifest = json.load(f)
+    items = manifest.get("items", [manifest]) \
+        if isinstance(manifest, dict) else manifest
+    for item in items:
+        kind = item.pop("kind", None) or args.kind
+        if not kind:
+            print("manifest item missing 'kind'", file=sys.stderr)
+            return 1
+        created = _req(args.server, "POST", f"/api/v1/{kind}", item)
+        name = created.get("name", "?")
+        print(f"{kind}/{name} created")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    _req(args.server, "DELETE", f"/api/v1/{args.kind}/{args.name}")
+    print(f"{args.kind}/{args.name} deleted")
+    return 0
+
+
+def _patch_node(server: str, name: str, **fields) -> dict:
+    node = _req(server, "GET", f"/api/v1/nodes/{name}")
+    node.update(fields)
+    return _req(server, "PUT", f"/api/v1/nodes/{name}", node)
+
+
+def cmd_cordon(args) -> int:
+    _patch_node(args.server, args.name, unschedulable=True)
+    print(f"node/{args.name} cordoned")
+    return 0
+
+
+def cmd_uncordon(args) -> int:
+    _patch_node(args.server, args.name, unschedulable=False)
+    print(f"node/{args.name} uncordoned")
+    return 0
+
+
+def cmd_drain(args) -> int:
+    _patch_node(args.server, args.name, unschedulable=True)
+    pods = _req(args.server, "GET", "/api/v1/pods").get("items", [])
+    for p in pods:
+        if p.get("node_name") == args.name:
+            key = f"{p['namespace']}/{p['name']}"
+            _req(args.server, "DELETE", f"/api/v1/pods/{key}")
+            print(f"pod/{key} evicted")
+    print(f"node/{args.name} drained")
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="kubectl-tpu")
+    ap.add_argument("--server", "-s", default=DEFAULT_SERVER)
+    sub = ap.add_subparsers(dest="verb", required=True)
+
+    g = sub.add_parser("get")
+    g.add_argument("kind")
+    g.add_argument("name", nargs="?")
+    g.add_argument("-o", "--output", choices=["table", "wide", "json"],
+                   default="table")
+    g.add_argument("-w", "--watch", action="store_true")
+    g.set_defaults(fn=cmd_get)
+
+    d = sub.add_parser("describe")
+    d.add_argument("kind")
+    d.add_argument("name")
+    d.set_defaults(fn=cmd_describe)
+
+    c = sub.add_parser("create")
+    c.add_argument("-f", "--filename", required=True)
+    c.add_argument("--kind")
+    c.set_defaults(fn=cmd_create)
+
+    rm = sub.add_parser("delete")
+    rm.add_argument("kind")
+    rm.add_argument("name")
+    rm.set_defaults(fn=cmd_delete)
+
+    for verb, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon),
+                     ("drain", cmd_drain)):
+        p = sub.add_parser(verb)
+        p.add_argument("name")
+        p.set_defaults(fn=fn)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,6 +128,11 @@ def main(argv: Optional[list[str]] = None) -> int:
                     help="write a jax.profiler trace (kernel timelines, "
                          "transfers) covering the scheduling loop — the "
                          "EnableProfiling/pprof analog (server.go:301)")
+    ap.add_argument("--api-port", type=int, default=0,
+                    help="also serve the REST apiserver surface over this "
+                         "process's store (the in-process master of "
+                         "test/integration/util/util.go:42) — kubectl-tpu "
+                         "points at it")
     args = ap.parse_args(argv)
 
     cfg = build_config(args)
@@ -137,6 +142,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     sched = create_scheduler(store, cfg)
     sched.sync()
     server = serve_http(sched, cfg, args.port) if args.port else None
+    api_server = None
+    if args.api_port:
+        from kubernetes_tpu.apiserver.server import APIServer
+        api_server = APIServer(store, port=args.api_port).start()
     profiler = None
     if args.profile_dir:
         from kubernetes_tpu.utils.tracing import Profiler
@@ -193,6 +202,8 @@ def main(argv: Optional[list[str]] = None) -> int:
                           "errors": attempts["error"]}))
     if server:
         server.shutdown()
+    if api_server is not None:
+        api_server.stop()
     return 0
 
 

@@ -1,0 +1,187 @@
+"""REST apiserver + admission + kubectl: the user-facing API surface
+(reference: staging/src/k8s.io/apiserver, plugin/pkg/admission/priority,
+cmd/kubectl)."""
+import io
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Pod, Node, Container, PriorityClass, Affinity, PodAntiAffinity,
+    PodAffinityTerm, LabelSelector, Taint, Toleration, LABEL_HOSTNAME,
+    NO_SCHEDULE,
+)
+from kubernetes_tpu.api import serde
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.store.store import (
+    Store, PODS, NODES, PRIORITYCLASSES,
+)
+
+GI = 1024 ** 3
+
+
+@pytest.fixture()
+def server():
+    store = Store()
+    with APIServer(store) as srv:
+        yield store, srv.url
+
+
+def req(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    r = urllib.request.Request(url, data=data, method=method,
+                               headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class TestSerde:
+    def test_pod_round_trip_with_nested_spec(self):
+        pod = Pod(name="p", labels={"a": "b"},
+                  node_selector={"zone": "z1"},
+                  affinity=Affinity(pod_anti_affinity=PodAntiAffinity(
+                      required=(PodAffinityTerm(
+                          label_selector=LabelSelector(
+                              match_labels=(("a", "b"),)),
+                          topology_key=LABEL_HOSTNAME),))),
+                  tolerations=(Toleration(key="k", value="v",
+                                          effect=NO_SCHEDULE,
+                                          toleration_seconds=5.0),),
+                  containers=(Container.make(
+                      name="c", requests={"cpu": 100, "memory": GI}),))
+        d = serde.to_dict(pod)
+        back = serde.from_dict(PODS, json.loads(json.dumps(d)))
+        assert back == pod
+
+    def test_node_round_trip(self):
+        node = Node(name="n", labels={"z": "1"},
+                    taints=(Taint(key="k", effect=NO_SCHEDULE),),
+                    allocatable={"cpu": 4000, "memory": GI, "pods": 110})
+        back = serde.from_dict(NODES, json.loads(json.dumps(
+            serde.to_dict(node))))
+        assert back == node
+
+
+class TestRESTSurface:
+    def test_crud_and_binding(self, server):
+        store, url = server
+        with urllib.request.urlopen(f"{url}/healthz") as resp:
+            assert resp.status == 200 and resp.read() == b"ok"
+        st, created = req(f"{url}/api/v1/nodes", "POST", serde.to_dict(Node(
+            name="n0", allocatable={"cpu": 4000, "memory": GI, "pods": 10})))
+        assert st == 201 and created["resource_version"] > 0
+        st, created = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p0", containers=(Container.make(
+                name="c", requests={"cpu": 100}),))))
+        assert st == 201
+        st, _ = req(f"{url}/api/v1/pods/default/p0/binding", "POST",
+                    {"node": "n0"})
+        assert st == 201
+        st, got = req(f"{url}/api/v1/pods/default/p0")
+        assert got["node_name"] == "n0"
+        st, lst = req(f"{url}/api/v1/pods")
+        assert len(lst["items"]) == 1 and lst["resourceVersion"] > 0
+        st, _ = req(f"{url}/api/v1/pods/default/p0", "DELETE")
+        assert st == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(f"{url}/api/v1/pods/default/p0")
+        assert e.value.code == 404
+
+    def test_update_conflict(self, server):
+        store, url = server
+        _, created = req(f"{url}/api/v1/nodes", "POST",
+                         serde.to_dict(Node(name="n0")))
+        stale = dict(created)
+        created["unschedulable"] = True
+        st, _ = req(f"{url}/api/v1/nodes/n0", "PUT", created)
+        assert st == 200
+        stale["unschedulable"] = False
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(f"{url}/api/v1/nodes/n0", "PUT", stale)
+        assert e.value.code == 409
+
+    def test_watch_stream(self, server):
+        store, url = server
+        got = []
+        done = threading.Event()
+
+        def watcher():
+            with urllib.request.urlopen(
+                    f"{url}/api/v1/pods?watch=true") as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if line:
+                        got.append(json.loads(line))
+                        if len(got) >= 2:
+                            done.set()
+                            return
+
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        import time
+        time.sleep(0.2)
+        store.create(PODS, Pod(name="w0"))
+        store.delete(PODS, "default/w0")
+        assert done.wait(5), f"watch delivered {got}"
+        assert [e["type"] for e in got] == ["ADDED", "DELETED"]
+        assert got[0]["object"]["name"] == "w0"
+
+    def test_priority_admission(self, server):
+        store, url = server
+        req(f"{url}/api/v1/priorityclasses", "POST",
+            serde.to_dict(PriorityClass(name="high", value=1000)))
+        req(f"{url}/api/v1/priorityclasses", "POST",
+            serde.to_dict(PriorityClass(name="base", value=7,
+                                        global_default=True)))
+        _, p = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p1", priority_class_name="high")))
+        assert p["priority"] == 1000
+        _, p = req(f"{url}/api/v1/pods", "POST",
+                   serde.to_dict(Pod(name="p2")))
+        assert p["priority"] == 7 and p["priority_class_name"] == "base"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+                name="p3", priority_class_name="nope")))
+        assert e.value.code == 422
+
+
+class TestKubectl:
+    def _run(self, url, *argv):
+        import contextlib
+        from kubernetes_tpu.cmd import kubectl
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = kubectl.main(["--server", url, *argv])
+        assert rc == 0
+        return out.getvalue()
+
+    def test_get_describe_delete_drain(self, server, tmp_path):
+        store, url = server
+        store.create(NODES, Node(
+            name="n0", allocatable={"cpu": 4000, "memory": GI, "pods": 10}))
+        manifest = {"items": [
+            {"kind": "pods", "name": "web-1", "labels": {"app": "web"},
+             "containers": [{"name": "c",
+                             "requests": [["cpu", 100]]}]},
+        ]}
+        f = tmp_path / "m.json"
+        f.write_text(json.dumps(manifest))
+        out = self._run(url, "create", "-f", str(f))
+        assert "pods/web-1 created" in out
+        store.bind_pod("default/web-1", "n0")
+        out = self._run(url, "get", "pods")
+        assert "web-1" in out and "n0" in out
+        out = self._run(url, "get", "nodes")
+        assert "n0" in out and "Ready" in out
+        out = self._run(url, "describe", "pods", "default/web-1")
+        assert "node_name: n0" in out
+        out = self._run(url, "cordon", "n0")
+        assert "cordoned" in out
+        assert store.get(NODES, "n0").unschedulable
+        out = self._run(url, "drain", "n0")
+        assert "pod/default/web-1 evicted" in out
+        assert not store.list(PODS)[0]
+        out = self._run(url, "uncordon", "n0")
+        assert not store.get(NODES, "n0").unschedulable
