@@ -276,9 +276,15 @@ class InterPodAffinityChecker:
 
     Like the reference's predicate metadata (predicates/metadata.go:71), the
     cluster-wide scans run once per incoming pod, producing topology-pair
-    sets; the per-node check is then O(terms) label lookups. This is also the
-    shape the device kernel consumes: per-term topology-value sets become
-    dictionary-encoded masks over the node axis.
+    COUNTS; the per-node check is then O(terms) label lookups. This is also
+    the shape the device kernel consumes: per-term topology-value sets
+    become dictionary-encoded masks over the node axis.
+
+    Counts (not sets) make the metadata INCREMENTAL: preemption's reprieve
+    loop and the nominated-ghost two-pass mutate one pod at a time and call
+    add_pod/remove_pod — the reference's meta.AddPod/RemovePod
+    (metadata.go:210/:239) — instead of recomputing the cluster scan per
+    fit check.
     """
 
     def __init__(self, node_infos: dict[str, NodeInfo]):
@@ -287,12 +293,42 @@ class InterPodAffinityChecker:
         self._meta = None
 
     def invalidate(self) -> None:
-        """Drop the per-pod metadata cache. Callers that mutate the
-        snapshot mid-pod (nominated-ghost pass, preemption reprieve loop)
-        must call this, mirroring the reference's meta.AddPod/RemovePod
-        (predicates/metadata.go:210/:239)."""
+        """Drop the per-pod metadata cache (whole-snapshot change, or a
+        mutation the caller can't express as add_pod/remove_pod)."""
         self._meta_uid = None
         self._meta = None
+
+    # -- incremental updates (metadata.go:210 RemovePod / :239 AddPod) -------
+    def _apply_delta(self, target: Pod, other: Pod,
+                     node: Optional[Node], sign: int) -> None:
+        if self._meta is None or self._meta_uid != target.uid \
+                or node is None or other.uid == target.uid:
+            return
+        violating, aff_terms, anti_terms = self._meta
+        oa = other.affinity
+        if oa is not None and oa.pod_anti_affinity is not None:
+            for term in oa.pod_anti_affinity.required:
+                if term.topology_key in node.labels and \
+                        pod_matches_term_props(target, other, term):
+                    k = (term.topology_key, node.labels[term.topology_key])
+                    violating[k] = violating.get(k, 0) + sign
+                    if violating[k] <= 0:
+                        del violating[k]
+        for term, values, total in aff_terms + anti_terms:
+            if pod_matches_term_props(other, target, term):
+                total[0] += sign
+                if term.topology_key in node.labels:
+                    v = node.labels[term.topology_key]
+                    values[v] = values.get(v, 0) + sign
+                    if values[v] <= 0:
+                        del values[v]
+
+    def add_pod(self, target: Pod, other: Pod, node: Optional[Node]) -> None:
+        self._apply_delta(target, other, node, 1)
+
+    def remove_pod(self, target: Pod, other: Pod,
+                   node: Optional[Node]) -> None:
+        self._apply_delta(target, other, node, -1)
 
     def _node_of(self, pod: Pod) -> Optional[Node]:
         ni = self.node_infos.get(pod.node_name)
@@ -301,9 +337,9 @@ class InterPodAffinityChecker:
     def _metadata(self, pod: Pod):
         if self._meta_uid == pod.uid:
             return self._meta
-        # (a) Existing pods' required anti-affinity: every (topologyKey, value)
-        # the incoming pod would violate by landing in that topology.
-        violating: set[tuple[str, str]] = set()
+        # (a) Existing pods' required anti-affinity: count of entries per
+        # (topologyKey, value) the incoming pod would violate.
+        violating: dict[tuple[str, str], int] = {}
         for ni in self.node_infos.values():
             for existing in ni.pods_with_affinity:
                 ea = existing.affinity
@@ -315,22 +351,25 @@ class InterPodAffinityChecker:
                 for term in ea.pod_anti_affinity.required:
                     if term.topology_key in e_node.labels and \
                             pod_matches_term_props(pod, existing, term):
-                        violating.add((term.topology_key,
-                                       e_node.labels[term.topology_key]))
+                        k = (term.topology_key,
+                             e_node.labels[term.topology_key])
+                        violating[k] = violating.get(k, 0) + 1
 
-        # (b) The pod's own required terms: per term, the set of topology
-        # values hosting a matching pod, plus whether any match exists at all.
-        def term_values(term) -> tuple[set[str], bool]:
-            values: set[str] = set()
-            exists = False
+        # (b) The pod's own required terms: per term, matching-pod counts by
+        # topology value plus the total match count ([mutable] so deltas
+        # apply in place).
+        def term_values(term) -> tuple[dict[str, int], list[int]]:
+            values: dict[str, int] = {}
+            total = [0]
             for ni in self.node_infos.values():
                 for existing in ni.pods:
                     if pod_matches_term_props(existing, pod, term):
-                        exists = True
+                        total[0] += 1
                         e_node = self._node_of(existing)
                         if e_node is not None and term.topology_key in e_node.labels:
-                            values.add(e_node.labels[term.topology_key])
-            return values, exists
+                            v = e_node.labels[term.topology_key]
+                            values[v] = values.get(v, 0) + 1
+            return values, total
 
         a = pod.affinity
         aff_terms = []
@@ -355,16 +394,16 @@ class InterPodAffinityChecker:
                 return False, [ERR_POD_AFFINITY_NOT_MATCH,
                                ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH]
         # 2. The pod's own required affinity/anti-affinity.
-        for term, values, exists in aff_terms:
+        for term, values, total in aff_terms:
             if labels.get(term.topology_key) not in values:
                 # First-pod-in-cluster rule (reference: predicates.go:1454-1464):
                 # if no pod anywhere matches the term, the term is waived when
                 # the pod matches its own term (it would otherwise never schedule).
-                if not exists and pod_matches_term_props(pod, pod, term):
+                if total[0] == 0 and pod_matches_term_props(pod, pod, term):
                     continue
                 return False, [ERR_POD_AFFINITY_NOT_MATCH,
                                ERR_POD_AFFINITY_RULES_NOT_MATCH]
-        for term, values, _ in anti_terms:
+        for term, values, _total in anti_terms:
             if labels.get(term.topology_key) in values:
                 return False, [ERR_POD_AFFINITY_NOT_MATCH,
                                ERR_POD_ANTI_AFFINITY_RULES_NOT_MATCH]

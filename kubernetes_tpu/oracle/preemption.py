@@ -88,15 +88,22 @@ def pods_violating_pdbs(pods: list[Pod],
 
 def select_victims_on_node(pod: Pod, node_info: NodeInfo,
                            fits_fn: Callable[[Pod, NodeInfo], bool],
-                           pdbs: list[PodDisruptionBudget]) -> Optional[Victims]:
+                           pdbs: list[PodDisruptionBudget],
+                           checker=None) -> Optional[Victims]:
     """Reference: :1054. `fits_fn` runs the predicate suite against a
     *mutated copy* of the node (the caller passes podFitsOnNode bound to the
-    predicate set). Returns None when preemption can't help on this node."""
+    predicate set). `checker` is the inter-pod-affinity metadata handle:
+    every mutation mirrors into it incrementally (meta.RemovePod/AddPod,
+    :1068-1078) instead of invalidating the cluster scan per fit check.
+    Returns None when preemption can't help on this node."""
     ni = node_info.clone()
+    node = ni.node
     # remove all lower-priority pods
     potential = [p for p in ni.pods if p.priority < pod.priority]
     for p in list(potential):
         ni.remove_pod(p)
+        if checker is not None:
+            checker.remove_pod(pod, p, node)
     if not fits_fn(pod, ni):
         return None
     # reprieve loop: PDB-violating victims get re-added first (so we prefer
@@ -110,9 +117,13 @@ def select_victims_on_node(pod: Pod, node_info: NodeInfo,
 
     def reprieve(p: Pod) -> bool:
         ni.add_pod(p)
+        if checker is not None:
+            checker.add_pod(pod, p, node)
         if fits_fn(pod, ni):
             return True
         ni.remove_pod(p)
+        if checker is not None:
+            checker.remove_pod(pod, p, node)
         return False
 
     for p in violating:
@@ -237,22 +248,22 @@ class Preemptor:
                                   _scratch=scratch, _funcs=funcs,
                                   _checker=checker) -> bool:
                 _scratch[_name] = mutated
-                if _checker is not None:
-                    _checker.invalidate()
                 try:
                     # the reference passes the scheduling queue into
                     # selectVictimsOnNode (:985), so victim fitting runs the
                     # nominated-ghost two-pass too — otherwise two preemptors
-                    # can nominate the same node with zero victims, live-locking
+                    # can nominate the same node with zero victims, live-locking.
+                    # The affinity metadata tracks victim mutations
+                    # incrementally (select_victims_on_node's checker hooks),
+                    # so no invalidation here.
                     ok, _ = pod_fits_on_node_with_nominated(
                         p, mutated, _funcs, nominated_pods_fn,
                         node_infos=_scratch)
                     return ok
                 finally:
                     _scratch[_name] = node_infos[_name]
-                    if _checker is not None:
-                        _checker.invalidate()
-            v = select_victims_on_node(pod, ni, fits_with_scratch, pdbs)
+            v = select_victims_on_node(pod, ni, fits_with_scratch, pdbs,
+                                       checker=checker)
             if v is not None:
                 nodes_to_victims[name] = v
         # extender preemption veto/trim (generic_scheduler.go:347)
@@ -299,18 +310,22 @@ def pod_fits_on_node_with_nominated(
         return preds.pod_fits_on_node(pod, node_info, predicate_funcs,
                                       always_check_all)
     checker = predicate_funcs.get("_ipa_checker")
-    # pass 1: with nominated pods
+    # pass 1: with nominated pods (the affinity metadata takes the ghosts
+    # as incremental AddPod deltas, removed again for pass 2 — meta.AddPod
+    # semantics, :627)
     ni = node_info.clone()
+    ghosts = []
     for p in nominated:
         ghost = copy.copy(p)
         ghost.node_name = node_name
         ni.add_pod(ghost)
+        ghosts.append(ghost)
+        if checker is not None:
+            checker.add_pod(pod, ghost, ni.node)
     swapped = node_infos is not None and node_name in node_infos
     if swapped:
         original = node_infos[node_name]
         node_infos[node_name] = ni
-    if checker is not None:
-        checker.invalidate()
     try:
         fit, reasons = preds.pod_fits_on_node(pod, ni, predicate_funcs,
                                               always_check_all)
@@ -318,7 +333,8 @@ def pod_fits_on_node_with_nominated(
         if swapped:
             node_infos[node_name] = original
         if checker is not None:
-            checker.invalidate()
+            for ghost in ghosts:
+                checker.remove_pod(pod, ghost, ni.node)
     if not fit:
         return fit, reasons
     # pass 2: without
