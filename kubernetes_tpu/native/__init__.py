@@ -1,0 +1,60 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The compute path is JAX/XLA; the runtime around it follows the reference's
+stance of natively-compiled infrastructure (the reference is Go throughout).
+Components live here as single-file CPython extensions compiled lazily into
+this directory (no pip, no network): `load(name)` rebuilds when the source
+is newer than the cached .so and returns None on ANY failure — every
+consumer keeps a pure-Python twin with identical semantics, so a missing
+toolchain degrades performance, never behavior.
+"""
+from __future__ import annotations
+
+import importlib.machinery
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict[str, object] = {}
+
+
+def _so_path(name: str) -> str:
+    tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_DIR, f"_{name}{tag}")
+
+
+def _build(name: str) -> str:
+    src = os.path.join(_DIR, f"{name}.cpp")
+    out = _so_path(name)
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           f"-I{include}", src, "-o", out]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    return out
+
+
+def load(name: str):
+    """Import native module `_name`, building it first if needed.
+    Returns the module, or None when building/loading fails."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        mod = None
+        try:
+            path = _build(name)
+            loader = importlib.machinery.ExtensionFileLoader(f"_{name}", path)
+            spec = importlib.util.spec_from_file_location(
+                f"_{name}", path, loader=loader)
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+        except Exception:
+            mod = None
+        _cache[name] = mod
+        return mod

@@ -1,0 +1,245 @@
+// heapcore — native keyed binary heap for the scheduling queue.
+//
+// The scheduler's activeQ/backoffQ (reference: pkg/scheduler/util/heap.go:127,
+// a Go — natively compiled — keyed heap) order by purely NUMERIC tuples:
+// activeQ by (-priority, timestamp, seq) (scheduling_queue.go:107 podsCompare)
+// and backoffQ by (expiry, seq). The Python twin (utils/heap.py) pays a
+// key-lambda + tuple allocation per comparison; this CPython extension keeps
+// the (a, b, c) ordering keys unboxed in a contiguous vector and sifts in
+// C++, holding the payload as an opaque PyObject*. Loaded on demand by
+// kubernetes_tpu.native (g++ build, no pip); utils/heap.NumericKeyedHeap
+// falls back to the Python twin when unavailable — identical semantics
+// either way (tests run both).
+//
+// Doubles hold every ordering component exactly: priorities are int32,
+// timestamps are seconds-as-float, and seq counters stay far below 2^53.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Entry {
+    double a, b, c;
+    std::string key;
+    PyObject* payload;   // owned reference
+};
+
+inline bool less(const Entry& x, const Entry& y) {
+    if (x.a != y.a) return x.a < y.a;
+    if (x.b != y.b) return x.b < y.b;
+    return x.c < y.c;
+}
+
+struct HeapCore {
+    PyObject_HEAD
+    std::vector<Entry>* items;
+    std::unordered_map<std::string, size_t>* index;
+};
+
+void set_pos(HeapCore* self, size_t i) {
+    (*self->index)[(*self->items)[i].key] = i;
+}
+
+void swap_entries(HeapCore* self, size_t i, size_t j) {
+    std::swap((*self->items)[i], (*self->items)[j]);
+    set_pos(self, i);
+    set_pos(self, j);
+}
+
+size_t sift_up(HeapCore* self, size_t i) {
+    auto& v = *self->items;
+    while (i > 0) {
+        size_t parent = (i - 1) / 2;
+        if (less(v[i], v[parent])) {
+            swap_entries(self, i, parent);
+            i = parent;
+        } else {
+            break;
+        }
+    }
+    return i;
+}
+
+void sift_down(HeapCore* self, size_t i) {
+    auto& v = *self->items;
+    size_t n = v.size();
+    for (;;) {
+        size_t smallest = i;
+        for (size_t c = 2 * i + 1; c <= 2 * i + 2 && c < n; ++c) {
+            if (less(v[c], v[smallest])) smallest = c;
+        }
+        if (smallest == i) return;
+        swap_entries(self, i, smallest);
+        i = smallest;
+    }
+}
+
+// returns an owned reference to the removed payload, or nullptr (no error)
+PyObject* remove_at(HeapCore* self, size_t i) {
+    auto& v = *self->items;
+    PyObject* payload = v[i].payload;
+    self->index->erase(v[i].key);
+    size_t last = v.size() - 1;
+    if (i != last) {
+        v[i] = std::move(v[last]);
+        v.pop_back();
+        set_pos(self, i);
+        sift_down(self, sift_up(self, i));
+    } else {
+        v.pop_back();
+    }
+    return payload;
+}
+
+PyObject* heap_add(HeapCore* self, PyObject* args) {
+    const char* key;
+    Py_ssize_t klen;
+    double a, b, c;
+    PyObject* payload;
+    if (!PyArg_ParseTuple(args, "s#dddO", &key, &klen, &a, &b, &c, &payload))
+        return nullptr;
+    std::string k(key, (size_t)klen);
+    Py_INCREF(payload);
+    auto it = self->index->find(k);
+    if (it != self->index->end()) {
+        Entry& e = (*self->items)[it->second];
+        Py_DECREF(e.payload);
+        e.a = a; e.b = b; e.c = c;
+        e.payload = payload;
+        sift_down(self, sift_up(self, it->second));
+    } else {
+        self->items->push_back(Entry{a, b, c, k, payload});
+        size_t i = self->items->size() - 1;
+        (*self->index)[k] = i;
+        sift_up(self, i);
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject* heap_get(HeapCore* self, PyObject* arg) {
+    Py_ssize_t klen;
+    const char* key = PyUnicode_AsUTF8AndSize(arg, &klen);
+    if (!key) return nullptr;
+    auto it = self->index->find(std::string(key, (size_t)klen));
+    if (it == self->index->end()) Py_RETURN_NONE;
+    PyObject* p = (*self->items)[it->second].payload;
+    Py_INCREF(p);
+    return p;
+}
+
+PyObject* heap_delete(HeapCore* self, PyObject* arg) {
+    Py_ssize_t klen;
+    const char* key = PyUnicode_AsUTF8AndSize(arg, &klen);
+    if (!key) return nullptr;
+    auto it = self->index->find(std::string(key, (size_t)klen));
+    if (it == self->index->end()) Py_RETURN_NONE;
+    return remove_at(self, it->second);
+}
+
+PyObject* heap_pop(HeapCore* self, PyObject*) {
+    if (self->items->empty()) Py_RETURN_NONE;
+    return remove_at(self, 0);
+}
+
+PyObject* heap_peek(HeapCore* self, PyObject*) {
+    if (self->items->empty()) Py_RETURN_NONE;
+    PyObject* p = (*self->items)[0].payload;
+    Py_INCREF(p);
+    return p;
+}
+
+PyObject* heap_list(HeapCore* self, PyObject*) {
+    PyObject* out = PyList_New((Py_ssize_t)self->items->size());
+    if (!out) return nullptr;
+    for (size_t i = 0; i < self->items->size(); ++i) {
+        PyObject* p = (*self->items)[i].payload;
+        Py_INCREF(p);
+        PyList_SET_ITEM(out, (Py_ssize_t)i, p);
+    }
+    return out;
+}
+
+int heap_contains(HeapCore* self, PyObject* arg) {
+    Py_ssize_t klen;
+    const char* key = PyUnicode_AsUTF8AndSize(arg, &klen);
+    if (!key) {
+        PyErr_Clear();
+        return 0;
+    }
+    return self->index->count(std::string(key, (size_t)klen)) ? 1 : 0;
+}
+
+Py_ssize_t heap_len(HeapCore* self) {
+    return (Py_ssize_t)self->items->size();
+}
+
+PyObject* heap_new(PyTypeObject* type, PyObject*, PyObject*) {
+    HeapCore* self = (HeapCore*)type->tp_alloc(type, 0);
+    if (!self) return nullptr;
+    self->items = new std::vector<Entry>();
+    self->index = new std::unordered_map<std::string, size_t>();
+    return (PyObject*)self;
+}
+
+void heap_dealloc(HeapCore* self) {
+    if (self->items) {
+        for (Entry& e : *self->items) Py_XDECREF(e.payload);
+        delete self->items;
+        delete self->index;
+    }
+    Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyMethodDef heap_methods[] = {
+    {"add", (PyCFunction)heap_add, METH_VARARGS,
+     "add(key, a, b, c, payload) — insert or replace by key"},
+    {"get", (PyCFunction)heap_get, METH_O, "payload by key or None"},
+    {"delete", (PyCFunction)heap_delete, METH_O,
+     "remove by key, returning the payload or None"},
+    {"pop", (PyCFunction)heap_pop, METH_NOARGS, "remove + return the min"},
+    {"peek", (PyCFunction)heap_peek, METH_NOARGS, "the min without removal"},
+    {"list", (PyCFunction)heap_list, METH_NOARGS, "payloads, heap order"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PySequenceMethods heap_as_sequence = {
+    .sq_length = (lenfunc)heap_len,
+    .sq_contains = (objobjproc)heap_contains,
+};
+
+PyTypeObject HeapCoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    .tp_name = "_heapcore.HeapCore",
+    .tp_basicsize = sizeof(HeapCore),
+    .tp_dealloc = (destructor)heap_dealloc,
+    .tp_as_sequence = &heap_as_sequence,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = PyDoc_STR("string-keyed binary heap over numeric (a,b,c)"),
+    .tp_methods = heap_methods,
+    .tp_new = heap_new,
+};
+
+PyModuleDef heapcore_module = {
+    PyModuleDef_HEAD_INIT, "_heapcore",
+    "native scheduling-queue heap core", -1, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__heapcore(void) {
+    if (PyType_Ready(&HeapCoreType) < 0) return nullptr;
+    PyObject* m = PyModule_Create(&heapcore_module);
+    if (!m) return nullptr;
+    Py_INCREF(&HeapCoreType);
+    if (PyModule_AddObject(m, "HeapCore", (PyObject*)&HeapCoreType) < 0) {
+        Py_DECREF(&HeapCoreType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

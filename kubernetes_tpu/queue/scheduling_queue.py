@@ -20,7 +20,7 @@ from typing import Optional
 
 from kubernetes_tpu.api.types import Pod, has_pod_affinity_terms
 from kubernetes_tpu.utils.clock import Clock, RealClock
-from kubernetes_tpu.utils.heap import KeyedHeap
+from kubernetes_tpu.utils.heap import KeyedHeap, NumericKeyedHeap
 
 INITIAL_BACKOFF = 1.0          # seconds (scheduling_queue.go:184)
 MAX_BACKOFF = 10.0
@@ -111,13 +111,14 @@ class PriorityQueue:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._seq = itertools.count()
-        self._active = KeyedHeap(
+        # both orderings are numeric triples -> native heap core when built
+        # (utils/heap.NumericKeyedHeap; Python twin otherwise)
+        self._active = NumericKeyedHeap(
             key_fn=lambda q: q.pod.key,
-            less_fn=lambda a, b: (
-                (-a.pod.priority, a.timestamp, a.seq) < (-b.pod.priority, b.timestamp, b.seq)))
-        self._backoffq = KeyedHeap(
+            triple_fn=lambda q: (-q.pod.priority, q.timestamp, q.seq))
+        self._backoffq = NumericKeyedHeap(
             key_fn=lambda q: q.pod.key,
-            less_fn=lambda a, b: (a.expiry, a.seq) < (b.expiry, b.seq))
+            triple_fn=lambda q: (q.expiry, q.seq, 0.0))
         self._unschedulable: dict[str, _QueuedPod] = {}
         self._backoff = PodBackoffMap(initial_backoff, max_backoff)
         self.unschedulable_timeout = unschedulable_timeout

@@ -3,6 +3,12 @@
 Mirrors the semantics of the reference's scheduler heap
 (pkg/scheduler/util/heap.go:127): items are keyed objects ordered by an
 arbitrary less-function; Add/Update re-sift in place, Delete removes by key.
+
+`NumericKeyedHeap` is the hot-path variant for orderings expressible as a
+numeric (a, b, c) triple — both scheduler queues are (scheduling_queue.go
+podsCompare and the backoff expiry) — backed by the C++ core in
+kubernetes_tpu/native/heapcore.cpp when it builds, with this module's
+Python heap as the behavioral twin otherwise.
 """
 from __future__ import annotations
 
@@ -96,3 +102,52 @@ class KeyedHeap:
                 return i
             self._swap(i, smallest)
             i = smallest
+
+
+class NumericKeyedHeap:
+    """KeyedHeap specialization: ordering = ascending numeric triple.
+    Uses the native core when available; falls back to KeyedHeap."""
+
+    def __new__(cls, key_fn: Callable[[Any], str],
+                triple_fn: Callable[[Any], tuple]):
+        from kubernetes_tpu import native
+        core_mod = native.load("heapcore")
+        if core_mod is None:
+            return KeyedHeap(key_fn,
+                             lambda x, y: triple_fn(x) < triple_fn(y))
+        self = super().__new__(cls)
+        self._key_fn = key_fn
+        self._triple = triple_fn
+        self._core = core_mod.HeapCore()
+        return self
+
+    def __len__(self) -> int:
+        return len(self._core)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._core
+
+    def get(self, key: str) -> Optional[Any]:
+        return self._core.get(key)
+
+    def list(self) -> list[Any]:
+        return self._core.list()
+
+    def add(self, item: Any) -> None:
+        a, b, c = self._triple(item)
+        self._core.add(self._key_fn(item), float(a), float(b), float(c), item)
+
+    update = add
+
+    def add_if_not_present(self, item: Any) -> None:
+        if self._key_fn(item) not in self._core:
+            self.add(item)
+
+    def delete(self, key: str) -> Optional[Any]:
+        return self._core.delete(key)
+
+    def peek(self) -> Optional[Any]:
+        return self._core.peek()
+
+    def pop(self) -> Optional[Any]:
+        return self._core.pop()

@@ -459,3 +459,44 @@ class TestReviewRegressions:
         clock.step(31)  # past sweep interval + expiry
         q.flush()
         assert "default/p2" not in q._backoff._attempts
+
+
+class TestNativeHeapParity:
+    """The C++ heap core and the Python twin must agree operation-for-
+    operation (kubernetes_tpu/native/heapcore.cpp vs utils/heap.KeyedHeap)."""
+
+    def test_randomized_op_parity(self):
+        import random
+        from kubernetes_tpu.utils.heap import KeyedHeap, NumericKeyedHeap
+        rng = random.Random(7)
+        key_fn = lambda it: it[0]
+        triple = lambda it: (it[1], it[2], it[3])
+        py = KeyedHeap(key_fn, lambda a, b: triple(a) < triple(b))
+        nat = NumericKeyedHeap(key_fn, triple)
+        keys = [f"k{i}" for i in range(40)]
+        for step in range(2000):
+            op = rng.random()
+            if op < 0.5:
+                item = (rng.choice(keys), rng.randint(-5, 5),
+                        rng.random(), step)
+                py.add(item)
+                nat.add(item)
+            elif op < 0.7:
+                k = rng.choice(keys)
+                assert (py.delete(k) is None) == (nat.delete(k) is None)
+            elif op < 0.9:
+                assert py.pop() == nat.pop()
+            else:
+                assert py.peek() == nat.peek()
+            assert len(py) == len(nat)
+            k = rng.choice(keys)
+            assert (k in py) == (k in nat)
+            assert py.get(k) == nat.get(k)
+        while len(py):
+            assert py.pop() == nat.pop()
+
+    def test_native_core_loads(self):
+        # the build toolchain is part of the environment contract; surface
+        # a loud failure if the native path silently regressed
+        from kubernetes_tpu import native
+        assert native.load("heapcore") is not None
