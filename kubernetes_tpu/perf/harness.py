@@ -82,7 +82,11 @@ def setup(cfg: PerfConfig) -> tuple[Store, Scheduler]:
         # reference: NewLabelNodePrepareStrategy(LabelZoneFailureDomain,
         # "zone1") — one zone spanning the whole cluster
         node_st.zones = 1
-    existing = ([_pod_strategy(cfg, cfg.existing_pods, "existing")]
+    # "The setup strategy creates pods with no affinity rules"
+    # (scheduler_bench_test.go:68,93): existing pods are PLAIN regardless of
+    # the measured workload's shape
+    existing = ([PodStrategy(count=cfg.existing_pods, name_prefix="existing",
+                             labels={"app": "setup"})]
                 if cfg.existing_pods else [])
     populate_store(store, [node_st], existing)
     sched = Scheduler(store, use_tpu=cfg.use_tpu,
