@@ -84,8 +84,48 @@ class NodeLifecycleController:
             self._dirty_nodes.add(n.name)
         self.reconcile_dirty()
 
+    # a node whose lease heartbeat is this stale reads Ready=Unknown
+    # (reference: node-monitor-grace-period, 40s default)
+    NODE_MONITOR_GRACE = 40.0
+
+    def monitor_node_health(self) -> None:
+        """monitorNodeHealth analog: grade nodes whose kubelet heartbeat
+        (node Lease renewal) has gone silent past the grace period as
+        Ready=Unknown; the condition->taint pass then isolates them. The
+        kubelet's own heartbeat restores Ready=True on recovery."""
+        from kubernetes_tpu.store.store import LEASES
+        from kubernetes_tpu.api.types import NodeCondition
+        now = self.clock.now()
+        leases = {l.holder: l for l in self.store.list(LEASES)[0]
+                  if l.name.startswith("node-")}
+        for node in self.store.list(NODES)[0]:
+            lease = leases.get(node.name)
+            if lease is None:
+                continue   # never heartbeated: static fixture node
+            status = _ready_status(node)
+            if now - lease.renew_time <= self.NODE_MONITOR_GRACE:
+                continue
+            if status == "Unknown":
+                continue
+
+            def grade(cur):
+                conds = [c for c in cur.conditions if c.type != "Ready"]
+                conds.append(NodeCondition(type="Ready", status="Unknown"))
+                cur.conditions = tuple(conds)
+                return cur
+            try:
+                self.store.guaranteed_update(NODES, node.name, grade)
+            except NotFoundError:
+                continue
+            self.recorder.event(
+                "Node", node.name, NORMAL, "NodeNotReady",
+                f"Node {node.name} hasn't heartbeated in "
+                f"{now - lease.renew_time:.0f}s")
+            self._dirty_nodes.add(node.name)
+
     def pump(self) -> int:
         self.informers.pump_all()
+        self.monitor_node_health()
         # bounded-toleration evictions fire on time, not on events
         for name in list(self._noexec_since):
             self._dirty_nodes.add(name)

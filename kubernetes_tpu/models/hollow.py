@@ -149,3 +149,56 @@ def populate_store(store: Store, node_strategies: Iterable[NodeStrategy],
             placed += 1
         pidx += ps.count
     return len(all_nodes), placed
+
+
+class HollowKubelet:
+    """Hollow node agent — pkg/kubemark/hollow_kubelet.go:44 plus the node
+    heartbeat the real kubelet performs (NodeLease renewal + Ready status,
+    pkg/kubelet nodelease/nodestatus): each heartbeat() CASes the node's
+    Lease record and asserts Ready=True on the Node through the store.
+    `stop()` silences it — the failure-injection switch: the node-lifecycle
+    controller's health monitor then grades the node Unknown, taints it,
+    and evicts its pods."""
+
+    def __init__(self, store: Store, node_name: str, clock=None):
+        from kubernetes_tpu.utils.clock import RealClock
+        self.store = store
+        self.node_name = node_name
+        self.clock = clock or RealClock()
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def heartbeat(self) -> None:
+        if self._stopped:
+            return
+        from kubernetes_tpu.api.types import NodeCondition
+        from kubernetes_tpu.utils.leader_election import Lease
+        from kubernetes_tpu.store.store import LEASES, NotFoundError
+        now = self.clock.now()
+        lease_key = f"node-{self.node_name}"
+        try:
+            def renew(lease):
+                lease.holder = self.node_name
+                lease.renew_time = now
+                return lease
+            self.store.guaranteed_update(LEASES, lease_key, renew)
+        except NotFoundError:
+            self.store.create(LEASES, Lease(
+                name=lease_key, holder=self.node_name,
+                acquire_time=now, renew_time=now))
+
+        def set_ready(node):
+            conds = [c for c in node.conditions if c.type != "Ready"]
+            conds.append(NodeCondition(type="Ready", status="True"))
+            new = tuple(conds)
+            if new == node.conditions:
+                return None
+            node.conditions = new
+            return node
+        try:
+            self.store.guaranteed_update(NODES, self.node_name, set_ready,
+                                         allow_skip=True)
+        except NotFoundError:
+            pass

@@ -276,3 +276,76 @@ class TestPodGC:
         gc.sync()
         keys = {p.key for p in store.list(PODS)[0]}
         assert keys == {"default/done1"}
+
+
+class TestFailureDetectionEndToEnd:
+    """kubelet heartbeat -> lease staleness -> Ready=Unknown ->
+    unreachable taints -> eviction -> rescheduling elsewhere: the full
+    failure-detection/recovery story (nodelifecycle monitorNodeHealth +
+    NoExecuteTaintManager + the scheduler shell)."""
+
+    def test_node_failure_evicts_and_reschedules(self):
+        from kubernetes_tpu.models.hollow import HollowKubelet
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            NodeLifecycleController, TAINT_UNREACHABLE)
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        clock = FakeClock(1000.0)
+        store = Store()
+        for name in ("n0", "n1"):
+            store.create(NODES, Node(
+                name=name,
+                allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+        kubelets = {n: HollowKubelet(store, n, clock=clock)
+                    for n in ("n0", "n1")}
+        for k in kubelets.values():
+            k.heartbeat()
+        lifecycle = NodeLifecycleController(store, clock=clock)
+        lifecycle.sync()
+        sched = Scheduler(store, use_tpu=False, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        store.create(PODS, Pod(name="w", labels={"app": "w"}, containers=(
+            Container.make(name="c", requests={"cpu": 100}),)))
+        sched.pump()
+        assert sched.schedule_one(timeout=0.0)
+        sched.pump()
+        first_node = store.get(PODS, "default/w").node_name
+        assert first_node in ("n0", "n1")
+
+        # the hosting node's kubelet dies; the survivor keeps heartbeating
+        kubelets[first_node].stop()
+        for _ in range(3):
+            clock.step(20)
+            for k in kubelets.values():
+                k.heartbeat()
+            lifecycle.pump()
+        node = store.get(NODES, first_node)
+        assert any(c.type == "Ready" and c.status == "Unknown"
+                   for c in node.conditions)
+        assert {t.key for t in node.taints} == {TAINT_UNREACHABLE}
+        # the pod was evicted and recreated by its "controller" (here: us)
+        assert "default/w" not in {p.key for p in store.list(PODS)[0]}
+        store.create(PODS, Pod(name="w2", labels={"app": "w"}, containers=(
+            Container.make(name="c", requests={"cpu": 100}),)))
+        sched.pump()
+        assert sched.schedule_one(timeout=0.0)
+        sched.pump()
+        other = store.get(PODS, "default/w2").node_name
+        assert other != first_node   # tainted node avoided
+
+        # recovery: kubelet returns, heartbeat restores Ready, taints clear
+        kubelets[first_node]._stopped = False
+        kubelets[first_node].heartbeat()
+        lifecycle.pump()
+        node = store.get(NODES, first_node)
+        assert _status(node) == "True"
+        assert node.taints == ()
+
+
+def _status(node):
+    for c in node.conditions:
+        if c.type == "Ready":
+            return c.status
+    return "True"
