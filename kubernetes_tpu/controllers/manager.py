@@ -15,12 +15,14 @@ from kubernetes_tpu.store.store import Store
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 
 # name -> constructor(store) (NewControllerInitializers analog)
 CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "disruption": DisruptionController,
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
+    "replicaset": ReplicaSetController,
 }
 
 

@@ -1,0 +1,116 @@
+"""ReplicaSet controller — pkg/controller/replicaset/replica_set.go.
+
+The workload-management loop: for every ReplicaSet (which also stands in
+for RC/StatefulSet in this pruned model), reconcile the number of matching
+live pods to spec.replicas — creating owned pods from the set's template
+shape when short (syncReplicaSet -> manageReplicas), deleting the
+youngest surplus pods when over (the reference prefers not-ready/younger
+pods via ActivePods ordering; creation time is the pruned criterion here).
+Owned pods carry owner_ref so the disruption controller's expected-scale
+walk and PodGC recognize them.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from kubernetes_tpu.api.types import Pod, Container, ReplicaSet
+from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.store.record import EventRecorder, NORMAL
+from kubernetes_tpu.store.store import (
+    Store, PODS, REPLICASETS, AlreadyExistsError, NotFoundError,
+)
+
+_suffix = itertools.count(1)
+
+
+class ReplicaSetController:
+    def __init__(self, store: Store, clock=None):
+        self.store = store
+        self.recorder = EventRecorder(store, component="controllermanager")
+        self.informers = InformerFactory(store)
+        self._dirty: set[str] = set()
+        rs = self.informers.informer(REPLICASETS)
+        rs.add_event_handler(on_add=lambda r: self._dirty.add(r.key),
+                             on_update=lambda o, n: self._dirty.add(n.key),
+                             on_delete=lambda r: self._dirty.discard(r.key))
+        pods = self.informers.informer(PODS)
+        pods.add_event_handler(on_add=self._pod_changed,
+                               on_update=lambda o, n: self._pod_changed(n),
+                               on_delete=self._pod_changed)
+
+    def _pod_changed(self, pod: Pod) -> None:
+        if pod.owner_ref is not None:
+            kind, name, _uid = pod.owner_ref
+            self._dirty.add(f"{pod.namespace}/{name}")
+        else:
+            # orphan adoption path: any selector might match it
+            for r in self.informers.informer(REPLICASETS).list():
+                self._dirty.add(r.key)
+
+    def sync(self) -> None:
+        self.informers.sync_all()
+        for r in self.informers.informer(REPLICASETS).list():
+            self._dirty.add(r.key)
+        self.reconcile_dirty()
+
+    def pump(self) -> int:
+        self.informers.pump_all()
+        return self.reconcile_dirty()
+
+    def reconcile_dirty(self) -> int:
+        n = 0
+        while self._dirty:
+            key = self._dirty.pop()
+            try:
+                rs = self.store.get(REPLICASETS, key)
+            except NotFoundError:
+                continue
+            self.manage_replicas(rs)
+            n += 1
+        return n
+
+    # -- syncReplicaSet -> manageReplicas ------------------------------------
+    def _matching_pods(self, rs: ReplicaSet) -> list[Pod]:
+        if rs.selector is None:
+            return []
+        pods, _rv = self.store.list(PODS)
+        return [p for p in pods
+                if p.namespace == rs.namespace and not p.deleted
+                and rs.selector.matches(p.labels)]
+
+    def _template_pod(self, rs: ReplicaSet) -> Pod:
+        labels = dict(rs.selector.match_labels) if rs.selector else {}
+        return Pod(name=f"{rs.name}-{next(_suffix):x}",
+                   namespace=rs.namespace, labels=labels,
+                   owner_ref=("ReplicaSet", rs.name, f"rs-{rs.name}"),
+                   containers=(Container.make(name="c"),))
+
+    def manage_replicas(self, rs: ReplicaSet) -> None:
+        pods = self._matching_pods(rs)
+        diff = rs.replicas - len(pods)
+        if diff > 0:
+            for _ in range(diff):
+                pod = self._template_pod(rs)
+                try:
+                    self.store.create(PODS, pod)
+                except AlreadyExistsError:
+                    continue
+                self.recorder.event(
+                    "ReplicaSet", rs.key, NORMAL, "SuccessfulCreate",
+                    f"Created pod: {pod.name}")
+        elif diff < 0:
+            # scale down: keep-worthiest first (scheduled, then older — the
+            # reference's ActivePods ranking deletes unscheduled/younger
+            # pods first), then delete the tail beyond spec.replicas
+            pods.sort(key=lambda p: (0 if p.node_name else 1,
+                                     p.creation_timestamp))
+            victims = pods[rs.replicas:]
+            for p in victims:
+                try:
+                    self.store.delete(PODS, p.key)
+                except NotFoundError:
+                    continue
+                self.recorder.event(
+                    "ReplicaSet", rs.key, NORMAL, "SuccessfulDelete",
+                    f"Deleted pod: {p.name}")

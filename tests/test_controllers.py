@@ -349,3 +349,63 @@ def _status(node):
         if c.type == "Ready":
             return c.status
     return "True"
+
+
+class TestReplicaSetController:
+    """Workload reconciliation (pkg/controller/replicaset): scale up by
+    creating owned pods, scale down deleting the least keep-worthy, and
+    replace pods that vanish — feeding the scheduler + PDB scale walk."""
+
+    def test_scale_up_schedule_and_replace(self):
+        from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+        from kubernetes_tpu.scheduler import Scheduler
+        store = Store()
+        for i in range(3):
+            store.create(NODES, Node(
+                name=f"n{i}",
+                allocatable={"cpu": 4000, "memory": 8 * GI, "pods": 110}))
+        rsc = ReplicaSetController(store)
+        store.create(REPLICASETS, ReplicaSet(
+            name="web", selector=sel(app="web"), replicas=3))
+        rsc.sync()
+        pods = store.list(PODS)[0]
+        assert len(pods) == 3
+        assert all(p.owner_ref == ("ReplicaSet", "web", "rs-web")
+                   and p.labels == {"app": "web"} for p in pods)
+        sched = Scheduler(store, use_tpu=False,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert all(p.node_name for p in store.list(PODS)[0])
+        # a pod vanishes (node failure / eviction): the controller replaces it
+        gone = store.list(PODS)[0][0]
+        store.delete(PODS, gone.key)
+        rsc.pump()
+        assert len(store.list(PODS)[0]) == 3
+
+    def test_scale_down_prefers_unscheduled_then_youngest(self):
+        from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+        store = Store()
+        rsc = ReplicaSetController(store)
+        old = bound_pod("old", "n0", {"app": "web"})
+        old.creation_timestamp = 1.0
+        young = bound_pod("young", "n1", {"app": "web"})
+        young.creation_timestamp = 9.0
+        pending = Pod(name="pending", labels={"app": "web"})
+        for p in (old, young, pending):
+            store.create(PODS, p)
+        store.create(REPLICASETS, ReplicaSet(
+            name="web", selector=sel(app="web"), replicas=2))
+        rsc.sync()
+        keys = {p.key for p in store.list(PODS)[0]}
+        assert keys == {"default/old", "default/young"}  # pending went first
+        def shrink(r):
+            r.replicas = 1
+            return r
+        store.guaranteed_update(REPLICASETS, "default/web", shrink)
+        rsc.pump()
+        keys = {p.key for p in store.list(PODS)[0]}
+        assert keys == {"default/old"}                  # youngest next
