@@ -59,7 +59,7 @@ class EventRecorder:
                 involved_kind=involved_kind, involved_key=involved_key,
                 type=etype, reason=reason, message=message,
                 component=self.component)
-            self.store.create(EVENTS, rec)
+            self.store.create(EVENTS, rec, move=True)
             self._known[agg] = rec.key
             while len(self._known) > self._max_entries:
                 self._known.popitem(last=False)

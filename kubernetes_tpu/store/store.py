@@ -153,13 +153,16 @@ class Store:
             return self._rv
 
     # -- writes -------------------------------------------------------------
-    def create(self, kind: str, obj: Any) -> Any:
+    def create(self, kind: str, obj: Any, move: bool = False) -> Any:
+        """`move=True` transfers ownership: the caller promises never to
+        touch `obj` again, skipping the write snapshot (the event recorder's
+        fire-and-forget records use this)."""
         with self._lock:
             bucket = self._objs.setdefault(kind, {})
             key = _key_of(obj)
             if key in bucket:
                 raise AlreadyExistsError(f"{kind}/{key}")
-            stored = _clone(obj)
+            stored = obj if move else _clone(obj)
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
@@ -217,11 +220,24 @@ class Store:
 
     # -- pod conveniences (the scheduler's write surface) --------------------
     def bind_pod(self, pod_key: str, node_name: str) -> Any:
-        """POST pods/<p>/binding analog (reference: factory.go:710)."""
-        def mutate(pod):
-            pod.node_name = node_name
-            return pod
-        return self.guaranteed_update(PODS, pod_key, mutate)
+        """POST pods/<p>/binding analog (reference: factory.go:710).
+
+        Single-lock fast path of guaranteed_update(set nodeName): the
+        binding subresource replaces one spec field unconditionally (the
+        reference's Bind POST carries no resourceVersion precondition), so
+        no CAS retry loop — one clone, one lock, one event."""
+        with self._lock:
+            bucket = self._objs.setdefault(PODS, {})
+            current = bucket.get(pod_key)
+            if current is None:
+                raise NotFoundError(f"{PODS}/{pod_key}")
+            stored = _clone(current)
+            stored.node_name = node_name
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[pod_key] = stored
+            self._emit(Event(MODIFIED, PODS, stored, self._rv))
+            return stored
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         def mutate(pod):
