@@ -541,3 +541,102 @@ class TestBanElimBurstParity:
             labels={"name": "test", "color": "green"}), 12)
         placed = [v for v in got.values() if v]
         assert len(placed) == 7
+
+
+class TestMixedWorkloadShellFuzz:
+    """Differential soak at the SHELL level: randomized clusters and mixed
+    pod classes (plain, node-selector, tolerations, hostname anti-affinity,
+    zone affinity, host ports, priorities) scheduled by the TPU burst path
+    vs the pure-oracle serial loop — bindings must be identical, covering
+    burst segmentation, uniform/ELIM/ban kernels, rotation replay, refusals,
+    and the serial fallback together."""
+
+    @pytest.mark.parametrize("seed", [11, 23, 47])
+    def test_bindings_identical(self, seed):
+        import random
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.api.types import (
+            Taint, Toleration, Affinity, PodAffinity, PodAntiAffinity,
+            PodAffinityTerm, ContainerPort, NO_SCHEDULE,
+            LABEL_ZONE_FAILURE_DOMAIN)
+        rng = random.Random(seed)
+        GI = 1024 ** 3
+        n_nodes = rng.randint(8, 24)
+        zones = rng.choice([1, 2, 3])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                labels = {LABEL_HOSTNAME: f"n{i}",
+                          LABEL_ZONE_FAILURE_DOMAIN: f"z{i % zones}"}
+                if i % 3 == 0:
+                    labels["disk"] = "ssd"
+                taints = (Taint(key="ded", value="x", effect=NO_SCHEDULE),) \
+                    if i % 5 == 0 else ()
+                s.create(NODES, Node(
+                    name=f"n{i}", labels=labels, taints=taints,
+                    allocatable={"cpu": rng.choice([2000, 4000]),
+                                 "memory": 8 * GI, "pods": 110}))
+            return s
+
+        def make_pod(j):
+            cls = rng.choice(["plain", "plain", "selector", "tolerate",
+                              "anti", "aff", "port", "prio"])
+            kw = {"labels": {"app": cls}}
+            if cls == "selector":
+                kw["node_selector"] = {"disk": "ssd"}
+            elif cls == "tolerate":
+                kw["tolerations"] = (Toleration(
+                    key="ded", value="x", effect=NO_SCHEDULE),)
+            elif cls == "anti":
+                kw["labels"] = {"name": "t", "color": "green"}
+                kw["affinity"] = Affinity(pod_anti_affinity=PodAntiAffinity(
+                    required=(PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels=(("color", "green"),)),
+                        topology_key=LABEL_HOSTNAME),)))
+            elif cls == "aff":
+                kw["labels"] = {"foo": ""}
+                kw["affinity"] = Affinity(pod_affinity=PodAffinity(
+                    required=(PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels=(("foo", ""),)),
+                        topology_key=LABEL_ZONE_FAILURE_DOMAIN),)))
+            elif cls == "port":
+                ports = (ContainerPort(host_port=8080,
+                                       container_port=8080),)
+                kw["containers"] = (Container.make(
+                    name="c", requests={"cpu": 100}, ports=ports),)
+            elif cls == "prio":
+                kw["priority"] = rng.randint(1, 3)
+            if "containers" not in kw:
+                kw["containers"] = (Container.make(
+                    name="c", requests={"cpu": rng.choice([100, 300, 700]),
+                                        "memory": GI}),)
+            return Pod(name=f"p{j}", **kw)
+
+        # one pod stream, two worlds
+        rng_state = rng.getstate()
+        bindings = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            for j in range(rng.randint(25, 50)):
+                s.create(PODS, make_pod(j))
+            sched.pump()
+            if use_tpu:
+                while sched.schedule_burst(max_pods=32):
+                    pass
+            else:
+                while sched.schedule_one(timeout=0.0):
+                    pass
+            sched.pump()
+            bindings.append({p.key: p.node_name for p in s.list(PODS)[0]})
+        diff = {k: (bindings[0].get(k), bindings[1].get(k))
+                for k in bindings[0]
+                if bindings[0].get(k) != bindings[1].get(k)}
+        assert not diff, f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}"
