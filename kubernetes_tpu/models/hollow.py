@@ -171,12 +171,18 @@ class HollowKubelet:
         self._stopped = True
 
     def heartbeat(self) -> None:
+        """One kubelet sync tick: renew the node lease, assert node Ready,
+        and 'run' the pods bound here (hollow_kubelet.go's fake runtime:
+        Pending pods become Running with Ready=True and a start time — the
+        status the disruption controller's healthy count and the reference's
+        IsPodReady read)."""
         if self._stopped:
             return
         from kubernetes_tpu.api.types import NodeCondition
         from kubernetes_tpu.utils.leader_election import Lease
         from kubernetes_tpu.store.store import LEASES, NotFoundError
         now = self.clock.now()
+        self._run_pods(now)
         lease_key = f"node-{self.node_name}"
         try:
             def renew(lease):
@@ -202,3 +208,27 @@ class HollowKubelet:
                                          allow_skip=True)
         except NotFoundError:
             pass
+
+    def _run_pods(self, now: float) -> None:
+        from kubernetes_tpu.api.types import PodCondition
+        from kubernetes_tpu.store.store import NotFoundError
+        pods, _rv = self.store.list(PODS)
+        for pod in pods:
+            if pod.node_name != self.node_name or pod.deleted \
+                    or pod.phase != "Pending":
+                continue
+
+            def run(cur, _now=now):
+                if cur.phase != "Pending" or not cur.node_name:
+                    return None
+                cur.phase = "Running"
+                cur.start_time = _now
+                conds = [c for c in cur.conditions if c.type != "Ready"]
+                conds.append(PodCondition(type="Ready", status="True"))
+                cur.conditions = tuple(conds)
+                return cur
+            try:
+                self.store.guaranteed_update(PODS, pod.key, run,
+                                             allow_skip=True)
+            except NotFoundError:
+                continue

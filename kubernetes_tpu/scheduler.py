@@ -218,11 +218,17 @@ class Scheduler:
             return False
 
         def sanitize(p: Pod) -> Pod:
+            # reference skipPodUpdate strips ResourceVersion, spec.NodeName,
+            # and the ENTIRE status (eventhandlers.go:275-315) — kubelet
+            # status writes (phase, conditions, startTime) on an assumed pod
+            # must not look like real updates
             c = p.clone()
             c.resource_version = 0
             c.node_name = ""
             c.nominated_node_name = ""
             c.phase = "Pending"
+            c.conditions = ()
+            c.start_time = None
             return c
 
         return sanitize(assumed) == sanitize(new)

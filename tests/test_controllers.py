@@ -409,3 +409,46 @@ class TestReplicaSetController:
         rsc.pump()
         keys = {p.key for p in store.list(PODS)[0]}
         assert keys == {"default/old"}                  # youngest next
+
+
+class TestHollowKubeletRunsPods:
+    """Scheduled pods become Running/Ready via the hollow kubelet's sync
+    tick, and the disruption controller's healthy count follows — the full
+    bind -> run -> status -> PDB pipeline."""
+
+    def test_pod_lifecycle_feeds_pdb_health(self):
+        from kubernetes_tpu.models.hollow import HollowKubelet
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.utils.clock import FakeClock
+        clock = FakeClock(50.0)
+        store = Store()
+        store.create(NODES, Node(
+            name="n0", allocatable={"cpu": 4000, "memory": 8 * GI,
+                                    "pods": 110}))
+        store.create(PDBS, PodDisruptionBudget(
+            name="b", selector=sel(app="db"), min_available=1))
+        for j in range(2):
+            store.create(PODS, Pod(
+                name=f"db{j}", labels={"app": "db"}, containers=(
+                    Container.make(name="c", requests={"cpu": 100}),)))
+        sched = Scheduler(store, use_tpu=False, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        kubelet = HollowKubelet(store, "n0", clock=clock)
+        kubelet.heartbeat()
+        pods = store.list(PODS)[0]
+        assert all(p.phase == "Running" and p.start_time == 50.0
+                   and any(c.type == "Ready" and c.status == "True"
+                           for c in p.conditions) for p in pods)
+        dc = DisruptionController(store)
+        dc.sync()
+        pdb = store.get(PDBS, "default/b")
+        assert (pdb.current_healthy, pdb.disruptions_allowed) == (2, 1)
+        # the kubelet's status write must not disturb the scheduler's
+        # assumed-pod cache (skipPodUpdate strips the whole status)
+        sched.pump()
+        assert sched.metrics.schedule_attempts["error"] == 0
