@@ -170,19 +170,24 @@ class HollowKubelet:
     def stop(self) -> None:
         self._stopped = True
 
-    def heartbeat(self) -> None:
+    def heartbeat(self, pods=None) -> None:
         """One kubelet sync tick: renew the node lease, assert node Ready,
         and 'run' the pods bound here (hollow_kubelet.go's fake runtime:
         Pending pods become Running with Ready=True and a start time — the
         status the disruption controller's healthy count and the reference's
-        IsPodReady read)."""
+        IsPodReady read).
+
+        A fleet driving thousands of hollow kubelets must store.list(PODS)
+        ONCE per round and pass the result as `pods` — otherwise each
+        heartbeat lists (clones) the whole pod set itself, making one fleet
+        round O(nodes x pods) in pod clones."""
         if self._stopped:
             return
         from kubernetes_tpu.api.types import NodeCondition
         from kubernetes_tpu.utils.leader_election import Lease
         from kubernetes_tpu.store.store import LEASES, NotFoundError
         now = self.clock.now()
-        self._run_pods(now)
+        self._run_pods(now, pods)
         lease_key = f"node-{self.node_name}"
         try:
             def renew(lease):
@@ -209,10 +214,11 @@ class HollowKubelet:
         except NotFoundError:
             pass
 
-    def _run_pods(self, now: float) -> None:
+    def _run_pods(self, now: float, pods=None) -> None:
         from kubernetes_tpu.api.types import PodCondition
         from kubernetes_tpu.store.store import NotFoundError
-        pods, _rv = self.store.list(PODS)
+        if pods is None:
+            pods, _rv = self.store.list(PODS)
         for pod in pods:
             if pod.node_name != self.node_name or pod.deleted \
                     or pod.phase != "Pending":
