@@ -640,3 +640,68 @@ class TestMixedWorkloadShellFuzz:
                 for k in bindings[0]
                 if bindings[0].get(k) != bindings[1].get(k)}
         assert not diff, f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}"
+
+
+class TestPreemptionPressureShellFuzz:
+    """Capacity-starved clusters with mixed priorities: pods fail, preempt
+    (device victim scan in the TPU world, oracle Preemptor in the other),
+    nominate, evict, and retry through backoff — final bindings and
+    nominations must match between the TPU shell and the oracle shell under
+    an identical deterministic round structure."""
+
+    @pytest.mark.parametrize("seed", [3, 5, 17])
+    def test_preemptive_convergence_identical(self, seed):
+        import random
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.utils.clock import FakeClock
+        rng = random.Random(seed)
+        GI = 1024 ** 3
+        n_nodes = rng.randint(3, 8)
+        cap = rng.choice([1000, 2000])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={LABEL_HOSTNAME: f"n{i}",
+                            "failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % 2}"},
+                    allocatable={"cpu": cap, "memory": 8 * GI, "pods": 110}))
+            return s
+
+        rng_state = rng.getstate()
+        outs = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            clock = FakeClock(100.0)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            for j in range(rng.randint(10, 25)):
+                s.create(PODS, Pod(
+                    name=f"p{j}", labels={"app": "x"},
+                    priority=rng.choice([0, 0, 0, 5, 9]),
+                    containers=(Container.make(name="c", requests={
+                        "cpu": rng.choice([300, 500, 900])}),)))
+            idle = 0
+            for _round in range(60):
+                sched.pump()
+                before = sched.metrics.schedule_attempts["scheduled"]
+                if use_tpu:
+                    while sched.schedule_burst(max_pods=8):
+                        pass
+                else:
+                    while sched.schedule_one(timeout=0.0):
+                        pass
+                sched.pump()
+                idle = 0 if sched.metrics.schedule_attempts["scheduled"] \
+                    > before else idle + 1
+                if idle >= 8:
+                    break
+                clock.step(2.0)   # deterministic backoff expiry
+            outs.append(sorted((p.key, p.node_name, p.nominated_node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1]
