@@ -185,3 +185,62 @@ class TestKubectl:
         assert not store.list(PODS)[0]
         out = self._run(url, "uncordon", "n0")
         assert not store.get(NODES, "n0").unschedulable
+
+
+class TestClusterInAProcess:
+    """kubeadm-analog bootstrap (cmd/cluster.py): every control-plane
+    component live over one store, driven purely through kubectl + REST —
+    ReplicaSet create -> controller creates pods -> scheduler binds ->
+    hollow kubelets run them -> disruption controller reconciles the PDB."""
+
+    def test_kubectl_driven_end_to_end(self, tmp_path):
+        from kubernetes_tpu.cmd.cluster import Cluster
+        from kubernetes_tpu.cmd import kubectl
+        import contextlib
+
+        def kc(url, *argv):
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                rc = kubectl.main(["--server", url, *argv])
+            assert rc == 0
+            return out.getvalue()
+
+        with Cluster(n_nodes=6, api_port=0, use_tpu=False,
+                     kubelet_interval=0.05) as cluster:
+            url = cluster.url
+            manifest = {"items": [
+                {"kind": "replicasets", "name": "web",
+                 "selector": {"match_labels": [["app", "web"]]},
+                 "replicas": 4},
+                {"kind": "poddisruptionbudgets", "name": "web-pdb",
+                 "selector": {"match_labels": [["app", "web"]]},
+                 "min_available": 3},
+            ]}
+            f = tmp_path / "m.json"
+            f.write_text(json.dumps(manifest))
+            kc(url, "create", "-f", str(f))
+
+            def all_running():
+                _, lst = req(f"{url}/api/v1/pods")
+                pods = lst["items"]
+                return len(pods) == 4 and all(
+                    p["node_name"] and p["phase"] == "Running"
+                    for p in pods)
+            assert cluster.wait_for(all_running, timeout=15), \
+                req(f"{url}/api/v1/pods")[1]
+
+            def pdb_reconciled():
+                _, pdb = req(f"{url}/api/v1/poddisruptionbudgets/default/web-pdb")
+                return (pdb["current_healthy"], pdb["disruptions_allowed"]) \
+                    == (4, 1)
+            assert cluster.wait_for(pdb_reconciled, timeout=10)
+
+            # kill a pod through kubectl: the RS controller replaces it and
+            # the scheduler + kubelet bring it back to Running
+            _, lst = req(f"{url}/api/v1/pods")
+            victim = lst["items"][0]
+            kc(url, "delete", "pods",
+               f"{victim['namespace']}/{victim['name']}")
+            assert cluster.wait_for(all_running, timeout=15)
+            out = kc(url, "get", "replicasets")
+            assert "web" in out
