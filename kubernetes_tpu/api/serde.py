@@ -27,6 +27,7 @@ KIND_TYPES = {
     store_mod.PVCS: T.PersistentVolumeClaim,
     store_mod.EVENTS: T.EventRecord,
     "priorityclasses": T.PriorityClass,
+    store_mod.ENDPOINTS: T.Endpoints,
 }
 
 

@@ -564,6 +564,25 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class Endpoints:
+    """Pruned v1.Endpoints — one subset: the ready backends of a Service.
+    Addresses are (pod_key, node_name) pairs (no pod IPs exist in this
+    model; the key is the routable identity). Reconciled by
+    controllers.endpoints from the service selector."""
+    name: str
+    namespace: str = "default"
+    addresses: tuple[tuple[str, str], ...] = ()
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "Endpoints":
+        return _shallow(self)
+
+
+@dataclass
 class PriorityClass:
     """Pruned scheduling.k8s.io/v1beta1 PriorityClass — resolved into
     pod.priority by the priority admission plugin

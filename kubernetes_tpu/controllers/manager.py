@@ -15,6 +15,7 @@ from kubernetes_tpu.store.store import Store
 from kubernetes_tpu.controllers.disruption import DisruptionController
 from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
+from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 
 # name -> constructor(store) (NewControllerInitializers analog)
@@ -23,6 +24,7 @@ CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
     "replicaset": ReplicaSetController,
+    "endpoint": EndpointsController,
 }
 
 

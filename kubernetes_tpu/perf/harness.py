@@ -157,3 +157,50 @@ def run_benchmark_cell(workload: str, nodes: int, existing: int,
                        burst: int = 1024) -> PerfResult:
     return run(PerfConfig(nodes=nodes, existing_pods=existing, pods=pods,
                           workload=workload, use_tpu=use_tpu, burst=burst))
+
+
+def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
+                    use_tpu: bool = True) -> dict:
+    """e2e scalability density analog (test/e2e/scalability/density.go):
+    pods created through the FULL cluster-in-a-process pipeline (apiserver
+    admission -> scheduler -> hollow kubelets running them), reporting
+    cluster-wide saturation throughput (SLO >= 8 pods/s, density.go:58) and
+    pod startup latency percentiles against the <= 5s SLO
+    (density.go:56,987-992). Startup = create time -> observed Running."""
+    import time as _t
+    from kubernetes_tpu.cmd.cluster import Cluster
+    from kubernetes_tpu.api.types import Pod, Container
+    from kubernetes_tpu.models.hollow import MI
+    with Cluster(n_nodes=n_nodes, api_port=-1, use_tpu=use_tpu,
+                 kubelet_interval=0.02) as cluster:
+        created: dict[str, float] = {}
+        started: dict[str, float] = {}
+        t0 = _t.perf_counter()
+        for j in range(n_pods):
+            p = Pod(name=f"density-{j}", labels={"app": "density"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100, "memory": 200 * MI}),))
+            cluster.store.create(PODS, p)
+            created[p.key] = _t.perf_counter()
+
+        def all_running():
+            pods, _rv = cluster.store.list(PODS)
+            now = _t.perf_counter()
+            running = 0
+            for p in pods:
+                if p.phase == "Running":
+                    running += 1
+                    started.setdefault(p.key, now)
+            return running >= n_pods
+        ok = cluster.wait_for(all_running, timeout=120)
+        elapsed = _t.perf_counter() - t0
+    lats = sorted(started[k] - created[k] for k in started)
+    pct = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))] if lats else None
+    return {
+        "saturated": ok,
+        "throughput": round(n_pods / elapsed, 1) if elapsed else 0.0,
+        "startup_p50": round(pct(0.50), 3) if lats else None,
+        "startup_p99": round(pct(0.99), 3) if lats else None,
+        "startup_slo_5s": bool(lats) and pct(0.99) <= 5.0,
+        "throughput_slo_8pps": (n_pods / elapsed) >= 8.0 if elapsed else False,
+    }

@@ -452,3 +452,36 @@ class TestHollowKubeletRunsPods:
         # assumed-pod cache (skipPodUpdate strips the whole status)
         sched.pump()
         assert sched.metrics.schedule_attempts["error"] == 0
+
+
+class TestEndpointsController:
+    def test_service_endpoints_track_ready_pods(self):
+        from kubernetes_tpu.api.types import Service, PodCondition
+        from kubernetes_tpu.controllers.endpoints import EndpointsController
+        from kubernetes_tpu.store.store import SERVICES, ENDPOINTS
+        store = Store()
+        ec = EndpointsController(store)
+        store.create(SERVICES, Service(name="db", selector={"app": "db"}))
+        a = bound_pod("a", "n0", {"app": "db"})
+        b = bound_pod("b", "n1", {"app": "db"})
+        b.conditions = (PodCondition(type="Ready", status="False"),)
+        pending = Pod(name="c", labels={"app": "db"})   # unbound
+        for p in (a, b, pending):
+            store.create(PODS, p)
+        ec.sync()
+        ep = store.get(ENDPOINTS, "default/db")
+        assert ep.addresses == (("default/a", "n0"),)
+        # pod becomes ready -> endpoint appears; service delete -> cleanup
+        def ready(cur):
+            cur.conditions = (PodCondition(type="Ready", status="True"),)
+            return cur
+        store.guaranteed_update(PODS, "default/b", ready)
+        ec.pump()
+        assert store.get(ENDPOINTS, "default/db").addresses == (
+            ("default/a", "n0"), ("default/b", "n1"))
+        store.delete(SERVICES, "default/db")
+        ec.pump()
+        import pytest as _pytest
+        from kubernetes_tpu.store.store import NotFoundError
+        with _pytest.raises(NotFoundError):
+            store.get(ENDPOINTS, "default/db")

@@ -103,3 +103,15 @@ class TestBurstSerialEquivalence:
             return sorted((p.name, p.node_name) for p in pods)
 
         assert go(16) == go(0)
+
+
+class TestE2EDensity:
+    """density.go analog through the full cluster-in-a-process pipeline:
+    saturation throughput >= 8 pods/s and p99 startup <= 5s SLOs."""
+
+    def test_density_slos(self):
+        from kubernetes_tpu.perf.harness import run_e2e_density
+        r = run_e2e_density(n_nodes=10, n_pods=30, use_tpu=False)
+        assert r["saturated"]
+        assert r["throughput_slo_8pps"], r
+        assert r["startup_slo_5s"], r
