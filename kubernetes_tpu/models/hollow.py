@@ -238,3 +238,48 @@ class HollowKubelet:
                                              allow_skip=True)
             except NotFoundError:
                 continue
+
+
+class HollowProxy:
+    """Hollow kube-proxy — pkg/kubemark/hollow_proxy.go:40 over the
+    userspace proxier's data structure: an event-driven service -> backends
+    routing table fed by Endpoints watches (the reference programs
+    iptables/IPVS from the same inputs; with no kernel here, the table IS
+    the dataplane). `route(service)` round-robins across ready backends
+    like the userspace proxier's LoadBalancerRR."""
+
+    def __init__(self, store: Store):
+        from kubernetes_tpu.store.informer import InformerFactory
+        from kubernetes_tpu.store.store import ENDPOINTS
+        self.store = store
+        self.informers = InformerFactory(store)
+        self._table: dict[str, tuple] = {}
+        self._rr: dict[str, int] = {}
+        eps = self.informers.informer(ENDPOINTS)
+        eps.add_event_handler(
+            on_add=lambda e: self._table.__setitem__(e.key, e.addresses),
+            on_update=lambda o, n: self._table.__setitem__(n.key, n.addresses),
+            on_delete=lambda e: (self._table.pop(e.key, None),
+                                 self._rr.pop(e.key, None)))
+
+    def sync(self) -> None:
+        self.informers.sync_all()
+        from kubernetes_tpu.store.store import ENDPOINTS
+        for e in self.informers.informer(ENDPOINTS).list():
+            self._table[e.key] = e.addresses
+
+    def pump(self) -> int:
+        return self.informers.pump_all()
+
+    def backends(self, service_key: str) -> tuple:
+        return self._table.get(service_key, ())
+
+    def route(self, service_key: str):
+        """(pod_key, node_name) of the next backend, or None when the
+        service has no ready endpoints."""
+        backends = self._table.get(service_key)
+        if not backends:
+            return None
+        i = self._rr.get(service_key, 0) % len(backends)
+        self._rr[service_key] = i + 1
+        return backends[i]

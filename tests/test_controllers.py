@@ -485,3 +485,30 @@ class TestEndpointsController:
         from kubernetes_tpu.store.store import NotFoundError
         with _pytest.raises(NotFoundError):
             store.get(ENDPOINTS, "default/db")
+
+
+class TestHollowProxy:
+    def test_routing_table_follows_endpoints(self):
+        from kubernetes_tpu.api.types import Service
+        from kubernetes_tpu.controllers.endpoints import EndpointsController
+        from kubernetes_tpu.models.hollow import HollowProxy
+        from kubernetes_tpu.store.store import SERVICES
+        store = Store()
+        ec = EndpointsController(store)
+        proxy = HollowProxy(store)
+        proxy.sync()
+        store.create(SERVICES, Service(name="db", selector={"app": "db"}))
+        store.create(PODS, bound_pod("a", "n0", {"app": "db"}))
+        store.create(PODS, bound_pod("b", "n1", {"app": "db"}))
+        ec.sync()
+        proxy.pump()
+        picks = {proxy.route("default/db") for _ in range(4)}
+        assert picks == {("default/a", "n0"), ("default/b", "n1")}
+        store.delete(PODS, "default/a")
+        ec.pump()
+        proxy.pump()
+        assert proxy.backends("default/db") == (("default/b", "n1"),)
+        store.delete(SERVICES, "default/db")
+        ec.pump()
+        proxy.pump()
+        assert proxy.route("default/db") is None
