@@ -46,9 +46,67 @@ class PriorityAdmission:
         return obj   # resolved priority 0 (the reference's default)
 
 
+class DefaultTolerationSeconds:
+    """plugin/pkg/admission/defaulttolerationseconds: pods that don't pin
+    their own not-ready/unreachable NoExecute tolerations get the cluster
+    defaults (300s), bounding how long they linger on a failed node before
+    the taint manager evicts them."""
+
+    DEFAULT_SECONDS = 300.0
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        from kubernetes_tpu.api.types import Toleration, TOLERATION_OP_EXISTS
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            TAINT_NOT_READY, TAINT_UNREACHABLE)
+        have = {t.key for t in obj.tolerations
+                if t.effect in ("", "NoExecute")}
+        extra = []
+        for key in (TAINT_NOT_READY, TAINT_UNREACHABLE):
+            if key not in have:
+                extra.append(Toleration(
+                    key=key, op=TOLERATION_OP_EXISTS, effect="NoExecute",
+                    toleration_seconds=self.DEFAULT_SECONDS))
+        if extra:
+            obj.tolerations = obj.tolerations + tuple(extra)
+        return obj
+
+
+class LimitRanger:
+    """plugin/pkg/admission/limitranger (defaulting half): containers with
+    no cpu/memory request get the configured defaults, so every pod the
+    scheduler sees has concrete resource demands."""
+
+    def __init__(self, default_cpu: int = 100, default_mem: int = 200 * 1024 ** 2):
+        self.default_cpu = default_cpu
+        self.default_mem = default_mem
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        from kubernetes_tpu.api.types import Container
+        changed = False
+        out = []
+        for c in obj.containers:
+            req = dict(c.requests)
+            if "cpu" not in req or "memory" not in req:
+                req.setdefault("cpu", self.default_cpu)
+                req.setdefault("memory", self.default_mem)
+                c = Container(name=c.name, image=c.image,
+                              requests=tuple(sorted(req.items())),
+                              limits=c.limits, ports=c.ports)
+                changed = True
+            out.append(c)
+        if changed:
+            obj.containers = tuple(out)
+        return obj
+
+
 class AdmissionChain:
     def __init__(self, plugins: Optional[list] = None):
-        self.plugins = plugins if plugins is not None else [PriorityAdmission()]
+        self.plugins = plugins if plugins is not None else [
+            PriorityAdmission(), DefaultTolerationSeconds(), LimitRanger()]
 
     def admit(self, kind: str, obj: Any, store: Store) -> Any:
         for p in self.plugins:

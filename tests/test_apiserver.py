@@ -244,3 +244,32 @@ class TestClusterInAProcess:
             assert cluster.wait_for(all_running, timeout=15)
             out = kc(url, "get", "replicasets")
             assert "web" in out
+
+
+class TestAdmissionDefaults:
+    def test_default_toleration_seconds_and_limit_ranger(self, server):
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            TAINT_NOT_READY, TAINT_UNREACHABLE)
+        store, url = server
+        _, p = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="bare", containers=(Container.make(name="c"),))))
+        # DefaultTolerationSeconds: both NoExecute tolerations, 300s
+        tols = {t["key"]: t for t in p["tolerations"]}
+        assert set(tols) == {TAINT_NOT_READY, TAINT_UNREACHABLE}
+        assert all(t["toleration_seconds"] == 300.0 and
+                   t["effect"] == "NoExecute" for t in tols.values())
+        # LimitRanger: request defaults applied
+        reqs = dict(map(tuple, p["containers"][0]["requests"]))
+        assert reqs == {"cpu": 100, "memory": 200 * 1024 ** 2}
+        # explicit values survive untouched
+        _, p = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="explicit",
+            tolerations=(Toleration(key=TAINT_NOT_READY, op="Exists",
+                                    effect="NoExecute",
+                                    toleration_seconds=7.0),),
+            containers=(Container.make(name="c",
+                                       requests={"cpu": 900,
+                                                 "memory": GI}),))))
+        tols = {t["key"]: t for t in p["tolerations"]}
+        assert tols[TAINT_NOT_READY]["toleration_seconds"] == 7.0
+        assert dict(map(tuple, p["containers"][0]["requests"]))["cpu"] == 900
