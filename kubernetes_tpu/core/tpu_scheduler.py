@@ -651,6 +651,52 @@ class TPUScheduler:
                 return None
         return np.stack(perm_rows), seq
 
+    def _generic_rotation(self, b: NodeBatch, bucket: int):
+        """(perms[L, n_pad], inv_perms, oid_seq[bucket]) for the generic
+        scan: each in-burst cycle's enumeration order as axis indices
+        (invalid rows tail every permutation so position-space feasibility
+        masks them out). oid_seq[0] is the axis itself (the enumeration the
+        shell just consumed for pod 0)."""
+        tree = self.node_tree
+        if tree is None:
+            return None
+        nxt = tree.rotation_map()
+        r = tree.zone_index
+        n_pad, n_real = b.n_pad, b.n_real
+        pad_tail = np.arange(n_real, n_pad, dtype=np.int32)
+        perm_rows = [np.concatenate([np.arange(n_real, dtype=np.int32),
+                                     pad_tail])]
+        id_of_r: dict[int, int] = {}
+
+        def order_id(rr: int) -> int:
+            iid = id_of_r.get(rr)
+            if iid is None:
+                names = tree.order_for_start(rr)
+                row = np.fromiter((b.index[nm] for nm in names), np.int32,
+                                  len(names))
+                if np.array_equal(row, perm_rows[0][: len(names)]):
+                    iid = 0
+                else:
+                    perm_rows.append(np.concatenate([row, pad_tail]))
+                    iid = len(perm_rows) - 1
+                id_of_r[rr] = iid
+            return iid
+
+        seq = np.zeros(bucket, dtype=np.int32)
+        for t in range(1, bucket):
+            seq[t] = order_id(r)
+            r = nxt[r]
+        # the number of distinct orders varies with the starting zone index;
+        # pad to a fixed row bucket so one compile serves every burst
+        l_pad = _pad_pow2(len(perm_rows), 4)
+        while len(perm_rows) < l_pad:
+            perm_rows.append(perm_rows[0])
+        perms = np.stack(perm_rows)
+        inv = np.empty_like(perms)
+        for l in range(perms.shape[0]):
+            inv[l, perms[l]] = np.arange(n_pad, dtype=np.int32)
+        return perms, inv, seq
+
     def schedule_burst(self, pods: list[Pod], node_infos: dict[str, NodeInfo],
                        all_node_names: list[str],
                        bucket: Optional[int] = None) -> Optional[list[Optional[str]]]:
@@ -721,14 +767,43 @@ class TPUScheduler:
             # are only safe on the uniform path above — refuse, the shell
             # runs them serially
             return None
-        if self._burst_rotation(b, len(pods)) is not None:
-            # the generic scan folds against ONE node order; under an
-            # unstable per-cycle rotation its tie-breaks would diverge from
-            # the serial walk — refuse, the shell runs these pods serially
+        # spec-identical pods produce identical encoder output against a
+        # fixed snapshot: encode ONE pod and share (the O(N) python feature
+        # loops — spread counting especially — dominate otherwise)
+        sig0 = self._class_signature(pods[0])
+        uniform_spec = all(self._class_signature(p) == sig0
+                           for p in pods[1:])
+        if uniform_spec:
+            feats = [enc.encode(pods[0])] * len(pods)
+        else:
+            feats = [enc.encode(p) for p in pods]
+        # selector-spread counts change with every in-burst placement; the
+        # scan carries them only for spec-identical pods (one selector set)
+        carry_spread = any(f.spread_counts is not None for f in feats)
+        if carry_spread and not uniform_spec:
             return None
-        feats = [enc.encode(p) for p in pods]
-        per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
-                   for p, f in zip(pods, feats)]
+        rotation = None
+        if self._burst_rotation(b, len(pods)) is not None:
+            # per-cycle rotated enumeration orders: ship the <= L distinct
+            # permutations + each cycle's order id; _cycle_core runs its
+            # walk/tie math in position space
+            rotation = self._generic_rotation(b, bucket)
+            if rotation is None:
+                return None
+        spread0 = None
+        if carry_spread:
+            # the scan carries ONE [N] count vector; the stacked per-pod
+            # field stays inert so no [B, N] upload happens
+            spread0 = feats[0].spread_counts
+        if uniform_spec:
+            base = self._pod_arrays(feats[0], b.n_pad, upd_fields=True,
+                                    pod=pods[0])
+            if carry_spread:
+                base["spread_counts"] = self._defaults["zeros_i64"]
+            per_pod = [base] * len(pods)   # _stack_pods broadcasts by identity
+        else:
+            per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
+                       for p, f in zip(pods, feats)]
         # pad the burst to a power-of-two bucket so lax.scan compiles once
         # per bucket instead of once per burst length
         if len(per_pod) < bucket:
@@ -736,8 +811,13 @@ class TPUScheduler:
             pad["skip"] = self._true
             per_pod.extend([pad] * (bucket - len(per_pod)))
         stacked = self._stack_pods(per_pod)
+        if carry_spread and (spread0 is None
+                             or spread0.shape[-1] != b.n_pad):
+            return None   # inert/dense mix — shouldn't happen, stay exact
         z_pad = _pad_pow2(len(b.zone_names), 4)
         if self.mesh is not None:
+            if rotation is not None or carry_spread:
+                return None   # the sharded scan doesn't model these yet
             from kubernetes_tpu.parallel import sharding as S
             if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
                 self._sharded_batch = (z_pad, S.sharded_batch_fn(
@@ -749,7 +829,8 @@ class TPUScheduler:
         else:
             state, li, lni, outs = K.schedule_batch(
                 nodes, stacked, self.last_index, self.last_node_index,
-                num_to_find, n, z_pad, weights=self.weights)
+                num_to_find, n, z_pad, weights=self.weights,
+                rotation=rotation, spread0=spread0)
         # persist the folds: the device-resident matrix is authoritative for
         # rows the scan mutated (the host mirror catches up via
         # note_burst_assumed; external changes still arrive via dirty rows)

@@ -295,13 +295,21 @@ def _feasibility(nodes, pod):
 
 
 def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                weights, z_pad):
+                weights, z_pad, perm=None, inv_perm=None):
     """One fused cycle. The reference's sequential walk from last_index
     (generic_scheduler.go:486,519) is emulated WITHOUT materializing the
     rotation permutation: for natural index j, its 1-based rank in rotation
     order among feasible nodes is S[j]-pre (j >= li) or F-pre+S[j] (j < li),
     where S is the natural-order feasibility cumsum, pre = S[li-1], F = S[-1]
-    — no gathers, int32 counters (TPU has no native int64)."""
+    — no gathers, int32 counters (TPU has no native int64).
+
+    When the per-cycle NodeTree enumeration differs from the device axis
+    (uneven zones rotate the zone-interleaved order between cycles —
+    node_tree.py rotation_map), `perm`/`inv_perm` supply THIS cycle's order:
+    perm[p] = natural row at enumeration position p, inv_perm its inverse.
+    The walk/tie math then runs in position space (the cumsums act on
+    permuted masks, one gather each way) and last_index keeps its positional
+    meaning; perm=None is the identity fast path."""
     n_pad = nodes["valid"].shape[0]
     i32 = jnp.int32
     i = jnp.arange(n_pad, dtype=i32)
@@ -317,18 +325,20 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     feasible, fail_first, general_bits = _feasibility(nodes, pod)
     feas = feasible & in_range
 
-    S = jnp.cumsum(feas.astype(i32))
+    feas_p = feas if perm is None else feas[perm]
+    S = jnp.cumsum(feas_p.astype(i32))
     F = S[-1]                                   # total feasible
     pre = jnp.where(li > 0, S[jnp.maximum(li - 1, 0)], 0)
-    after = i >= li
-    rank = jnp.where(after, S - pre, F - pre + S)   # rotation rank at feasible j
-    kept = feas & (rank <= ntf)
+    after = i >= li                              # position space
+    rank_p = jnp.where(after, S - pre, F - pre + S)  # rank at position p
+    kept_p = feas_p & (rank_p <= ntf)
+    kept = kept_p if perm is None else kept_p[inv_perm]
     found = jnp.minimum(F, ntf)
     reached = F >= ntf
-    # the node where the sequential walk stops: unique feasible j with
-    # rank == num_to_find; evaluated = its rotation position + 1
-    jstar = jnp.argmax(kept & (rank == ntf)).astype(i32)
-    stop_pos = jnp.where(jstar >= li, jstar - li, nr - li + jstar)
+    # the position where the sequential walk stops: unique feasible p with
+    # rank == num_to_find; evaluated = its rotation offset + 1
+    pstar = jnp.argmax(kept_p & (rank_p == ntf)).astype(i32)
+    stop_pos = jnp.where(pstar >= li, pstar - li, nr - li + pstar)
     evaluated = jnp.where(reached, stop_pos + 1, nr)
     # a skip (bucket-padding) pod consumes no rotation state
     evaluated = jnp.where(pod["skip"], 0, evaluated).astype(jnp.int64)
@@ -341,10 +351,12 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     num_ties = jnp.maximum(jnp.sum(is_tie.astype(i32)), 1)
     # round-robin k-th tie in rotation order (selectHost :286-295)
     k = (last_node_index % num_ties.astype(jnp.int64)).astype(i32)
-    T = jnp.cumsum(is_tie.astype(i32))
+    tie_p = is_tie if perm is None else is_tie[perm]
+    T = jnp.cumsum(tie_p.astype(i32))
     preT = jnp.where(li > 0, T[jnp.maximum(li - 1, 0)], 0)
     trank = jnp.where(after, T - preT, T[-1] - preT + T)
-    sel = jnp.argmax(is_tie & (trank == k + 1)).astype(jnp.int64)
+    sel_p = jnp.argmax(tie_p & (trank == k + 1)).astype(jnp.int64)
+    sel = sel_p if perm is None else perm[sel_p].astype(jnp.int64)
     selected = jnp.where(found > 0, sel, -1)
 
     return {
@@ -408,39 +420,80 @@ def _fold_state(state, pod, sel, hit):
     }
 
 
-@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
+                                   "carry_spread"))
 def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
-                        n_real, z_pad, weights_tuple):
+                        n_real, perms, inv_perms, oid_seq, spread0, z_pad,
+                        weights_tuple, rotate, carry_spread):
     weights = dict(weights_tuple)
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
+    # selector-spread counts evolve with in-burst placements: the caller
+    # guarantees every pod shares one selector set (spec-identical), so the
+    # shared dense base counts (spread0 [N]) are carried and each placement
+    # folds +1 on its node (selector_spreading.go:66 counting semantics)
 
-    def step(carry, pod):
-        state, li, lni = carry
+    def step(carry, xs):
+        if rotate:
+            state, li, lni, spread = carry
+            pod, oid = xs
+            perm, inv_perm = perms[oid], inv_perms[oid]
+        else:
+            state, li, lni, spread = carry
+            pod = xs
+            perm = inv_perm = None
+        if carry_spread:
+            pod = {**pod, "spread_counts": spread}
         full = {**static, **state}
-        out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights, z_pad)
+        out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights,
+                          z_pad, perm=perm, inv_perm=inv_perm)
         sel = out["selected"]
         hit = out["found"] > 0
         new_state = _fold_state(state, pod, sel, hit)
-        return (new_state, out["next_last_index"], out["next_last_node_index"]), {
+        if carry_spread:
+            spread = spread.at[jnp.maximum(sel, 0)].add(
+                jnp.where(hit & ~pod["skip"], 1, 0))
+        return ((new_state, out["next_last_index"],
+                 out["next_last_node_index"], spread), {
             "selected": sel,
             "found": out["found"],
             "evaluated": out["evaluated"],
             "max_score": out["max_score"],
-        }
+        })
 
-    init = ({k: nodes[k] for k in _MUTABLE}, last_index, last_node_index)
-    (state, li, lni), outs = jax.lax.scan(step, init, pods)
+    if carry_spread:
+        pods = {k: v for k, v in pods.items() if k != "spread_counts"}
+    xs = (pods, oid_seq) if rotate else pods
+    init = ({k: nodes[k] for k in _MUTABLE}, last_index, last_node_index,
+            spread0)
+    (state, li, lni, _spread), outs = jax.lax.scan(step, init, xs)
     return state, li, lni, outs
 
 
 def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
-                   z_pad, weights=None):
+                   z_pad, weights=None, rotation=None, spread0=None):
     """Schedule a burst of pods against one snapshot, decisions serially
-    equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays."""
+    equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays.
+
+    `rotation` = (perms[L, n_pad], inv_perms[L, n_pad], oid_seq[B]) supplies
+    each in-burst cycle's NodeTree enumeration order when it differs from
+    the device axis (uneven zones); None = the axis order every cycle.
+    `spread0` [n_pad] carries selector-spread counts across the burst
+    (requires spec-identical pods — one shared selector set)."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    if rotation is None:
+        z = jnp.zeros((1, 1), jnp.int32)
+        perms = inv_perms = z
+        oid_seq = jnp.zeros(1, jnp.int32)
+    else:
+        perms, inv_perms, oid_seq = (jnp.asarray(a, jnp.int32)
+                                     for a in rotation)
+    carry_spread = spread0 is not None
+    s0 = jnp.asarray(spread0, jnp.int64) if carry_spread \
+        else jnp.zeros((), jnp.int64)
     return _schedule_batch_jit(
         nodes, pods, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
-        _i64(n_real), z_pad, weights_tuple)
+        _i64(n_real), perms, inv_perms, oid_seq, s0, z_pad, weights_tuple,
+        rotation is not None, carry_spread)
 
 
 # ---------------------------------------------------------------------------

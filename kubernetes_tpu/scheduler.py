@@ -509,30 +509,25 @@ class Scheduler:
 
     # -- burst mode (TPU throughput path) -------------------------------------
     def _pod_is_burstable(self, pod: Pod, services=None, replicasets=None) -> bool:
-        """A pod may ride a device burst unless its per-node state depends on
-        in-burst placements in ways no burst kernel models yet: volume
-        binding and selector-spread counts. Affinity/port pods are admitted
-        — the uniform kernel folds their interactions (self-node bans) and
-        refuses anything it can't replay exactly. `services`/`replicasets`
-        are passed in so a burst lists them once, not once per pod."""
+        """A pod may ride a device burst unless its per-node state depends
+        on in-burst placements in a way no burst kernel models yet — only
+        volume binding remains. Affinity/port/spread pods are admitted: the
+        kernels fold their interactions (self-node bans, carried spread
+        counts) and refuse anything they can't replay exactly."""
         if pod.volumes:
-            return False
-        from kubernetes_tpu.oracle.priorities import get_selectors
-        if get_selectors(pod,
-                         self._services_fn() if services is None else services,
-                         self._replicasets_fn() if replicasets is None else replicasets):
             return False
         return True
 
-    @staticmethod
-    def _burst_class(pod: Pod):
+    def _burst_class(self, pod: Pod, services, replicasets):
         """Segmentation key: pods with in-burst-dynamic features (affinity /
-        host ports) burst only with spec-identical peers (the uniform
-        kernel's contract); plain pods share one generic segment even when
-        heterogeneous."""
+        host ports / selector-spread) burst only with spec-identical peers
+        (the kernels' eligibility contract); plain pods share one generic
+        segment even when heterogeneous."""
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports)
-        if has_pod_affinity_terms(pod) or get_container_ports(pod):
+        from kubernetes_tpu.oracle.priorities import get_selectors
+        if has_pod_affinity_terms(pod) or get_container_ports(pod) \
+                or get_selectors(pod, services, replicasets):
             from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
             return TPUScheduler._class_signature(pod)
         return "plain"
@@ -573,11 +568,12 @@ class Scheduler:
                 self._process_one(pods[i], cycles[i])
                 i += 1
                 continue
-            seg_class = self._burst_class(pods[i])
+            seg_class = self._burst_class(pods[i], services, replicasets)
             j = i
             while j < len(pods) and not self.queue.nominated.has_any() \
                     and self._pod_is_burstable(pods[j], services, replicasets) \
-                    and self._burst_class(pods[j]) == seg_class:
+                    and self._burst_class(pods[j], services,
+                                          replicasets) == seg_class:
                 j += 1
             self._burst_segment(pods[i:j], cycles[i:j], max_pods)
             i = j

@@ -705,3 +705,57 @@ class TestPreemptionPressureShellFuzz:
             outs.append(sorted((p.key, p.node_name, p.nominated_node_name)
                                for p in s.list(PODS)[0]))
         assert outs[0] == outs[1]
+
+
+class TestSpreadBurstParity:
+    """Service-matched pods ride the generic scan with carried spread
+    counts and per-cycle rotation orders; bindings must match the oracle
+    including the zone blend and uneven-zone rotation."""
+
+    @pytest.mark.parametrize("n_nodes,zones,n_pods", [
+        (7, 3, 20),     # uneven zones -> rotated orders in-burst
+        (12, 2, 30),    # even zones -> stable axis order
+        (5, 1, 40),     # deep stacking on few nodes
+    ])
+    def test_burst_matches_oracle(self, n_nodes, zones, n_pods):
+        from kubernetes_tpu.store.store import Store, PODS, NODES, SERVICES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.api.types import Service
+        GI = 1024 ** 3
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={LABEL_HOSTNAME: f"n{i}",
+                            "failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % zones}",
+                            "failure-domain.beta.kubernetes.io/region": "r1"},
+                    allocatable={"cpu": 4000, "memory": 32 * GI,
+                                 "pods": 110}))
+            s.create(SERVICES, Service(name="svc", selector={"app": "web"}))
+            return s
+
+        outs = []
+        for use_tpu in (True, False):
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            for j in range(n_pods):
+                s.create(PODS, Pod(name=f"p{j}", labels={"app": "web"},
+                                   containers=(Container.make(
+                                       name="c", requests={"cpu": 300,
+                                                           "memory": GI}),)))
+            sched.pump()
+            if use_tpu:
+                while sched.schedule_burst(max_pods=16):
+                    pass
+            else:
+                while sched.schedule_one(timeout=0.0):
+                    pass
+            sched.pump()
+            outs.append(sorted((p.key, p.node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1]
