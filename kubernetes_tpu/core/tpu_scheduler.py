@@ -950,6 +950,13 @@ class TPUScheduler:
         victims = [p for j, p in enumerate(slots[name]) if flags[j]]
         return PreemptionResult(node_infos[name].node, victims, [])
 
+    def discard_burst_folds(self) -> None:
+        """Forget the device-resident node matrix: in-scan folds for burst
+        decisions the shell discarded (the serial tail after a mid-burst
+        failure) must not leak into later cycles — the next use re-uploads
+        from the host mirror, which only reflects consumed decisions."""
+        self._dev_nodes = None
+
     def note_burst_assumed(self, pod: Pod, host: str, generation: int) -> None:
         """Post-burst bookkeeping for one placed pod: fold the same delta
         the device scan applied into the host numpy mirror and sync the

@@ -584,6 +584,8 @@ class Scheduler:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
         self._last_names = names
+        li0 = getattr(self.algorithm, "last_index", None)
+        lni0 = getattr(self.algorithm, "last_node_index", None)
         hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
                                               names, bucket=bucket)
         if hosts is None:
@@ -594,16 +596,25 @@ class Scheduler:
             for i, (pod, cycle) in enumerate(zip(pods, cycles)):
                 self._process_one(pod, cycle, names=names if i == 0 else None)
             return
+        if any(host is None for host in hosts):
+            # a failing pod's serial rerun can preempt — nominating a node
+            # and deleting victims, state the OTHER kernel decisions never
+            # saw. The kernel also already committed whole-burst rotation
+            # counters and device folds, so partial consumption can't be
+            # made serial-exact: roll the segment back entirely (device
+            # matrix + last_index/lastNodeIndex) and run it serially.
+            discard = getattr(self.algorithm, "discard_burst_folds", None)
+            if discard is not None:
+                discard()
+            if li0 is not None:
+                self.algorithm.last_index = li0
+            if lni0 is not None:
+                self.algorithm.last_node_index = lni0
+            for k, (pod, cycle) in enumerate(zip(pods, cycles)):
+                self._process_one(pod, cycle, names=names if k == 0 else None)
+            return
         note = getattr(self.algorithm, "note_burst_assumed", None)
         for pod, host, cycle in zip(pods, hosts, cycles):
-            if host is None:
-                # re-run serially for the failure reasons + preemption path.
-                # Reuse the segment's enumeration: an unschedulable verdict
-                # is order-independent (F == 0 in the kernel's cycle), and a
-                # fresh list_names() here would drift the tree's zone index
-                # past what `len(pods)` serial cycles consume
-                self._process_one(pod, cycle, names=names)
-                continue
             assumed = pod.clone()
             assumed.node_name = host
             self.cache.assume_pod(assumed)
@@ -615,8 +626,8 @@ class Scheduler:
                     note(assumed, host, gen)
             self._bind(assumed, host, pod, cycle)  # observes "scheduled"
         # serial semantics consume one NodeTree enumeration per pod; the
-        # kernel modeled cycles 0..len(pods)-1 but only pod 0's enumeration
-        # was actually consumed — fast-forward the rest
+        # kernel modeled cycles 0..len(pods)-1 on the segment's single
+        # enumeration — fast-forward the rest
         self.cache.node_tree.advance_enumerations(len(pods) - 1)
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:

@@ -759,3 +759,53 @@ class TestSpreadBurstParity:
             outs.append(sorted((p.key, p.node_name)
                                for p in s.list(PODS)[0]))
         assert outs[0] == outs[1]
+
+
+class TestMidBurstPreemptionConsistency:
+    """A mid-burst failure's preemption (nomination + victim deletion)
+    mutates state the remaining kernel decisions never saw — the shell must
+    discard those decisions (and their device folds) and finish the burst
+    serially. Regression: B used to bind onto the node A had just
+    nominated, and A's preemption read a device matrix polluted by B's
+    discarded fold."""
+
+    def test_later_pod_respects_fresh_nomination(self):
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        GI = 1024 ** 3
+
+        def build():
+            s = Store(watch_log_size=65536)
+            s.create(NODES, Node(name="Y", labels={LABEL_HOSTNAME: "Y"},
+                                 allocatable={"cpu": 1000, "memory": 8 * GI,
+                                              "pods": 110}))
+            s.create(PODS, Pod(name="w", priority=1, node_name="Y",
+                               containers=(Container.make(
+                                   name="c", requests={"cpu": 400}),)))
+            return s
+
+        results = []
+        for use_tpu in (True, False):
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            s.create(PODS, Pod(name="A", priority=5, containers=(
+                Container.make(name="c", requests={"cpu": 1000}),)))
+            s.create(PODS, Pod(name="B", priority=0, containers=(
+                Container.make(name="c", requests={"cpu": 300}),)))
+            sched.pump()
+            if use_tpu:
+                sched.schedule_burst(max_pods=8)
+            else:
+                sched.schedule_one(timeout=0.0)
+                sched.schedule_one(timeout=0.0)
+            sched.pump()
+            results.append(sorted(
+                (p.key, p.node_name, p.nominated_node_name)
+                for p in s.list(PODS)[0]))
+        assert results[0] == results[1]
+        # the high-priority pod nominated Y (victim evicted); the later
+        # low-priority pod must NOT have taken the nominated space
+        assert ("default/A", "", "Y") in results[0]
+        assert ("default/B", "", "") in results[0]
