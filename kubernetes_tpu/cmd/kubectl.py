@@ -147,19 +147,56 @@ def cmd_describe(args) -> int:
     return 0
 
 
-def cmd_create(args) -> int:
+def _load_items(args) -> list[tuple[str, dict]]:
     with open(args.filename) as f:
         manifest = json.load(f)
     items = manifest.get("items", [manifest]) \
         if isinstance(manifest, dict) else manifest
+    out = []
     for item in items:
-        kind = item.pop("kind", None) or args.kind
+        kind = item.pop("kind", None) or getattr(args, "kind", None)
         if not kind:
-            print("manifest item missing 'kind'", file=sys.stderr)
-            return 1
+            raise SystemExit("manifest item missing 'kind'")
+        out.append((kind, item))
+    return out
+
+
+def cmd_create(args) -> int:
+    for kind, item in _load_items(args):
         created = _req(args.server, "POST", f"/api/v1/{kind}", item)
-        name = created.get("name", "?")
-        print(f"{kind}/{name} created")
+        print(f"{kind}/{created.get('name', '?')} created")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    """Declarative create-or-update: POST, and on AlreadyExists re-read the
+    live object and PUT the manifest over it at the current
+    resourceVersion (kubectl apply's effective behavior for this model)."""
+    import urllib.error
+    for kind, item in _load_items(args):
+        data = json.dumps(item).encode()
+        req = urllib.request.Request(
+            f"{args.server}/api/v1/{kind}", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                created = json.loads(resp.read())
+            print(f"{kind}/{created.get('name', '?')} created")
+            continue
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                print(f"Error from server ({e.code})", file=sys.stderr)
+                raise APIError(1)
+        # exists: overlay at the live resourceVersion
+        ns = item.get("namespace", "default")
+        name = item.get("name", "")
+        key = name if kind in ("nodes", "persistentvolumes",
+                               "priorityclasses") else f"{ns}/{name}"
+        live = _req(args.server, "GET", f"/api/v1/{kind}/{key}")
+        merged = {**live, **item,
+                  "resource_version": live.get("resource_version", 0)}
+        _req(args.server, "PUT", f"/api/v1/{kind}/{key}", merged)
+        print(f"{kind}/{name} configured")
     return 0
 
 
@@ -221,6 +258,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     c.add_argument("-f", "--filename", required=True)
     c.add_argument("--kind")
     c.set_defaults(fn=cmd_create)
+
+    a = sub.add_parser("apply")
+    a.add_argument("-f", "--filename", required=True)
+    a.add_argument("--kind")
+    a.set_defaults(fn=cmd_apply)
 
     rm = sub.add_parser("delete")
     rm.add_argument("kind")

@@ -273,3 +273,29 @@ class TestAdmissionDefaults:
         tols = {t["key"]: t for t in p["tolerations"]}
         assert tols[TAINT_NOT_READY]["toleration_seconds"] == 7.0
         assert dict(map(tuple, p["containers"][0]["requests"]))["cpu"] == 900
+
+
+class TestKubectlApply:
+    def test_apply_creates_then_configures(self, server, tmp_path):
+        store, url = server
+        import contextlib
+        from kubernetes_tpu.cmd import kubectl
+
+        def kc(*argv):
+            out = io.StringIO()
+            with contextlib.redirect_stdout(out):
+                assert kubectl.main(["--server", url, *argv]) == 0
+            return out.getvalue()
+
+        f = tmp_path / "rs.json"
+        f.write_text(json.dumps({"kind": "replicasets", "name": "web",
+                                 "selector": {"match_labels": [["app", "web"]]},
+                                 "replicas": 2}))
+        assert "created" in kc("apply", "-f", str(f))
+        from kubernetes_tpu.store.store import REPLICASETS
+        assert store.get(REPLICASETS, "default/web").replicas == 2
+        f.write_text(json.dumps({"kind": "replicasets", "name": "web",
+                                 "selector": {"match_labels": [["app", "web"]]},
+                                 "replicas": 5}))
+        assert "configured" in kc("apply", "-f", str(f))
+        assert store.get(REPLICASETS, "default/web").replicas == 5
