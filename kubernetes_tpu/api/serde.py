@@ -30,6 +30,12 @@ KIND_TYPES = {
     store_mod.ENDPOINTS: T.Endpoints,
 }
 
+# kinds whose objects key by bare name (Node.key etc.); everything else
+# keys by namespace/name — the single owner of REST path scoping
+CLUSTER_SCOPED_KINDS = frozenset(
+    kind for kind, cls in KIND_TYPES.items()
+    if "namespace" not in {f.name for f in dataclasses.fields(cls)})
+
 
 def to_dict(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):

@@ -29,7 +29,11 @@ class APIError(SystemExit):
     pass
 
 
-def _req(server: str, method: str, path: str, body: Optional[dict] = None):
+def _req(server: str, method: str, path: str, body: Optional[dict] = None,
+         return_codes: tuple = ()):
+    """HTTP round trip; server errors print the Status message and exit,
+    except codes in `return_codes`, which return (code, status_dict) so
+    callers can handle them (apply's AlreadyExists/Conflict races)."""
     data = json.dumps(body).encode() if body is not None else None
     req = urllib.request.Request(server + path, data=data, method=method,
                                  headers={"Content-Type": "application/json"})
@@ -38,10 +42,12 @@ def _req(server: str, method: str, path: str, body: Optional[dict] = None):
             return json.loads(resp.read() or b"{}")
     except urllib.error.HTTPError as e:
         try:
-            status = json.loads(e.read())
+            status = json.loads(e.read() or b"{}")
             msg = status.get("message", str(e))
         except Exception:
-            msg = str(e)
+            status, msg = {}, str(e)
+        if e.code in return_codes:
+            return (e.code, status)
         print(f"Error from server ({e.code}): {msg}", file=sys.stderr)
         raise APIError(1)
 
@@ -171,31 +177,31 @@ def cmd_create(args) -> int:
 def cmd_apply(args) -> int:
     """Declarative create-or-update: POST, and on AlreadyExists re-read the
     live object and PUT the manifest over it at the current
-    resourceVersion (kubectl apply's effective behavior for this model)."""
-    import urllib.error
+    resourceVersion, retrying the read-modify-write on Conflict (kubectl
+    apply's effective behavior for this model)."""
+    from kubernetes_tpu.api.serde import CLUSTER_SCOPED_KINDS
     for kind, item in _load_items(args):
-        data = json.dumps(item).encode()
-        req = urllib.request.Request(
-            f"{args.server}/api/v1/{kind}", data=data, method="POST",
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req) as resp:
-                created = json.loads(resp.read())
-            print(f"{kind}/{created.get('name', '?')} created")
+        r = _req(args.server, "POST", f"/api/v1/{kind}", item,
+                 return_codes=(409,))
+        if not (isinstance(r, tuple) and r[0] == 409):
+            print(f"{kind}/{r.get('name', '?')} created")
             continue
-        except urllib.error.HTTPError as e:
-            if e.code != 409:
-                print(f"Error from server ({e.code})", file=sys.stderr)
-                raise APIError(1)
-        # exists: overlay at the live resourceVersion
-        ns = item.get("namespace", "default")
+        # exists: overlay at the live resourceVersion; a concurrent writer
+        # between GET and PUT conflicts — re-read and retry, bounded
         name = item.get("name", "")
-        key = name if kind in ("nodes", "persistentvolumes",
-                               "priorityclasses") else f"{ns}/{name}"
-        live = _req(args.server, "GET", f"/api/v1/{kind}/{key}")
-        merged = {**live, **item,
-                  "resource_version": live.get("resource_version", 0)}
-        _req(args.server, "PUT", f"/api/v1/{kind}/{key}", merged)
+        key = name if kind in CLUSTER_SCOPED_KINDS \
+            else f"{item.get('namespace', 'default')}/{name}"
+        for _attempt in range(5):
+            live = _req(args.server, "GET", f"/api/v1/{kind}/{key}")
+            merged = {**live, **item,
+                      "resource_version": live.get("resource_version", 0)}
+            r = _req(args.server, "PUT", f"/api/v1/{kind}/{key}", merged,
+                     return_codes=(409,))
+            if not (isinstance(r, tuple) and r[0] == 409):
+                break
+        else:
+            print(f"Error: {kind}/{key}: conflict persisted", file=sys.stderr)
+            raise APIError(1)
         print(f"{kind}/{name} configured")
     return 0
 
