@@ -299,3 +299,36 @@ class TestKubectlApply:
                                  "replicas": 5}))
         assert "configured" in kc("apply", "-f", str(f))
         assert store.get(REPLICASETS, "default/web").replicas == 5
+
+
+class TestWatchResume:
+    def test_resume_from_rv_and_410_gone(self, server):
+        store, url = server
+        # generate history
+        for j in range(5):
+            store.create(PODS, Pod(name=f"h{j}"))
+        rv = store.resource_version()
+        store.create(PODS, Pod(name="after"))
+        # resume from rv: only the later event arrives
+        got = []
+        def watcher():
+            with urllib.request.urlopen(
+                    f"{url}/api/v1/pods?watch=true&resourceVersion={rv}") as r:
+                for raw in r:
+                    line = raw.strip()
+                    if line:
+                        got.append(json.loads(line))
+                        return
+        t = threading.Thread(target=watcher, daemon=True)
+        t.start()
+        t.join(5)
+        assert got and got[0]["object"]["name"] == "after"
+        # a resume point older than the log window is 410 Gone -> re-list
+        small = Store(watch_log_size=4)
+        with APIServer(small) as srv2:
+            for j in range(10):
+                small.create(PODS, Pod(name=f"x{j}"))
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"{srv2.url}/api/v1/pods?watch=true&resourceVersion=1")
+            assert e.value.code == 410
