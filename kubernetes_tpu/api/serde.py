@@ -28,6 +28,7 @@ KIND_TYPES = {
     store_mod.EVENTS: T.EventRecord,
     "priorityclasses": T.PriorityClass,
     store_mod.ENDPOINTS: T.Endpoints,
+    store_mod.RESOURCEQUOTAS: T.ResourceQuota,
 }
 
 # kinds whose objects key by bare name (Node.key etc.); everything else

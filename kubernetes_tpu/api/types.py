@@ -583,6 +583,30 @@ class Endpoints:
 
 
 @dataclass
+class ResourceQuota:
+    """Pruned v1.ResourceQuota: per-namespace hard caps on aggregate pod
+    requests and object counts. `hard` / `used` map resource names
+    ("cpu" milli, "memory" bytes, "pods") to totals; `used` is reconciled
+    by controllers.resourcequota and enforced at admission
+    (plugin/pkg/admission/resourcequota)."""
+    name: str
+    namespace: str = "default"
+    hard: dict[str, int] = field(default_factory=dict)
+    used: dict[str, int] = field(default_factory=dict)
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def clone(self) -> "ResourceQuota":
+        out = _shallow(self)
+        out.hard = dict(self.hard)
+        out.used = dict(self.used)
+        return out
+
+
+@dataclass
 class PriorityClass:
     """Pruned scheduling.k8s.io/v1beta1 PriorityClass — resolved into
     pod.priority by the priority admission plugin

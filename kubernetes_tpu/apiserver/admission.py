@@ -103,10 +103,40 @@ class LimitRanger:
         return obj
 
 
+class ResourceQuotaAdmission:
+    """plugin/pkg/admission/resourcequota: reject pod creation that would
+    push any namespace quota's usage past its hard caps. Enforcement is
+    against the controller-reconciled `used` totals plus this pod's
+    requests (the reference evaluates + CASes quota status the same way)."""
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        from kubernetes_tpu.controllers.resourcequota import pod_usage
+        quotas, _rv = store.list(RESOURCEQUOTAS)
+        usage = None
+        for q in quotas:
+            if q.namespace != obj.namespace or not q.hard:
+                continue
+            if usage is None:
+                usage = pod_usage(obj)
+            over = [
+                f"{name}: used {q.used.get(name, 0)} + requested "
+                f"{usage.get(name, 0)} > hard {cap}"
+                for name, cap in q.hard.items()
+                if q.used.get(name, 0) + usage.get(name, 0) > cap]
+            if over:
+                raise AdmissionError(
+                    f"exceeded quota {q.key}: " + "; ".join(over))
+        return obj
+
+
 class AdmissionChain:
     def __init__(self, plugins: Optional[list] = None):
         self.plugins = plugins if plugins is not None else [
-            PriorityAdmission(), DefaultTolerationSeconds(), LimitRanger()]
+            PriorityAdmission(), DefaultTolerationSeconds(), LimitRanger(),
+            ResourceQuotaAdmission()]
 
     def admit(self, kind: str, obj: Any, store: Store) -> Any:
         for p in self.plugins:

@@ -17,6 +17,7 @@ from kubernetes_tpu.controllers.nodelifecycle import NodeLifecycleController
 from kubernetes_tpu.controllers.podgc import PodGCController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
 
 # name -> constructor(store) (NewControllerInitializers analog)
 CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
@@ -25,6 +26,7 @@ CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "podgc": PodGCController,
     "replicaset": ReplicaSetController,
     "endpoint": EndpointsController,
+    "resourcequota": ResourceQuotaController,
 }
 
 

@@ -40,6 +40,7 @@ LEASES = "leases"  # leader-election locks (resourcelock analog)
 EVENTS = "events"  # user-visible audit records (record.EventRecorder analog)
 PRIORITYCLASSES = "priorityclasses"  # scheduling.k8s.io (admission-resolved)
 ENDPOINTS = "endpoints"  # service backends (controllers.endpoints)
+RESOURCEQUOTAS = "resourcequotas"  # per-namespace caps (admission-enforced)
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
 

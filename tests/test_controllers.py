@@ -512,3 +512,51 @@ class TestHollowProxy:
         ec.pump()
         proxy.pump()
         assert proxy.route("default/db") is None
+
+
+class TestResourceQuota:
+    def test_usage_reconciled_and_enforced(self):
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.controllers.resourcequota import (
+            ResourceQuotaController)
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.api import serde
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        import urllib.request, urllib.error, json as _json
+
+        store = Store()
+        rqc = ResourceQuotaController(store)
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="q", hard={"cpu": 1000, "pods": 3}))
+        store.create(PODS, bound_pod("a", "n0", cpu=400))
+        store.create(PODS, bound_pod("b", "n0", cpu=400))
+        rqc.sync()
+        q = store.get(RESOURCEQUOTAS, "default/q")
+        assert q.used == {"cpu": 800, "pods": 2}
+
+        with APIServer(store) as srv:
+            def post(pod):
+                data = _json.dumps(serde.to_dict(pod)).encode()
+                req = urllib.request.Request(
+                    f"{srv.url}/api/v1/pods", data=data, method="POST",
+                    headers={"Content-Type": "application/json"})
+                return urllib.request.urlopen(req)
+            # 300m would exceed the 1000m cap (800 used) -> 422
+            import pytest as _pytest
+            with _pytest.raises(urllib.error.HTTPError) as e:
+                post(bound_pod("c", "", cpu=300))
+            assert e.value.code == 422
+            assert "exceeded quota" in _json.loads(e.value.read())["message"]
+            # 150m fits
+            assert post(bound_pod("d", "", cpu=150)).status == 201
+        rqc.pump()
+        q = store.get(RESOURCEQUOTAS, "default/q")
+        assert q.used == {"cpu": 950, "pods": 3}
+        # terminated pods leave the quota
+        def finish(cur):
+            cur.phase = "Succeeded"
+            return cur
+        store.guaranteed_update(PODS, "default/a", finish)
+        rqc.pump()
+        assert store.get(RESOURCEQUOTAS, "default/q").used == \
+            {"cpu": 550, "pods": 2}
