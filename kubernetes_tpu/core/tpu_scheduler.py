@@ -474,12 +474,6 @@ class TPUScheduler:
         return ScheduleResult(host, evaluated, found, host_priority, failed)
 
     # -- burst path ----------------------------------------------------------
-    _FEATURE_FIELDS = ("sel_ok", "taints_ok", "unsched_ok", "ports_ok",
-                       "host_ok", "disk_ok", "maxvol_ok", "volbind_ok",
-                       "volzone_ok", "interpod_code", "node_aff_counts",
-                       "taint_counts", "spread_counts", "interpod_counts",
-                       "interpod_tracked", "image_sums", "prefer_avoid")
-
     # per-node mask fields that CANNOT change from in-burst placements —
     # they depend on node labels/taints/spec and pre-burst pods only
     _STATIC_MASKS = ("sel_ok", "taints_ok", "unsched_ok", "host_ok",
