@@ -100,9 +100,10 @@ class TPUScheduler:
         # multi-chip mode: node axis sharded over a jax.sharding.Mesh
         # (parallel/sharding.py — per-shard filter/score, ICI all-gather,
         # replicated select). mesh="auto" builds one over every visible
-        # device; None stays single-chip. Cycles and generic-scan bursts run
-        # sharded; the uniform K-batch kernel stays single-chip (its
-        # while-loop epilogue is scalar-bound, not node-bound).
+        # device; None stays single-chip. Cycles, generic-scan bursts AND
+        # the uniform K-batch kernel all run sharded — the north-star
+        # multi-chip config (BASELINE.json configs[4]) rides the uniform
+        # path with per-shard sweeps and a replicated tie-walk epilogue.
         if mesh == "auto":
             import jax as _jax
             mesh = None
@@ -717,7 +718,7 @@ class TPUScheduler:
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
         uniform = None
         feats: Optional[list] = None
-        if self.mesh is None and num_to_find >= n and self.last_index == 0:
+        if num_to_find >= n and self.last_index == 0:
             # spec-identical pods produce identical encoder output against a
             # fixed snapshot, so the uniform path encodes ONE pod — per-pod
             # feature encoding (IPA topology counting in particular) is the
@@ -745,7 +746,7 @@ class TPUScheduler:
                 rows, packed = K.schedule_batch_uniform(
                     nodes, dict(cls), chunk, self.last_node_index, n,
                     self.check_resources, weights=self.weights, rotation=rot,
-                    extra_ok=extra_ok, ban=ban)
+                    extra_ok=extra_ok, ban=ban, mesh=self.mesh)
                 self._dev_nodes = {**self._dev_nodes, **rows}
                 nodes = self._dev_nodes
                 h = np.asarray(packed)   # ONE fetch: selections + lni delta

@@ -550,12 +550,16 @@ K_BATCH = 512        # pods resolved per O(N) pass (static)
 B_CAP = 16384        # output-buffer capacity (static); callers chunk above it
 
 
-@partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap", "k_batch",
-                                   "rotate", "ban", "has_extra"))
-def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
-                                perm, oid_seq, extra_ok, weights_tuple, flags,
-                                b_cap, k_batch, rotate, ban, has_extra):
-    weights = dict(weights_tuple)
+def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
+                  perm, oid_seq, extra_ok, weights, flags,
+                  b_cap, k_batch, rotate, ban, has_extra, constrain=None):
+    """Body of the uniform-class burst kernel. `constrain` (optional) pins
+    node-axis arrays — the carried [R, N1]/[N1] state and the static alloc
+    vectors — to a mesh sharding so the O(N) sweep splits across chips while
+    the scalar tie-walk epilogue replicates (parallel/sharding.py wraps this
+    for the north-star multi-chip config; None = single-chip identity)."""
+    if constrain is None:
+        constrain = lambda v: v
     check_res, has_req, carry_eph, static_eph, carried_s, static_s = flags
     i32 = jnp.int32
     n_pad = nodes["valid"].shape[0]
@@ -578,10 +582,11 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
     # there so active lanes (distinct by construction) never collide
     def pad1(v):
         return jnp.concatenate([v, jnp.zeros(1, v.dtype)])
-    ok = pad1(ok)
-    alloc_cpu, alloc_mem = pad1(nodes["alloc_cpu"]), pad1(nodes["alloc_mem"])
-    allowed = pad1(nodes["allowed_pods"])
-    alloc_eph = pad1(nodes["alloc_eph"])
+    ok = constrain(pad1(ok))
+    alloc_cpu = constrain(pad1(nodes["alloc_cpu"]))
+    alloc_mem = constrain(pad1(nodes["alloc_mem"]))
+    allowed = constrain(pad1(nodes["allowed_pods"]))
+    alloc_eph = constrain(pad1(nodes["alloc_eph"]))
 
     rows = [nodes["req_cpu"], nodes["req_mem"], nodes["nz_cpu"],
             nodes["nz_mem"], nodes["pod_count"]]
@@ -596,13 +601,14 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
     for s in carried_s:
         rows.append(nodes["req_scalar"][:, s])
         delta.append(cls["upd_scalar"][s])
-        alloc_sc.append(pad1(nodes["alloc_scalar"][:, s]))
-    st0 = jnp.stack([pad1(r) for r in rows])
+        alloc_sc.append(constrain(pad1(nodes["alloc_scalar"][:, s])))
+    st0 = constrain(jnp.stack([pad1(r) for r in rows]))
     delta_vec = jnp.stack([jnp.asarray(d, jnp.int64) for d in delta])
     I32_MIN = jnp.int32(-2**31)
 
-    tot0 = _local_total(weights, cls["nz_cpu"] + st0[2], cls["nz_mem"] + st0[3],
-                        alloc_cpu, alloc_mem).astype(i32)
+    tot0 = constrain(_local_total(
+        weights, cls["nz_cpu"] + st0[2], cls["nz_mem"] + st0[3],
+        alloc_cpu, alloc_mem).astype(i32))
     jlane = jnp.arange(k_batch, dtype=i32)
     B = jnp.asarray(n_pods, i32)
 
@@ -746,11 +752,12 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
         emit = jnp.where((jlane < v) & (F > 0), sel, -1)
         out = jax.lax.dynamic_update_slice(out, emit, (done,))
         lni = lni + jnp.where(F > 1, v, 0).astype(jnp.int64)
-        return st, tot, banned, lni, done + v, out
+        return (constrain(st), constrain(tot), constrain(banned),
+                lni, done + v, out)
 
     out0 = jnp.full(b_cap + k_batch, -1, i32)
     lni0 = jnp.asarray(last_node_index, jnp.int64)
-    banned0 = jnp.zeros(n_pad + 1, dtype=bool)
+    banned0 = constrain(jnp.zeros(n_pad + 1, dtype=bool))
     st, tot, _banned, lni, done, out = jax.lax.while_loop(
         lambda c: c[4] < B, body, (st0, tot0, banned0, lni0, jnp.int32(0), out0))
     # pack the lastNodeIndex advance into the selection buffer so the caller
@@ -772,9 +779,19 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
     return out_rows, out[: b_cap + 1]
 
 
+@partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap", "k_batch",
+                                   "rotate", "ban", "has_extra"))
+def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
+                                perm, oid_seq, extra_ok, weights_tuple, flags,
+                                b_cap, k_batch, rotate, ban, has_extra):
+    return _uniform_core(nodes, cls, n_pods, last_node_index, n_real, perm,
+                         oid_seq, extra_ok, dict(weights_tuple), flags, b_cap,
+                         k_batch, rotate, ban, has_extra)
+
+
 def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
                            check_resources, weights=None, rotation=None,
-                           extra_ok=None, ban=False):
+                           extra_ok=None, ban=False, mesh=None):
     """Uniform-class burst (see block comment above). `cls` holds the shared
     per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
     upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
@@ -815,6 +832,14 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     has_extra = extra_ok is not None
     extra = jnp.asarray(extra_ok, bool) if has_extra \
         else jnp.zeros(1, dtype=bool)
+    if mesh is not None:
+        # north-star multi-chip config: node-axis state sharded over the
+        # mesh, tie-walk epilogue replicated (parallel/sharding.py)
+        from kubernetes_tpu.parallel import sharding as S
+        fn = S.sharded_uniform_fn(mesh, weights_tuple, flags, B_CAP, K_BATCH,
+                                  rotation is not None, bool(ban), has_extra)
+        return fn(nodes, cls, _i64(n_pods), _i64(last_node_index),
+                  _i64(n_real), perm, oid_seq, extra)
     return _schedule_batch_uniform_jit(
         nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
         perm, oid_seq, extra, weights_tuple, flags, B_CAP, K_BATCH,
