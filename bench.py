@@ -6,9 +6,24 @@ zone-labeled), P pending pods created through the store, scheduled by the
 TPU burst path (store -> informers -> cache/queue -> fused kernel ->
 assume/bind). Prints ONE JSON line.
 
-Baseline: the reference harness warns below 100 pods/s and fails below 30
-(scheduler_test.go:35-38); vs_baseline is measured against the 100 pods/s
-"healthy default scheduler" mark.
+Baseline semantics (be precise about what the ratios divide by):
+- `vs_baseline` divides by the reference harness's 100 pods/s "healthy
+  scheduler" CI warn threshold (scheduler_test.go:35-38) — a CI floor, NOT
+  a measured Go-scheduler run.
+- `vs_measured_oracle` divides by a measured run of this repo's pure-Python
+  oracle (the exact-semantics referee) at the same node count — the honest
+  apples-to-apples ratio.
+
+The default run also emits:
+- `matrix`: the scheduler_bench_test.go-style workload lanes (plain /
+  anti-affinity / affinity / node-affinity / spread at 1000 nodes / 1000
+  existing / 1000 measured pods, median of repeats, reference
+  scheduler_bench_test.go:39-131) plus the preemption victim-scan lane —
+  so every burst kernel lane is driver-captured, not self-reported.
+- `mesh`: the same north-star workload with the node axis sharded over a
+  jax.sharding.Mesh of every visible device (the BASELINE.json configs[4]
+  path; on a single chip this is a 1-device mesh exercising the sharded
+  program — guarding against mesh-mode throughput regressions).
 """
 from __future__ import annotations
 
@@ -16,6 +31,10 @@ import argparse
 import json
 import sys
 import time
+
+BASELINE_NOTE = ("vs_baseline = throughput / 100 pods/s, the reference "
+                 "harness CI warn floor (scheduler_test.go:35-38), not a "
+                 "measured Go run; vs_measured_oracle is measured")
 
 
 def build_cluster(store, n_nodes: int):
@@ -42,6 +61,11 @@ def make_pods(store, n_pods: int, start: int = 0):
                 name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
 
 
+def _make_mesh():
+    from kubernetes_tpu.parallel import sharding as S
+    return S.make_mesh()
+
+
 def measure_oracle(n_nodes: int, n_pods: int) -> float:
     """Measured pods/s of the pure-Python oracle at the same node count.
     The oracle's per-pod cost is O(nodes) and flat in pod count (each cycle
@@ -53,14 +77,14 @@ def measure_oracle(n_nodes: int, n_pods: int) -> float:
 
 
 def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
-              compare: bool = True) -> dict:
+              compare: bool = True, mesh=None) -> dict:
     from kubernetes_tpu.store.store import Store
     from kubernetes_tpu.scheduler import Scheduler
 
     store = Store(watch_log_size=max(65536, 2 * (n_nodes + n_pods)))
     build_cluster(store, n_nodes)
     sched = Scheduler(store, use_tpu=(mode != "oracle"),
-                      percentage_of_nodes_to_score=100)
+                      percentage_of_nodes_to_score=100, mesh=mesh)
     sched.sync()
 
     # warmup: trigger jit compilation outside the timed window
@@ -91,16 +115,17 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
     sched.pump()  # confirm bindings
 
     throughput = bound / elapsed if elapsed > 0 else 0.0
+    tag = "_mesh" if mesh is not None else ""
     result = {
-        "metric": f"sched_throughput_{n_nodes}n_{n_pods}p_{mode}",
+        "metric": f"sched_throughput_{n_nodes}n_{n_pods}p_{mode}{tag}",
         "value": round(throughput, 1),
         "unit": "pods/s",
         "vs_baseline": round(throughput / 100.0, 2),
     }
     if compare and mode != "oracle":
         # measured same-node-count oracle ratio next to the fixed 100 pods/s
-        # "healthy default scheduler" mark (the oracle's per-pod cost is flat
-        # in pod count; sample a small burst of pods at full cluster size)
+        # CI floor (the oracle's per-pod cost is flat in pod count; sample a
+        # small burst of pods at full cluster size)
         sample = min(n_pods, 100)
         oracle = measure_oracle(n_nodes, sample)
         result["oracle_measured"] = oracle
@@ -164,6 +189,36 @@ def run_preempt_bench(n_nodes: int, n_victims: int) -> dict:
     }
 
 
+# the non-plain lanes of the benchmark matrix at the reference's 1000-node /
+# 1000-existing cell (scheduler_bench_test.go:61-118) plus the spread lane
+MATRIX_LANES = ("plain", "anti-affinity", "affinity", "node-affinity",
+                "spread")
+
+
+def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
+               pods: int = 1000) -> dict:
+    """Median pods/s per workload lane + the preemption scan lane — one dict
+    the driver captures, so a regression in any burst kernel lane shows up
+    in BENCH_r{N}.json instead of only in self-reported README numbers."""
+    from kubernetes_tpu.perf.harness import PerfConfig, run
+    out = {}
+    for lane in MATRIX_LANES:
+        vals = []
+        for _ in range(max(repeat, 1)):
+            res = run(PerfConfig(nodes=nodes, existing_pods=existing,
+                                 pods=pods, workload=lane))
+            vals.append(res.throughput)
+        vals.sort()
+        # lower-middle for even counts: with the tunnel's +-15% variance,
+        # the upper-middle would systematically report the optimistic run
+        out[lane.replace("-", "_")] = round(vals[(len(vals) - 1) // 2], 1)
+    p = run_preempt_bench(1000, 10000)
+    out["preempt_scans_per_s"] = p["value"]
+    out["preempt_vs_oracle"] = p["vs_baseline"]
+    out["cell"] = f"{nodes}n_{existing}existing_{pods}p"
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=15000)
@@ -177,23 +232,51 @@ def main():
     # the tunneled chip's dispatch latency varies +-15% run to run; report
     # the median of N timed runs (compiles are cached after the first)
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--mesh", action="store_true",
+                    help="shard the node axis over every visible device "
+                         "(1-device mesh on a single chip)")
+    ap.add_argument("--no-mesh", dest="mesh_check", action="store_false",
+                    help="skip the mesh-mode sub-benchmark")
+    ap.add_argument("--no-matrix", dest="matrix", action="store_false",
+                    help="skip the workload-lane matrix")
+    ap.add_argument("--matrix-repeat", type=int, default=2)
     args = ap.parse_args()
     if args.mode == "preempt":
         result = run_preempt_bench(args.nodes, args.pods)
-    else:
-        runs = [run_bench(args.nodes, args.pods, args.mode, args.burst,
-                          compare=False)
-                for _ in range(max(args.repeat, 1))]
-        runs.sort(key=lambda r: r["value"])
-        result = runs[len(runs) // 2]
-        result["runs"] = [r["value"] for r in runs]
-        if args.mode != "oracle":
-            sample = min(args.pods, 100)
-            oracle = measure_oracle(args.nodes, sample)
-            result["oracle_measured"] = oracle
-            result["oracle_pods_sampled"] = sample
-            result["vs_measured_oracle"] = (
-                round(result["value"] / oracle, 2) if oracle > 0 else None)
+        print(json.dumps(result))
+        return
+    mesh = _make_mesh() if args.mesh else None
+    runs = [run_bench(args.nodes, args.pods, args.mode, args.burst,
+                      compare=False, mesh=mesh)
+            for _ in range(max(args.repeat, 1))]
+    runs.sort(key=lambda r: r["value"])
+    result = runs[len(runs) // 2]
+    result["runs"] = [r["value"] for r in runs]
+    result["baseline_note"] = BASELINE_NOTE
+    if args.mode != "oracle":
+        sample = min(args.pods, 100)
+        oracle = measure_oracle(args.nodes, sample)
+        result["oracle_measured"] = oracle
+        result["oracle_pods_sampled"] = sample
+        result["vs_measured_oracle"] = (
+            round(result["value"] / oracle, 2) if oracle > 0 else None)
+    if args.mode == "burst" and not args.mesh and args.mesh_check:
+        # the north-star multi-chip config on whatever devices exist: the
+        # uniform kernel sharded over a mesh must NOT regress vs single-chip
+        # (VERDICT r03 weak #1 — mesh mode used to silently cost 8x)
+        import jax
+        m = _make_mesh()   # one mesh for all repeats (one compile)
+        mesh_runs = [run_bench(args.nodes, args.pods, args.mode, args.burst,
+                               compare=False, mesh=m)["value"]
+                     for _ in range(max(min(args.repeat, 2), 1))]
+        mesh_runs.sort()
+        result["mesh"] = {
+            "pods_per_s": mesh_runs[(len(mesh_runs) - 1) // 2],
+            "runs": mesh_runs,
+            "devices": len(jax.devices()),
+        }
+    if args.mode == "burst" and args.matrix:
+        result["matrix"] = run_matrix(repeat=args.matrix_repeat)
     print(json.dumps(result))
 
 

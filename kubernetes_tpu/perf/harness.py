@@ -32,7 +32,8 @@ class PerfConfig:
     existing_pods: int = 0
     pods: int = 3000
     zones: int = 0
-    workload: str = "plain"     # plain | anti-affinity | affinity | node-affinity
+    # plain | anti-affinity | affinity | node-affinity | spread
+    workload: str = "plain"
     use_tpu: bool = True
     burst: int = 1024           # 0 = serial schedule_one loop
     percentage_of_nodes_to_score: int = 100
@@ -66,8 +67,10 @@ def _pod_strategy(cfg: PerfConfig, count: int, prefix: str) -> PodStrategy:
     elif cfg.workload == "node-affinity":
         st.node_affinity_key = "perf-group"
         st.node_affinity_values = ("a", "b")
-    elif cfg.workload != "plain":
+    elif cfg.workload not in ("plain", "spread"):
         raise ValueError(f"unknown workload {cfg.workload!r}")
+    # "spread" pods are plain-shaped; the Service created in setup() makes
+    # SelectorSpreadPriority count them (selector_spreading.go:66)
     return st
 
 
@@ -82,6 +85,10 @@ def setup(cfg: PerfConfig) -> tuple[Store, Scheduler]:
         # reference: NewLabelNodePrepareStrategy(LabelZoneFailureDomain,
         # "zone1") — one zone spanning the whole cluster
         node_st.zones = 1
+    elif cfg.workload == "spread" and not cfg.zones:
+        # zone blend is 2/3 of the spread score (selector_spreading.go:34);
+        # exercise it
+        node_st.zones = 3
     # "The setup strategy creates pods with no affinity rules"
     # (scheduler_bench_test.go:68,93): existing pods are PLAIN regardless of
     # the measured workload's shape
@@ -89,6 +96,11 @@ def setup(cfg: PerfConfig) -> tuple[Store, Scheduler]:
                              labels={"app": "setup"})]
                 if cfg.existing_pods else [])
     populate_store(store, [node_st], existing)
+    if cfg.workload == "spread":
+        from kubernetes_tpu.api.types import Service
+        from kubernetes_tpu.store.store import SERVICES
+        store.create(SERVICES, Service(name="spread-svc",
+                                       selector={"app": "density"}))
     sched = Scheduler(store, use_tpu=cfg.use_tpu,
                       percentage_of_nodes_to_score=cfg.percentage_of_nodes_to_score)
     sched.sync()
