@@ -105,35 +105,100 @@ class LimitRanger:
 
 class ResourceQuotaAdmission:
     """plugin/pkg/admission/resourcequota: reject pod creation that would
-    push any namespace quota's usage past its hard caps. Enforcement is
-    against the controller-reconciled `used` totals plus this pod's
-    requests (the reference evaluates + CASes quota status the same way)."""
+    push any namespace quota past its hard caps, COMMITTING the new usage
+    synchronously via CAS on admit (the reference's checkQuotas CASes quota
+    status through the evaluator before the pod write lands,
+    plugin/pkg/admission/resourcequota/controller.go). A rapid burst of
+    creates therefore cannot overshoot: each admit observes the previous
+    admit's committed usage. The controller reconciles drift (pod deletes,
+    terminal phases) from live state afterwards."""
 
     def admit(self, kind: str, obj: Any, store: Store) -> Any:
         if kind != PODS:
             return obj
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS, NotFoundError
+        from kubernetes_tpu.controllers.resourcequota import pod_usage
+        quotas, _rv = store.list(RESOURCEQUOTAS)
+        matching = [q for q in quotas
+                    if q.namespace == obj.namespace and q.hard]
+        if not matching:
+            return obj
+        usage = pod_usage(obj)
+
+        def charge(cur):
+            over = [
+                f"{name}: used {cur.used.get(name, 0)} + requested "
+                f"{usage.get(name, 0)} > hard {cap}"
+                for name, cap in cur.hard.items()
+                if cur.used.get(name, 0) + usage.get(name, 0) > cap]
+            if over:
+                raise AdmissionError(
+                    f"exceeded quota {cur.key}: " + "; ".join(over))
+            used = dict(cur.used)
+            for name in cur.hard:
+                if usage.get(name):
+                    used[name] = used.get(name, 0) + usage[name]
+            cur.used = used
+            return cur
+
+        def refund(cur):
+            used = dict(cur.used)
+            for name in cur.hard:
+                if usage.get(name):
+                    used[name] = max(0, used.get(name, 0) - usage[name])
+            cur.used = used
+            return cur
+
+        charged: list[str] = []
+        try:
+            for q in matching:
+                store.guaranteed_update(RESOURCEQUOTAS, q.key, charge)
+                charged.append(q.key)
+        except AdmissionError:
+            # a later quota rejected after earlier ones were charged: put
+            # the earlier charges back before surfacing the rejection
+            self._refund_keys(store, charged, usage)
+            raise
+        return obj
+
+    def _refund_keys(self, store: Store, keys, usage) -> None:
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS, NotFoundError
+
+        def refund(cur):
+            used = dict(cur.used)
+            for name in cur.hard:
+                if usage.get(name):
+                    used[name] = max(0, used.get(name, 0) - usage[name])
+            cur.used = used
+            return cur
+
+        for key in keys:
+            try:
+                store.guaranteed_update(RESOURCEQUOTAS, key, refund)
+            except NotFoundError:
+                pass
+
+    def refund(self, kind: str, obj: Any, store: Store) -> None:
+        """Undo admit()'s usage commit when the admitted write itself fails
+        (AlreadyExists/Conflict): without this, every failed create leaks a
+        permanent charge against the namespace quotas."""
+        if kind != PODS:
+            return
         from kubernetes_tpu.store.store import RESOURCEQUOTAS
         from kubernetes_tpu.controllers.resourcequota import pod_usage
         quotas, _rv = store.list(RESOURCEQUOTAS)
-        usage = None
-        for q in quotas:
-            if q.namespace != obj.namespace or not q.hard:
-                continue
-            if usage is None:
-                usage = pod_usage(obj)
-            over = [
-                f"{name}: used {q.used.get(name, 0)} + requested "
-                f"{usage.get(name, 0)} > hard {cap}"
-                for name, cap in q.hard.items()
-                if q.used.get(name, 0) + usage.get(name, 0) > cap]
-            if over:
-                raise AdmissionError(
-                    f"exceeded quota {q.key}: " + "; ".join(over))
-        return obj
+        keys = [q.key for q in quotas
+                if q.namespace == obj.namespace and q.hard]
+        if keys:
+            self._refund_keys(store, keys, pod_usage(obj))
 
 
 class AdmissionChain:
     def __init__(self, plugins: Optional[list] = None):
+        # ResourceQuotaAdmission runs LAST: its admit commits quota usage,
+        # and only a failure of the store write itself (handled by the
+        # caller via refund()) — not a later plugin's rejection — may
+        # follow a successful charge
         self.plugins = plugins if plugins is not None else [
             PriorityAdmission(), DefaultTolerationSeconds(), LimitRanger(),
             ResourceQuotaAdmission()]
@@ -142,3 +207,12 @@ class AdmissionChain:
         for p in self.plugins:
             obj = p.admit(kind, obj, store)
         return obj
+
+    def refund(self, kind: str, obj: Any, store: Store) -> None:
+        """Roll back side-effecting admissions (quota usage commits) after
+        the admitted write failed to land (AlreadyExists/Conflict). Callers
+        that admit-then-create MUST call this on create failure."""
+        for p in self.plugins:
+            r = getattr(p, "refund", None)
+            if r is not None:
+                r(kind, obj, store)

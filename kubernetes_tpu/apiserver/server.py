@@ -163,14 +163,18 @@ def make_handler(store: Store, admission: AdmissionChain):
                 self._error(404, "NotFound", path)
                 return
             kind = parts[2]
+            admitted = None
             try:
                 obj = serde.from_dict(kind, self._body())
-                obj = admission.admit(kind, obj, store)
+                obj = admitted = admission.admit(kind, obj, store)
                 created = store.create(kind, obj)
             except AdmissionError as e:
                 self._error(422, "Invalid", str(e))
                 return
             except AlreadyExistsError as e:
+                # the admitted write never landed: roll back side-effecting
+                # admissions (quota usage) or the charge leaks per retry
+                admission.refund(kind, admitted, store)
                 self._error(409, "AlreadyExists", str(e))
                 return
             except (TypeError, ValueError, KeyError) as e:

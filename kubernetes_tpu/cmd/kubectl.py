@@ -4,8 +4,9 @@ Mirrors the pkg/kubectl verbs the scheduler ecosystem exercises
 (cmd/kubectl; cli-runtime): talks HTTP to the apiserver (never the store
 directly — process boundary preserved), prints get tables and describe
 blocks (with the object's Events), applies JSON manifests, deletes, and
-runs the node maintenance verbs (cordon/uncordon/drain — drain evicts by
-deletion, like the reference's --disable-eviction mode).
+runs the node maintenance verbs (cordon/uncordon/drain — drain honors
+matching PodDisruptionBudgets like the eviction subresource; pass
+--disable-eviction for the reference's unconditional-delete mode).
 
   kubectl-tpu --server URL get pods [-o json|wide] [--watch]
   kubectl-tpu get pods default/p0 | nodes n0
@@ -231,15 +232,47 @@ def cmd_uncordon(args) -> int:
 
 
 def cmd_drain(args) -> int:
+    """Cordon + evict every pod on the node. Eviction consults matching
+    PodDisruptionBudgets' controller-reconciled disruptions_allowed (the
+    eviction subresource's check, reference pkg/registry/core/pod/rest/
+    eviction.go): a pod whose PDB is exhausted is refused and left running.
+    --disable-eviction deletes unconditionally (the reference flag that
+    bypasses the eviction API)."""
     _patch_node(args.server, args.name, unschedulable=True)
     pods = _req(args.server, "GET", "/api/v1/pods").get("items", [])
+    budgets = []
+    if not getattr(args, "disable_eviction", False):
+        from kubernetes_tpu.api import serde
+        from kubernetes_tpu.store.store import PDBS
+        raw = _req(args.server, "GET", "/api/v1/poddisruptionbudgets")
+        budgets = [serde.from_dict(PDBS, d) for d in raw.get("items", [])]
+        # track this drain's own evictions against each budget so a burst
+        # of deletes can't overshoot before the disruption controller
+        # re-reconciles the status
+        allowed = {b.key: b.disruptions_allowed for b in budgets}
+    refused = 0
     for p in pods:
-        if p.get("node_name") == args.name:
-            key = f"{p['namespace']}/{p['name']}"
-            _req(args.server, "DELETE", f"/api/v1/pods/{key}")
-            print(f"pod/{key} evicted")
-    print(f"node/{args.name} drained")
-    return 0
+        if p.get("node_name") != args.name:
+            continue
+        key = f"{p['namespace']}/{p['name']}"
+        if budgets:
+            labels = p.get("labels") or {}
+            blockers = [b for b in budgets
+                        if b.namespace == p.get("namespace", "default")
+                        and b.selector is not None
+                        and b.selector.matches(labels)]
+            if any(allowed[b.key] <= 0 for b in blockers):
+                print(f"error when evicting pod {key}: Cannot evict pod as "
+                      "it would violate the pod's disruption budget.",
+                      file=sys.stderr)
+                refused += 1
+                continue
+            for b in blockers:
+                allowed[b.key] -= 1
+        _req(args.server, "DELETE", f"/api/v1/pods/{key}")
+        print(f"pod/{key} evicted")
+    print(f"node/{args.name} drained" + (f" ({refused} refused)" if refused else ""))
+    return 1 if refused else 0
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -279,6 +312,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                      ("drain", cmd_drain)):
         p = sub.add_parser(verb)
         p.add_argument("name")
+        if verb == "drain":
+            p.add_argument("--disable-eviction", action="store_true",
+                           help="delete pods directly, skipping the PDB "
+                                "eviction check")
         p.set_defaults(fn=fn)
 
     args = ap.parse_args(argv)

@@ -25,8 +25,15 @@ _suffix = itertools.count(1)
 
 
 class ReplicaSetController:
-    def __init__(self, store: Store, clock=None):
+    def __init__(self, store: Store, clock=None, admission=None):
         self.store = store
+        # controller-originated pod writes go through the same admission
+        # chain as kubectl-path writes (LimitRanger defaults, PriorityClass
+        # resolution, toleration defaulting, quota), so scale-up pods are
+        # not shaped differently from user-created ones — in the reference
+        # every controller write passes apiserver admission
+        from kubernetes_tpu.apiserver.admission import AdmissionChain
+        self.admission = admission if admission is not None else AdmissionChain()
         self.recorder = EventRecorder(store, component="controllermanager")
         self.informers = InformerFactory(store)
         self._dirty: set[str] = set()
@@ -90,12 +97,24 @@ class ReplicaSetController:
         pods = self._matching_pods(rs)
         diff = rs.replicas - len(pods)
         if diff > 0:
+            from kubernetes_tpu.apiserver.admission import AdmissionError
             for _ in range(diff):
                 pod = self._template_pod(rs)
+                admitted = None
                 try:
+                    pod = admitted = self.admission.admit(PODS, pod, self.store)
                     self.store.create(PODS, pod)
                 except AlreadyExistsError:
+                    # the admitted create never landed: refund quota charges
+                    self.admission.refund(PODS, admitted, self.store)
                     continue
+                except AdmissionError as e:
+                    # quota exhausted (etc.): surface and stop this pass —
+                    # the remaining creates would fail the same way
+                    self.recorder.event(
+                        "ReplicaSet", rs.key, "Warning", "FailedCreate",
+                        f"Error creating: {e}")
+                    break
                 self.recorder.event(
                     "ReplicaSet", rs.key, NORMAL, "SuccessfulCreate",
                     f"Created pod: {pod.name}")

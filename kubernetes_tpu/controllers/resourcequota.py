@@ -1,9 +1,10 @@
 """ResourceQuota controller — pkg/controller/resourcequota.
 
 Reconciles each quota's `used` totals (aggregate pod cpu/memory requests +
-pod count per namespace) from live state; the admission plugin enforces
-`hard` against the reconciled usage on create. Terminated pods don't count
-(the reference's quota evaluator scopes to non-terminal pods)."""
+pod count per namespace) from live state. The admission plugin both
+enforces `hard` AND commits usage synchronously on create (CAS); this
+controller reconciles the drift admission can't see — deletes, terminal
+phases (the reference's quota evaluator scopes to non-terminal pods)."""
 from __future__ import annotations
 
 from kubernetes_tpu.api.types import Pod, ResourceQuota, get_resource_request
@@ -63,22 +64,26 @@ class ResourceQuotaController:
         return n
 
     def reconcile(self, quota: ResourceQuota) -> None:
-        pods, _rv = self.store.list(PODS)
-        used = {k: 0 for k in quota.hard}
-        for p in pods:
-            if p.namespace != quota.namespace or p.deleted \
-                    or p.phase in TERMINAL_PHASES:
-                continue
-            for k, v in pod_usage(p).items():
-                if k in used:
-                    used[k] += v
-        if used == quota.used:
-            return
-
+        # the pod total is computed INSIDE the CAS mutate so a retry after a
+        # concurrent admission charge (admission.py commits usage on admit)
+        # re-lists live pods instead of clobbering the quota with a stale
+        # pre-charge total
         def mutate(cur):
+            pods, _rv = self.store.list(PODS)
+            used = {k: 0 for k in cur.hard}
+            for p in pods:
+                if p.namespace != cur.namespace or p.deleted \
+                        or p.phase in TERMINAL_PHASES:
+                    continue
+                for k, v in pod_usage(p).items():
+                    if k in used:
+                        used[k] += v
+            if used == cur.used:
+                return None
             cur.used = used
             return cur
         try:
-            self.store.guaranteed_update(RESOURCEQUOTAS, quota.key, mutate)
+            self.store.guaranteed_update(RESOURCEQUOTAS, quota.key, mutate,
+                                         allow_skip=True)
         except NotFoundError:
             pass

@@ -19,6 +19,7 @@ clone() first, exactly as API clients deserialize their own copy.
 from __future__ import annotations
 
 import copy
+import os
 import queue as _queue
 import threading
 from dataclasses import dataclass
@@ -127,7 +128,8 @@ def _clone(obj: Any) -> Any:
 class Store:
     """Threadsafe versioned KV with per-kind watch fan-out."""
 
-    def __init__(self, watch_log_size: int = DEFAULT_WATCH_LOG):
+    def __init__(self, watch_log_size: int = DEFAULT_WATCH_LOG,
+                 debug_integrity: Optional[bool] = None):
         self._lock = threading.RLock()
         self._rv = 0
         self._objs: dict[str, dict[str, Any]] = {}
@@ -135,6 +137,46 @@ class Store:
         # per-kind ring of recent events for watch resume
         self._log: dict[str, list[Event]] = {}
         self._log_size = watch_log_size
+        # alias tripwire: watch events and create/update return values alias
+        # the write snapshot, read-only BY CONVENTION. In debug mode every
+        # write records a fingerprint of the stored object; the next write
+        # to the same key (and check_integrity()) verifies it, so a consumer
+        # that mutated an aliased object in place fails LOUDLY instead of
+        # silently corrupting every other consumer. Enabled explicitly or
+        # via KTPU_STORE_INTEGRITY=1 (the test suite turns it on).
+        if debug_integrity is None:
+            debug_integrity = bool(os.environ.get("KTPU_STORE_INTEGRITY"))
+        self._integrity: Optional[dict] = {} if debug_integrity else None
+
+    # -- alias tripwire ------------------------------------------------------
+    @staticmethod
+    def _fingerprint(obj: Any) -> int:
+        return hash(repr(obj))
+
+    def _record_entry(self, kind: str, key: str, obj: Any) -> None:
+        if self._integrity is not None:
+            self._integrity[(kind, key)] = self._fingerprint(obj)
+
+    def _check_entry(self, kind: str, key: str, obj: Any) -> None:
+        if self._integrity is None:
+            return
+        fp = self._integrity.get((kind, key))
+        if fp is not None and fp != self._fingerprint(obj):
+            raise RuntimeError(
+                f"store integrity violation: {kind}/{key} was mutated in "
+                "place through an aliased reference (watch event or "
+                "create/update return value) — consumers must clone() "
+                "before mutating")
+
+    def check_integrity(self) -> None:
+        """Verify every live bucket entry still matches the fingerprint
+        recorded at its write (debug mode only; no-op otherwise)."""
+        with self._lock:
+            if self._integrity is None:
+                return
+            for kind, bucket in self._objs.items():
+                for key, obj in bucket.items():
+                    self._check_entry(kind, key, obj)
 
     # -- reads --------------------------------------------------------------
     def get(self, kind: str, key: str) -> Any:
@@ -168,6 +210,7 @@ class Store:
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
+            self._record_entry(kind, key, stored)
             # one snapshot serves the bucket, the event log, and the return
             # value: the store NEVER mutates a stored object in place (every
             # write replaces the bucket entry), and consumers receive store
@@ -186,10 +229,12 @@ class Store:
             if expect_rv is not None and current.resource_version != expect_rv:
                 raise ConflictError(
                     f"{kind}/{key}: rv {current.resource_version} != expected {expect_rv}")
+            self._check_entry(kind, key, current)
             stored = _clone(obj)
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
+            self._record_entry(kind, key, stored)
             self._emit(Event(MODIFIED, kind, stored, self._rv))  # see create()
             return stored
 
@@ -216,6 +261,9 @@ class Store:
             obj = bucket.pop(key, None)
             if obj is None:
                 raise NotFoundError(f"{kind}/{key}")
+            self._check_entry(kind, key, obj)
+            if self._integrity is not None:
+                self._integrity.pop((kind, key), None)
             self._rv += 1
             self._emit(Event(DELETED, kind, _clone(obj), self._rv))
             return obj
@@ -233,11 +281,13 @@ class Store:
             current = bucket.get(pod_key)
             if current is None:
                 raise NotFoundError(f"{PODS}/{pod_key}")
+            self._check_entry(PODS, pod_key, current)
             stored = _clone(current)
             stored.node_name = node_name
             self._rv += 1
             stored.resource_version = self._rv
             bucket[pod_key] = stored
+            self._record_entry(PODS, pod_key, stored)
             self._emit(Event(MODIFIED, PODS, stored, self._rv))
             return stored
 

@@ -7,6 +7,10 @@ how the driver validates multi-chip sharding without real chips.
 """
 import os
 
+# store alias tripwire: fail loudly if any consumer mutates an object it
+# received from a watch event / write return value without cloning first
+os.environ.setdefault("KTPU_STORE_INTEGRITY", "1")
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

@@ -332,3 +332,50 @@ class TestWatchResume:
                 urllib.request.urlopen(
                     f"{srv2.url}/api/v1/pods?watch=true&resourceVersion=1")
             assert e.value.code == 410
+
+
+class TestDrainHonorsPDB:
+    """drain consults the disruption controller's reconciled
+    disruptions_allowed like the eviction subresource (reference:
+    pkg/registry/core/pod/rest/eviction.go); --disable-eviction keeps the
+    unconditional-delete mode."""
+
+    def _drain(self, url, *argv):
+        import contextlib
+        from kubernetes_tpu.cmd import kubectl
+        out, err = io.StringIO(), io.StringIO()
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+            rc = kubectl.main(["--server", url, "drain", *argv])
+        return rc, out.getvalue(), err.getvalue()
+
+    def test_drain_refuses_when_budget_exhausted(self, server):
+        from kubernetes_tpu.api.types import PodDisruptionBudget
+        from kubernetes_tpu.store.store import PDBS
+        store, url = server
+        store.create(NODES, Node(
+            name="n0", allocatable={"cpu": 4000, "memory": GI, "pods": 10}))
+        # PDB allows ONE disruption across the two web pods
+        store.create(PDBS, PodDisruptionBudget(
+            name="web-pdb",
+            selector=LabelSelector(match_labels=(("app", "web"),)),
+            min_available=1, disruptions_allowed=1,
+            current_healthy=2, desired_healthy=1, expected_pods=2))
+        for n in ("w0", "w1"):
+            store.create(PODS, Pod(
+                name=n, node_name="n0", labels={"app": "web"},
+                containers=(Container.make(name="c"),)))
+        # an unbudgeted pod drains freely
+        store.create(PODS, Pod(
+            name="loose", node_name="n0", labels={"app": "batch"},
+            containers=(Container.make(name="c"),)))
+        rc, out, err = self._drain(url, "n0")
+        assert rc == 1            # one eviction refused
+        assert "pod/default/loose evicted" in out
+        assert out.count("evicted") == 2   # loose + exactly one web pod
+        assert "violate the pod's disruption budget" in err
+        remaining = [p.name for p in store.list(PODS)[0]]
+        assert len(remaining) == 1 and remaining[0].startswith("w")
+        assert store.get(NODES, "n0").unschedulable
+        # --disable-eviction clears the survivor unconditionally
+        rc, out, _err = self._drain(url, "n0", "--disable-eviction")
+        assert rc == 0 and not store.list(PODS)[0]

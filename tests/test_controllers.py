@@ -560,3 +560,108 @@ class TestResourceQuota:
         rqc.pump()
         assert store.get(RESOURCEQUOTAS, "default/q").used == \
             {"cpu": 550, "pods": 2}
+
+
+class TestQuotaAdmissionCAS:
+    """Admission commits quota usage synchronously via CAS (the reference's
+    checkQuotas evaluator commit), so a rapid burst of creates cannot
+    overshoot hard caps before the controller reconciles."""
+
+    def _post(self, url, pod):
+        import urllib.request, urllib.error, json as _json
+        from kubernetes_tpu.api import serde
+        data = _json.dumps(serde.to_dict(pod)).encode()
+        req = urllib.request.Request(
+            f"{url}/api/v1/pods", data=data, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                return resp.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    def test_burst_creates_cannot_overshoot(self):
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        store = Store()
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="q", hard={"pods": 3, "cpu": 10_000}))
+        with APIServer(store) as srv:
+            codes = [self._post(srv.url, bound_pod(f"p{i}", "", cpu=100))
+                     for i in range(6)]
+        # NO controller pump between creates: admission alone must stop
+        # the overshoot at exactly the hard cap
+        assert codes.count(201) == 3 and codes.count(422) == 3
+        q = store.get(RESOURCEQUOTAS, "default/q")
+        assert q.used == {"pods": 3, "cpu": 300}
+        assert len(store.list(PODS)[0]) == 3
+
+    def test_rejection_refunds_earlier_quota_charges(self):
+        """Two quotas in one namespace: the second rejecting must refund
+        the first's already-committed charge."""
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionChain, AdmissionError)
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        store = Store()
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="a-wide", hard={"pods": 100}))
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="b-tight", hard={"cpu": 50}))
+        chain = AdmissionChain()
+        with pytest.raises(AdmissionError):
+            chain.admit(PODS, bound_pod("p", "", cpu=100), store)
+        assert store.get(RESOURCEQUOTAS, "default/a-wide").used \
+            == {"pods": 0}
+
+
+class TestControllerWritesPassAdmission:
+    """Controller-originated pod creates run the same admission chain as
+    user writes (the reference routes every controller write through
+    apiserver admission), so scale-up pods get LimitRanger defaults and
+    quota enforcement."""
+
+    def test_rs_pods_get_limitranger_defaults(self):
+        from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+        store = Store()
+        rsc = ReplicaSetController(store)
+        store.create(REPLICASETS, ReplicaSet(
+            name="web", selector=sel(app="web"), replicas=2))
+        rsc.sync()
+        pods = store.list(PODS)[0]
+        assert len(pods) == 2
+        for p in pods:
+            reqs = dict(p.containers[0].requests)
+            assert reqs.get("cpu") == 100
+            assert reqs.get("memory") == 200 * 1024 ** 2
+
+    def test_rs_scale_up_respects_quota(self):
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.controllers.replicaset import ReplicaSetController
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS, EVENTS
+        store = Store()
+        store.create(RESOURCEQUOTAS, ResourceQuota(name="q", hard={"pods": 2}))
+        rsc = ReplicaSetController(store)
+        store.create(REPLICASETS, ReplicaSet(
+            name="web", selector=sel(app="web"), replicas=5))
+        rsc.sync()
+        assert len(store.list(PODS)[0]) == 2
+        evs = [e for e in store.list(EVENTS)[0]
+               if e.reason == "FailedCreate"]
+        assert evs and "exceeded quota" in evs[0].message
+
+    def test_failed_create_refunds_charge(self):
+        """AlreadyExists after a successful admit must refund the quota
+        charge — otherwise every create retry leaks usage permanently."""
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        store = Store()
+        store.create(RESOURCEQUOTAS, ResourceQuota(name="q", hard={"pods": 5}))
+        with APIServer(store) as srv:
+            p = TestQuotaAdmissionCAS()
+            assert p._post(srv.url, bound_pod("dup", "")) == 201
+            for _ in range(3):   # duplicate creates: 409, no charge leak
+                assert p._post(srv.url, bound_pod("dup", "")) == 409
+        assert store.get(RESOURCEQUOTAS, "default/q").used == {"pods": 1}

@@ -500,3 +500,46 @@ class TestNativeHeapParity:
         # a loud failure if the native path silently regressed
         from kubernetes_tpu import native
         assert native.load("heapcore") is not None
+
+
+class TestStoreIntegrityTripwire:
+    """Watch events / write return values alias the write snapshot, read-only
+    by convention; debug mode turns a convention violation into a loud
+    failure instead of silent cross-consumer corruption (ADVICE r03)."""
+
+    def test_mutation_through_aliased_return_value_fails(self):
+        from kubernetes_tpu.api.types import Pod, Container
+        from kubernetes_tpu.store.store import Store, PODS
+        store = Store(debug_integrity=True)
+        p = store.create(PODS, Pod(
+            name="a", containers=(Container.make(name="c"),)))
+        # a well-behaved consumer: reads are fine, clones are fine
+        store.check_integrity()
+        store.get(PODS, "default/a").labels["fine"] = "clone"
+        store.check_integrity()
+        # the violation: mutating the aliased create() return value
+        p.labels["oops"] = "1"
+        import pytest
+        with pytest.raises(RuntimeError, match="integrity violation"):
+            store.check_integrity()
+
+    def test_mutation_caught_at_next_write(self):
+        from kubernetes_tpu.api.types import Pod, Container
+        from kubernetes_tpu.store.store import Store, PODS
+        store = Store(debug_integrity=True)
+        p = store.create(PODS, Pod(
+            name="a", containers=(Container.make(name="c"),)))
+        p.node_name = "mutated-through-alias"
+        import pytest
+        with pytest.raises(RuntimeError, match="integrity violation"):
+            store.bind_pod("default/a", "n0")
+
+    def test_disabled_by_default_off_env(self, monkeypatch):
+        from kubernetes_tpu.api.types import Pod, Container
+        from kubernetes_tpu.store.store import Store, PODS
+        monkeypatch.delenv("KTPU_STORE_INTEGRITY", raising=False)
+        store = Store()
+        p = store.create(PODS, Pod(
+            name="a", containers=(Container.make(name="c"),)))
+        p.labels["oops"] = "1"
+        store.check_integrity()   # no-op when disabled
