@@ -598,12 +598,16 @@ class TPUScheduler:
         """Per-cycle enumeration orders for a burst: pod 0 rides the device
         axis (the list_names() enumeration the shell just consumed); pod
         i >= 1 rides the order starting at the tree's current zone index
-        walked i-1 steps through rotation_map. Returns None when every
-        in-burst cycle provably repeats the axis order (equal-size zones,
-        single zone, or no tree — the common large-cluster case)."""
-        tree = self.node_tree
-        if tree is None or len(tree._zones) <= 1:
+        walked i-1 steps through rotation_map. Returns None only when the
+        tree can NEVER rotate (equal-size zones, single zone, no tree); an
+        identity walk on a rotating tree still returns the (all-zero)
+        machinery — rotation presence is a CLUSTER property, not a
+        per-burst one, so the jit signature never flips between bursts
+        (each flip costs a fresh multi-second XLA compile). The permutation
+        row count is padded to a power-of-two bucket for the same reason."""
+        if not self._tree_rotates():
             return None
+        tree = self.node_tree
         nxt = tree.rotation_map()
         r = tree.zone_index
         length = n_pods + K.K_BATCH
@@ -631,20 +635,29 @@ class TPUScheduler:
 
         seq = np.zeros(length, dtype=np.int32)
         if nxt[r] == r:
-            # fixed-point walk: every cycle >= 1 repeats P_r — either the
-            # axis itself (stable: no rotation machinery at all) or one
-            # other order (constant seq, no per-cycle walk to build)
-            iid = order_id(r)
-            if iid == 0:
-                return None
-            seq[1:] = iid
+            # fixed-point walk: every cycle >= 1 repeats P_r
+            seq[1:] = order_id(r)
         else:
             for i in range(1, length):
                 seq[i] = order_id(r)
                 r = nxt[r]
-            if not seq.any():
-                return None
-        return np.stack(perm_rows), seq
+        perms = np.stack(perm_rows)
+        l_pad = _pad_pow2(len(perm_rows), 4)
+        if len(perm_rows) < l_pad:
+            perms = np.concatenate(
+                [perms, np.repeat(perms[:1], l_pad - len(perm_rows), axis=0)])
+        return perms, seq
+
+    def _tree_rotates(self) -> bool:
+        """True when the NodeTree's per-cycle enumeration can EVER differ
+        from the device axis: multiple zones with uneven sizes (even sizes
+        return the cursor to its start every full enumeration, so every
+        cycle repeats the axis order)."""
+        tree = self.node_tree
+        if tree is None or len(tree._zones) <= 1:
+            return False
+        sizes = {len(tree._tree[z]) for z in tree._zones}
+        return len(sizes) > 1
 
     def _generic_rotation(self, b: NodeBatch, bucket: int):
         """(perms[L, n_pad], inv_perms, oid_seq[bucket]) for the generic
@@ -752,6 +765,13 @@ class TPUScheduler:
                 h = np.asarray(packed)   # ONE fetch: selections + lni delta
                 self.last_node_index += int(h[K.B_CAP])
                 sel.extend(h[:chunk].tolist())
+                if any(s < 0 for s in h[:chunk]):
+                    # failures are a frozen-state SUFFIX (feasibility only
+                    # shrinks as folds accumulate, so F==0 persists): the
+                    # kernel's counters/folds reflect exactly the non-None
+                    # prefix already — stop launching further chunks
+                    break
+            sel.extend([-1] * (len(pods) - len(sel)))
             return [b.names[s] if s >= 0 else None for s in sel]
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports)
@@ -778,13 +798,23 @@ class TPUScheduler:
         if carry_spread and not uniform_spec:
             return None
         rotation = None
-        if self._burst_rotation(b, len(pods)) is not None:
+        rotation_pos = None
+        if self._tree_rotates():
             # per-cycle rotated enumeration orders: ship the <= L distinct
-            # permutations + each cycle's order id; _cycle_core runs its
-            # walk/tie math in position space
-            rotation = self._generic_rotation(b, bucket)
-            if rotation is None:
-                return None
+            # permutations + each cycle's order id. In the full-scan regime
+            # (num_to_find >= n) the gather-free position mode applies —
+            # one [N] sort per cycle instead of three [N] gathers, which
+            # serialize ~30x slower on TPU at 1k nodes. The rotation program
+            # is selected from CLUSTER shape (uneven zones), not from
+            # whether THIS burst's walk happens to be the identity: the
+            # identity is just data (order id 0), while flip-flopping the
+            # jit signature between bursts costs a fresh 10s+ XLA compile
+            # mid-workload each time the zone cursor lands on a fixed point
+            rot = self._generic_rotation(b, bucket)
+            if num_to_find >= n:
+                rotation_pos = (rot[1], rot[2])   # inv_perms ARE positions
+            else:
+                rotation = rot
         spread0 = None
         if carry_spread:
             # the scan carries ONE [N] count vector; the stacked per-pod
@@ -811,8 +841,18 @@ class TPUScheduler:
             return None   # inert/dense mix — shouldn't happen, stay exact
         z_pad = _pad_pow2(len(b.zone_names), 4)
         if self.mesh is not None:
-            if rotation is not None or carry_spread:
-                return None   # the sharded scan doesn't model these yet
+            if rotation is not None or rotation_pos is not None:
+                # identity-only rotation (the zone cursor sits at a fixed
+                # point this burst) is just data — run sharded without the
+                # rotation machinery; real rotation still refuses (the
+                # sharded scan doesn't model it yet)
+                seq = (rotation[2] if rotation is not None
+                       else rotation_pos[1])
+                if np.asarray(seq[:len(pods)]).any():
+                    return None
+                rotation = rotation_pos = None
+            if carry_spread:
+                return None   # the sharded scan doesn't model this yet
             from kubernetes_tpu.parallel import sharding as S
             if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
                 self._sharded_batch = (z_pad, S.sharded_batch_fn(
@@ -825,15 +865,33 @@ class TPUScheduler:
             state, li, lni, outs = K.schedule_batch(
                 nodes, stacked, self.last_index, self.last_node_index,
                 num_to_find, n, z_pad, weights=self.weights,
-                rotation=rotation, spread0=spread0)
+                rotation=rotation, spread0=spread0,
+                rotation_pos=rotation_pos)
+        selected = np.asarray(outs["selected"])[: len(pods)]
+        if (selected < 0).any():
+            # burst contract: everything from the first failure on is
+            # returned undecided (None) and counters/folds rewind to the
+            # prefix — the shell commits the prefix and reruns the tail
+            # serially (a failed pod's serial rerun may preempt, which the
+            # post-failure kernel decisions never saw)
+            kf = int(np.argmax(selected < 0))
+            ev = np.asarray(outs["evaluated"])[:kf]
+            fo = np.asarray(outs["found"])[:kf]
+            self.last_index = int((self.last_index + ev.sum()) % max(n, 1))
+            self.last_node_index += int((fo > 1).sum())
+            # the device matrix holds folds from post-failure successes the
+            # serial tail may invalidate: drop it (the host mirror reflects
+            # exactly the committed prefix after note_burst_assumed)
+            self.discard_burst_folds()
+            return [b.names[s] if i < kf else None
+                    for i, s in enumerate(selected.tolist())]
         # persist the folds: the device-resident matrix is authoritative for
         # rows the scan mutated (the host mirror catches up via
         # note_burst_assumed; external changes still arrive via dirty rows)
         self._dev_nodes = {**self._dev_nodes, **state}
         self.last_index = int(li)
         self.last_node_index = int(lni)
-        selected = np.asarray(outs["selected"])[: len(pods)].tolist()
-        return [b.names[s] if s >= 0 else None for s in selected]
+        return [b.names[s] if s >= 0 else None for s in selected.tolist()]
 
     # -- device preemption ---------------------------------------------------
     def preempt(self, pod: Pod, node_infos: dict[str, NodeInfo],
@@ -851,7 +909,8 @@ class TPUScheduler:
         removal cannot change any mask, only free resources)."""
         from kubernetes_tpu.oracle.preemption import (
             pod_eligible_to_preempt_others, nodes_where_preemption_might_help,
-            pods_violating_pdbs, importance_key, PreemptionResult)
+            pods_violating_pdbs, importance_key, PreemptionResult,
+            no_possible_victims)
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports, get_resource_request)
         from kubernetes_tpu.cache.node_info import calculate_resource
@@ -875,6 +934,9 @@ class TPUScheduler:
             # preemption can't help anywhere: clear the pod's own stale
             # nomination (generic_scheduler.go:330-333)
             return PreemptionResult(None, [], [pod])
+        if no_possible_victims(pod, node_infos, candidates):
+            # same fast path as the oracle Preemptor — skip the device launch
+            return PreemptionResult(None, [], [])
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
         P = K.PREEMPT_P

@@ -295,7 +295,7 @@ def _feasibility(nodes, pod):
 
 
 def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                weights, z_pad, perm=None, inv_perm=None):
+                weights, z_pad, perm=None, inv_perm=None, pos=None):
     """One fused cycle. The reference's sequential walk from last_index
     (generic_scheduler.go:486,519) is emulated WITHOUT materializing the
     rotation permutation: for natural index j, its 1-based rank in rotation
@@ -309,7 +309,15 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     perm[p] = natural row at enumeration position p, inv_perm its inverse.
     The walk/tie math then runs in position space (the cumsums act on
     permuted masks, one gather each way) and last_index keeps its positional
-    meaning; perm=None is the identity fast path."""
+    meaning; perm=None is the identity fast path.
+
+    `pos` is the GATHER-FREE rotation mode for the full-scan regime (the
+    caller guarantees num_to_find >= n_real): pos[j] = node j's position in
+    this cycle's enumeration (the inverse permutation). With a full scan
+    kept == feasible and evaluated == n, so the only order-dependent step
+    is selectHost's k-th-tie pick — resolved by one [N] sort of tie
+    positions instead of the three [N] gathers of the perm path, which
+    serialize badly on TPU (30x per-cycle cost at 1k nodes)."""
     n_pad = nodes["valid"].shape[0]
     i32 = jnp.int32
     i = jnp.arange(n_pad, dtype=i32)
@@ -325,23 +333,32 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     feasible, fail_first, general_bits = _feasibility(nodes, pod)
     feas = feasible & in_range
 
-    feas_p = feas if perm is None else feas[perm]
-    S = jnp.cumsum(feas_p.astype(i32))
-    F = S[-1]                                   # total feasible
-    pre = jnp.where(li > 0, S[jnp.maximum(li - 1, 0)], 0)
-    after = i >= li                              # position space
-    rank_p = jnp.where(after, S - pre, F - pre + S)  # rank at position p
-    kept_p = feas_p & (rank_p <= ntf)
-    kept = kept_p if perm is None else kept_p[inv_perm]
-    found = jnp.minimum(F, ntf)
-    reached = F >= ntf
-    # the position where the sequential walk stops: unique feasible p with
-    # rank == num_to_find; evaluated = its rotation offset + 1
-    pstar = jnp.argmax(kept_p & (rank_p == ntf)).astype(i32)
-    stop_pos = jnp.where(pstar >= li, pstar - li, nr - li + pstar)
-    evaluated = jnp.where(reached, stop_pos + 1, nr)
-    # a skip (bucket-padding) pod consumes no rotation state
-    evaluated = jnp.where(pod["skip"], 0, evaluated).astype(jnp.int64)
+    if pos is not None:
+        # full-scan regime (num_to_find >= n by caller contract): every
+        # feasible node is kept and the walk always evaluates all n, so no
+        # position-space cumsum machinery is needed at all
+        F = jnp.sum(feas.astype(i32))
+        kept = feas
+        found = jnp.minimum(F, ntf)
+        evaluated = jnp.where(pod["skip"], 0, nr).astype(jnp.int64)
+    else:
+        feas_p = feas if perm is None else feas[perm]
+        S = jnp.cumsum(feas_p.astype(i32))
+        F = S[-1]                                   # total feasible
+        pre = jnp.where(li > 0, S[jnp.maximum(li - 1, 0)], 0)
+        after = i >= li                              # position space
+        rank_p = jnp.where(after, S - pre, F - pre + S)  # rank at position p
+        kept_p = feas_p & (rank_p <= ntf)
+        kept = kept_p if perm is None else kept_p[inv_perm]
+        found = jnp.minimum(F, ntf)
+        reached = F >= ntf
+        # the position where the sequential walk stops: unique feasible p
+        # with rank == num_to_find; evaluated = its rotation offset + 1
+        pstar = jnp.argmax(kept_p & (rank_p == ntf)).astype(i32)
+        stop_pos = jnp.where(pstar >= li, pstar - li, nr - li + pstar)
+        evaluated = jnp.where(reached, stop_pos + 1, nr)
+        # a skip (bucket-padding) pod consumes no rotation state
+        evaluated = jnp.where(pod["skip"], 0, evaluated).astype(jnp.int64)
 
     total = _fit_scores(nodes, pod, kept, weights, z_pad)
 
@@ -351,12 +368,27 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     num_ties = jnp.maximum(jnp.sum(is_tie.astype(i32)), 1)
     # round-robin k-th tie in rotation order (selectHost :286-295)
     k = (last_node_index % num_ties.astype(jnp.int64)).astype(i32)
-    tie_p = is_tie if perm is None else is_tie[perm]
-    T = jnp.cumsum(tie_p.astype(i32))
-    preT = jnp.where(li > 0, T[jnp.maximum(li - 1, 0)], 0)
-    trank = jnp.where(after, T - preT, T[-1] - preT + T)
-    sel_p = jnp.argmax(tie_p & (trank == k + 1)).astype(jnp.int64)
-    sel = sel_p if perm is None else perm[sel_p].astype(jnp.int64)
+    if pos is not None:
+        # k-th tie by enumeration position relative to the walk origin:
+        # one sort replaces the permuted cumsum + two gathers. Positions of
+        # valid nodes are distinct in [0, n); ties exclude invalid rows.
+        rel = jnp.where(pos >= li, pos - li, nr - li + pos)
+        t_pos = jnp.where(is_tie, rel, jnp.int32(2 ** 30))
+        kth = jax.lax.dynamic_slice(jnp.sort(t_pos), (k,), (1,))[0]
+        sel = jnp.argmax(is_tie & (rel == kth)).astype(jnp.int64)
+    elif perm is None:
+        tie_p = is_tie
+        T = jnp.cumsum(tie_p.astype(i32))
+        preT = jnp.where(li > 0, T[jnp.maximum(li - 1, 0)], 0)
+        trank = jnp.where(after, T - preT, T[-1] - preT + T)
+        sel = jnp.argmax(tie_p & (trank == k + 1)).astype(jnp.int64)
+    else:
+        tie_p = is_tie[perm]
+        T = jnp.cumsum(tie_p.astype(i32))
+        preT = jnp.where(li > 0, T[jnp.maximum(li - 1, 0)], 0)
+        trank = jnp.where(after, T - preT, T[-1] - preT + T)
+        sel_p = jnp.argmax(tie_p & (trank == k + 1)).astype(jnp.int64)
+        sel = perm[sel_p].astype(jnp.int64)
     selected = jnp.where(found > 0, sel, -1)
 
     return {
@@ -421,10 +453,11 @@ def _fold_state(state, pod, sel, hit):
 
 
 @partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
-                                   "carry_spread"))
+                                   "carry_spread", "rotate_pos"))
 def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
                         n_real, perms, inv_perms, oid_seq, spread0, z_pad,
-                        weights_tuple, rotate, carry_spread):
+                        weights_tuple, rotate, carry_spread,
+                        rotate_pos=False):
     weights = dict(weights_tuple)
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
     # selector-spread counts evolve with in-burst placements: the caller
@@ -433,19 +466,24 @@ def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
     # folds +1 on its node (selector_spreading.go:66 counting semantics)
 
     def step(carry, xs):
-        if rotate:
+        perm = inv_perm = pos = None
+        if rotate_pos:
+            # gather-free rotation: perms holds per-order POSITION vectors
+            state, li, lni, spread = carry
+            pod, oid = xs
+            pos = perms[oid]
+        elif rotate:
             state, li, lni, spread = carry
             pod, oid = xs
             perm, inv_perm = perms[oid], inv_perms[oid]
         else:
             state, li, lni, spread = carry
             pod = xs
-            perm = inv_perm = None
         if carry_spread:
             pod = {**pod, "spread_counts": spread}
         full = {**static, **state}
         out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights,
-                          z_pad, perm=perm, inv_perm=inv_perm)
+                          z_pad, perm=perm, inv_perm=inv_perm, pos=pos)
         sel = out["selected"]
         hit = out["found"] > 0
         new_state = _fold_state(state, pod, sel, hit)
@@ -462,7 +500,7 @@ def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
 
     if carry_spread:
         pods = {k: v for k, v in pods.items() if k != "spread_counts"}
-    xs = (pods, oid_seq) if rotate else pods
+    xs = (pods, oid_seq) if (rotate or rotate_pos) else pods
     init = ({k: nodes[k] for k in _MUTABLE}, last_index, last_node_index,
             spread0)
     (state, li, lni, _spread), outs = jax.lax.scan(step, init, xs)
@@ -470,18 +508,28 @@ def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
 
 
 def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
-                   z_pad, weights=None, rotation=None, spread0=None):
+                   z_pad, weights=None, rotation=None, spread0=None,
+                   rotation_pos=None):
     """Schedule a burst of pods against one snapshot, decisions serially
     equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays.
 
     `rotation` = (perms[L, n_pad], inv_perms[L, n_pad], oid_seq[B]) supplies
     each in-burst cycle's NodeTree enumeration order when it differs from
     the device axis (uneven zones); None = the axis order every cycle.
-    `spread0` [n_pad] carries selector-spread counts across the burst
-    (requires spec-identical pods — one shared selector set)."""
+    `rotation_pos` = (pos_arr[L, n_pad], oid_seq[B]) is the gather-free
+    variant for the full-scan regime (caller guarantees
+    num_to_find >= n_real): pos_arr[l][j] = node j's enumeration position
+    under order l (the inverse permutation). Mutually exclusive with
+    `rotation`. `spread0` [n_pad] carries selector-spread counts across the
+    burst (requires spec-identical pods — one shared selector set)."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    if rotation is None:
-        z = jnp.zeros((1, 1), jnp.int32)
+    z = jnp.zeros((1, 1), jnp.int32)
+    if rotation_pos is not None:
+        assert rotation is None
+        perms = jnp.asarray(rotation_pos[0], jnp.int32)
+        inv_perms = z
+        oid_seq = jnp.asarray(rotation_pos[1], jnp.int32)
+    elif rotation is None:
         perms = inv_perms = z
         oid_seq = jnp.zeros(1, jnp.int32)
     else:
@@ -493,7 +541,8 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     return _schedule_batch_jit(
         nodes, pods, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
         _i64(n_real), perms, inv_perms, oid_seq, s0, z_pad, weights_tuple,
-        rotation is not None, carry_spread)
+        rotation is not None, carry_spread,
+        rotate_pos=rotation_pos is not None)
 
 
 # ---------------------------------------------------------------------------
@@ -688,9 +737,14 @@ def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
         m_elim = jnp.minimum(jnp.minimum(remaining, k_batch),
                              jnp.minimum(max_elim, jnp.maximum(F - 1, 1)))
         if rotate:
-            # the original-rank formula assumes ONE tie order; per-cycle
-            # rotated orders fall back to exact single steps
-            m_elim = jnp.minimum(m_elim, 1)
+            # the original-rank formula assumes ONE tie order; limit the
+            # batch to this pass's constant-order prefix (ranks are distinct
+            # within one order, so the rank->node map stays consistent).
+            # Identity-heavy walks — uneven-zone clusters whose cursor sits
+            # at a fixed point — keep FULL ELIM batching this way.
+            same = jnp.cumprod((oid == oid[0]).astype(i32), dtype=i32)
+            m_elim = jnp.minimum(m_elim, jnp.maximum(
+                jnp.sum(same, dtype=i32), 1))
         m = jnp.where(F == 0, jnp.minimum(remaining, k_batch),
                       jnp.where(elim, m_elim,
                                 jnp.where(kbig, m_stay, 1)))

@@ -71,6 +71,22 @@ def nodes_where_preemption_might_help(
     return out
 
 
+def no_possible_victims(pod: Pod, node_infos: dict[str, NodeInfo],
+                        candidates: list[str]) -> bool:
+    """Fast-path predicate shared by the oracle Preemptor and the device
+    path (core/tpu_scheduler.preempt) so the two cannot drift: when no
+    candidate hosts any lower-priority pod, victim removal is a no-op on
+    every node — a candidate could only succeed if the pod already fit
+    unchanged, impossible against the snapshot that produced its FitError.
+    The reference discovers this by walking every candidate through
+    selectVictimsOnNode (generic_scheduler.go:1054); skipping the walk
+    avoids an O(candidates x predicate-set) scan per failed pod in
+    same-priority saturation workloads."""
+    return not any(p.priority < pod.priority
+                   for name in candidates
+                   for p in node_infos[name].pods)
+
+
 def pods_violating_pdbs(pods: list[Pod],
                         pdbs: list[PodDisruptionBudget]) -> list[Pod]:
     """Reference: :1032 filterPodsWithPDBViolation — a pod violates when a
@@ -230,6 +246,8 @@ class Preemptor:
             # []*v1.Pod{pod} as nominatedPodsToClear)
             return PreemptionResult(None, [], [pod])
         pdbs = self.pdbs_fn()
+        if no_possible_victims(pod, node_infos, candidates):
+            return PreemptionResult(None, [], [])
 
         nodes_to_victims: dict[str, Victims] = {}
         for name in candidates:

@@ -111,7 +111,15 @@ def run(cfg: PerfConfig, warmup: int = 64) -> PerfResult:
     store, sched = setup(cfg)
     # warmup outside the timed window (jit compilation, informer sync)
     if warmup:
-        for pod in make_pods(_pod_strategy(cfg, warmup, "warmup"), 0):
+        wst = _pod_strategy(cfg, warmup, "warmup")
+        if cfg.workload == "anti-affinity":
+            # warmup pods must exercise the same kernels WITHOUT consuming
+            # the measured workload's anti-affinity capacity: a distinct
+            # label set self-anti-affines among the warmup pods only (the
+            # reference sizes its cells so every measured pod fits,
+            # scheduler_bench_test.go:61-66)
+            wst.labels = {"app": "warmup"}
+        for pod in make_pods(wst, 0):
             store.create(PODS, pod)
         sched.pump()
         _drain(sched, cfg)

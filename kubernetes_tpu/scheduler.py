@@ -584,8 +584,6 @@ class Scheduler:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
         self._last_names = names
-        li0 = getattr(self.algorithm, "last_index", None)
-        lni0 = getattr(self.algorithm, "last_node_index", None)
         hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
                                               names, bucket=bucket)
         if hosts is None:
@@ -596,25 +594,18 @@ class Scheduler:
             for i, (pod, cycle) in enumerate(zip(pods, cycles)):
                 self._process_one(pod, cycle, names=names if i == 0 else None)
             return
+        kf = len(pods)
         if any(host is None for host in hosts):
-            # a failing pod's serial rerun can preempt — nominating a node
-            # and deleting victims, state the OTHER kernel decisions never
-            # saw. The kernel also already committed whole-burst rotation
-            # counters and device folds, so partial consumption can't be
-            # made serial-exact: roll the segment back entirely (device
-            # matrix + last_index/lastNodeIndex) and run it serially.
-            discard = getattr(self.algorithm, "discard_burst_folds", None)
-            if discard is not None:
-                discard()
-            if li0 is not None:
-                self.algorithm.last_index = li0
-            if lni0 is not None:
-                self.algorithm.last_node_index = lni0
-            for k, (pod, cycle) in enumerate(zip(pods, cycles)):
-                self._process_one(pod, cycle, names=names if k == 0 else None)
-            return
+            # burst contract (tpu_scheduler.schedule_burst): decisions from
+            # the first None on are UNDECIDED — the algorithm rewound its
+            # counters and device folds to the non-None prefix, whose
+            # decisions are serial-exact and final. Commit the prefix, then
+            # run the tail serially (a failing pod's serial rerun can
+            # preempt — nominating a node and deleting victims — state the
+            # discarded kernel decisions never saw).
+            kf = hosts.index(None)
         note = getattr(self.algorithm, "note_burst_assumed", None)
-        for pod, host, cycle in zip(pods, hosts, cycles):
+        for pod, host, cycle in zip(pods[:kf], hosts[:kf], cycles[:kf]):
             assumed = pod.clone()
             assumed.node_name = host
             self.cache.assume_pod(assumed)
@@ -626,9 +617,15 @@ class Scheduler:
                     note(assumed, host, gen)
             self._bind(assumed, host, pod, cycle)  # observes "scheduled"
         # serial semantics consume one NodeTree enumeration per pod; the
-        # kernel modeled cycles 0..len(pods)-1 on the segment's single
-        # enumeration — fast-forward the rest
-        self.cache.node_tree.advance_enumerations(len(pods) - 1)
+        # kernel modeled cycles 0..kf-1 on the segment's single
+        # enumeration — fast-forward the rest of the committed prefix
+        if kf > 0:
+            self.cache.node_tree.advance_enumerations(kf - 1)
+        for k in range(kf, len(pods)):
+            # pod 0's enumeration (list_names above) is consumed by the
+            # kernel only when it decided at least one pod
+            self._process_one(pods[k], cycles[k],
+                              names=names if kf == 0 and k == 0 else None)
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""
