@@ -29,6 +29,14 @@ KIND_TYPES = {
     "priorityclasses": T.PriorityClass,
     store_mod.ENDPOINTS: T.Endpoints,
     store_mod.RESOURCEQUOTAS: T.ResourceQuota,
+    store_mod.DEPLOYMENTS: T.Deployment,
+    store_mod.JOBS: T.Job,
+    store_mod.DAEMONSETS: T.DaemonSet,
+    store_mod.STATEFULSETS: T.StatefulSet,
+    store_mod.NAMESPACES: T.Namespace,
+    store_mod.CONFIGMAPS: T.ConfigMap,
+    store_mod.SECRETS: T.Secret,
+    store_mod.SERVICEACCOUNTS: T.ServiceAccount,
 }
 
 # kinds whose objects key by bare name (Node.key etc.); everything else

@@ -528,12 +528,196 @@ class Service:
 
 
 @dataclass
+class PodTemplate:
+    """Pruned v1.PodTemplateSpec — the pod shape workload controllers stamp
+    out (reference: pkg/apis/core/types.go PodTemplateSpec as embedded in
+    apps/batch workload specs)."""
+    labels: dict[str, str] = field(default_factory=dict)
+    containers: tuple[Container, ...] = ()
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: tuple[Toleration, ...] = ()
+    affinity: Optional[Affinity] = None
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+
+    def make_pod(self, name: str, namespace: str,
+                 owner_ref: Optional[tuple[str, str, str]] = None,
+                 extra_labels: Optional[dict[str, str]] = None,
+                 node_name: str = "") -> Pod:
+        labels = dict(self.labels)
+        if extra_labels:
+            labels.update(extra_labels)
+        return Pod(
+            name=name, namespace=namespace, labels=labels,
+            containers=self.containers or (Container.make(name="c"),),
+            node_selector=dict(self.node_selector),
+            tolerations=self.tolerations, affinity=self.affinity,
+            priority_class_name=self.priority_class_name,
+            scheduler_name=self.scheduler_name,
+            node_name=node_name, owner_ref=owner_ref)
+
+
+@dataclass
 class ReplicaSet:
-    """Stands in for RC/RS/StatefulSet — anything with a label selector."""
+    """Pruned apps/v1.ReplicaSet (also stands in for RC). `template` drives
+    the pods the controller stamps out; None keeps the legacy
+    selector-labels-only shape (reference: pkg/apis/apps/types.go
+    ReplicaSetSpec)."""
     name: str
     namespace: str = "default"
     selector: Optional[LabelSelector] = None
     replicas: int = 1            # spec.replicas (PDB expected-scale source)
+    template: Optional[PodTemplate] = None
+    # set by the deployment controller on rollout-owned sets
+    owner_ref: Optional[tuple[str, str, str]] = None
+    # status (reconciled by controllers.replicaset)
+    observed_replicas: int = 0
+    ready_replicas: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Deployment:
+    """Pruned apps/v1.Deployment: declarative rollout over owned
+    ReplicaSets (reference: pkg/apis/apps/types.go DeploymentSpec;
+    controller pkg/controller/deployment)."""
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: Optional[PodTemplate] = None
+    strategy: str = "RollingUpdate"        # RollingUpdate | Recreate
+    max_surge: int = 1                     # rolling: extra pods allowed
+    max_unavailable: int = 1               # rolling: pods that may be down
+    paused: bool = False
+    # status
+    observed_revision: str = ""            # template hash of the newest RS
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    available_replicas: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Job:
+    """Pruned batch/v1.Job: run-to-completion workload
+    (reference: pkg/apis/batch/types.go JobSpec; controller
+    pkg/controller/job)."""
+    name: str
+    namespace: str = "default"
+    template: Optional[PodTemplate] = None
+    completions: int = 1
+    parallelism: int = 1
+    backoff_limit: int = 6
+    ttl_seconds_after_finished: Optional[float] = None
+    # status
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    complete: bool = False
+    job_failed: bool = False               # backoff limit exceeded
+    completion_time: Optional[float] = None
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class DaemonSet:
+    """Pruned apps/v1.DaemonSet. In the reference snapshot the DS controller
+    schedules its own pods (sets nodeName directly,
+    pkg/controller/daemon/daemon_controller.go:81) — mirrored here."""
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplate] = None
+    # status
+    desired_number_scheduled: int = 0
+    current_number_scheduled: int = 0
+    number_ready: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class StatefulSet:
+    """Pruned apps/v1.StatefulSet: stable ordinal identities name-0..N-1,
+    OrderedReady scale-up/down (reference: pkg/apis/apps/types.go
+    StatefulSetSpec; controller pkg/controller/statefulset)."""
+    name: str
+    namespace: str = "default"
+    selector: Optional[LabelSelector] = None
+    template: Optional[PodTemplate] = None
+    replicas: int = 1
+    service_name: str = ""
+    pod_management_policy: str = "OrderedReady"   # | Parallel
+    # status
+    current_replicas: int = 0
+    ready_replicas: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Namespace:
+    """Pruned v1.Namespace (cluster-scoped). DELETE moves it to Terminating;
+    the namespace controller empties it then removes it (reference:
+    pkg/controller/namespace finalization)."""
+    name: str
+    phase: str = "Active"                  # Active | Terminating
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+
+@dataclass
+class ConfigMap:
+    name: str
+    namespace: str = "default"
+    data: dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class Secret:
+    name: str
+    namespace: str = "default"
+    type: str = "Opaque"
+    data: dict[str, str] = field(default_factory=dict)   # base64 by convention
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class ServiceAccount:
+    name: str
+    namespace: str = "default"
+    secrets: tuple[str, ...] = ()
     resource_version: int = 0
 
     @property

@@ -210,6 +210,24 @@ def make_handler(store: Store, admission: AdmissionChain):
                 return
             kind = parts[2]
             key = "/".join(parts[3:])
+            from kubernetes_tpu.store.store import NAMESPACES
+            if kind == NAMESPACES:
+                # namespace finalization (reference: registry/core/namespace
+                # storage sets DeletionTimestamp -> phase Terminating; the
+                # namespace controller empties it and removes the object)
+                def terminate(cur):
+                    if cur.phase == "Terminating":
+                        return None
+                    cur.phase = "Terminating"
+                    return cur
+                try:
+                    gone = store.guaranteed_update(NAMESPACES, key, terminate,
+                                                   allow_skip=True)
+                except NotFoundError:
+                    self._error(404, "NotFound", f"{kind}/{key}")
+                    return
+                self._send(200, serde.to_dict(gone))
+                return
             try:
                 gone = store.delete(kind, key)
             except NotFoundError:

@@ -1,0 +1,59 @@
+"""Shared controller scaffold: informer-driven dirty-key reconciliation.
+
+Every workload controller follows the reference's controller shape
+(informer event handlers -> workqueue -> syncHandler; e.g.
+pkg/controller/deployment/deployment_controller.go:63): the primary kind's
+events mark keys dirty, reconcile_dirty drains them through reconcile().
+Subclasses set KIND, implement reconcile(obj), and add any secondary-kind
+handlers in _register_extra_handlers().
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.store.store import Store, NotFoundError
+
+
+class DirtyKeyController:
+    KIND: str = ""
+
+    def __init__(self, store: Store, clock=None):
+        self.store = store
+        self.clock = clock
+        self.informers = InformerFactory(store)
+        self._dirty: set[str] = set()
+        prim = self.informers.informer(self.KIND)
+        prim.add_event_handler(
+            on_add=lambda o: self._dirty.add(o.key),
+            on_update=lambda o, n: self._dirty.add(n.key),
+            on_delete=lambda o: self._dirty.discard(o.key))
+        self._register_extra_handlers()
+
+    def _register_extra_handlers(self) -> None:
+        """Secondary-kind informer wiring (pods -> owner dirty, etc.)."""
+
+    def sync(self) -> None:
+        self.informers.sync_all()
+        for o in self.informers.informer(self.KIND).list():
+            self._dirty.add(o.key)
+        self.reconcile_dirty()
+
+    def pump(self) -> int:
+        self.informers.pump_all()
+        return self.reconcile_dirty()
+
+    def reconcile_dirty(self) -> int:
+        n = 0
+        while self._dirty:
+            key = self._dirty.pop()
+            try:
+                obj = self.store.get(self.KIND, key)
+            except NotFoundError:
+                continue
+            self.reconcile(obj)
+            n += 1
+        return n
+
+    def reconcile(self, obj: Any) -> None:
+        raise NotImplementedError

@@ -18,15 +18,34 @@ from kubernetes_tpu.controllers.podgc import PodGCController
 from kubernetes_tpu.controllers.endpoints import EndpointsController
 from kubernetes_tpu.controllers.replicaset import ReplicaSetController
 from kubernetes_tpu.controllers.resourcequota import ResourceQuotaController
+from kubernetes_tpu.controllers.deployment import DeploymentController
+from kubernetes_tpu.controllers.job import JobController
+from kubernetes_tpu.controllers.daemonset import DaemonSetController
+from kubernetes_tpu.controllers.statefulset import StatefulSetController
+from kubernetes_tpu.controllers.namespace import (
+    NamespaceController, ServiceAccountController,
+)
+from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 
-# name -> constructor(store) (NewControllerInitializers analog)
+# name -> constructor(store) (NewControllerInitializers analog,
+# controllermanager.go:372-412). Ordering matters for single-threaded
+# pump() convergence: deployment before replicaset (rollout scales feed the
+# RS reconcile in the same pass), garbagecollector last (owners deleted by
+# earlier loops cascade in the same pump).
 CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "disruption": DisruptionController,
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
+    "deployment": DeploymentController,
     "replicaset": ReplicaSetController,
+    "job": JobController,
+    "daemonset": DaemonSetController,
+    "statefulset": StatefulSetController,
     "endpoint": EndpointsController,
     "resourcequota": ResourceQuotaController,
+    "namespace": NamespaceController,
+    "serviceaccount": ServiceAccountController,
+    "garbagecollector": GarbageCollector,
 }
 
 

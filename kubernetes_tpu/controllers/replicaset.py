@@ -15,7 +15,7 @@ import itertools
 from typing import Optional
 
 from kubernetes_tpu.api.types import Pod, Container, ReplicaSet
-from kubernetes_tpu.store.informer import InformerFactory
+from kubernetes_tpu.controllers.base import DirtyKeyController
 from kubernetes_tpu.store.record import EventRecorder, NORMAL
 from kubernetes_tpu.store.store import (
     Store, PODS, REPLICASETS, AlreadyExistsError, NotFoundError,
@@ -24,9 +24,11 @@ from kubernetes_tpu.store.store import (
 _suffix = itertools.count(1)
 
 
-class ReplicaSetController:
+class ReplicaSetController(DirtyKeyController):
+    KIND = REPLICASETS
+
     def __init__(self, store: Store, clock=None, admission=None):
-        self.store = store
+        super().__init__(store, clock=clock)
         # controller-originated pod writes go through the same admission
         # chain as kubectl-path writes (LimitRanger defaults, PriorityClass
         # resolution, toleration defaulting, quota), so scale-up pods are
@@ -35,12 +37,8 @@ class ReplicaSetController:
         from kubernetes_tpu.apiserver.admission import AdmissionChain
         self.admission = admission if admission is not None else AdmissionChain()
         self.recorder = EventRecorder(store, component="controllermanager")
-        self.informers = InformerFactory(store)
-        self._dirty: set[str] = set()
-        rs = self.informers.informer(REPLICASETS)
-        rs.add_event_handler(on_add=lambda r: self._dirty.add(r.key),
-                             on_update=lambda o, n: self._dirty.add(n.key),
-                             on_delete=lambda r: self._dirty.discard(r.key))
+
+    def _register_extra_handlers(self) -> None:
         pods = self.informers.informer(PODS)
         pods.add_event_handler(on_add=self._pod_changed,
                                on_update=lambda o, n: self._pod_changed(n),
@@ -55,27 +53,8 @@ class ReplicaSetController:
             for r in self.informers.informer(REPLICASETS).list():
                 self._dirty.add(r.key)
 
-    def sync(self) -> None:
-        self.informers.sync_all()
-        for r in self.informers.informer(REPLICASETS).list():
-            self._dirty.add(r.key)
-        self.reconcile_dirty()
-
-    def pump(self) -> int:
-        self.informers.pump_all()
-        return self.reconcile_dirty()
-
-    def reconcile_dirty(self) -> int:
-        n = 0
-        while self._dirty:
-            key = self._dirty.pop()
-            try:
-                rs = self.store.get(REPLICASETS, key)
-            except NotFoundError:
-                continue
-            self.manage_replicas(rs)
-            n += 1
-        return n
+    def reconcile(self, rs: ReplicaSet) -> None:
+        self.manage_replicas(rs)
 
     # -- syncReplicaSet -> manageReplicas ------------------------------------
     def _matching_pods(self, rs: ReplicaSet) -> list[Pod]:
@@ -84,13 +63,24 @@ class ReplicaSetController:
         pods, _rv = self.store.list(PODS)
         return [p for p in pods
                 if p.namespace == rs.namespace and not p.deleted
-                and rs.selector.matches(p.labels)]
+                and rs.selector.matches(p.labels)
+                # adopt orphans; never count pods owned by a DIFFERENT
+                # controller (a Job pod with overlapping labels is not ours
+                # — reference ControllerRefManager ClaimPods)
+                and (p.owner_ref is None
+                     or p.owner_ref[:2] == ("ReplicaSet", rs.name))]
 
     def _template_pod(self, rs: ReplicaSet) -> Pod:
+        owner = ("ReplicaSet", rs.name, f"rs-{rs.name}")
+        name = f"{rs.name}-{next(_suffix):x}"
+        if rs.template is not None:
+            extra = dict(rs.selector.match_labels) if rs.selector else {}
+            return rs.template.make_pod(name, rs.namespace, owner_ref=owner,
+                                        extra_labels=extra)
         labels = dict(rs.selector.match_labels) if rs.selector else {}
-        return Pod(name=f"{rs.name}-{next(_suffix):x}",
+        return Pod(name=name,
                    namespace=rs.namespace, labels=labels,
-                   owner_ref=("ReplicaSet", rs.name, f"rs-{rs.name}"),
+                   owner_ref=owner,
                    containers=(Container.make(name="c"),))
 
     def manage_replicas(self, rs: ReplicaSet) -> None:
@@ -133,3 +123,26 @@ class ReplicaSetController:
                 self.recorder.event(
                     "ReplicaSet", rs.key, NORMAL, "SuccessfulDelete",
                     f"Deleted pod: {p.name}")
+        self._update_status(rs)
+
+    def _update_status(self, rs: ReplicaSet) -> None:
+        """calculateStatus analog: observed + ready replica counts the
+        deployment controller's rollout gating reads."""
+        pods = self._matching_pods(rs)
+        observed = len(pods)
+        ready = sum(1 for p in pods if p.phase == "Running")
+        if observed == rs.observed_replicas and ready == rs.ready_replicas:
+            return
+
+        def mutate(cur):
+            if cur.observed_replicas == observed \
+                    and cur.ready_replicas == ready:
+                return None
+            cur.observed_replicas = observed
+            cur.ready_replicas = ready
+            return cur
+        try:
+            self.store.guaranteed_update(REPLICASETS, rs.key, mutate,
+                                         allow_skip=True)
+        except NotFoundError:
+            pass

@@ -42,6 +42,14 @@ EVENTS = "events"  # user-visible audit records (record.EventRecorder analog)
 PRIORITYCLASSES = "priorityclasses"  # scheduling.k8s.io (admission-resolved)
 ENDPOINTS = "endpoints"  # service backends (controllers.endpoints)
 RESOURCEQUOTAS = "resourcequotas"  # per-namespace caps (admission-enforced)
+DEPLOYMENTS = "deployments"  # apps workload tier (controllers.deployment)
+JOBS = "jobs"  # batch run-to-completion (controllers.job)
+DAEMONSETS = "daemonsets"  # one-pod-per-node (controllers.daemonset)
+STATEFULSETS = "statefulsets"  # ordinal identities (controllers.statefulset)
+NAMESPACES = "namespaces"  # lifecycle owned by controllers.namespace
+CONFIGMAPS = "configmaps"
+SECRETS = "secrets"
+SERVICEACCOUNTS = "serviceaccounts"
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
 
