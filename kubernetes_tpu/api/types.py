@@ -678,9 +678,12 @@ class StatefulSet:
 class Namespace:
     """Pruned v1.Namespace (cluster-scoped). DELETE moves it to Terminating;
     the namespace controller empties it then removes it (reference:
-    pkg/controller/namespace finalization)."""
+    pkg/controller/namespace finalization). `annotations` carries the
+    scheduler.alpha.kubernetes.io/{defaultTolerations,tolerationsWhitelist}
+    JSON the podtolerationrestriction admission plugin reads."""
     name: str
     phase: str = "Active"                  # Active | Terminating
+    annotations: dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
 
     @property

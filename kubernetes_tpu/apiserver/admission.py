@@ -102,6 +102,22 @@ class LimitRanger:
             obj.containers = tuple(out)
         return obj
 
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store) -> Any:
+        # the reference LimitRanger runs on updates too: a PUT must not
+        # strip the defaults a create received
+        return self.admit(kind, new, store)
+
+
+def _subtract_usage(cur, amounts: dict) -> Any:
+    """Clamp-at-zero usage decrement over a quota's hard-capped resources —
+    the single mutate every quota refund path shares."""
+    used = dict(cur.used)
+    for name in cur.hard:
+        if amounts.get(name):
+            used[name] = max(0, used.get(name, 0) - amounts[name])
+    cur.used = used
+    return cur
+
 
 class ResourceQuotaAdmission:
     """plugin/pkg/admission/resourcequota: reject pod creation that would
@@ -141,14 +157,6 @@ class ResourceQuotaAdmission:
             cur.used = used
             return cur
 
-        def refund(cur):
-            used = dict(cur.used)
-            for name in cur.hard:
-                if usage.get(name):
-                    used[name] = max(0, used.get(name, 0) - usage[name])
-            cur.used = used
-            return cur
-
         charged: list[str] = []
         try:
             for q in matching:
@@ -163,18 +171,11 @@ class ResourceQuotaAdmission:
 
     def _refund_keys(self, store: Store, keys, usage) -> None:
         from kubernetes_tpu.store.store import RESOURCEQUOTAS, NotFoundError
-
-        def refund(cur):
-            used = dict(cur.used)
-            for name in cur.hard:
-                if usage.get(name):
-                    used[name] = max(0, used.get(name, 0) - usage[name])
-            cur.used = used
-            return cur
-
         for key in keys:
             try:
-                store.guaranteed_update(RESOURCEQUOTAS, key, refund)
+                store.guaranteed_update(
+                    RESOURCEQUOTAS, key,
+                    lambda cur: _subtract_usage(cur, usage))
             except NotFoundError:
                 pass
 
@@ -192,6 +193,252 @@ class ResourceQuotaAdmission:
         if keys:
             self._refund_keys(store, keys, pod_usage(obj))
 
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store) -> Any:
+        """The classic escape hatch this closes: create a conforming pod,
+        PUT it oversized. Charges/refunds the usage DELTA via the same CAS
+        (negative deltas replenish immediately; the controller reconciles
+        any drift)."""
+        if kind != PODS:
+            return new
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS, NotFoundError
+        from kubernetes_tpu.controllers.resourcequota import pod_usage
+        quotas, _rv = store.list(RESOURCEQUOTAS)
+        matching = [q for q in quotas
+                    if q.namespace == new.namespace and q.hard]
+        if not matching:
+            return new
+        old_u, new_u = pod_usage(old), pod_usage(new)
+        delta = {k: new_u.get(k, 0) - old_u.get(k, 0)
+                 for k in set(old_u) | set(new_u)}
+        if not any(delta.values()):
+            return new
+
+        def apply(cur):
+            # only GROWING resources are checked (the reference rejects only
+            # usage increases past hard): an already-over-cap namespace —
+            # e.g. after an admin lowered the cap — must not block shrinking
+            # or unrelated updates
+            over = [
+                f"{name}: used {cur.used.get(name, 0)} + delta "
+                f"{delta.get(name, 0)} > hard {cap}"
+                for name, cap in cur.hard.items()
+                if delta.get(name, 0) > 0
+                and cur.used.get(name, 0) + delta.get(name, 0) > cap]
+            if over:
+                raise AdmissionError(
+                    f"exceeded quota {cur.key}: " + "; ".join(over))
+            used = dict(cur.used)
+            for name in cur.hard:
+                if delta.get(name):
+                    used[name] = max(0, used.get(name, 0) + delta[name])
+            cur.used = used
+            return cur
+
+        charged: list[str] = []
+        try:
+            for q in matching:
+                store.guaranteed_update(RESOURCEQUOTAS, q.key, apply)
+                charged.append(q.key)
+        except AdmissionError:
+            for key in charged:
+                try:
+                    store.guaranteed_update(
+                        RESOURCEQUOTAS, key,
+                        lambda cur: _subtract_usage(cur, delta))
+                except NotFoundError:
+                    pass
+            raise
+        return new
+
+    def refund_update(self, kind: str, old: Any, new: Any,
+                      store: Store) -> None:
+        """Inverse of admit_update's delta charge, for a PUT that failed to
+        land (Conflict/NotFound)."""
+        if kind != PODS:
+            return
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS, NotFoundError
+        from kubernetes_tpu.controllers.resourcequota import pod_usage
+        old_u, new_u = pod_usage(old), pod_usage(new)
+        delta = {k: new_u.get(k, 0) - old_u.get(k, 0)
+                 for k in set(old_u) | set(new_u)}
+        if not any(delta.values()):
+            return
+        quotas, _rv = store.list(RESOURCEQUOTAS)
+        for q in quotas:
+            if q.namespace != new.namespace or not q.hard:
+                continue
+            try:
+                store.guaranteed_update(
+                    RESOURCEQUOTAS, q.key,
+                    lambda cur: _subtract_usage(cur, delta))
+            except NotFoundError:
+                pass
+
+
+class NodeRestriction:
+    """plugin/pkg/admission/noderestriction/admission.go:46: a kubelet
+    identity (`system:node:<name>`) may only update ITS OWN Node object and
+    pods bound to its node. Identity arrives as the REST layer's
+    `X-Remote-User` header (the reference's header authn front end); writes
+    with no user (in-process controllers, admins) are unrestricted."""
+
+    PREFIX = "system:node:"
+
+    def _node_of(self, user: Optional[str]) -> Optional[str]:
+        if user and user.startswith(self.PREFIX):
+            return user[len(self.PREFIX):]
+        return None
+
+    def admit(self, kind: str, obj: Any, store: Store,
+              user: Optional[str] = None) -> Any:
+        from kubernetes_tpu.store.store import NODES
+        node = self._node_of(user)
+        if node is None:
+            return obj
+        if kind == NODES and obj.name != node:
+            raise AdmissionError(
+                f"node {node!r} is not allowed to modify node {obj.name!r}")
+        if kind == PODS and getattr(obj, "node_name", "") not in ("", node):
+            raise AdmissionError(
+                f"node {node!r} is not allowed to modify pods bound to "
+                f"node {obj.node_name!r}")
+        return obj
+
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store,
+                     user: Optional[str] = None) -> Any:
+        node = self._node_of(user)
+        if node is not None and kind == PODS \
+                and getattr(old, "node_name", "") not in ("", node):
+            # the OLD binding counts too: a kubelet may not unbind/steal a
+            # pod bound to another node by rewriting node_name in the body
+            raise AdmissionError(
+                f"node {node!r} is not allowed to modify pods bound to "
+                f"node {old.node_name!r}")
+        return self.admit(kind, new, store, user=user)
+
+
+class PodTolerationRestriction:
+    """plugin/pkg/admission/podtolerationrestriction: merge the namespace's
+    default tolerations into the pod and reject tolerations outside the
+    namespace whitelist (both from namespace annotations, as JSON lists of
+    {key, operator, value, effect})."""
+
+    DEFAULT_KEY = "scheduler.alpha.kubernetes.io/defaultTolerations"
+    WHITELIST_KEY = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
+
+    @staticmethod
+    def _parse(raw: str):
+        import json as _json
+        from kubernetes_tpu.api.types import Toleration
+        out = []
+        for d in _json.loads(raw):
+            out.append(Toleration(
+                key=d.get("key", ""), op=d.get("operator", "Equal"),
+                value=d.get("value", ""), effect=d.get("effect", "")))
+        return out
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        from kubernetes_tpu.store.store import NAMESPACES, NotFoundError
+        try:
+            ns = store.get(NAMESPACES, obj.namespace)
+        except NotFoundError:
+            return obj
+        if self.DEFAULT_KEY in ns.annotations:
+            defaults = self._parse(ns.annotations[self.DEFAULT_KEY])
+            have = set(obj.tolerations)
+            extra = tuple(t for t in defaults if t not in have)
+            if extra:
+                obj.tolerations = obj.tolerations + extra
+        if self.WHITELIST_KEY in ns.annotations:
+            allowed = set(self._parse(ns.annotations[self.WHITELIST_KEY]))
+            bad = [t for t in obj.tolerations if t not in allowed]
+            if bad:
+                raise AdmissionError(
+                    f"pod tolerations (possibly merged) conflict with "
+                    f"namespace whitelist of {obj.namespace}")
+        return obj
+
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store) -> Any:
+        # the reference registers for Create AND Update — a PUT must not
+        # smuggle in tolerations the namespace forbids. The cluster NoExecute
+        # defaults (DefaultTolerationSeconds) were added on create and sit in
+        # `new` already; whitelist them implicitly by judging only the diff
+        # against old's accepted set when a whitelist exists.
+        if kind != PODS:
+            return new
+        from kubernetes_tpu.store.store import NAMESPACES, NotFoundError
+        try:
+            ns = store.get(NAMESPACES, new.namespace)
+        except NotFoundError:
+            return new
+        if self.WHITELIST_KEY in ns.annotations:
+            allowed = set(self._parse(ns.annotations[self.WHITELIST_KEY]))
+            allowed |= set(old.tolerations)
+            bad = [t for t in new.tolerations if t not in allowed]
+            if bad:
+                raise AdmissionError(
+                    f"pod tolerations conflict with namespace whitelist "
+                    f"of {new.namespace}")
+        return new
+
+
+class AntiAffinityAdmission:
+    """plugin/pkg/admission/antiaffinity (LimitPodHardAntiAffinityTopology):
+    required pod anti-affinity with a topology key other than the hostname
+    label is rejected — cluster-wide anti-affinity is an abuse vector."""
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        if kind != PODS:
+            return obj
+        from kubernetes_tpu.api.types import LABEL_HOSTNAME
+        a = getattr(obj, "affinity", None)
+        paa = a.pod_anti_affinity if a is not None else None
+        for term in (paa.required if paa else ()):
+            if term.topology_key != LABEL_HOSTNAME:
+                raise AdmissionError(
+                    "affinity.podAntiAffinity.requiredDuringScheduling... "
+                    f"topologyKey {term.topology_key!r} is not allowed "
+                    f"(only {LABEL_HOSTNAME})")
+        return obj
+
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store) -> Any:
+        return self.admit(kind, new, store)
+
+
+class EventRateLimit:
+    """plugin/pkg/admission/eventratelimit: a token bucket over event
+    creates (server scope) so an event storm cannot swamp the store."""
+
+    def __init__(self, qps: float = 50.0, burst: int = 100, clock=None):
+        import threading
+        import time as _time
+        self.qps = qps
+        self.burst = burst
+        self._tokens = float(burst)
+        self._last = None
+        self._now = clock or _time.monotonic
+        # the chain runs inside ThreadingHTTPServer request threads; the
+        # read-modify-write of the bucket must not race
+        self._lock = threading.Lock()
+
+    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+        from kubernetes_tpu.store.store import EVENTS
+        if kind != EVENTS:
+            return obj
+        with self._lock:
+            now = self._now()
+            if self._last is not None:
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            if self._tokens < 1.0:
+                raise AdmissionError(
+                    "event rate limited (server bucket empty)")
+            self._tokens -= 1.0
+        return obj
+
 
 class AdmissionChain:
     def __init__(self, plugins: Optional[list] = None):
@@ -199,14 +446,38 @@ class AdmissionChain:
         # and only a failure of the store write itself (handled by the
         # caller via refund()) — not a later plugin's rejection — may
         # follow a successful charge
+        # PodTolerationRestriction precedes DefaultTolerationSeconds (as in
+        # the reference's recommended order) so namespace whitelists judge
+        # the POD'S tolerations, not the cluster-injected NoExecute defaults
         self.plugins = plugins if plugins is not None else [
-            PriorityAdmission(), DefaultTolerationSeconds(), LimitRanger(),
+            NodeRestriction(), PriorityAdmission(),
+            PodTolerationRestriction(), AntiAffinityAdmission(),
+            EventRateLimit(), DefaultTolerationSeconds(), LimitRanger(),
             ResourceQuotaAdmission()]
 
-    def admit(self, kind: str, obj: Any, store: Store) -> Any:
+    def admit(self, kind: str, obj: Any, store: Store,
+              user: Optional[str] = None) -> Any:
         for p in self.plugins:
-            obj = p.admit(kind, obj, store)
+            if user is not None and isinstance(p, NodeRestriction):
+                obj = p.admit(kind, obj, store, user=user)
+            else:
+                obj = p.admit(kind, obj, store)
         return obj
+
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store,
+                     user: Optional[str] = None) -> Any:
+        """The UPDATE half of the chain (the reference runs admission on
+        every write verb): plugins exposing admit_update participate; pure
+        create-defaulting plugins are skipped."""
+        for p in self.plugins:
+            au = getattr(p, "admit_update", None)
+            if au is None:
+                continue
+            if isinstance(p, NodeRestriction):
+                new = au(kind, old, new, store, user=user)
+            else:
+                new = au(kind, old, new, store)
+        return new
 
     def refund(self, kind: str, obj: Any, store: Store) -> None:
         """Roll back side-effecting admissions (quota usage commits) after
@@ -216,3 +487,12 @@ class AdmissionChain:
             r = getattr(p, "refund", None)
             if r is not None:
                 r(kind, obj, store)
+
+    def refund_update(self, kind: str, old: Any, new: Any,
+                      store: Store) -> None:
+        """Roll back admit_update side effects (quota delta charges) after
+        the admitted PUT failed to land (Conflict/NotFound)."""
+        for p in self.plugins:
+            r = getattr(p, "refund_update", None)
+            if r is not None:
+                r(kind, old, new, store)

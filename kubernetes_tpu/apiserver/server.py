@@ -166,7 +166,9 @@ def make_handler(store: Store, admission: AdmissionChain):
             admitted = None
             try:
                 obj = serde.from_dict(kind, self._body())
-                obj = admitted = admission.admit(kind, obj, store)
+                obj = admitted = admission.admit(
+                    kind, obj, store,
+                    user=self.headers.get("X-Remote-User"))
                 created = store.create(kind, obj)
             except AdmissionError as e:
                 self._error(422, "Invalid", str(e))
@@ -188,14 +190,30 @@ def make_handler(store: Store, admission: AdmissionChain):
                 self._error(404, "NotFound", path)
                 return
             kind = parts[2]
+            old = admitted = None
             try:
                 obj = serde.from_dict(kind, self._body())
+                # the chain runs on UPDATES too (the reference runs
+                # admission on every write verb) — closing the PUT escape
+                # hatch around LimitRanger/quota; the old object gives
+                # plugins their delta
+                old = store.get(kind, obj.key)
+                obj = admitted = admission.admit_update(
+                    kind, old, obj, store,
+                    user=self.headers.get("X-Remote-User"))
                 expect = obj.resource_version or None
                 updated = store.update(kind, obj, expect_rv=expect)
+            except AdmissionError as e:
+                self._error(422, "Invalid", str(e))
+                return
             except NotFoundError as e:
+                if admitted is not None:   # vanished between admit and write
+                    admission.refund_update(kind, old, admitted, store)
                 self._error(404, "NotFound", str(e))
                 return
             except ConflictError as e:
+                # the admitted write never landed: put the delta back
+                admission.refund_update(kind, old, admitted, store)
                 self._error(409, "Conflict", str(e))
                 return
             except (TypeError, ValueError, KeyError) as e:

@@ -113,6 +113,10 @@ class TPUScheduler:
         self.mesh = mesh
         self._sharded_cycle = None
         self._sharded_batch = None
+        # optional SchedulerMetrics handle (the shell injects it): burst
+        # calls observe encode/kernel/fetch phase durations
+        # (scheduling_duration_seconds{operation}, metrics.go:67-169)
+        self.metrics = None
         self.encoder = NodeStateEncoder()
         # device-resident node matrix: full upload on rebuild, dirty-row
         # scatter otherwise (SURVEY §2.4 delta uploader)
@@ -719,6 +723,14 @@ class TPUScheduler:
         assume + note_burst_assumed) before the next cycle."""
         if not all_node_names or not pods:
             return [None] * len(pods)
+        import time as _time
+        _t0 = _time.perf_counter()
+
+        def _obs(phase: str, t_start: float) -> float:
+            now = _time.perf_counter()
+            if self.metrics is not None:
+                self.metrics.observe_phase(phase, now - t_start)
+            return now
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
@@ -746,6 +758,7 @@ class TPUScheduler:
             # resolution with exact prefix validation (kernels.py K_BATCH)
             cls, extra_ok, ban = uniform
             rotation = self._burst_rotation(b, len(pods))
+            _t = _obs("encode", _t0)
             sel: list[int] = []
             for lo in range(0, len(pods), K.B_CAP):
                 chunk = min(K.B_CAP, len(pods) - lo)
@@ -762,7 +775,9 @@ class TPUScheduler:
                     extra_ok=extra_ok, ban=ban, mesh=self.mesh)
                 self._dev_nodes = {**self._dev_nodes, **rows}
                 nodes = self._dev_nodes
+                _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
                 h = np.asarray(packed)   # ONE fetch: selections + lni delta
+                _t = _obs("fetch", _t)
                 self.last_node_index += int(h[K.B_CAP])
                 sel.extend(h[:chunk].tolist())
                 if any(s < 0 for s in h[:chunk]):
@@ -840,6 +855,7 @@ class TPUScheduler:
                              or spread0.shape[-1] != b.n_pad):
             return None   # inert/dense mix — shouldn't happen, stay exact
         z_pad = _pad_pow2(len(b.zone_names), 4)
+        _t = _obs("encode", _t0)
         if self.mesh is not None:
             if rotation is not None or rotation_pos is not None:
                 # identity-only rotation (the zone cursor sits at a fixed
@@ -867,7 +883,10 @@ class TPUScheduler:
                 num_to_find, n, z_pad, weights=self.weights,
                 rotation=rotation, spread0=spread0,
                 rotation_pos=rotation_pos)
+        _t = _obs("kernel", _t)
         selected = np.asarray(outs["selected"])[: len(pods)]
+        li, lni = int(li), int(lni)
+        _obs("fetch", _t)
         if (selected < 0).any():
             # burst contract: everything from the first failure on is
             # returned undecided (None) and counters/folds rewind to the

@@ -36,9 +36,30 @@ def render_metrics(sched: "Scheduler") -> str:
         f"# HELP {PREFIX}_pod_preemption_victims Number of preemption victims.",
         f"# TYPE {PREFIX}_pod_preemption_victims counter",
         f"{PREFIX}_pod_preemption_victims {m.preemption_victims}",
-        f"# HELP {PREFIX}_e2e_scheduling_duration_seconds_sum Sum of end-to-end scheduling latency.",
-        f"# TYPE {PREFIX}_e2e_scheduling_duration_seconds_sum counter",
-        f"{PREFIX}_e2e_scheduling_duration_seconds_sum {m.e2e_latency_sum:.6f}",
+    ]
+    # per-phase duration histograms (metrics.go:67-169
+    # scheduling_duration_seconds / binding_duration_seconds /
+    # e2e_scheduling_duration_seconds) — phases here are the TPU pipeline's:
+    # encode/kernel/fetch plus algorithm/preemption/binding
+    lines += [
+        f"# HELP {PREFIX}_scheduling_duration_seconds Scheduling phase latency, by operation.",
+        f"# TYPE {PREFIX}_scheduling_duration_seconds histogram",
+    ]
+    for phase in sorted(m.phase_duration):
+        lines += m.phase_duration[phase].render(
+            f"{PREFIX}_scheduling_duration_seconds",
+            labels=f'operation="{phase}"')
+    lines += [
+        f"# HELP {PREFIX}_binding_duration_seconds Binding latency.",
+        f"# TYPE {PREFIX}_binding_duration_seconds histogram",
+    ]
+    lines += m.binding_duration.render(f"{PREFIX}_binding_duration_seconds")
+    lines += [
+        f"# HELP {PREFIX}_e2e_scheduling_duration_seconds End-to-end scheduling latency.",
+        f"# TYPE {PREFIX}_e2e_scheduling_duration_seconds histogram",
+    ]
+    lines += m.e2e_duration.render(f"{PREFIX}_e2e_scheduling_duration_seconds")
+    lines += [
         f"# HELP {PREFIX}_pending_pods Pending pods by queue.",
         f"# TYPE {PREFIX}_pending_pods gauge",
     ]
@@ -60,8 +81,12 @@ def render_metrics(sched: "Scheduler") -> str:
 def reset_metrics(sched: "Scheduler") -> None:
     """DELETE /metrics analog (metrics.Reset, metrics.go:242)."""
     m = sched.metrics
+    from kubernetes_tpu.scheduler import Histogram
     m.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
     m.binding_count = 0
     m.preemption_attempts = 0
     m.preemption_victims = 0
     m.e2e_latency_sum = 0.0
+    m.phase_duration = {}
+    m.binding_duration = Histogram()
+    m.e2e_duration = Histogram()

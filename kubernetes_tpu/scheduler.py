@@ -38,6 +38,38 @@ from kubernetes_tpu.utils.clock import Clock, RealClock
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
 
+class Histogram:
+    """Prometheus-style cumulative histogram (reference buckets:
+    ExponentialBuckets(0.001, 2, 15), metrics.go:93)."""
+
+    BOUNDS = tuple(0.001 * 2 ** i for i in range(15))
+
+    def __init__(self):
+        self.buckets = [0] * len(self.BOUNDS)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self.count += 1
+        self.sum += seconds
+        for i, b in enumerate(self.BOUNDS):
+            if seconds <= b:
+                self.buckets[i] += 1
+
+    def render(self, name: str, labels: str = "") -> list[str]:
+        sep = "," if labels else ""
+        out = []
+        for i, b in enumerate(self.BOUNDS):
+            out.append(f'{name}_bucket{{{labels}{sep}le="{b:g}"}} '
+                       f'{self.buckets[i]}')
+        out.append(f'{name}_bucket{{{labels}{sep}le="+Inf"}} {self.count}')
+        out.append(f'{name}_sum{{{labels}}} {self.sum:.6f}'
+                   if labels else f'{name}_sum {self.sum:.6f}')
+        out.append(f'{name}_count{{{labels}}} {self.count}'
+                   if labels else f'{name}_count {self.count}')
+        return out
+
+
 @dataclass
 class SchedulerMetrics:
     """Counter mirror of pkg/scheduler/metrics/metrics.go."""
@@ -47,9 +79,22 @@ class SchedulerMetrics:
     preemption_attempts: int = 0
     preemption_victims: int = 0
     e2e_latency_sum: float = 0.0
+    # per-phase duration histograms (scheduling_duration_seconds{operation},
+    # metrics.go:67-169) — TPU-shaped phases: encode (host feature
+    # encoding), kernel (device dispatch), fetch (device->host readback),
+    # plus the reference's algorithm/preemption/binding/e2e
+    phase_duration: dict[str, "Histogram"] = field(default_factory=dict)
+    binding_duration: "Histogram" = field(default_factory=lambda: Histogram())
+    e2e_duration: "Histogram" = field(default_factory=lambda: Histogram())
 
     def observe(self, result: str) -> None:
         self.schedule_attempts[result] = self.schedule_attempts.get(result, 0) + 1
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        h = self.phase_duration.get(phase)
+        if h is None:
+            h = self.phase_duration[phase] = Histogram()
+        h.observe(seconds)
 
 
 class Scheduler:
@@ -135,6 +180,7 @@ class Scheduler:
                 # full-vector transfer every cycle (extenders, which do read
                 # host_priority, run on the oracle path)
                 collect_host_priority=False)
+            self.algorithm.metrics = self.metrics   # encode/kernel/fetch phases
             if priority_weights is not None:
                 from kubernetes_tpu.factory import tpu_kernel_weights
                 self.algorithm.weights = tpu_kernel_weights(priority_weights)
@@ -298,11 +344,19 @@ class Scheduler:
             names = self.cache.node_tree.list_names()
         self._last_names = names
         try:
-            result = self._schedule(pod, names)
+            t_alg = self.clock.now()
+            try:
+                result = self._schedule(pod, names)
+            finally:
+                self.metrics.observe_phase("algorithm",
+                                           self.clock.now() - t_alg)
         except FitError as err:
             self.metrics.observe("unschedulable")
             if not self.disable_preemption:
+                t_pre = self.clock.now()
                 self._preempt(pod, err)
+                self.metrics.observe_phase("preemption",
+                                           self.clock.now() - t_pre)
             self._record_failure(pod, cycle, REASON_UNSCHEDULABLE, str(err))
             return
         except Exception as err:
@@ -345,7 +399,9 @@ class Scheduler:
             self._bind_threads.append(t)
         else:
             self._bind(assumed, result.suggested_host, pod, cycle, ctx)
-        self.metrics.e2e_latency_sum += self.clock.now() - start
+        e2e = self.clock.now() - start
+        self.metrics.e2e_latency_sum += e2e
+        self.metrics.e2e_duration.observe(e2e)
 
     def wait_for_binds(self, timeout: float = 5.0) -> None:
         """Join outstanding async bind threads (test/shutdown helper)."""
@@ -375,6 +431,7 @@ class Scheduler:
         wait) + Prebind + store write + FinishBinding; on failure
         ForgetPod + Unreserve + re-queue."""
         ctx = ctx or PluginContext()
+        t_bind = self.clock.now()
 
         def fail(unschedulable: bool, message: str = "") -> None:
             self.cache.forget_pod(assumed)
@@ -413,6 +470,8 @@ class Scheduler:
                 self.store.bind_pod(assumed.key, host)
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
+            self.metrics.binding_duration.observe(self.clock.now() - t_bind)
+            self.metrics.observe_phase("binding", self.clock.now() - t_bind)
             self.metrics.observe("scheduled")
             # user-visible audit record (scheduler.go:433)
             self.recorder.pod_event(

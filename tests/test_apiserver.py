@@ -379,3 +379,263 @@ class TestDrainHonorsPDB:
         # --disable-eviction clears the survivor unconditionally
         rc, out, _err = self._drain(url, "n0", "--disable-eviction")
         assert rc == 0 and not store.list(PODS)[0]
+
+
+class TestAdmissionOnPut:
+    """The chain runs on UPDATES (VERDICT r03 weak #6): the create-then-PUT
+    escape hatch around LimitRanger/quota is closed."""
+
+    def _put(self, url, kind, obj, user=None):
+        data = json.dumps(serde.to_dict(obj)).encode()
+        headers = {"Content-Type": "application/json"}
+        if user:
+            headers["X-Remote-User"] = user
+        r = urllib.request.Request(f"{url}/api/v1/{kind}/{obj.key}",
+                                   data=data, method="PUT", headers=headers)
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    def test_oversized_put_rejected_by_quota(self, server):
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        store, url = server
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="q", hard={"cpu": 500}))
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p", containers=(Container.make(
+                name="c", requests={"cpu": 400, "memory": GI}),))))
+        assert code == 201
+        big = serde.from_dict("pods", body)
+        big.containers = (Container.make(
+            name="c", requests={"cpu": 2000, "memory": GI}),)
+        code, body = self._put(url, "pods", big)
+        assert code == 422 and "exceeded quota" in body["message"]
+        # the rejected delta must not leak into usage
+        assert store.get(RESOURCEQUOTAS, "default/q").used["cpu"] == 400
+        # a conforming PUT (shrink) lands and replenishes
+        small = store.get(PODS, "default/p")
+        small.containers = (Container.make(
+            name="c", requests={"cpu": 100, "memory": GI}),)
+        code, _ = self._put(url, "pods", small)
+        assert code == 200
+        assert store.get(RESOURCEQUOTAS, "default/q").used["cpu"] == 100
+
+    def test_put_reapplies_limitranger_defaults(self, server):
+        store, url = server
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="d", containers=(Container.make(name="c"),))))
+        assert code == 201
+        stripped = serde.from_dict("pods", body)
+        stripped.containers = (Container(name="c", requests=()),)
+        code, body = self._put(url, "pods", stripped)
+        assert code == 200
+        reqs = dict(store.get(PODS, "default/d").containers[0].requests)
+        assert reqs.get("cpu") == 100 and "memory" in reqs
+
+
+class TestNodeRestriction:
+    def test_kubelet_identity_limited_to_own_node(self, server):
+        store, url = server
+        for nm in ("n0", "n1"):
+            store.create(NODES, Node(
+                name=nm, allocatable={"cpu": 1000, "memory": GI, "pods": 10}))
+        helper = TestAdmissionOnPut()
+        own = store.get(NODES, "n0")
+        own.unschedulable = True
+        code, _ = helper._put(url, "nodes", own, user="system:node:n0")
+        assert code == 200
+        other = store.get(NODES, "n1")
+        other.unschedulable = True
+        code, body = helper._put(url, "nodes", other, user="system:node:n0")
+        assert code == 422 and "not allowed" in body["message"]
+        # a node identity may not create pods bound to ANOTHER node
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="mirror", node_name="n1",
+            containers=(Container.make(name="c"),))))
+        assert code == 201   # no identity: unrestricted
+        data = json.dumps(serde.to_dict(Pod(
+            name="mirror2", node_name="n1",
+            containers=(Container.make(name="c"),)))).encode()
+        r = urllib.request.Request(
+            f"{url}/api/v1/pods", data=data, method="POST",
+            headers={"Content-Type": "application/json",
+                     "X-Remote-User": "system:node:n0"})
+        try:
+            urllib.request.urlopen(r)
+            assert False, "cross-node mirror pod must be rejected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+
+
+class TestPodTolerationRestriction:
+    def test_namespace_whitelist_and_defaults(self, server):
+        from kubernetes_tpu.api.types import Namespace, Toleration
+        from kubernetes_tpu.store.store import NAMESPACES
+        store, url = server
+        store.create(NAMESPACES, Namespace(
+            name="locked",
+            annotations={
+                "scheduler.alpha.kubernetes.io/defaultTolerations":
+                    '[{"key": "team", "operator": "Equal", "value": "a", '
+                    '"effect": "NoSchedule"}]',
+                "scheduler.alpha.kubernetes.io/tolerationsWhitelist":
+                    '[{"key": "team", "operator": "Equal", "value": "a", '
+                    '"effect": "NoSchedule"}]',
+            }))
+        ok = Pod(name="good", namespace="locked",
+                 containers=(Container.make(name="c"),))
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(ok))
+        assert code == 201
+        stored = store.get(PODS, "locked/good")
+        assert any(t.key == "team" and t.value == "a"
+                   for t in stored.tolerations), "defaults merged"
+        bad = Pod(name="bad", namespace="locked",
+                  tolerations=(Toleration(key="other", value="x",
+                                          effect="NoSchedule"),),
+                  containers=(Container.make(name="c"),))
+        data = json.dumps(serde.to_dict(bad)).encode()
+        r = urllib.request.Request(f"{url}/api/v1/pods", data=data,
+                                   method="POST",
+                                   headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(r)
+            assert False, "non-whitelisted toleration must be rejected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+
+
+class TestAntiAffinityAdmission:
+    def test_non_hostname_required_anti_affinity_rejected(self, server):
+        store, url = server
+        bad = Pod(name="wide", affinity=Affinity(
+            pod_anti_affinity=PodAntiAffinity(required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels=(("app", "x"),)),
+                    topology_key="failure-domain.beta.kubernetes.io/zone"),
+            ))), containers=(Container.make(name="c"),))
+        data = json.dumps(serde.to_dict(bad)).encode()
+        r = urllib.request.Request(f"{url}/api/v1/pods", data=data,
+                                   method="POST",
+                                   headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(r)
+            assert False, "zone-wide required anti-affinity must be rejected"
+        except urllib.error.HTTPError as e:
+            assert e.code == 422
+        ok = Pod(name="narrow", affinity=Affinity(
+            pod_anti_affinity=PodAntiAffinity(required=(
+                PodAffinityTerm(
+                    label_selector=LabelSelector(
+                        match_labels=(("app", "x"),)),
+                    topology_key=LABEL_HOSTNAME),
+            ))), containers=(Container.make(name="c"),))
+        code, _ = req(f"{url}/api/v1/pods", "POST", serde.to_dict(ok))
+        assert code == 201
+
+
+class TestEventRateLimit:
+    def test_event_burst_throttled(self):
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionChain, AdmissionError, EventRateLimit)
+        from kubernetes_tpu.api.types import EventRecord
+        from kubernetes_tpu.store.store import Store, EVENTS
+        store = Store()
+        fake_now = [0.0]
+        chain = AdmissionChain(plugins=[
+            EventRateLimit(qps=10, burst=3, clock=lambda: fake_now[0])])
+        def mk(i):
+            return EventRecord(name=f"e{i}", involved_kind="Pod",
+                               involved_key=f"default/p{i}", type="Normal",
+                               reason="Scheduled")
+        for i in range(3):
+            chain.admit(EVENTS, mk(i), store)
+        with pytest.raises(AdmissionError):
+            chain.admit(EVENTS, mk(3), store)
+        fake_now[0] += 0.2    # 2 tokens replenish
+        chain.admit(EVENTS, mk(4), store)
+
+
+class TestAdmissionPutBypassesClosed:
+    """The PUT-path bypass vectors from review: old-binding hijack,
+    whitelist/anti-affinity injection, over-cap shrink blocking."""
+
+    def test_kubelet_cannot_steal_other_nodes_pod(self, server):
+        store, url = server
+        store.create(PODS, Pod(name="victim", node_name="n1",
+                               containers=(Container.make(name="c"),)))
+        helper = TestAdmissionOnPut()
+        stolen = store.get(PODS, "default/victim")
+        stolen.node_name = "n0"     # rewrite the binding in the body
+        code, body = helper._put(url, "pods", stolen, user="system:node:n0")
+        assert code == 422 and "not allowed" in body["message"]
+        unbound = store.get(PODS, "default/victim")
+        unbound.node_name = ""      # unbinding is a modification too
+        code, _ = helper._put(url, "pods", unbound, user="system:node:n0")
+        assert code == 422
+
+    def test_put_cannot_inject_forbidden_toleration(self, server):
+        from kubernetes_tpu.api.types import Namespace, Toleration
+        from kubernetes_tpu.store.store import NAMESPACES
+        store, url = server
+        store.create(NAMESPACES, Namespace(
+            name="locked",
+            annotations={
+                "scheduler.alpha.kubernetes.io/tolerationsWhitelist": "[]"}))
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p", namespace="locked",
+            containers=(Container.make(name="c"),))))
+        assert code == 201
+        helper = TestAdmissionOnPut()
+        hacked = store.get(PODS, "locked/p")
+        hacked.tolerations = hacked.tolerations + (
+            Toleration(key="smuggled", value="x", effect="NoSchedule"),)
+        code, body = helper._put(url, "pods", hacked)
+        assert code == 422 and "whitelist" in body["message"]
+        # re-PUT with only the create-time (cluster-default) tolerations: ok
+        same = store.get(PODS, "locked/p")
+        same.labels["touch"] = "1"
+        code, _ = helper._put(url, "pods", same)
+        assert code == 200
+
+    def test_put_cannot_inject_zone_anti_affinity(self, server):
+        store, url = server
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p", containers=(Container.make(name="c"),))))
+        assert code == 201
+        helper = TestAdmissionOnPut()
+        hacked = store.get(PODS, "default/p")
+        hacked.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=(PodAffinityTerm(
+                label_selector=LabelSelector(match_labels=(("a", "b"),)),
+                topology_key="failure-domain.beta.kubernetes.io/zone"),)))
+        code, _ = helper._put(url, "pods", hacked)
+        assert code == 422
+
+    def test_shrinking_put_allowed_when_over_cap(self, server):
+        """An admin lowering hard caps below current usage must not block
+        the shrinking updates that recover the namespace."""
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.store.store import RESOURCEQUOTAS
+        store, url = server
+        store.create(RESOURCEQUOTAS, ResourceQuota(
+            name="q", hard={"cpu": 1000}))
+        code, body = req(f"{url}/api/v1/pods", "POST", serde.to_dict(Pod(
+            name="p", containers=(Container.make(
+                name="c", requests={"cpu": 600, "memory": GI}),))))
+        assert code == 201
+        # cap lowered below usage
+        def lower(cur):
+            cur.hard = {"cpu": 500}
+            return cur
+        store.guaranteed_update(RESOURCEQUOTAS, "default/q", lower)
+        helper = TestAdmissionOnPut()
+        shrink = store.get(PODS, "default/p")
+        shrink.containers = (Container.make(
+            name="c", requests={"cpu": 300, "memory": GI}),)
+        code, _ = helper._put(url, "pods", shrink)
+        assert code == 200
+        assert store.get(RESOURCEQUOTAS, "default/q").used["cpu"] == 300
