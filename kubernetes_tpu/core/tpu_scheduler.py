@@ -922,14 +922,21 @@ class TPUScheduler:
         preemption isn't expressible as resources + static masks (the
         caller falls back to the oracle).
 
-        Eligible when: no active nominations, the incoming pod is
-        resource-only (no affinity/ports/volumes/extended resources), and
-        no pod in the cluster carries (anti-)affinity terms (so victim
-        removal cannot change any mask, only free resources)."""
+        Eligible when: no active nominations, the incoming pod carries no
+        volumes or extended-resource requests, and every POTENTIAL VICTIM
+        (lower-priority pod on a candidate node) is mask-inert: it has no
+        (anti-)affinity terms, declares no host ports when the incoming pod
+        wants one, and matches none of the incoming pod's required
+        (anti-)affinity term selectors. Affinity-bearing BYSTANDERS
+        (priority >= the preemptor, or off the candidate set) are fine —
+        they are never removed, so the pod's masks (selector/taints/ports/
+        inter-pod-affinity) are invariant under victim removal and fold
+        into the static feasibility vector."""
         from kubernetes_tpu.oracle.preemption import (
             pod_eligible_to_preempt_others, nodes_where_preemption_might_help,
             pods_violating_pdbs, importance_key, PreemptionResult,
             no_possible_victims)
+        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports, get_resource_request)
         from kubernetes_tpu.cache.node_info import calculate_resource
@@ -937,14 +944,18 @@ class TPUScheduler:
             return None
         if self.nominated is not None and self.nominated.has_any():
             return None
-        if has_pod_affinity_terms(pod) or get_container_ports(pod) \
-                or pod.volumes:
+        if pod.volumes:
             return None
         req = get_resource_request(pod)
         if req.scalar:
             return None
-        if any(ni.pods_with_affinity for ni in node_infos.values()):
-            return None
+        pod_ports = bool(get_container_ports(pod))
+        a = pod.affinity
+        pod_terms = []
+        if a is not None:
+            for grp in (a.pod_affinity, a.pod_anti_affinity):
+                if grp is not None and grp.required:
+                    pod_terms.extend(grp.required)
         if not pod_eligible_to_preempt_others(pod, node_infos):
             return PreemptionResult(None, [], [])
         candidates = nodes_where_preemption_might_help(
@@ -980,6 +991,16 @@ class TPUScheduler:
                                      importance_key(p)))
             i = b.index[name]
             for j, p in enumerate(pots):
+                # victim removal must not be able to change any of the
+                # incoming pod's masks — otherwise the per-candidate fit is
+                # not "resources + static feasibility" and the oracle runs
+                if has_pod_affinity_terms(p):
+                    return None
+                if pod_ports and get_container_ports(p):
+                    return None
+                if pod_terms and any(pod_matches_term_props(p, pod, t)
+                                     for t in pod_terms):
+                    return None
                 r = calculate_resource(p)
                 if r.scalar:
                     return None
@@ -1007,9 +1028,15 @@ class TPUScheduler:
             i = b.index[name]
             feas[i] = True
             order_rank[i] = order
-        for mask in (f.sel_ok, f.taints_ok, f.unsched_ok, f.host_ok):
+        for mask in (f.sel_ok, f.taints_ok, f.unsched_ok, f.host_ok,
+                     f.ports_ok):
             if mask is not None:
                 feas &= np.asarray(mask, bool)
+        if f.interpod_code is not None:
+            # static under victim removal: no victim carries terms or
+            # matches the pod's (gated above), so the full-cluster IPA
+            # verdict holds for every mutated candidate
+            feas &= np.asarray(f.interpod_code) == 0
         vic = {"cpu": vcpu, "mem": vmem, "eph": veph, "prio": vprio,
                "start": vstart, "valid": vvalid, "violating": vviol}
         pod_in = {"req_cpu": np.int64(req.milli_cpu),

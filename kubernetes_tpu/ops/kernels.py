@@ -295,7 +295,8 @@ def _feasibility(nodes, pod):
 
 
 def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                weights, z_pad, perm=None, inv_perm=None, pos=None):
+                weights, z_pad, perm=None, inv_perm=None, pos=None,
+                ghost=None):
     """One fused cycle. The reference's sequential walk from last_index
     (generic_scheduler.go:486,519) is emulated WITHOUT materializing the
     rotation permutation: for natural index j, its 1-based rank in rotation
@@ -330,7 +331,19 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     ntf = jnp.asarray(num_to_find, i32)
     in_range = i < nr
 
-    feasible, fail_first, general_bits = _feasibility(nodes, pod)
+    # Nominated-ghost two-pass (podFitsOnNode :598,627) for resource-only
+    # ghosts: pass 1 filters against ghost-augmented usage; pass 2 (without
+    # ghosts) is implied, since removing pods only frees resources. Scores
+    # run on the RAW rows — PrioritizeNodes never adds nominated pods.
+    if ghost is not None:
+        fnodes = {**nodes,
+                  "req_cpu": nodes["req_cpu"] + ghost["cpu"],
+                  "req_mem": nodes["req_mem"] + ghost["mem"],
+                  "req_eph": nodes["req_eph"] + ghost["eph"],
+                  "pod_count": nodes["pod_count"] + ghost["cnt"]}
+    else:
+        fnodes = nodes
+    feasible, fail_first, general_bits = _feasibility(fnodes, pod)
     feas = feasible & in_range
 
     if pos is not None:
@@ -416,10 +429,24 @@ def _schedule_cycle_jit(nodes, pod, last_index, last_node_index, num_to_find,
                        n_real, weights, z_pad)
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _schedule_cycle_ghost_jit(nodes, ghost, pod, last_index, last_node_index,
+                              num_to_find, n_real, z_pad, weights_tuple):
+    weights = dict(weights_tuple)
+    return _cycle_core(nodes, pod, last_index, last_node_index, num_to_find,
+                       n_real, weights, z_pad, ghost=ghost)
+
+
 def schedule_cycle(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                   z_pad, weights=None):
-    """One scheduling cycle. `nodes`/`pod` are dicts of device arrays."""
+                   z_pad, weights=None, ghost=None):
+    """One scheduling cycle. `nodes`/`pod` are dicts of device arrays.
+    `ghost` ({cpu,mem,eph,cnt} [N] i64, or None) carries nominated-pod
+    usage for the two-pass filter — see _cycle_core."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    if ghost is not None:
+        return _schedule_cycle_ghost_jit(
+            nodes, ghost, pod, _i64(last_index), _i64(last_node_index),
+            _i64(num_to_find), _i64(n_real), z_pad, weights_tuple)
     return _schedule_cycle_jit(
         nodes, pod, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
         _i64(n_real), z_pad, weights_tuple)
@@ -925,9 +952,9 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
 PREEMPT_P = 128    # victim slots per node (>= AllowedPodNumber cap of 110)
 
 
-@partial(jax.jit, static_argnames=("check_res", "has_req"))
+@partial(jax.jit, static_argnames=("check_res", "has_req", "has_ghost"))
 def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
-                         check_res, has_req):
+                         check_res, has_req, has_ghost=False, ghost=None):
     i32, i64, f64 = jnp.int32, jnp.int64, jnp.float64
     n_pad = nodes["alloc_cpu"].shape[0]
     in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
@@ -940,6 +967,15 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
     base_eph = nodes["req_eph"] - jnp.sum(
         jnp.where(valid_v, vic["eph"], 0), axis=1)
     base_cnt = nodes["pod_count"] - nvic_all
+    if has_ghost:
+        # nominated ghosts (priority >= preemptor) occupy capacity that is
+        # NOT removable — selectVictimsOnNode's fit runs the two-pass with
+        # them added (preemption.py:277), and for resource-only ghosts the
+        # without-pass is implied
+        base_cpu = base_cpu + ghost["cpu"]
+        base_mem = base_mem + ghost["mem"]
+        base_eph = base_eph + ghost["eph"]
+        base_cnt = base_cnt + ghost["cnt"]
 
     def fits(rc, rm, re, pc):
         f = jnp.ones(n_pad, dtype=bool)
@@ -1014,12 +1050,13 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
 
 
 def preemption_scan(nodes, vic, pod, feas_static, order_rank, n_real,
-                    check_resources, has_request):
+                    check_resources, has_request, ghost=None):
     """One launch over all candidate nodes. `vic` arrays are [N, P] with
     victims pre-sorted into processing order per node. Returns packed i32
     [3 + P]: winner node index (-1 = no candidate), its victim count and
     PDB-violation count, then the winner's per-slot victim flags (aligned
-    to the sorted order the host supplied)."""
+    to the sorted order the host supplied). `ghost` ({cpu,mem,eph,cnt} [N]
+    or None) adds non-removable nominated-pod usage to every base load."""
     return _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank,
                                 _i64(n_real), bool(check_resources),
-                                bool(has_request))
+                                bool(has_request), ghost is not None, ghost)
