@@ -199,22 +199,50 @@ def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
                pods: int = 1000) -> dict:
     """Median pods/s per workload lane + the preemption scan lane — one dict
     the driver captures, so a regression in any burst kernel lane shows up
-    in BENCH_r{N}.json instead of only in self-reported README numbers."""
-    from kubernetes_tpu.perf.harness import PerfConfig, run
+    in BENCH_r{N}.json instead of only in self-reported README numbers.
+
+    Each lane is isolated against TRANSIENT tunnel failures only: a lane
+    whose transport stays down after bounded retries records its error
+    string and the remaining lanes still run (round 4 lost its whole bench
+    to one dropped response). A non-transient error — a real kernel or
+    parity bug — still propagates and fails the bench."""
+    from kubernetes_tpu.perf.harness import (PerfConfig, is_transient_error,
+                                             retry_transient, run)
     out = {}
     for lane in MATRIX_LANES:
+        key = lane.replace("-", "_")
         vals = []
-        for _ in range(max(repeat, 1)):
-            res = run(PerfConfig(nodes=nodes, existing_pods=existing,
-                                 pods=pods, workload=lane))
-            vals.append(res.throughput)
-        vals.sort()
-        # lower-middle for even counts: with the tunnel's +-15% variance,
-        # the upper-middle would systematically report the optimistic run
-        out[lane.replace("-", "_")] = round(vals[(len(vals) - 1) // 2], 1)
-    p = run_preempt_bench(1000, 10000)
-    out["preempt_scans_per_s"] = p["value"]
-    out["preempt_vs_oracle"] = p["vs_baseline"]
+        try:
+            for _ in range(max(repeat, 1)):
+                # retry the single measurement, not the whole lane: a drop
+                # on the last repeat must not redo earlier full runs
+                res = retry_transient(lambda lane=lane: run(
+                    PerfConfig(nodes=nodes, existing_pods=existing,
+                               pods=pods, workload=lane)))
+                vals.append(res.throughput)
+        except Exception as e:
+            if not is_transient_error(e):
+                raise               # real bug: fail the bench loudly
+            out.setdefault("errors", {})[key] = str(e)[:200]
+        if vals:
+            # keep whatever repeats DID land even if a later one was lost;
+            # lower-middle for even counts: with the tunnel's +-15%
+            # variance, the upper-middle would systematically report the
+            # optimistic run
+            vals.sort()
+            out[key] = round(vals[(len(vals) - 1) // 2], 1)
+        else:
+            out[key] = None
+    try:
+        p = retry_transient(lambda: run_preempt_bench(1000, 10000))
+        out["preempt_scans_per_s"] = p["value"]
+        out["preempt_vs_oracle"] = p["vs_baseline"]
+    except Exception as e:
+        if not is_transient_error(e):
+            raise
+        out["preempt_scans_per_s"] = None      # keep the schema stable
+        out["preempt_vs_oracle"] = None
+        out.setdefault("errors", {})["preempt"] = str(e)[:200]
     out["cell"] = f"{nodes}n_{existing}existing_{pods}p"
     return out
 
@@ -241,41 +269,66 @@ def main():
                     help="skip the workload-lane matrix")
     ap.add_argument("--matrix-repeat", type=int, default=2)
     args = ap.parse_args()
+    from kubernetes_tpu.perf.harness import (is_transient_error,
+                                             retry_transient)
     if args.mode == "preempt":
-        result = run_preempt_bench(args.nodes, args.pods)
+        result = retry_transient(
+            lambda: run_preempt_bench(args.nodes, args.pods))
         print(json.dumps(result))
         return
     mesh = _make_mesh() if args.mesh else None
-    runs = [run_bench(args.nodes, args.pods, args.mode, args.burst,
-                      compare=False, mesh=mesh)
+    # each timed repeat individually survives a dropped tunnel response
+    # (bounded retry on transient JaxRuntimeErrors only; real failures
+    # still propagate — see perf.harness.retry_transient)
+    runs = [retry_transient(
+                lambda: run_bench(args.nodes, args.pods, args.mode,
+                                  args.burst, compare=False, mesh=mesh))
             for _ in range(max(args.repeat, 1))]
     runs.sort(key=lambda r: r["value"])
-    result = runs[len(runs) // 2]
+    # lower-middle for even counts, matching the matrix/mesh medians: the
+    # upper-middle would systematically report the optimistic run
+    result = runs[(len(runs) - 1) // 2]
     result["runs"] = [r["value"] for r in runs]
     result["baseline_note"] = BASELINE_NOTE
     if args.mode != "oracle":
         sample = min(args.pods, 100)
-        oracle = measure_oracle(args.nodes, sample)
+        try:
+            oracle = retry_transient(
+                lambda: measure_oracle(args.nodes, sample))
+        except Exception as e:
+            if not is_transient_error(e):
+                raise
+            oracle = None           # keep the already-collected headline
+            result["oracle_error"] = str(e)[:200]
         result["oracle_measured"] = oracle
         result["oracle_pods_sampled"] = sample
         result["vs_measured_oracle"] = (
-            round(result["value"] / oracle, 2) if oracle > 0 else None)
+            round(result["value"] / oracle, 2) if oracle else None)
     if args.mode == "burst" and not args.mesh and args.mesh_check:
         # the north-star multi-chip config on whatever devices exist: the
         # uniform kernel sharded over a mesh must NOT regress vs single-chip
         # (VERDICT r03 weak #1 — mesh mode used to silently cost 8x)
-        import jax
-        m = _make_mesh()   # one mesh for all repeats (one compile)
-        mesh_runs = [run_bench(args.nodes, args.pods, args.mode, args.burst,
-                               compare=False, mesh=m)["value"]
-                     for _ in range(max(min(args.repeat, 2), 1))]
-        mesh_runs.sort()
-        result["mesh"] = {
-            "pods_per_s": mesh_runs[(len(mesh_runs) - 1) // 2],
-            "runs": mesh_runs,
-            "devices": len(jax.devices()),
-        }
+        try:
+            import jax
+            m = _make_mesh()   # one mesh for all repeats (one compile)
+            mesh_runs = [retry_transient(
+                             lambda: run_bench(args.nodes, args.pods,
+                                               args.mode, args.burst,
+                                               compare=False, mesh=m))["value"]
+                         for _ in range(max(min(args.repeat, 2), 1))]
+            mesh_runs.sort()
+            result["mesh"] = {
+                "pods_per_s": mesh_runs[(len(mesh_runs) - 1) // 2],
+                "runs": mesh_runs,
+                "devices": len(jax.devices()),
+            }
+        except Exception as e:
+            if not is_transient_error(e):
+                raise
+            result["mesh"] = {"error": str(e)[:200]}
     if args.mode == "burst" and args.matrix:
+        # run_matrix handles transient isolation per lane internally and
+        # re-raises real bugs — no wrapper here
         result["matrix"] = run_matrix(repeat=args.matrix_repeat)
     print(json.dumps(result))
 

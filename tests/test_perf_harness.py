@@ -117,6 +117,94 @@ class TestE2EDensity:
         assert r["startup_slo_5s"], r
 
 
+class TestTransientRetry:
+    """The tunneled chip drops HTTP responses mid-run (round 4's driver
+    bench died to 'remote_compile: read body: response body closed');
+    bench.py must survive that without masking real failures."""
+
+    def test_retry_recovers_from_connection_drop(self):
+        from kubernetes_tpu.perf.harness import retry_transient
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError(
+                    "INTERNAL: http://127.0.0.1:8083/remote_compile: "
+                    "read body: response body closed before all bytes "
+                    "were read")
+            return 42
+
+        assert retry_transient(flaky, attempts=3, sleep=lambda _t: None) == 42
+        assert len(calls) == 3
+
+    def test_retry_propagates_real_errors_immediately(self):
+        from kubernetes_tpu.perf.harness import retry_transient
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("parity mismatch: device != oracle")
+
+        with pytest.raises(ValueError):
+            retry_transient(broken, attempts=3, sleep=lambda _t: None)
+        assert len(calls) == 1  # no retry on non-transient failures
+
+    def test_retry_exhaustion_reraises_last_transient(self):
+        from kubernetes_tpu.perf.harness import retry_transient
+
+        def always_down():
+            raise RuntimeError("connection reset by peer")
+
+        with pytest.raises(RuntimeError, match="connection reset"):
+            retry_transient(always_down, attempts=2, sleep=lambda _t: None)
+
+    def test_matrix_isolates_a_lane_that_stays_down(self, monkeypatch):
+        """A mid-run connection drop in one lane must not lose the other
+        lanes' numbers (per-lane isolation, VERDICT r04 weak #1)."""
+        import sys, os, time as _time
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+        from kubernetes_tpu.perf import harness
+        from kubernetes_tpu.perf.harness import PerfResult
+
+        monkeypatch.setattr(_time, "sleep", lambda _t: None)
+
+        def fake_run(cfg, warmup=64):
+            if cfg.workload == "affinity":   # this lane's tunnel stays down
+                raise RuntimeError(
+                    "INTERNAL: remote_compile: read body: response body "
+                    "closed before all bytes were read")
+            return PerfResult(scheduled=cfg.pods, elapsed=0.5,
+                              throughput=123.4, min_qps=100.0)
+
+        monkeypatch.setattr(harness, "run", fake_run)
+        monkeypatch.setattr(bench, "run_preempt_bench",
+                            lambda n, v: {"value": 9.9, "vs_baseline": 5.0})
+        m = bench.run_matrix(repeat=1)
+        assert m["plain"] == 123.4 and m["spread"] == 123.4
+        assert m["affinity"] is None
+        assert "affinity" in m["errors"]
+        assert m["preempt_scans_per_s"] == 9.9
+
+    def test_matrix_real_bug_still_fails_the_bench(self, monkeypatch):
+        """Lane isolation must NOT swallow non-transient errors — a parity
+        bug in one lane fails the whole bench (nonzero rc for the driver)."""
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+        from kubernetes_tpu.perf import harness
+
+        def buggy_run(cfg, warmup=64):
+            raise ValueError("parity mismatch: device != oracle")
+
+        monkeypatch.setattr(harness, "run", buggy_run)
+        with pytest.raises(ValueError):
+            bench.run_matrix(repeat=1)
+
+
 class TestSpreadWorkloadAndMatrix:
     def test_spread_cell_schedules_and_spreads(self):
         """The spread lane: a Service selects the measured pods, so
