@@ -969,7 +969,88 @@ class TPUScheduler:
             return PreemptionResult(None, [], [])
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
-        P = K.PREEMPT_P
+        packed = self._encode_victims(node_infos, b, candidates, pod.priority,
+                                      pdbs, pod=pod, pod_ports=pod_ports,
+                                      pod_terms=pod_terms)
+        if packed is None:
+            return None
+        vic, slots = packed
+        enc = PodEncoder(node_infos, b, self.services_fn(),
+                         self.replicasets_fn(),
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                         enabled=self.enabled_predicates,
+                         volume_listers=self.volume_listers,
+                         volume_binder=self.volume_binder)
+        f = enc.encode(pod)
+        if f.unknown_scalars:
+            return None
+        n_pad = b.n_pad
+        feas = np.zeros(n_pad, bool)
+        order_rank = np.full(n_pad, 1 << 30, np.int64)
+        for order, name in enumerate(candidates):
+            i = b.index[name]
+            feas[i] = True
+            order_rank[i] = order
+        for mask in (f.sel_ok, f.taints_ok, f.unsched_ok, f.host_ok,
+                     f.ports_ok):
+            if mask is not None:
+                feas &= np.asarray(mask, bool)
+        if f.interpod_code is not None:
+            # static under victim removal: no victim carries terms or
+            # matches the pod's (gated above), so the full-cluster IPA
+            # verdict holds for every mutated candidate
+            feas &= np.asarray(f.interpod_code) == 0
+        pod_in = {"req_cpu": np.int64(req.milli_cpu),
+                  "req_mem": np.int64(req.memory),
+                  "req_eph": np.int64(req.ephemeral_storage)}
+        out = np.asarray(K.preemption_scan(
+            nodes, vic, pod_in, feas, order_rank, b.n_real,
+            self.check_resources, f.has_request))
+        winner = int(out[0])
+        if winner < 0:
+            return PreemptionResult(None, [], [])
+        name = b.names[winner]
+        flags = out[3:].astype(bool)
+        # a zero-victim winner has no slots entry (preemption can still
+        # pick it when another pod's nomination freed nothing — rare)
+        victims = [p for j, p in enumerate(slots.get(name, ())) if flags[j]]
+        return PreemptionResult(node_infos[name].node, victims, [])
+
+    def _encode_victims(self, node_infos: dict[str, NodeInfo], b: NodeBatch,
+                        names, max_prio: int, pdbs: list,
+                        pod: Optional[Pod] = None, pod_ports: bool = False,
+                        pod_terms=()):
+        """[N, P] victim-slot arrays for every pod of priority < `max_prio`
+        on `names`, sorted per node into the reprieve processing order
+        (PDB-violating first, each group by descending importance —
+        preemption.py select_victims_on_node). P is bucketed to the
+        smallest power-of-two that fits the fullest node (one compile per
+        bucket; the old fixed 128-slot layout shipped 8x the bytes the
+        common case needs). Returns (vic dict, slots map) or None when any
+        potential victim is not mask-inert — removal of a non-inert victim
+        could change the incoming pod's masks, which the kernels treat as
+        static, so the caller must fall back to the oracle."""
+        from kubernetes_tpu.oracle.preemption import (pods_violating_pdbs,
+                                                      importance_key)
+        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
+        from kubernetes_tpu.api.types import (has_pod_affinity_terms,
+                                              get_container_ports)
+        from kubernetes_tpu.cache.node_info import calculate_resource
+        per_node: list[tuple[int, list[Pod], set]] = []
+        maxp = 1
+        for name in names:
+            ni = node_infos[name]
+            pots = [p for p in ni.pods if p.priority < max_prio]
+            if not pots:
+                continue
+            if len(pots) > K.PREEMPT_P:
+                return None
+            violating = {p.uid for p in pods_violating_pdbs(pots, pdbs)}
+            pots.sort(key=lambda p: (0 if p.uid in violating else 1,
+                                     importance_key(p)))
+            per_node.append((b.index[name], pots, violating))
+            maxp = max(maxp, len(pots))
+        P = min(_pad_pow2(maxp, 8), K.PREEMPT_P)
         n_pad = b.n_pad
         vcpu = np.zeros((n_pad, P), np.int64)
         vmem = np.zeros((n_pad, P), np.int64)
@@ -979,21 +1060,8 @@ class TPUScheduler:
         vvalid = np.zeros((n_pad, P), bool)
         vviol = np.zeros((n_pad, P), bool)
         slots: dict[str, list[Pod]] = {}
-        for name in candidates:
-            ni = node_infos[name]
-            pots = [p for p in ni.pods if p.priority < pod.priority]
-            if len(pots) > P:
-                return None
-            violating = {p.uid for p in pods_violating_pdbs(pots, pdbs)}
-            # the reprieve processing order: PDB-violating first, each group
-            # by descending importance (preemption.py select_victims_on_node)
-            pots.sort(key=lambda p: (0 if p.uid in violating else 1,
-                                     importance_key(p)))
-            i = b.index[name]
+        for i, pots, violating in per_node:
             for j, p in enumerate(pots):
-                # victim removal must not be able to change any of the
-                # incoming pod's masks — otherwise the per-candidate fit is
-                # not "resources + static feasibility" and the oracle runs
                 if has_pod_affinity_terms(p):
                     return None
                 if pod_ports and get_container_ports(p):
@@ -1012,46 +1080,143 @@ class TPUScheduler:
                     vstart[i, j] = p.start_time
                 vvalid[i, j] = True
                 vviol[i, j] = p.uid in violating
-            slots[name] = pots
+            slots[b.names[i]] = pots
+        vic = {"cpu": vcpu, "mem": vmem, "eph": veph, "prio": vprio,
+               "start": vstart, "valid": vvalid, "violating": vviol}
+        return vic, slots
+
+    # batched pressure chunks: bounds the [B, ...] upload and lets chunk
+    # k+1's launch overlap chunk k's on-device execution
+    PRESSURE_B_CAP = 128
+
+    def preempt_pressure_burst(self, pods: list[Pod],
+                               node_infos: dict[str, NodeInfo],
+                               all_node_names: list[str], pdbs: list):
+        """Schedule-else-preempt a failed burst tail in ONE launch
+        (kernels.pressure_batch) instead of one ~100ms round trip per failed
+        pod. Replays the serial loop exactly: per pod in queue order, a
+        ghost-aware schedule attempt (podFitsOnNode two-pass,
+        generic_scheduler.go:598,627), then the victim scan + 5-criteria
+        node pick (:966,1054,837), accumulating nominations as ghost load
+        for the pods behind it.
+
+        Eligible when: no pre-existing nominations, the NodeTree enumeration
+        is the device axis every cycle (even zones), pod priorities are
+        non-increasing (queue pop order — so every accumulated ghost counts
+        for every later pod), each pod is resource-only (no volumes /
+        affinity terms / host ports / scalars / stale nomination / spread
+        selector match), and every potential victim is mask-inert. Returns
+        None to refuse (shell falls back to the serial loop) or a per-pod
+        outcome list:
+          ("bound", host_name)           — scheduled, delta folded on device
+          ("nominated", node, victims)   — preemption chose `node`
+          ("failed", any_candidates)     — no fit, no preemption; the flag
+            distinguishes "no candidate nodes" (the oracle clears the pod's
+            own stale nomination, :330-333) from "candidates but no fit"."""
+        from kubernetes_tpu.api.types import (has_pod_affinity_terms,
+                                              get_container_ports,
+                                              get_resource_request)
+        if not pods or not all_node_names:
+            return None
+        if self.mesh is not None:
+            return None
+        if self.nominated is not None and self.nominated.has_any():
+            return None
+        if self._tree_rotates():
+            return None
+        prios = [p.priority for p in pods]
+        if any(a < bb for a, bb in zip(prios, prios[1:])):
+            return None
+        for p in pods:
+            if p.volumes or p.nominated_node_name:
+                return None
+            if has_pod_affinity_terms(p) or get_container_ports(p):
+                return None
+            if get_resource_request(p).scalar:
+                return None
+        b = self.encoder.encode(node_infos, all_node_names)
+        nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(),
                          self.replicasets_fn(),
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
                          volume_binder=self.volume_binder)
-        f = enc.encode(pod)
-        if f.unknown_scalars:
+        feat_by_sig: dict = {}
+        feats = []
+        for p in pods:
+            sig = self._class_signature(p)
+            f = feat_by_sig.get(sig)
+            if f is None:
+                f = feat_by_sig[sig] = enc.encode(p)
+            feats.append(f)
+        for f in feats:
+            if f.unknown_scalars:
+                return None
+            if f.spread_counts is not None:
+                # selector-spread scoring depends on in-burst placements;
+                # the pressure scan doesn't carry spread counts
+                return None
+        packed = self._encode_victims(node_infos, b, all_node_names,
+                                      prios[0], pdbs)
+        if packed is None:
             return None
-        feas = np.zeros(n_pad, bool)
-        order_rank = np.full(n_pad, 1 << 30, np.int64)
-        for order, name in enumerate(candidates):
-            i = b.index[name]
-            feas[i] = True
-            order_rank[i] = order
-        for mask in (f.sel_ok, f.taints_ok, f.unsched_ok, f.host_ok,
-                     f.ports_ok):
-            if mask is not None:
-                feas &= np.asarray(mask, bool)
-        if f.interpod_code is not None:
-            # static under victim removal: no victim carries terms or
-            # matches the pod's (gated above), so the full-cluster IPA
-            # verdict holds for every mutated candidate
-            feas &= np.asarray(f.interpod_code) == 0
-        vic = {"cpu": vcpu, "mem": vmem, "eph": veph, "prio": vprio,
-               "start": vstart, "valid": vvalid, "violating": vviol}
-        pod_in = {"req_cpu": np.int64(req.milli_cpu),
-                  "req_mem": np.int64(req.memory),
-                  "req_eph": np.int64(req.ephemeral_storage)}
-        out = np.asarray(K.preemption_scan(
-            nodes, vic, pod_in, feas, order_rank, b.n_real,
-            self.check_resources, f.has_request))
-        winner = int(out[0])
-        if winner < 0:
-            return PreemptionResult(None, [], [])
-        name = b.names[winner]
-        flags = out[3:].astype(bool)
-        victims = [p for j, p in enumerate(slots[name]) if flags[j]]
-        return PreemptionResult(node_infos[name].node, victims, [])
+        vic, slots = packed
+        per_pod = []
+        for p, f in zip(pods, feats):
+            d = self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
+            d["pprio"] = np.int64(p.priority)
+            per_pod.append(d)
+        n = b.n_real
+        num_to_find = num_feasible_nodes_to_find(
+            n, self.percentage_of_nodes_to_score)
+        z_pad = _pad_pow2(len(b.zone_names), 4)
+        mut0 = {k: nodes[k] for k in K._MUTABLE}
+        ghost0 = {k: jnp.zeros(b.n_pad, jnp.int64)
+                  for k in ("cpu", "mem", "eph", "cnt")}
+        li, lni = self.last_index, self.last_node_index
+        outs_chunks = []
+        for lo in range(0, len(per_pod), self.PRESSURE_B_CAP):
+            chunk = per_pod[lo: lo + self.PRESSURE_B_CAP]
+            bucket = _pad_pow2(len(chunk), 8)
+            if len(chunk) < bucket:
+                pad = dict(chunk[-1])
+                pad["skip"] = self._true
+                chunk = chunk + [pad] * (bucket - len(chunk))
+            stacked = self._stack_pods(chunk)
+            mut0, ghost0, li, lni, outs = K.pressure_batch(
+                nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find, n,
+                z_pad, weights=self.weights)
+            outs_chunks.append(outs)
+        # ONE fetch for every chunk's outputs + the final counters
+        h_chunks, li, lni = jax.device_get((outs_chunks, li, lni))
+        outcomes = []
+        k = 0
+        for h in h_chunks:
+            bb = len(h["selected"])
+            for j in range(bb):
+                if k >= len(pods):
+                    break
+                sel = int(h["selected"][j])
+                win = int(h["winner"][j])
+                if sel >= 0:
+                    outcomes.append(("bound", b.names[sel]))
+                elif win >= 0:
+                    name = b.names[win]
+                    flags = h["victims"][j].astype(bool)
+                    victims = [p for s, p in enumerate(slots.get(name, []))
+                               if flags[s]]
+                    outcomes.append(("nominated", name, victims))
+                else:
+                    outcomes.append(("failed", bool(h["any_cand"][j])))
+                k += 1
+        # persist: the mutable rows now live on device (successes folded);
+        # the shell syncs the host mirror per bound pod via
+        # note_burst_assumed, exactly like the burst prefix commit
+        self._dev_nodes = {**self._dev_nodes, **mut0}
+        self.last_index = int(li)
+        self.last_node_index = int(lni)
+        return outcomes
 
     def discard_burst_folds(self) -> None:
         """Forget the device-resident node matrix: in-scan folds for burst

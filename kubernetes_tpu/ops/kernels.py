@@ -429,24 +429,12 @@ def _schedule_cycle_jit(nodes, pod, last_index, last_node_index, num_to_find,
                        n_real, weights, z_pad)
 
 
-@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
-def _schedule_cycle_ghost_jit(nodes, ghost, pod, last_index, last_node_index,
-                              num_to_find, n_real, z_pad, weights_tuple):
-    weights = dict(weights_tuple)
-    return _cycle_core(nodes, pod, last_index, last_node_index, num_to_find,
-                       n_real, weights, z_pad, ghost=ghost)
-
-
 def schedule_cycle(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                   z_pad, weights=None, ghost=None):
+                   z_pad, weights=None):
     """One scheduling cycle. `nodes`/`pod` are dicts of device arrays.
-    `ghost` ({cpu,mem,eph,cnt} [N] i64, or None) carries nominated-pod
-    usage for the two-pass filter — see _cycle_core."""
+    (Nominated-ghost cycles run only inside the pressure batch —
+    _pressure_batch_jit — which calls _cycle_core with its carried ghost.)"""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    if ghost is not None:
-        return _schedule_cycle_ghost_jit(
-            nodes, ghost, pod, _i64(last_index), _i64(last_node_index),
-            _i64(num_to_find), _i64(n_real), z_pad, weights_tuple)
     return _schedule_cycle_jit(
         nodes, pod, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
         _i64(n_real), z_pad, weights_tuple)
@@ -952,13 +940,21 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
 PREEMPT_P = 128    # victim slots per node (>= AllowedPodNumber cap of 110)
 
 
-@partial(jax.jit, static_argnames=("check_res", "has_req", "has_ghost"))
-def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
-                         check_res, has_req, has_ghost=False, ghost=None):
-    i32, i64, f64 = jnp.int32, jnp.int64, jnp.float64
+def _victim_select(nodes, vic, valid_v, req_cpu, req_mem, req_eph,
+                   ghost, feas_static, check_res, has_req):
+    """selectVictimsOnNode over every node at once (:1054): remove all
+    masked victims, check fit, then the order-dependent reprieve scan.
+    `valid_v` [N, P] masks which slots are potential victims FOR THIS
+    preemptor (priority < preemptor's); `ghost` ({cpu,mem,eph,cnt} [N] or
+    None) adds non-removable nominated-pod usage — selectVictimsOnNode's
+    fit runs the two-pass with them added (preemption.py:277), and for
+    resource-only ghosts the without-pass is implied. `check_res`/`has_req`
+    may be Python bools or traced booleans. Returns (feas0[N], victims[N,P],
+    aggregates dict for the node pick)."""
+    i64, f64 = jnp.int64, jnp.float64
     n_pad = nodes["alloc_cpu"].shape[0]
-    in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
-    valid_v = vic["valid"]                          # [N, P]
+    cr = jnp.asarray(check_res, bool)
+    hr = jnp.asarray(has_req, bool) & cr
     nvic_all = jnp.sum(valid_v, axis=1, dtype=i64)
     base_cpu = nodes["req_cpu"] - jnp.sum(
         jnp.where(valid_v, vic["cpu"], 0), axis=1)
@@ -967,11 +963,7 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
     base_eph = nodes["req_eph"] - jnp.sum(
         jnp.where(valid_v, vic["eph"], 0), axis=1)
     base_cnt = nodes["pod_count"] - nvic_all
-    if has_ghost:
-        # nominated ghosts (priority >= preemptor) occupy capacity that is
-        # NOT removable — selectVictimsOnNode's fit runs the two-pass with
-        # them added (preemption.py:277), and for resource-only ghosts the
-        # without-pass is implied
+    if ghost is not None:
         base_cpu = base_cpu + ghost["cpu"]
         base_mem = base_mem + ghost["mem"]
         base_eph = base_eph + ghost["eph"]
@@ -979,16 +971,13 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
 
     def fits(rc, rm, re, pc):
         f = jnp.ones(n_pad, dtype=bool)
-        if check_res:
-            f &= pc + 1 <= nodes["allowed_pods"]
-            if has_req:
-                f &= (nodes["alloc_cpu"] >= pod["req_cpu"] + rc) \
-                    & (nodes["alloc_mem"] >= pod["req_mem"] + rm) \
-                    & (nodes["alloc_eph"] >= pod["req_eph"] + re)
+        f &= ~cr | (pc + 1 <= nodes["allowed_pods"])
+        f &= ~hr | ((nodes["alloc_cpu"] >= req_cpu + rc)
+                    & (nodes["alloc_mem"] >= req_mem + rm)
+                    & (nodes["alloc_eph"] >= req_eph + re))
         return f
 
-    feas0 = feas_static & in_range & fits(base_cpu, base_mem, base_eph,
-                                          base_cnt)
+    feas0 = feas_static & fits(base_cpu, base_mem, base_eph, base_cnt)
 
     def step(carry, xs):
         rc, rm, re, pc = carry
@@ -1018,10 +1007,19 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
     earliest_high = jnp.min(
         jnp.where(victims & (vic["prio"] == high[:, None]),
                   vic["start"], INF), axis=1)
+    return feas0, victims, {"nv": nv, "viol_ct": viol_ct,
+                            "first_prio": first_prio, "sum_prio": sum_prio,
+                            "earliest_high": earliest_high}
 
-    # -- pickOneNodeForPreemption (:837) --------------------------------
+
+def _pick_one_node(feas0, agg, order_rank):
+    """pickOneNodeForPreemption (:837): zero-victim instant win, then the
+    staged 5-criteria reduction, ties broken by first-in-candidate-order
+    (`order_rank` — any strictly order-isomorphic ranking works)."""
+    i32, i64, f64 = jnp.int32, jnp.int64, jnp.float64
+    INF = jnp.asarray(jnp.inf, f64)
     any_cand = jnp.any(feas0)
-    zerov = feas0 & (nv == 0)
+    zerov = feas0 & (agg["nv"] == 0)
     rank = jnp.asarray(order_rank, i64)
     BIGR = jnp.asarray(1 << 60, i64)
 
@@ -1029,34 +1027,167 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
         return jnp.argmin(jnp.where(mask, rank, BIGR)).astype(i32)
 
     m = feas0
-    for crit in (viol_ct.astype(f64),
-                 first_prio.astype(f64),
-                 sum_prio.astype(f64),
-                 nv.astype(f64),
-                 -earliest_high):
+    for crit in (agg["viol_ct"].astype(f64),
+                 agg["first_prio"].astype(f64),
+                 agg["sum_prio"].astype(f64),
+                 agg["nv"].astype(f64),
+                 -agg["earliest_high"]):
         # +-inf criteria are fine: IEEE inf == inf keeps the equality
         # matching exact (None start times read as +inf, :176-180)
         best = jnp.min(jnp.where(m, crit, INF))
         m &= jnp.where(m, crit, INF) == best
     winner = jnp.where(jnp.any(zerov), argmin_rank(zerov), argmin_rank(m))
-    winner = jnp.where(any_cand, winner, -1)
+    return jnp.where(any_cand, winner, -1)
 
+
+@partial(jax.jit, static_argnames=("check_res", "has_req"))
+def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
+                         check_res, has_req):
+    i32 = jnp.int32
+    n_pad = nodes["alloc_cpu"].shape[0]
+    in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
+    feas0, victims, agg = _victim_select(
+        nodes, vic, vic["valid"], pod["req_cpu"], pod["req_mem"],
+        pod["req_eph"], None, feas_static & in_range, check_res, has_req)
+    winner = _pick_one_node(feas0, agg, order_rank)
     w = jnp.maximum(winner, 0)
     out = jnp.concatenate([
         jnp.stack([winner.astype(i32),
-                   nv[w].astype(i32), viol_ct[w].astype(i32)]),
+                   agg["nv"][w].astype(i32), agg["viol_ct"][w].astype(i32)]),
         victims[w].astype(i32)])
     return out
 
 
 def preemption_scan(nodes, vic, pod, feas_static, order_rank, n_real,
-                    check_resources, has_request, ghost=None):
+                    check_resources, has_request):
     """One launch over all candidate nodes. `vic` arrays are [N, P] with
     victims pre-sorted into processing order per node. Returns packed i32
     [3 + P]: winner node index (-1 = no candidate), its victim count and
     PDB-violation count, then the winner's per-slot victim flags (aligned
-    to the sorted order the host supplied). `ghost` ({cpu,mem,eph,cnt} [N]
-    or None) adds non-removable nominated-pod usage to every base load."""
+    to the sorted order the host supplied)."""
     return _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank,
                                 _i64(n_real), bool(check_resources),
-                                bool(has_request), ghost is not None, ghost)
+                                bool(has_request))
+
+
+# ---------------------------------------------------------------------------
+# Batched preemption pressure: schedule-else-preempt scan over a failed tail
+# ---------------------------------------------------------------------------
+# The serial failure path pays one dispatch+readback round trip (~100ms over
+# a tunneled chip) PER failed pod: schedule -> FitError -> victim scan ->
+# nominate. This kernel runs the whole failed tail in ONE launch, replaying
+# the reference's serial semantics exactly (scheduleOne -> preempt per pod,
+# scheduler.go:438,292):
+#
+#   per pod, in queue order (priorities non-increasing — host-gated):
+#   1. one _cycle_core schedule attempt with accumulated nominated-ghost
+#      usage (podFitsOnNode two-pass, :598,627 — for resource-only ghosts
+#      pass 2 is implied); a success folds its delta into the node state
+#      like the burst kernel and consumes rotation/tie counters.
+#   2. on failure, the victim scan (selectVictimsOnNode :1054 semantics,
+#      _victim_select) over every node with this preemptor's victim mask
+#      (slot priority < preemptor priority) and the ghost-augmented base
+#      load; the 5-criteria pick chooses the node (:837); the winner's
+#      usage folds into the ghost vector so later pods see the nomination.
+#
+#   `any_cand` replays nodesWherePreemptionMightHelp (:1142) from the
+#   cycle's fail-first codes: a node is a candidate unless its FIRST
+#   failing predicate's reasons contain an unresolvable member (:65-84) —
+#   the caller needs this to distinguish "no candidates" (clear the pod's
+#   own stale nomination, :330-333) from "candidates but no fit".
+
+
+def _resolvable_candidates(fail_first, general_bits):
+    """nodesWherePreemptionMightHelp from device fail codes: recorded
+    failure reasons are the FIRST failing predicate's (pod_fits_on_node
+    breaks on first failure); GENERAL carries host/selector bits whose
+    reasons are unresolvable (generic_scheduler.go:65-84)."""
+    unresolv = ((fail_first == FAIL_UNSCHEDULABLE)
+                | (fail_first == FAIL_TAINTS)
+                | (fail_first == FAIL_VOLZONE)
+                | (fail_first == FAIL_VOLBIND)
+                | ((fail_first == FAIL_GENERAL)
+                   & (((general_bits >> BIT_HOST) & 1)
+                      | ((general_bits >> BIT_SELECTOR) & 1)).astype(bool)))
+    return ~unresolv
+
+
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
+                        last_node_index, num_to_find, n_real, z_pad,
+                        weights_tuple):
+    weights = dict(weights_tuple)
+    i32 = jnp.int32
+    static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
+    n_pad = nodes["alloc_cpu"].shape[0]
+    in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
+    axis_rank = jnp.arange(n_pad, dtype=jnp.int64)
+
+    def step(carry, pod):
+        mut, ghost, li, lni = carry
+        full = {**static, **mut}
+        out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights,
+                          z_pad, ghost=ghost)
+        sel = out["selected"]
+        hit = out["found"] > 0
+        skip = jnp.any(pod["skip"])
+        mut2 = _fold_state(mut, pod, sel, hit)
+        # victim scan with this preemptor's mask and the ghost base. The
+        # static feasibility is the pod's own mask families (victim removal
+        # cannot change them — eligibility host-gated): a winner must pass
+        # every non-resource predicate outright.
+        feas_stat = in_range & static["valid"]
+        for key in ("sel_ok", "taints_ok", "unsched_ok", "host_ok",
+                    "ports_ok", "disk_ok", "maxvol_ok", "volbind_ok",
+                    "volzone_ok"):
+            feas_stat = feas_stat & pod[key]
+        feas_stat = feas_stat & (pod["interpod_code"] == 0)
+        valid_k = vic["valid"] & (vic["prio"] < pod["pprio"])
+        feas0, victims, agg = _victim_select(
+            {**static, **mut}, vic, valid_k, pod["req_cpu"], pod["req_mem"],
+            pod["req_eph"], ghost, feas_stat, pod["check_resources"],
+            pod["has_request"])
+        winner_raw = _pick_one_node(feas0, agg, axis_rank)
+        cand = in_range & _resolvable_candidates(out["fail_first"],
+                                                 out["general_bits"])
+        any_cand = jnp.any(cand) & ~hit & ~skip
+        preempted = (~hit) & (~skip) & (winner_raw >= 0)
+        winner = jnp.where(hit, -2, jnp.where(skip, -1, winner_raw))
+        w = jnp.maximum(winner_raw, 0)
+        ghost2 = {
+            "cpu": ghost["cpu"].at[w].add(
+                jnp.where(preempted, pod["upd_cpu"], 0)),
+            "mem": ghost["mem"].at[w].add(
+                jnp.where(preempted, pod["upd_mem"], 0)),
+            "eph": ghost["eph"].at[w].add(
+                jnp.where(preempted, pod["upd_eph"], 0)),
+            "cnt": ghost["cnt"].at[w].add(jnp.where(preempted, 1, 0)),
+        }
+        return ((mut2, ghost2, out["next_last_index"],
+                 out["next_last_node_index"]), {
+            "selected": jnp.where(hit, sel, -1),
+            "winner": winner,
+            "any_cand": any_cand,
+            "victims": victims[w].astype(jnp.int8),
+        })
+
+    init = (mut0, ghost0, last_index, last_node_index)
+    (mut, ghost, li, lni), outs = jax.lax.scan(step, init, pods)
+    return mut, ghost, li, lni, outs
+
+
+def pressure_batch(nodes, mut0, ghost0, pods, vic, last_index,
+                   last_node_index, num_to_find, n_real, z_pad, weights=None):
+    """Schedule-else-preempt a failed burst tail in one launch. `pods` is a
+    dict of [B, ...] stacked arrays (including `pprio` [B] preemptor
+    priorities and the upd_* fold fields); `vic` arrays are [N, P] with ALL
+    pods of priority < the batch maximum, pre-sorted per node into the
+    reprieve processing order. Returns (mut_state, ghost, li, lni, outs)
+    where outs carries per-pod: selected (>=0 bound host row, -1 failed),
+    winner (-2 bound, -1 no preemption, >=0 nominated node row), any_cand,
+    and the winner's victim slot flags [P]."""
+    weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    return _pressure_batch_jit(nodes, mut0, ghost0, pods, vic,
+                               _i64(last_index), _i64(last_node_index),
+                               _i64(num_to_find), _i64(n_real), z_pad,
+                               weights_tuple)

@@ -536,6 +536,14 @@ class Scheduler:
                 updated, self._snapshot.node_infos, names,
                 err, nominated_pods_fn=self.queue.nominated.pods_for_node,
                 predicate_set_fn=predicate_set_fn)
+        self._apply_preemption_result(pod, updated, result)
+
+    def _apply_preemption_result(self, pod: Pod, updated: Pod, result) -> None:
+        """Side effects of one preemption decision (the back half of the
+        reference's preempt, scheduler.go:310-339): in-memory nomination,
+        the NominatedNodeName API write, victim deletion + audit events,
+        stale-nomination cleanup. Shared by the serial path and the batched
+        pressure tail so the two cannot drift."""
         if result.node is not None:
             # in-memory nomination first (scheduler.go:310), then the API write
             self.queue.nominated.add(updated, result.node.name)
@@ -680,11 +688,90 @@ class Scheduler:
         # enumeration — fast-forward the rest of the committed prefix
         if kf > 0:
             self.cache.node_tree.advance_enumerations(kf - 1)
-        for k in range(kf, len(pods)):
-            # pod 0's enumeration (list_names above) is consumed by the
-            # kernel only when it decided at least one pod
-            self._process_one(pods[k], cycles[k],
-                              names=names if kf == 0 and k == 0 else None)
+        if kf < len(pods):
+            # the tail's first pod rides one fresh enumeration (or the
+            # segment's own when the kernel decided nothing) whether it runs
+            # batched or serial
+            tail_names = names if kf == 0 \
+                else self.cache.node_tree.list_names()
+            if self._try_pressure_tail(pods[kf:], cycles[kf:], tail_names):
+                return
+            for k in range(kf, len(pods)):
+                self._process_one(pods[k], cycles[k],
+                                  names=tail_names if k == kf else None)
+
+    def _try_pressure_tail(self, pods: list[Pod], cycles: list[int],
+                           names: list[str]) -> bool:
+        """Run a failed burst tail through the batched schedule-else-preempt
+        launch (algorithm.preempt_pressure_burst) instead of one serial
+        cycle + victim scan per pod. Returns False when the batch isn't
+        applicable — the caller falls back to the serial loop. Decisions and
+        store/queue side effects are identical to the serial path (the
+        batched-kernel gates + shared _apply_preemption_result guarantee
+        it; the pressure parity fuzzes are the tripwire)."""
+        fn = getattr(self.algorithm, "preempt_pressure_burst", None)
+        if fn is None or self.disable_preemption or self.extenders:
+            return False
+        if self.queue.nominated.has_any():
+            return False
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        self._last_names = names
+        t_launch = self.clock.now()
+        outcomes = fn(pods, self._snapshot.node_infos, names,
+                      self.informers.informer(PDBS).list())
+        if outcomes is None:
+            return False
+        # metric-shape parity with the serial loop: every pod gets an
+        # "algorithm" phase sample (its share of the one launch), failed
+        # pods a "preemption" sample, bound pods an e2e sample — so the
+        # per-phase histograms keep comparable shapes whichever
+        # (decision-identical) path ran
+        share = (self.clock.now() - t_launch) / max(len(pods), 1)
+        from kubernetes_tpu.oracle.preemption import PreemptionResult
+        note = getattr(self.algorithm, "note_burst_assumed", None)
+        n = len(names)
+        for pod, cycle, oc in zip(pods, cycles, outcomes):
+            t_pod = self.clock.now()
+            self.metrics.observe_phase("algorithm", share)
+            if oc[0] == "bound":
+                host = oc[1]
+                assumed = pod.clone()
+                assumed.node_name = host
+                self.cache.assume_pod(assumed)
+                if note is not None:
+                    gen = self.cache.node_generation(host)
+                    if gen is not None:
+                        note(assumed, host, gen)
+                self.queue.nominated.delete(pod)
+                self._bind(assumed, host, pod, cycle)
+                e2e = share + (self.clock.now() - t_pod)
+                self.metrics.e2e_latency_sum += e2e
+                self.metrics.e2e_duration.observe(e2e)
+                continue
+            self.metrics.observe("unschedulable")
+            self.metrics.preemption_attempts += 1
+            try:
+                updated = self.store.get(PODS, pod.key)   # factory.go:732
+            except NotFoundError:
+                updated = None
+            if updated is not None:
+                if oc[0] == "nominated":
+                    node = self._snapshot.node_infos[oc[1]].node
+                    result = PreemptionResult(node, oc[2], [])
+                else:
+                    # no candidate nodes at all: the oracle returns the pod
+                    # itself so its stale nomination is cleared (:330-333)
+                    result = PreemptionResult(
+                        None, [], [] if oc[1] else [updated])
+                self._apply_preemption_result(pod, updated, result)
+            self.metrics.observe_phase("preemption",
+                                       self.clock.now() - t_pod)
+            self._record_failure(pod, cycle, REASON_UNSCHEDULABLE,
+                                 str(FitError(pod, n, {})))
+        # the kernel modeled one enumeration per pod on the axis order
+        # (identity rotation is a batch gate); consume the remainder
+        self.cache.node_tree.advance_enumerations(len(pods) - 1)
+        return True
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""

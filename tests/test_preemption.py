@@ -478,7 +478,6 @@ class TestDevicePreemptionParity:
         import random
         from kubernetes_tpu.api.types import LABEL_HOSTNAME
         rng = random.Random(20260731)
-        kept = 0
         for trial in range(10):
             n_nodes = rng.randint(2, 6)
             nodes = [mknode(f"n{i}", cpu=rng.choice([2000, 4000]))
@@ -506,10 +505,10 @@ class TestDevicePreemptionParity:
             infos = snapshot(nodes, by_node)
             incoming = mkpod("hi", cpu=rng.choice([1500, 2000]), priority=10,
                              labels={"app": rng.choice(["web", "db", "etc"])})
-            dev = self._compare(infos, [n.name for n in nodes], incoming, [],
-                                seed_msg=f"trial={trial}")
-            kept += 1
-        assert kept == 10   # every affinity-bystander world stayed on device
+            # _compare asserts the device path kept the case (dev not None)
+            # and matched the oracle bit-for-bit
+            self._compare(infos, [n.name for n in nodes], incoming, [],
+                          seed_msg=f"trial={trial}")
 
     def test_randomized_parity(self):
         import random
@@ -537,3 +536,172 @@ class TestDevicePreemptionParity:
             incoming = mkpod("hi", cpu=rng.choice([1000, 1500]), priority=7)
             self._compare(infos, [n.name for n in nodes], incoming, pdbs,
                           seed_msg=f"trial={trial}")
+
+
+class TestPressureBatchParity:
+    """TPUScheduler.preempt_pressure_burst (one launch for a whole failed
+    tail) vs the oracle serial loop: schedule (ghost two-pass) -> preempt ->
+    nominate per pod, priorities non-increasing — outcomes must be
+    identical per pod, including bound hosts, chosen nodes, ordered victim
+    lists, and the no-candidates flag."""
+
+    def _oracle_serial(self, pods, node_infos, names, pdbs):
+        """The referee: scheduleOne-else-preempt with nominated ghosts
+        accumulated in a map, successes folded into cloned NodeInfos —
+        exactly what the shell's serial fallback does."""
+        nominated: dict = {}
+
+        def nom_fn(name):
+            return list(nominated.get(name, []))
+
+        g = GenericScheduler(percentage_of_nodes_to_score=100,
+                             nominated_pods_fn=nom_fn)
+        infos = dict(node_infos)
+        out = []
+        for pod in pods:
+            funcs = preds.default_predicate_set(infos)
+            try:
+                r = g.schedule(pod, infos, names, predicate_funcs=funcs)
+            except FitError as err:
+                res = Preemptor(pdbs_fn=lambda: pdbs).preempt(
+                    pod, infos, names, err, nominated_pods_fn=nom_fn)
+                if res.node is not None:
+                    ghost = pod.clone()
+                    ghost.node_name = res.node.name
+                    nominated.setdefault(res.node.name, []).append(ghost)
+                    out.append(("nominated", res.node.name,
+                                [v.name for v in res.victims]))
+                else:
+                    out.append(("failed", not res.nominated_to_clear))
+                continue
+            host = r.suggested_host
+            assumed = pod.clone()
+            assumed.node_name = host
+            ni = infos[host].clone()
+            ni.add_pod(assumed)
+            infos = {**infos, host: ni}
+            out.append(("bound", host))
+        return out
+
+    def _compare_batch(self, pods, infos, names, pdbs, msg=""):
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        got = tpu.preempt_pressure_burst(pods, infos, names, pdbs)
+        assert got is not None, f"batch refused an eligible world {msg}"
+        want = self._oracle_serial(pods, infos, names, pdbs)
+        norm = [(o[0], o[1], [v.name for v in o[2]]) if o[0] == "nominated"
+                else o for o in got]
+        assert norm == want, f"{msg}: batch={norm} oracle={want}"
+        return norm
+
+    def test_identical_preemptors_spread_nominations(self):
+        """Ghost accumulation: each nomination makes that node worse, so
+        equal preemptors fan out across nodes exactly like the serial
+        loop."""
+        nodes = [mknode(f"n{i}", cpu=1000) for i in range(4)]
+        infos = snapshot(nodes, {
+            f"n{i}": [mkpod(f"v{i}a", cpu=400, priority=0),
+                      mkpod(f"v{i}b", cpu=400, priority=0)]
+            for i in range(4)})
+        pods = [mkpod(f"hi{k}", cpu=400, priority=9) for k in range(6)]
+        out = self._compare_batch(pods, infos, [n.name for n in nodes], [])
+        assert [o[0] for o in out] == ["nominated"] * 6
+        assert len({o[1] for o in out[:4]}) == 4   # first four fan out
+
+    def test_mixed_bind_and_preempt(self):
+        """Heterogeneous requests: small pods still bind mid-tail while big
+        ones preempt — the batch folds successes like the burst kernel."""
+        nodes = [mknode("n0", cpu=1000), mknode("n1", cpu=1000)]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("v0", cpu=900, priority=0)],
+            "n1": [mkpod("v1", cpu=600, priority=0)],
+        })
+        pods = [mkpod("big", cpu=900, priority=5),
+                mkpod("small", cpu=100, priority=5),
+                mkpod("big2", cpu=900, priority=5)]
+        out = self._compare_batch(pods, infos, ["n0", "n1"], [])
+        kinds = [o[0] for o in out]
+        assert "bound" in kinds and "nominated" in kinds
+
+    def test_no_candidates_flag(self):
+        """Unresolvable failure everywhere (selector mismatch): the batch
+        must report any_candidates=False so the shell clears the pod's own
+        stale nomination exactly when the oracle would."""
+        nodes = [mknode("n0", cpu=1000)]
+        infos = snapshot(nodes, {"n0": [mkpod("v", cpu=1000, priority=0)]})
+        p = mkpod("pre", cpu=100, priority=9)
+        p.node_selector = {"disk": "ssd"}   # no node matches
+        out = self._compare_batch([p], infos, ["n0"], [])
+        assert out == [("failed", False)]
+
+    def test_pdb_steering_in_batch(self):
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        pdbs = [PodDisruptionBudget(name="b", selector=sel,
+                                    disruptions_allowed=0)]
+        nodes = [mknode("n0", cpu=1000), mknode("n1", cpu=1000)]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("v0", cpu=1000, priority=1, labels={"app": "db"})],
+            "n1": [mkpod("v1", cpu=1000, priority=2)],
+        })
+        pods = [mkpod("hi", cpu=1000, priority=9)]
+        out = self._compare_batch(pods, infos, ["n0", "n1"], pdbs)
+        assert out[0][1] == "n1"
+
+    def test_refusals(self):
+        """Gates: increasing priorities, stale nominations, affinity terms,
+        and pre-existing non-batch nominations all refuse (serial fallback
+        keeps exactness)."""
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        from kubernetes_tpu.api.types import (
+            Affinity, PodAntiAffinity, PodAffinityTerm, LabelSelector as LS,
+            LABEL_HOSTNAME)
+        nodes = [mknode("n0", cpu=1000)]
+        infos = snapshot(nodes, {"n0": [mkpod("v", cpu=800, priority=0)]})
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        lo, hi = mkpod("lo", cpu=400, priority=1), mkpod("hi", cpu=400,
+                                                         priority=9)
+        assert tpu.preempt_pressure_burst([lo, hi], infos, ["n0"], []) is None
+        stale = mkpod("stale", cpu=400, priority=9)
+        stale.nominated_node_name = "n0"
+        assert tpu.preempt_pressure_burst([stale], infos, ["n0"], []) is None
+        aff = mkpod("aff", cpu=400, priority=9)
+        aff.affinity = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=(PodAffinityTerm(
+                label_selector=LS(match_labels=(("a", "b"),)),
+                topology_key=LABEL_HOSTNAME),)))
+        assert tpu.preempt_pressure_burst([aff], infos, ["n0"], []) is None
+
+    def test_randomized_pressure_parity(self):
+        """Capacity-starved random worlds, mixed priorities/requests/PDBs/
+        start times, preemptors sorted by priority (queue pop order): batch
+        == serial oracle for every pod."""
+        import random
+        rng = random.Random(20260801)
+        for trial in range(10):
+            n_nodes = rng.randint(2, 6)
+            cap = rng.choice([1000, 2000])
+            nodes = [mknode(f"n{i}", cpu=cap) for i in range(n_nodes)]
+            by_node = {}
+            uid = 0
+            for n in nodes:
+                pods = []
+                for _ in range(rng.randint(1, 4)):
+                    uid += 1
+                    pods.append(mkpod(
+                        f"p{uid}", cpu=rng.choice([200, 500, 800]),
+                        priority=rng.randint(0, 5),
+                        labels={"app": rng.choice(["db", "web"])},
+                        start=rng.choice([None, float(rng.randint(1, 90))])))
+                by_node[n.name] = pods
+            infos = snapshot(nodes, by_node)
+            pdbs = [PodDisruptionBudget(
+                name="b",
+                selector=LabelSelector(match_labels=(("app", "db"),)),
+                disruptions_allowed=rng.randint(0, 1))]
+            k = rng.randint(2, 8)
+            pres = [mkpod(f"hi{j}", cpu=rng.choice([300, 600, 900]),
+                          priority=rng.choice([6, 7, 8, 9]))
+                    for j in range(k)]
+            pres.sort(key=lambda p: -p.priority)
+            self._compare_batch(pres, infos, [n.name for n in nodes], pdbs,
+                                msg=f"trial={trial}")
