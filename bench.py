@@ -247,7 +247,7 @@ MATRIX_LANES = ("plain", "anti-affinity", "affinity", "node-affinity",
 
 
 def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
-               pods: int = 1000) -> dict:
+               pods: int = 1000, big_nodes: int = 5000) -> dict:
     """Median pods/s per workload lane + the preemption scan lane — one dict
     the driver captures, so a regression in any burst kernel lane shows up
     in BENCH_r{N}.json instead of only in self-reported README numbers.
@@ -260,40 +260,53 @@ def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
     from kubernetes_tpu.perf.harness import (PerfConfig, is_transient_error,
                                              retry_transient, run)
     out = {}
-    for lane in MATRIX_LANES:
-        key = lane.replace("-", "_")
-        vals = []
+
+    def isolate(key, fn):
+        """One transient-isolation policy for every lane: on retry
+        exhaustion record the error under `key` and return None; real bugs
+        propagate. Partial results the callable accumulated are preserved
+        by the caller (it owns the list)."""
         try:
-            for _ in range(max(repeat, 1)):
-                # retry the single measurement, not the whole lane: a drop
-                # on the last repeat must not redo earlier full runs
-                res = retry_transient(lambda lane=lane: run(
-                    PerfConfig(nodes=nodes, existing_pods=existing,
-                               pods=pods, workload=lane)))
-                vals.append(res.throughput)
+            return fn()
         except Exception as e:
             if not is_transient_error(e):
                 raise               # real bug: fail the bench loudly
             out.setdefault("errors", {})[key] = str(e)[:200]
-        if vals:
-            # keep whatever repeats DID land even if a later one was lost;
-            # lower-middle for even counts: with the tunnel's +-15%
-            # variance, the upper-middle would systematically report the
-            # optimistic run
-            vals.sort()
-            out[key] = round(vals[(len(vals) - 1) // 2], 1)
-        else:
-            out[key] = None
-    try:
-        p = retry_transient(lambda: run_preempt_bench(1000, 10000))
-        out["preempt_scans_per_s"] = p["value"]
-        out["preempt_vs_oracle"] = p["vs_baseline"]
-    except Exception as e:
-        if not is_transient_error(e):
-            raise
-        out["preempt_scans_per_s"] = None      # keep the schema stable
-        out["preempt_vs_oracle"] = None
-        out.setdefault("errors", {})["preempt"] = str(e)[:200]
+            return None
+
+    def median_low(vals):
+        # lower-middle for even counts: with the tunnel's +-15% variance,
+        # the upper-middle would systematically report the optimistic run
+        if not vals:
+            return None
+        vals.sort()
+        return round(vals[(len(vals) - 1) // 2], 1)
+
+    def lane_median(key, cfg):
+        # retry the single measurement, not the whole lane (a drop on the
+        # last repeat must not redo earlier full runs), and keep whatever
+        # repeats DID land even if a later one was lost
+        vals: list = []
+
+        def runs():
+            for _ in range(max(repeat, 1)):
+                vals.append(retry_transient(lambda: run(cfg)).throughput)
+        isolate(key, runs)
+        out[key] = median_low(vals)
+
+    for lane in MATRIX_LANES:
+        lane_median(lane.replace("-", "_"),
+                    PerfConfig(nodes=nodes, existing_pods=existing,
+                               pods=pods, workload=lane))
+    # BASELINE configs[2]: InterPodAffinity at 5000 nodes
+    # (scheduler_bench_test.go:86-91's largest affinity cell)
+    lane_median("affinity_5000n",
+                PerfConfig(nodes=big_nodes, existing_pods=existing,
+                           pods=pods, workload="affinity"))
+    p = isolate("preempt",
+                lambda: retry_transient(lambda: run_preempt_bench(1000, 10000)))
+    out["preempt_scans_per_s"] = p["value"] if p else None
+    out["preempt_vs_oracle"] = p["vs_baseline"] if p else None
     out["cell"] = f"{nodes}n_{existing}existing_{pods}p"
     return out
 

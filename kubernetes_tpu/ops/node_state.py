@@ -315,6 +315,9 @@ class PodEncoder:
             ni.node is not None and ni.node.prefer_avoid_pod_uids
             for ni in node_infos.values())
         self._any_images = any(ni.image_states for ni in node_infos.values())
+        # per-(topologyKey) dictionary encoding of node label values, built
+        # lazily for the inter-pod segment-sum counting (SURVEY §2.3)
+        self._topo_cache: dict[str, tuple[np.ndarray, dict]] = {}
 
     def _nodes(self):
         b = self.batch
@@ -510,37 +513,69 @@ class PodEncoder:
                         scores[i] = 0
             f.prefer_avoid = scores
 
+    def _topo_values(self, key: str):
+        """Dictionary-encode node label values for one topology key:
+        (ids[N] int32, vocab value->id), id -1 where the label is absent.
+        Built once per encoder (= per burst/cycle snapshot)."""
+        got = self._topo_cache.get(key)
+        if got is None:
+            b = self.batch
+            ids = np.full(b.n_pad, -1, np.int32)
+            vocab: dict[str, int] = {}
+            for i, ni in self._nodes():
+                n = ni.node
+                if n is None:
+                    continue
+                v = n.labels.get(key)
+                if v is not None:
+                    ids[i] = vocab.setdefault(v, len(vocab))
+            got = self._topo_cache[key] = (ids, vocab)
+        return got
+
     def _interpod_pref_counts(self, pod: Pod):
         """Mirror of the oracle's interpod_affinity_priority counting
-        (priorities.py), emitted as dense arrays."""
+        (priorities.py; reference interpod_affinity.go:116,215), emitted as
+        dense arrays via the SURVEY §2.3 segment-sum formulation: each
+        matching (term, existing-pod) event adds its weight to a
+        (topologyKey, value) bucket — the existing pod's node fixes the
+        value — and the per-node counts are one bucket gather per distinct
+        key. The reference instead walks every node per event inside
+        processTerm (:215); the old mirror of that walk was the
+        O(events x nodes) host bottleneck of the affinity lanes."""
         b = self.batch
-        # reuse the oracle's exact counting by running it over all nodes and
-        # reading back counts: the oracle normalizes internally, so instead we
-        # inline its counting here via its helper semantics.
-        from kubernetes_tpu.oracle.predicates import (
-            pod_matches_term_props, nodes_same_topology)
+        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
         a = pod.affinity
         has_aff = a is not None and a.pod_affinity is not None
         has_anti = a is not None and a.pod_anti_affinity is not None
-        counts: dict[str, int] = {}
-        tracked: set[str] = set()
+        trk = np.zeros(b.n_pad, dtype=bool)
         for name, ni in self.node_infos.items():
             if has_aff or has_anti or ni.pods_with_affinity:
-                counts[name] = 0
-                tracked.add(name)
+                i = b.index.get(name)
+                if i is not None:
+                    trk[i] = True
+        acc: dict[str, np.ndarray] = {}
 
         def node_of(p: Pod):
             ni = self.node_infos.get(p.node_name)
             return ni.node if ni else None
 
         def process_term(term, defining, to_check, fixed_node, weight):
-            if fixed_node is None:
+            key = term.topology_key
+            if fixed_node is None or not key:
+                return   # nodes_same_topology is False for empty keys
+            if not pod_matches_term_props(to_check, defining, term):
                 return
-            if pod_matches_term_props(to_check, defining, term):
-                for name in tracked:
-                    n = self.node_infos[name].node
-                    if n is not None and nodes_same_topology(n, fixed_node, term.topology_key):
-                        counts[name] += weight
+            v = fixed_node.labels.get(key)
+            if v is None:
+                return   # the fixed node lacks the label: no node matches
+            ids, vocab = self._topo_values(key)
+            vid = vocab.get(v)
+            if vid is None:
+                return
+            buckets = acc.get(key)
+            if buckets is None:
+                buckets = acc[key] = np.zeros(len(vocab), np.int64)
+            buckets[vid] += weight
 
         def process_pod(existing: Pod):
             existing_node = node_of(existing)
@@ -569,10 +604,9 @@ class PodEncoder:
                 process_pod(existing)
 
         arr = np.zeros(b.n_pad, dtype=np.int64)
-        trk = np.zeros(b.n_pad, dtype=bool)
-        for name, c in counts.items():
-            i = b.index.get(name)
-            if i is not None:
-                arr[i] = c
-                trk[i] = True
+        for key, buckets in acc.items():
+            ids, _vocab = self._topo_cache[key]
+            mask = ids >= 0
+            arr[mask] += buckets[ids[mask]]
+        arr[~trk] = 0
         return arr, trk

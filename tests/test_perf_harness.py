@@ -221,9 +221,10 @@ class TestSpreadWorkloadAndMatrix:
         sys.path.insert(0, os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         import bench
-        m = bench.run_matrix(repeat=1, nodes=24, existing=8, pods=12)
+        m = bench.run_matrix(repeat=1, nodes=24, existing=8, pods=12,
+                             big_nodes=40)
         for lane in ("plain", "anti_affinity", "affinity", "node_affinity",
-                     "spread"):
+                     "spread", "affinity_5000n"):
             assert lane in m and m[lane] > 0, lane
         assert m["preempt_scans_per_s"] > 0
         assert "cell" in m

@@ -223,6 +223,37 @@ class TestFeatureParity:
         pods = [make_pod(rng, j, pod_affinity=True) for j in range(18)]
         run_parity_sequence(rng, nodes, pods)
 
+    def test_interpod_affinity_partial_labels(self):
+        """Nodes MISSING the topology labels exercise the segment-sum
+        rewrite's absent-label branches (ids == -1 rows, fixed nodes
+        without the key): a node lacking the label must never match any
+        topology pair (nodes_same_topology is False when either side lacks
+        the key) — bit-identical to the oracle on a mixed cluster."""
+        rng = random.Random(53)
+        nodes = make_cluster(rng, 12, zones=3)
+        for i, n in enumerate(nodes):
+            if i % 3 == 0:
+                n.labels = {k: v for k, v in n.labels.items()
+                            if k != LABEL_ZONE_FAILURE_DOMAIN}
+            if i % 4 == 0:
+                n.labels = {k: v for k, v in n.labels.items()
+                            if k != LABEL_HOSTNAME}
+        pods = [make_pod(rng, j, pod_affinity=True) for j in range(24)]
+        run_parity_sequence(rng, nodes, pods)
+
+    @pytest.mark.parametrize("seed", [101, 211, 307])
+    def test_interpod_affinity_heavy(self, seed):
+        """Affinity-heavy worlds for the segment-sum counting path
+        (node_state._interpod_pref_counts): most pods carry preferred +/-
+        required terms over hostname AND zone topologies with random
+        weights, so the per-(key,value) buckets accumulate many signed
+        events per cycle — host_priority must stay bit-identical to the
+        oracle's processTerm walk (interpod_affinity.go:116,215)."""
+        rng = random.Random(seed)
+        nodes = make_cluster(rng, rng.choice([9, 15]), zones=3)
+        pods = [make_pod(rng, j, pod_affinity=True) for j in range(30)]
+        assert run_parity_sequence(rng, nodes, pods) > 0
+
     def test_image_locality(self):
         rng = random.Random(31)
         nodes = make_cluster(rng, 10, images=True)
