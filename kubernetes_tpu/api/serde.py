@@ -39,6 +39,12 @@ KIND_TYPES = {
     store_mod.SERVICEACCOUNTS: T.ServiceAccount,
 }
 
+# coordination.k8s.io/Lease (resourcelock) — registered so leader election
+# works over the remote transport too (leaselock semantics need the same
+# CAS surface whichever store a component holds)
+from kubernetes_tpu.utils.leader_election import Lease as _Lease  # noqa: E402
+KIND_TYPES[store_mod.LEASES] = _Lease
+
 # kinds whose objects key by bare name (Node.key etc.); everything else
 # keys by namespace/name — the single owner of REST path scoping
 CLUSTER_SCOPED_KINDS = frozenset(

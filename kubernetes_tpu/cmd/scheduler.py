@@ -133,12 +133,29 @@ def main(argv: Optional[list[str]] = None) -> int:
                          "process's store (the in-process master of "
                          "test/integration/util/util.go:42) — kubectl-tpu "
                          "points at it")
+    ap.add_argument("--server",
+                    help="attach to a REMOTE apiserver URL instead of an "
+                         "embedded store: list+watch over HTTP with "
+                         "resourceVersion resume and 410 re-list "
+                         "(reflector.go:159) — the out-of-process posture "
+                         "of every reference control-plane component")
     args = ap.parse_args(argv)
 
     cfg = build_config(args)
-    store = Store(watch_log_size=1 << 20)
-    if args.cluster_spec:
-        load_cluster_spec(store, args.cluster_spec)
+    if args.server:
+        if args.api_port:
+            raise SystemExit("--server and --api-port are exclusive: a "
+                             "remote-attached scheduler has no store of its "
+                             "own to serve")
+        from kubernetes_tpu.store.remote import RemoteStore
+        store = RemoteStore(args.server)
+        if args.cluster_spec:
+            raise SystemExit("--cluster-spec requires the embedded store; "
+                             "create objects through the apiserver instead")
+    else:
+        store = Store(watch_log_size=1 << 20)
+        if args.cluster_spec:
+            load_cluster_spec(store, args.cluster_spec)
     sched = create_scheduler(store, cfg)
     sched.sync()
     server = serve_http(sched, cfg, args.port) if args.port else None

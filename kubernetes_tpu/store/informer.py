@@ -102,6 +102,17 @@ class SharedInformer:
     # -- list+watch ---------------------------------------------------------
     def sync(self) -> None:
         """Initial list + open watch at the list's resourceVersion."""
+        self._relist()
+        self._synced = True
+
+    def _relist(self) -> None:
+        """List + re-open the watch, then reconcile the local cache with
+        DeltaFIFO Replace semantics (delta_fifo.go:96): vanished keys emit
+        deletes, changed keys updates, new keys adds — so a 410-Gone resume
+        (reflector.go:159) never replays spurious adds or loses deletes
+        that happened inside the expired window."""
+        if self._watch is not None:
+            self._watch.stop()
         while True:
             objs, rv = self.store.list(self.kind)
             try:
@@ -109,11 +120,19 @@ class SharedInformer:
             except ExpiredError:
                 continue
             break
+        new = {o.key: o for o in objs}
         with self._lock:
-            self._cache = {o.key: o for o in objs}
-        for obj in objs:
-            self._dispatch(ADDED, None, obj)
-        self._synced = True
+            old_cache = self._cache
+            self._cache = new
+        for key, obj in new.items():
+            old = old_cache.get(key)
+            if old is None:
+                self._dispatch(ADDED, None, obj)
+            elif old.resource_version != obj.resource_version:
+                self._dispatch(MODIFIED, old, obj)
+        for key, obj in old_cache.items():
+            if key not in new:
+                self._dispatch(DELETED, None, obj)
 
     def pump(self, max_events: Optional[int] = None,
              timeout: float = 0.0) -> int:
@@ -122,7 +141,14 @@ class SharedInformer:
             self.sync()
         n = 0
         while max_events is None or n < max_events:
-            ev = self._watch.next(timeout=timeout) if timeout else self._watch.try_next()
+            try:
+                ev = (self._watch.next(timeout=timeout) if timeout
+                      else self._watch.try_next())
+            except ExpiredError:
+                # the watch outran the server's event log: re-list
+                # (reflector 410 contract)
+                self._relist()
+                continue
             if ev is None:
                 break
             self._apply(ev)
@@ -160,9 +186,28 @@ class SharedInformer:
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            ev = self._watch.next(timeout=0.05)
+            try:
+                ev = self._watch.next(timeout=0.05)
+            except ExpiredError:
+                self._safe_relist()
+                continue
             if ev is not None:
                 self._apply(ev)
+
+    def _safe_relist(self) -> None:
+        """Background-mode re-list: transient transport failures (a remote
+        apiserver mid-restart) must not kill the informer thread — retry
+        until the list+watch lands or the informer stops. The synchronous
+        pump() path propagates transport errors to its caller instead."""
+        while not self._stop.is_set():
+            try:
+                self._relist()
+                return
+            except ExpiredError:
+                continue
+            except Exception:
+                if self._stop.wait(0.2):
+                    return
 
     def stop(self) -> None:
         self._stop.set()

@@ -13,7 +13,10 @@ import threading
 from collections import OrderedDict
 
 from kubernetes_tpu.api.types import EventRecord
-from kubernetes_tpu.store.store import Store, EVENTS, NotFoundError
+from kubernetes_tpu.store.store import (
+    Store, EVENTS, AlreadyExistsError, ConflictError, NotFoundError,
+)
+from kubernetes_tpu.store.remote import APIStatusError
 
 NORMAL = "Normal"
 WARNING = "Warning"
@@ -59,7 +62,17 @@ class EventRecorder:
                 involved_kind=involved_kind, involved_key=involved_key,
                 type=etype, reason=reason, message=message,
                 component=self.component)
-            self.store.create(EVENTS, rec, move=True)
+            try:
+                self.store.create(EVENTS, rec, move=True)
+            except (APIStatusError, AlreadyExistsError, ConflictError,
+                    OSError):
+                # fire-and-forget like the reference recorder: a rejected
+                # or undeliverable event write (rate-limit 422, transport
+                # failure, name collision) must never fail the component's
+                # work loop — events are audit records, not state.
+                # Programming errors (TypeError from schema drift) still
+                # propagate.
+                return
             self._known[agg] = rec.key
             while len(self._known) > self._max_entries:
                 self._known.popitem(last=False)

@@ -1,0 +1,271 @@
+"""HTTP client store — the client-go analog for the apiserver.
+
+`RemoteStore` implements the Store surface the scheduler, controllers, and
+shared informers consume (get/list/watch + the write verbs) over the
+apiserver's REST + chunked-watch contract (apiserver/server.py), so
+`Scheduler(RemoteStore(url))` runs a control-plane component OUT of the
+apiserver's process. It mirrors the reference's client runtime:
+
+- REST client with status->error mapping
+  (client-go/rest/request.go; Conflict/AlreadyExists/NotFound/Gone).
+- Reflector transport semantics (client-go/tools/cache/reflector.go:159):
+  list returns (objects, resourceVersion); watch streams JSON-lines from
+  that version, transparently RECONNECTING from the last seen version when
+  the TCP stream drops, and raising ExpiredError (410 Gone) when the
+  server's event log no longer covers the resume point — the informer then
+  re-lists.
+- Client-side optimistic concurrency: guaranteed_update is a
+  get -> mutate -> PUT(resourceVersion) -> retry-on-409 loop, exactly how
+  reference controllers wrap their writes (GuaranteedUpdate semantics over
+  plain REST); the pod convenience verbs reuse it with the same mutate
+  logic as the embedded store so both transports produce identical writes.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+from kubernetes_tpu.api import serde
+from kubernetes_tpu.store.store import (
+    Event, PODS, AlreadyExistsError, ConflictError, ExpiredError,
+    NotFoundError, nominated_node_mutator, pod_condition_mutator,
+)
+
+
+class APIStatusError(Exception):
+    """Non-2xx response that maps to no store error (e.g. 422 admission
+    rejection): carries the server's Status reason/message."""
+
+    def __init__(self, code: int, reason: str, message: str):
+        super().__init__(f"{code} {reason}: {message}")
+        self.code = code
+        self.reason = reason
+        self.message = message
+
+
+def _raise_for(code: int, reason: str, message: str) -> None:
+    if code == 404:
+        raise NotFoundError(message)
+    if code == 409:
+        if reason == "AlreadyExists":
+            raise AlreadyExistsError(message)
+        raise ConflictError(message)
+    if code == 410:
+        raise ExpiredError(message)
+    raise APIStatusError(code, reason, message)
+
+
+class RemoteWatch:
+    """Chunked JSON-lines watch stream with reflector resume semantics.
+
+    A background reader parses events into a queue; on a dropped stream it
+    reopens from the last delivered resourceVersion. A 410 at (re)open
+    surfaces as ExpiredError from next()/try_next() — the informer
+    re-lists (reflector.go:159 / the server's watch contract)."""
+
+    _RECONNECT_DELAY = 0.05
+
+    def __init__(self, base: str, kind: str, since_rv: Optional[int],
+                 timeout: float):
+        self.kind = kind
+        self._base = base
+        self._timeout = timeout
+        self._queue: "queue.Queue[Event]" = queue.Queue()
+        self._stop = threading.Event()
+        self._expired: Optional[str] = None
+        self._last_rv = since_rv
+        # open synchronously so an immediate 410 raises from watch() like
+        # the embedded store's Store.watch does
+        self._resp = self._open(since_rv)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"remote-watch-{kind}")
+        self._thread.start()
+
+    def _open(self, since_rv: Optional[int]):
+        url = f"{self._base}/api/v1/{self.kind}?watch=true"
+        if since_rv is not None:
+            url += f"&resourceVersion={since_rv}"
+        req = urllib.request.Request(url, method="GET")
+        try:
+            return urllib.request.urlopen(req, timeout=self._timeout)
+        except urllib.error.HTTPError as e:
+            body = _status_body(e)
+            _raise_for(e.code, body.get("reason", ""),
+                       body.get("message", str(e)))
+
+    def _run(self) -> None:
+        resp = self._resp
+        while not self._stop.is_set():
+            try:
+                line = resp.readline()
+            except (OSError, ValueError, AttributeError):
+                # AttributeError: stop() closed the response under us and
+                # http.client's chunked reader lost its fp mid-call
+                line = b""
+            if self._stop.is_set():
+                break
+            if line == b"":
+                # stream ended: reconnect from the last seen version
+                try:
+                    resp.close()
+                except OSError:
+                    pass
+                try:
+                    resp = self._resp = self._open(self._last_rv)
+                except ExpiredError as e:
+                    self._expired = str(e)
+                    return
+                except (urllib.error.URLError, OSError, APIStatusError,
+                        NotFoundError):
+                    if self._stop.wait(self._RECONNECT_DELAY):
+                        return
+                continue
+            line = line.strip()
+            if not line:
+                continue   # keep-alive blank line
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            rv = int(d.get("resourceVersion", 0))
+            obj = serde.from_dict(self.kind, d["object"])
+            self._last_rv = rv
+            self._queue.put(Event(d["type"], self.kind, obj, rv))
+
+    def _check_expired(self) -> None:
+        if self._expired is not None and self._queue.empty():
+            raise ExpiredError(self._expired)
+
+    def next(self, timeout: Optional[float] = None) -> Optional[Event]:
+        self._check_expired()
+        try:
+            return self._queue.get(
+                timeout=timeout if timeout and timeout > 0 else 0.001)
+        except queue.Empty:
+            self._check_expired()
+            return None
+
+    def try_next(self) -> Optional[Event]:
+        self._check_expired()
+        try:
+            return self._queue.get_nowait()
+        except queue.Empty:
+            return None
+
+    def drain(self) -> list[Event]:
+        out = []
+        while True:
+            ev = self.try_next()
+            if ev is None:
+                return out
+            out.append(ev)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._resp.close()
+        except OSError:
+            pass
+
+
+def _status_body(e: urllib.error.HTTPError) -> dict:
+    try:
+        return json.loads(e.read() or b"{}")
+    except ValueError:
+        return {}
+
+
+class RemoteStore:
+    """The Store read/write surface over HTTP. Watch streams reconnect;
+    unary calls fail fast with mapped errors."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport -----------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            b = _status_body(e)
+            _raise_for(e.code, b.get("reason", ""),
+                       b.get("message", str(e)))
+
+    # -- reads ---------------------------------------------------------------
+    def get(self, kind: str, key: str) -> Any:
+        return serde.from_dict(kind, self._request(
+            "GET", f"/api/v1/{kind}/{key}"))
+
+    def list(self, kind: str) -> tuple[list[Any], int]:
+        d = self._request("GET", f"/api/v1/{kind}")
+        return ([serde.from_dict(kind, o) for o in d["items"]],
+                int(d["resourceVersion"]))
+
+    def watch(self, kind: str, since_rv: Optional[int] = None) -> RemoteWatch:
+        return RemoteWatch(self.base_url, kind, since_rv, self.timeout)
+
+    # -- writes --------------------------------------------------------------
+    def create(self, kind: str, obj: Any, move: bool = False) -> Any:
+        # `move` is the embedded store's no-clone fast path; over the wire
+        # serialization copies regardless
+        return serde.from_dict(kind, self._request(
+            "POST", f"/api/v1/{kind}", serde.to_dict(obj)))
+
+    def update(self, kind: str, obj: Any,
+               expect_rv: Optional[int] = None) -> Any:
+        d = serde.to_dict(obj)
+        # the server uses the object's resourceVersion as the CAS
+        # precondition; expect_rv overrides it (None = unconditional)
+        d["resource_version"] = expect_rv if expect_rv is not None else 0
+        return serde.from_dict(kind, self._request(
+            "PUT", f"/api/v1/{kind}/{obj.key}", d))
+
+    def delete(self, kind: str, key: str) -> Any:
+        return serde.from_dict(kind, self._request(
+            "DELETE", f"/api/v1/{kind}/{key}"))
+
+    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+        # POST pods/{ns}/{name}/binding (factory.go:710)
+        return self._request("POST", f"/api/v1/{PODS}/{pod_key}/binding",
+                             {"node": node_name})
+
+    def guaranteed_update(self, kind: str, key: str,
+                          mutate: Callable[[Any], Any],
+                          allow_skip: bool = False) -> Any:
+        """Client-side read-modify-write loop: GET, mutate, PUT with the
+        read resourceVersion, retry on 409 — the reference controller
+        pattern over plain REST."""
+        while True:
+            current = self.get(kind, key)
+            rv = current.resource_version
+            updated = mutate(current)
+            if allow_skip and updated is None:
+                return current
+            try:
+                return self.update(kind, updated, expect_rv=rv)
+            except ConflictError:
+                continue
+
+    # pod conveniences: the SAME mutate closures as the embedded store
+    # (store.nominated_node_mutator / pod_condition_mutator), so both
+    # transports write identical objects by construction
+    def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
+        return self.guaranteed_update(PODS, pod_key,
+                                      nominated_node_mutator(node_name))
+
+    def update_pod_condition(self, pod_key: str, condition) -> Any:
+        return self.guaranteed_update(PODS, pod_key,
+                                      pod_condition_mutator(condition),
+                                      allow_skip=True)

@@ -122,6 +122,35 @@ class Watch:
         self._q.put(None)  # wake any blocked next()
 
 
+def nominated_node_mutator(node_name: str) -> Callable[[Any], Any]:
+    """Mutate closure for SetNominatedNodeName — shared by the embedded
+    store and RemoteStore so both transports write identical objects."""
+    def mutate(pod):
+        pod.nominated_node_name = node_name
+        return pod
+    return mutate
+
+
+def pod_condition_mutator(condition) -> Callable[[Any], Any]:
+    """Mutate closure for podutil.UpdatePodCondition (factory.go:715):
+    replace the same-type condition if changed, append if absent, None for
+    a no-op (with allow_skip the write is skipped entirely). Shared by the
+    embedded store and RemoteStore."""
+    def mutate(pod):
+        conds = list(pod.conditions)
+        for i, c in enumerate(conds):
+            if c.type == condition.type:
+                if c == condition:
+                    return None   # unchanged -> no write
+                conds[i] = condition
+                break
+        else:
+            conds.append(condition)
+        pod.conditions = tuple(conds)
+        return pod
+    return mutate
+
+
 def _key_of(obj: Any) -> str:
     return obj.key
 
@@ -300,29 +329,16 @@ class Store:
             return stored
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
-        def mutate(pod):
-            pod.nominated_node_name = node_name
-            return pod
-        return self.guaranteed_update(PODS, pod_key, mutate)
+        return self.guaranteed_update(PODS, pod_key,
+                                      nominated_node_mutator(node_name))
 
     def update_pod_condition(self, pod_key: str, condition) -> Any:
         """UpdateStatus analog for one condition (reference: factory.go:715
         podConditionUpdater + podutil.UpdatePodCondition): replace the
         condition of the same type if it changed, append if absent; no-op
         write is skipped entirely."""
-        def mutate(pod):
-            conds = list(pod.conditions)
-            for i, c in enumerate(conds):
-                if c.type == condition.type:
-                    if c == condition:
-                        return None   # unchanged -> no write
-                    conds[i] = condition
-                    break
-            else:
-                conds.append(condition)
-            pod.conditions = tuple(conds)
-            return pod
-        return self.guaranteed_update(PODS, pod_key, mutate,
+        return self.guaranteed_update(PODS, pod_key,
+                                      pod_condition_mutator(condition),
                                       allow_skip=True)
 
     # -- watch --------------------------------------------------------------

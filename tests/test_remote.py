@@ -1,0 +1,320 @@
+"""Remote-transport tests: RemoteStore (the client-go analog) and the
+HTTP-attached scheduler — the reflector contract of
+client-go/tools/cache/reflector.go:159 (list+watch, resourceVersion
+resume, 410 Gone -> re-list) over the apiserver's REST surface, so the
+control plane itself crosses a real process boundary, not just kubectl."""
+import time
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Node, Container
+from kubernetes_tpu.apiserver.server import APIServer
+from kubernetes_tpu.store.remote import RemoteStore, APIStatusError
+from kubernetes_tpu.store.store import (
+    Store, PODS, NODES, AlreadyExistsError, ConflictError, ExpiredError,
+    NotFoundError,
+)
+
+GI = 1024 ** 3
+
+
+def mknode(name, cpu=4000):
+    return Node(name=name,
+                allocatable={"cpu": cpu, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, cpu=100, priority=0):
+    return Pod(name=name, priority=priority,
+               containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+@pytest.fixture()
+def served():
+    store = Store(watch_log_size=65536)
+    with APIServer(store) as srv:
+        yield store, RemoteStore(srv.url)
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+class TestRemoteStoreCRUD:
+    def test_create_get_list_delete(self, served):
+        store, remote = served
+        created = remote.create(NODES, mknode("n1"))
+        assert created.resource_version > 0
+        got = remote.get(NODES, "n1")
+        assert got.name == "n1" and got.allocatable["cpu"] == 4000
+        objs, rv = remote.list(NODES)
+        assert [o.name for o in objs] == ["n1"]
+        assert rv == store.resource_version()
+        gone = remote.delete(NODES, "n1")
+        assert gone.name == "n1"
+        with pytest.raises(NotFoundError):
+            remote.get(NODES, "n1")
+        with pytest.raises(NotFoundError):
+            remote.delete(NODES, "n1")
+
+    def test_already_exists_and_conflict(self, served):
+        store, remote = served
+        remote.create(NODES, mknode("n1"))
+        with pytest.raises(AlreadyExistsError):
+            remote.create(NODES, mknode("n1"))
+        cur = remote.get(NODES, "n1")
+        cur.unschedulable = True
+        remote.update(NODES, cur, expect_rv=cur.resource_version)
+        stale = cur   # now one version behind
+        with pytest.raises(ConflictError):
+            remote.update(NODES, stale, expect_rv=stale.resource_version)
+
+    def test_guaranteed_update_retries_conflict(self, served):
+        store, remote = served
+        remote.create(PODS, mkpod("p1"))
+        raced = {"done": False}
+
+        def mutate(pod):
+            if not raced["done"]:
+                raced["done"] = True
+                # out-of-band writer bumps the rv between GET and PUT
+                store.set_nominated_node_name(pod.key, "other")
+            pod.nominated_node_name = "winner"
+            return pod
+
+        out = remote.guaranteed_update(PODS, "default/p1", mutate)
+        assert out.nominated_node_name == "winner"
+        assert store.get(PODS, "default/p1").nominated_node_name == "winner"
+
+    def test_bind_and_pod_conveniences(self, served):
+        store, remote = served
+        remote.create(PODS, mkpod("p1"))
+        remote.bind_pod("default/p1", "n7")
+        assert store.get(PODS, "default/p1").node_name == "n7"
+        remote.set_nominated_node_name("default/p1", "n9")
+        assert store.get(PODS, "default/p1").nominated_node_name == "n9"
+        from kubernetes_tpu.api.types import (PodCondition, POD_SCHEDULED,
+                                              CONDITION_FALSE)
+        rv0 = store.get(PODS, "default/p1").resource_version
+        cond = PodCondition(type=POD_SCHEDULED, status=CONDITION_FALSE,
+                            reason="Unschedulable", message="m")
+        remote.update_pod_condition("default/p1", cond)
+        assert store.get(PODS, "default/p1").conditions[0].reason == \
+            "Unschedulable"
+        # the no-op skip must hold over the wire too (store.py:308)
+        rv1 = store.get(PODS, "default/p1").resource_version
+        assert rv1 > rv0
+        remote.update_pod_condition("default/p1", cond)
+        assert store.get(PODS, "default/p1").resource_version == rv1
+
+
+class TestRemoteWatch:
+    def test_stream_resume_and_types(self, served):
+        store, remote = served
+        remote.create(NODES, mknode("n1"))
+        objs, rv = remote.list(NODES)
+        w = remote.watch(NODES, since_rv=rv)
+        try:
+            store.create(NODES, mknode("n2"))
+            store.delete(NODES, "n1")
+            evs = []
+            assert wait_until(lambda: (evs.extend(w.drain()), len(evs) >= 2)[1])
+            assert [(e.type, e.obj.name) for e in evs[:2]] == \
+                [("ADDED", "n2"), ("DELETED", "n1")]
+        finally:
+            w.stop()
+
+    def test_open_past_window_raises_expired(self):
+        store = Store(watch_log_size=8)
+        with APIServer(store) as srv:
+            remote = RemoteStore(srv.url)
+            for i in range(40):
+                store.create(NODES, mknode(f"n{i}"))
+            with pytest.raises(ExpiredError):
+                remote.watch(NODES, since_rv=1)
+
+    def test_reconnect_after_server_restart(self):
+        """The stream drops when the server dies; the watch reconnects from
+        the last seen resourceVersion once a server is back on the port and
+        delivers everything written in between — reflector resume."""
+        store = Store(watch_log_size=65536)
+        srv = APIServer(store, port=0).start()
+        port = int(srv.url.rsplit(":", 1)[1])
+        remote = RemoteStore(srv.url)
+        store.create(NODES, mknode("n1"))
+        objs, rv = remote.list(NODES)
+        w = remote.watch(NODES, since_rv=rv)
+        try:
+            store.create(NODES, mknode("n2"))
+            evs = []
+            assert wait_until(lambda: (evs.extend(w.drain()), len(evs) >= 1)[1])
+            srv.stop()
+            store.create(NODES, mknode("n3"))   # written while disconnected
+            srv2 = APIServer(store, port=port).start()
+            try:
+                assert wait_until(
+                    lambda: (evs.extend(w.drain()), len(evs) >= 2)[1],
+                    timeout=15.0)
+                assert [e.obj.name for e in evs[:2]] == ["n2", "n3"]
+            finally:
+                srv2.stop()
+        finally:
+            w.stop()
+
+
+class TestInformerRelist:
+    def test_replace_semantics_on_relist(self, served):
+        """DeltaFIFO Replace (delta_fifo.go:96): after an expired-window
+        resume the informer must emit deletes for vanished keys, updates
+        for changed ones, adds for new ones — not a blind add replay."""
+        store, remote = served
+        from kubernetes_tpu.store.informer import SharedInformer
+        store.create(NODES, mknode("n1"))
+        store.create(NODES, mknode("n2"))
+        inf = SharedInformer(remote, NODES)
+        seen = []
+        inf.add_event_handler(
+            on_add=lambda o: seen.append(("add", o.name)),
+            on_update=lambda o, n: seen.append(("upd", n.name)),
+            on_delete=lambda o: seen.append(("del", o.name)))
+        inf.sync()
+        assert sorted(seen) == [("add", "n1"), ("add", "n2")]
+        seen.clear()
+        # out-of-band world change the expired watch window would hide
+        store.delete(NODES, "n1")
+        store.create(NODES, mknode("n3"))
+        n2 = store.get(NODES, "n2")
+        n2.unschedulable = True
+        store.update(NODES, n2)
+        inf._relist()
+        assert sorted(seen) == [("add", "n3"), ("del", "n1"), ("upd", "n2")]
+        assert sorted(o.name for o in inf.list()) == ["n2", "n3"]
+
+
+class TestRemoteLeaderElection:
+    def test_lease_cas_over_http(self, served):
+        """Leader election's lease CAS works over the remote transport
+        (resourcelock semantics; Lease is a registered API kind), so
+        --server --leader-elect is a working combination."""
+        from kubernetes_tpu.utils.leader_election import (
+            LeaderElector, LeaderElectionConfig)
+        from kubernetes_tpu.utils.clock import FakeClock
+        store, remote = served
+        clock = FakeClock(100.0)
+        a = LeaderElector(remote, LeaderElectionConfig(
+            identity="a", lease_duration=15.0), clock=clock)
+        b = LeaderElector(remote, LeaderElectionConfig(
+            identity="b", lease_duration=15.0), clock=clock)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        assert a.try_acquire_or_renew() is True      # renewal (bumps rv)
+        clock.step(20.0)
+        # b first OBSERVES the renewed record here — the observation clock
+        # resets on any record change (leaderelection.go:287 semantics), so
+        # takeover needs another full lease_duration of silence
+        assert b.try_acquire_or_renew() is False
+        clock.step(20.0)
+        assert b.try_acquire_or_renew() is True      # takeover via CAS
+        assert store.get("leases", "kube-scheduler").holder == "b"
+
+
+class TestRemoteScheduler:
+    def test_bindings_identical_to_in_process(self):
+        """The headline contract (VERDICT r4 next #4): a scheduler attached
+        over HTTP produces byte-identical bindings to the in-process run on
+        the same world."""
+        from kubernetes_tpu.scheduler import Scheduler
+
+        def world():
+            s = Store(watch_log_size=65536)
+            for i in range(6):
+                s.create(NODES, mknode(f"n{i}",
+                                       cpu=2000 if i % 2 else 4000))
+            for j in range(20):
+                s.create(PODS, mkpod(f"p{j}", cpu=[100, 300, 700][j % 3],
+                                     priority=[0, 5][j % 2]))
+            return s
+
+        # in-process referee
+        s_local = world()
+        sched = Scheduler(s_local, use_tpu=False,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        want = sorted((p.key, p.node_name) for p in s_local.list(PODS)[0])
+
+        # HTTP-attached run on an identical world
+        s_remote = world()
+        with APIServer(s_remote) as srv:
+            remote = RemoteStore(srv.url)
+            rsched = Scheduler(remote, use_tpu=False,
+                               percentage_of_nodes_to_score=100)
+            rsched.sync()
+
+            def drain():
+                rsched.pump()
+                progressed = False
+                while rsched.schedule_one(timeout=0.0):
+                    progressed = True
+                return progressed
+
+            def all_bound():
+                drain()
+                pods, _ = s_remote.list(PODS)
+                return all(p.node_name for p in pods)
+            assert wait_until(all_bound, timeout=30.0)
+        got = sorted((p.key, p.node_name) for p in s_remote.list(PODS)[0])
+        assert got == want
+
+    def test_controller_manager_attaches_over_http(self):
+        """The controller manager's whole surface (list / get / create /
+        update / delete / guaranteed_update + informers) works over the
+        remote transport: a Deployment reconciles to pods through HTTP."""
+        from kubernetes_tpu.controllers.manager import ControllerManager
+        from kubernetes_tpu.api.types import (Deployment, PodTemplate,
+                                              LabelSelector)
+        from kubernetes_tpu.store.store import DEPLOYMENTS
+        store = Store(watch_log_size=65536)
+        with APIServer(store) as srv:
+            remote = RemoteStore(srv.url)
+            mgr = ControllerManager(remote,
+                                    enabled=["deployment", "replicaset"])
+            mgr.sync()
+            remote.create(DEPLOYMENTS, Deployment(
+                name="web", replicas=3,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                template=PodTemplate(
+                    labels={"app": "web"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100}),))))
+
+            def reconciled():
+                mgr.pump()
+                pods, _ = store.list(PODS)
+                return len(pods) == 3
+            assert wait_until(reconciled, timeout=20.0)
+            assert all(p.labels.get("app") == "web"
+                       for p in store.list(PODS)[0])
+
+    def test_cmd_scheduler_attaches_over_http(self):
+        """cmd/scheduler.py --server URL: the CLI entry runs out-of-process
+        against a served store (--once drain)."""
+        from kubernetes_tpu.cmd import scheduler as cmd_sched
+        store = Store(watch_log_size=65536)
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        for j in range(6):
+            store.create(PODS, mkpod(f"p{j}"))
+        with APIServer(store) as srv:
+            rc = cmd_sched.main(["--server", srv.url, "--once",
+                                 "--percentage-of-nodes-to-score", "100"])
+            assert rc == 0
+            pods, _ = store.list(PODS)
+            assert all(p.node_name for p in pods)
