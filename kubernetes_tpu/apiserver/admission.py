@@ -316,6 +316,30 @@ class NodeRestriction:
                 f"node {old.node_name!r}")
         return self.admit(kind, new, store, user=user)
 
+    def admit_binding(self, pod: Any, node_name: str, store: Store,
+                      user: Optional[str] = None) -> None:
+        # binding is the scheduler's verb: a node identity may not bind
+        # (or steal) pods at all (admission.go:46 posture; kubelets report
+        # status, they do not place workloads)
+        node = self._node_of(user)
+        if node is not None:
+            raise AdmissionError(
+                f"node {node!r} is not allowed to create pod bindings")
+
+    def admit_delete(self, kind: str, obj: Any, store: Store,
+                     user: Optional[str] = None) -> None:
+        from kubernetes_tpu.store.store import NODES
+        node = self._node_of(user)
+        if node is None:
+            return
+        if kind == PODS and getattr(obj, "node_name", "") not in ("", node):
+            raise AdmissionError(
+                f"node {node!r} is not allowed to delete pods bound to "
+                f"node {obj.node_name!r}")
+        if kind == NODES and obj.name != node:
+            raise AdmissionError(
+                f"node {node!r} is not allowed to delete node {obj.name!r}")
+
 
 class PodTolerationRestriction:
     """plugin/pkg/admission/podtolerationrestriction: merge the namespace's
@@ -463,6 +487,27 @@ class AdmissionChain:
             else:
                 obj = p.admit(kind, obj, store)
         return obj
+
+    def admit_binding(self, pod: Any, node_name: str, store: Store,
+                      user: Optional[str] = None) -> None:
+        """Admission for the pods/binding subresource (the scheduler's
+        write verb, factory.go:710): plugins exposing admit_binding judge
+        (current pod, target node, identity) — NodeRestriction uses it to
+        keep node identities from binding/stealing pods."""
+        for p in self.plugins:
+            ab = getattr(p, "admit_binding", None)
+            if ab is not None:
+                ab(pod, node_name, store, user=user)
+
+    def admit_delete(self, kind: str, obj: Any, store: Store,
+                     user: Optional[str] = None) -> None:
+        """Admission for deletes: plugins exposing admit_delete judge the
+        object about to go away (NodeRestriction: a kubelet may evict only
+        pods bound to its own node, delete only its own Node)."""
+        for p in self.plugins:
+            ad = getattr(p, "admit_delete", None)
+            if ad is not None:
+                ad(kind, obj, store, user=user)
 
     def admit_update(self, kind: str, old: Any, new: Any, store: Store,
                      user: Optional[str] = None) -> Any:

@@ -30,6 +30,7 @@ from urllib.parse import urlparse, parse_qs
 
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
+from kubernetes_tpu.apiserver.auth import Attributes
 from kubernetes_tpu.store.store import (
     Store, PODS, AlreadyExistsError, ConflictError, NotFoundError,
     ExpiredError,
@@ -38,12 +39,52 @@ from kubernetes_tpu.store.store import (
 API_PREFIX = "/api/v1"
 
 
-def make_handler(store: Store, admission: AdmissionChain):
+def make_handler(store: Store, admission: AdmissionChain,
+                 authenticator=None, authorizer=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, *a):   # quiet
             pass
+
+        # -- authn/authz ----------------------------------------------------
+        def _authenticate(self):
+            """Bearer-token authn (tokenfile analog). Returns the UserInfo
+            (None when auth is disabled — the open in-process posture)."""
+            if authenticator is None:
+                return None
+            return authenticator.authenticate(
+                self.headers.get("Authorization"))
+
+        def _authorized(self, user, verb: str, resource: str,
+                        name: str = "") -> bool:
+            """401 for anonymous, 403 on authorizer deny; True = proceed.
+            With auth disabled every request passes (trusted in-process
+            callers)."""
+            if authenticator is None:
+                return True
+            if user is None:
+                self._error(401, "Unauthorized",
+                            "authentication required: present a bearer "
+                            "token")
+                return False
+            if authorizer is not None and not authorizer.authorize(
+                    Attributes(user=user, verb=verb, resource=resource,
+                               name=name)):
+                self._error(403, "Forbidden",
+                            f"user {user.name!r} cannot {verb} {resource}"
+                            f"{'/' + name if name else ''}")
+                return False
+            return True
+
+        def _user_name(self, user) -> str | None:
+            """The identity admission plugins act on: the VERIFIED token
+            identity when auth is enabled, else the (trusting, in-process)
+            X-Remote-User header — the spoofable header is dead the moment
+            an authenticator is configured."""
+            if authenticator is not None:
+                return user.name if user is not None else None
+            return self.headers.get("X-Remote-User")
 
         # -- helpers --------------------------------------------------------
         def _send(self, code: int, payload, chunked: bool = False) -> None:
@@ -89,15 +130,22 @@ def make_handler(store: Store, admission: AdmissionChain):
             if kind not in serde.KIND_TYPES:
                 self._error(404, "NotFound", f"unknown resource {kind}")
                 return
+            user = self._authenticate()
             if len(parts) == 3:
                 if q.get("watch", ["false"])[0] == "true":
+                    if not self._authorized(user, "watch", kind):
+                        return
                     self._watch(kind, q)
+                    return
+                if not self._authorized(user, "list", kind):
                     return
                 objs, rv = store.list(kind)
                 self._send(200, {"kind": kind, "resourceVersion": rv,
                                  "items": [serde.to_dict(o) for o in objs]})
                 return
             key = "/".join(parts[3:])
+            if not self._authorized(user, "get", kind, key):
+                return
             try:
                 self._send(200, serde.to_dict(store.get(kind, key)))
             except NotFoundError:
@@ -148,12 +196,23 @@ def make_handler(store: Store, admission: AdmissionChain):
 
         def do_POST(self):
             path, parts, q = self._route()
+            user = self._authenticate()
             # binding subresource: POST /api/v1/pods/{ns}/{name}/binding
             if len(parts) == 6 and parts[2] == PODS and parts[5] == "binding":
                 key = f"{parts[3]}/{parts[4]}"
+                if not self._authorized(user, "create", PODS, key):
+                    return
                 node = self._body().get("node", "")
                 try:
+                    current = store.get(PODS, key)
+                    # the binding subresource runs admission too
+                    # (NodeRestriction: node identities never bind)
+                    admission.admit_binding(current, node, store,
+                                            user=self._user_name(user))
                     store.bind_pod(key, node)
+                except AdmissionError as e:
+                    self._error(422, "Invalid", str(e))
+                    return
                 except NotFoundError:
                     self._error(404, "NotFound", key)
                     return
@@ -163,12 +222,13 @@ def make_handler(store: Store, admission: AdmissionChain):
                 self._error(404, "NotFound", path)
                 return
             kind = parts[2]
+            if not self._authorized(user, "create", kind):
+                return
             admitted = None
             try:
                 obj = serde.from_dict(kind, self._body())
                 obj = admitted = admission.admit(
-                    kind, obj, store,
-                    user=self.headers.get("X-Remote-User"))
+                    kind, obj, store, user=self._user_name(user))
                 created = store.create(kind, obj)
             except AdmissionError as e:
                 self._error(422, "Invalid", str(e))
@@ -190,6 +250,10 @@ def make_handler(store: Store, admission: AdmissionChain):
                 self._error(404, "NotFound", path)
                 return
             kind = parts[2]
+            user = self._authenticate()
+            if not self._authorized(user, "update", kind,
+                                    "/".join(parts[3:])):
+                return
             old = admitted = None
             try:
                 obj = serde.from_dict(kind, self._body())
@@ -199,8 +263,7 @@ def make_handler(store: Store, admission: AdmissionChain):
                 # plugins their delta
                 old = store.get(kind, obj.key)
                 obj = admitted = admission.admit_update(
-                    kind, old, obj, store,
-                    user=self.headers.get("X-Remote-User"))
+                    kind, old, obj, store, user=self._user_name(user))
                 expect = obj.resource_version or None
                 updated = store.update(kind, obj, expect_rv=expect)
             except AdmissionError as e:
@@ -228,6 +291,20 @@ def make_handler(store: Store, admission: AdmissionChain):
                 return
             kind = parts[2]
             key = "/".join(parts[3:])
+            user = self._authenticate()
+            if not self._authorized(user, "delete", kind, key):
+                return
+            # deletes run admission too (NodeRestriction: a kubelet may
+            # evict only pods bound to its own node)
+            try:
+                admission.admit_delete(kind, store.get(kind, key), store,
+                                       user=self._user_name(user))
+            except AdmissionError as e:
+                self._error(422, "Invalid", str(e))
+                return
+            except NotFoundError:
+                self._error(404, "NotFound", f"{kind}/{key}")
+                return
             from kubernetes_tpu.store.store import NAMESPACES
             if kind == NAMESPACES:
                 # namespace finalization (reference: registry/core/namespace
@@ -257,14 +334,20 @@ def make_handler(store: Store, admission: AdmissionChain):
 
 
 class APIServer:
-    """In-process apiserver: `with APIServer(store) as srv: srv.url`."""
+    """In-process apiserver: `with APIServer(store) as srv: srv.url`.
+
+    Pass `authenticator` (apiserver.auth.TokenAuthenticator) to require
+    bearer tokens, and `authorizer` (RBAC/node/union) to enforce access —
+    admission's NodeRestriction then acts on the verified identity."""
 
     def __init__(self, store: Store, host: str = "127.0.0.1", port: int = 0,
-                 admission: AdmissionChain | None = None):
+                 admission: AdmissionChain | None = None,
+                 authenticator=None, authorizer=None):
         self.store = store
         self.admission = admission or AdmissionChain()
         self._httpd = ThreadingHTTPServer(
-            (host, port), make_handler(store, self.admission))
+            (host, port), make_handler(store, self.admission,
+                                       authenticator, authorizer))
         self._thread: threading.Thread | None = None
 
     @property

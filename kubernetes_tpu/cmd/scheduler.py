@@ -139,6 +139,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                          "resourceVersion resume and 410 re-list "
                          "(reflector.go:159) — the out-of-process posture "
                          "of every reference control-plane component")
+    ap.add_argument("--token",
+                    help="bearer token for --server (tokenfile authn; the "
+                         "bootstrapped scheduler identity is "
+                         "system:kube-scheduler)")
     args = ap.parse_args(argv)
 
     cfg = build_config(args)
@@ -148,7 +152,7 @@ def main(argv: Optional[list[str]] = None) -> int:
                              "remote-attached scheduler has no store of its "
                              "own to serve")
         from kubernetes_tpu.store.remote import RemoteStore
-        store = RemoteStore(args.server)
+        store = RemoteStore(args.server, token=args.token)
         if args.cluster_spec:
             raise SystemExit("--cluster-spec requires the embedded store; "
                              "create objects through the apiserver instead")

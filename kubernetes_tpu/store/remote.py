@@ -71,10 +71,11 @@ class RemoteWatch:
     _RECONNECT_DELAY = 0.05
 
     def __init__(self, base: str, kind: str, since_rv: Optional[int],
-                 timeout: float):
+                 timeout: float, token: Optional[str] = None):
         self.kind = kind
         self._base = base
         self._timeout = timeout
+        self._token = token
         self._queue: "queue.Queue[Event]" = queue.Queue()
         self._stop = threading.Event()
         self._expired: Optional[str] = None
@@ -90,7 +91,10 @@ class RemoteWatch:
         url = f"{self._base}/api/v1/{self.kind}?watch=true"
         if since_rv is not None:
             url += f"&resourceVersion={since_rv}"
-        req = urllib.request.Request(url, method="GET")
+        headers = {}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        req = urllib.request.Request(url, method="GET", headers=headers)
         try:
             return urllib.request.urlopen(req, timeout=self._timeout)
         except urllib.error.HTTPError as e:
@@ -120,8 +124,17 @@ class RemoteWatch:
                 except ExpiredError as e:
                     self._expired = str(e)
                     return
-                except (urllib.error.URLError, OSError, APIStatusError,
-                        NotFoundError):
+                except APIStatusError as e:
+                    if e.code in (401, 403):
+                        # token revoked/denied mid-watch: not transient.
+                        # Surface as expiry so the informer's re-list runs
+                        # and raises the auth error to its caller instead
+                        # of a silent forever-retry.
+                        self._expired = str(e)
+                        return
+                    if self._stop.wait(self._RECONNECT_DELAY):
+                        return
+                except (urllib.error.URLError, OSError, NotFoundError):
                     if self._stop.wait(self._RECONNECT_DELAY):
                         return
                 continue
@@ -184,17 +197,21 @@ class RemoteStore:
     """The Store read/write surface over HTTP. Watch streams reconnect;
     unary calls fail fast with mapped errors."""
 
-    def __init__(self, base_url: str, timeout: float = 30.0):
+    def __init__(self, base_url: str, timeout: float = 30.0,
+                 token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token   # bearer identity (tokenfile authn analog)
 
     # -- transport -----------------------------------------------------------
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None) -> Any:
         data = json.dumps(body).encode() if body is not None else None
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self.base_url + path, data=data, method=method, headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"{}")
@@ -214,7 +231,8 @@ class RemoteStore:
                 int(d["resourceVersion"]))
 
     def watch(self, kind: str, since_rv: Optional[int] = None) -> RemoteWatch:
-        return RemoteWatch(self.base_url, kind, since_rv, self.timeout)
+        return RemoteWatch(self.base_url, kind, since_rv, self.timeout,
+                           token=self.token)
 
     # -- writes --------------------------------------------------------------
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
