@@ -37,6 +37,9 @@ KIND_TYPES = {
     store_mod.CONFIGMAPS: T.ConfigMap,
     store_mod.SECRETS: T.Secret,
     store_mod.SERVICEACCOUNTS: T.ServiceAccount,
+    store_mod.HPAS: T.HorizontalPodAutoscaler,
+    store_mod.PODMETRICS: T.PodMetrics,
+    store_mod.CRONJOBS: T.CronJob,
 }
 
 # coordination.k8s.io/Lease (resourcelock) — registered so leader election

@@ -478,6 +478,7 @@ class Node:
     """Pruned v1.Node."""
     name: str
     labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
     # spec
     taints: tuple[Taint, ...] = ()
     unschedulable: bool = False
@@ -498,6 +499,7 @@ class Node:
     def clone(self) -> "Node":
         out = _shallow(self)
         out.labels = dict(self.labels)
+        out.annotations = dict(self.annotations)
         out.allocatable = dict(self.allocatable)
         return out
 
@@ -618,6 +620,9 @@ class Job:
     parallelism: int = 1
     backoff_limit: int = 6
     ttl_seconds_after_finished: Optional[float] = None
+    # controller owner reference (kind, name, uid) — the CronJob controller
+    # claims its Jobs through this, like pods carry owner_ref
+    owner_ref: Optional[tuple] = None
     # status
     active: int = 0
     succeeded: int = 0
@@ -667,6 +672,72 @@ class StatefulSet:
     # status
     current_replicas: int = 0
     ready_replicas: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """Pruned autoscaling/v1.HorizontalPodAutoscaler (reference:
+    pkg/apis/autoscaling/types.go; controller
+    pkg/controller/podautoscaler/horizontal.go): CPU-utilization-driven
+    scaling of a workload's replica count."""
+    name: str
+    namespace: str = "default"
+    # scaleTargetRef — (kind, name); Deployment is the supported target
+    scale_target_ref: tuple[str, str] = ("Deployment", "")
+    min_replicas: int = 1
+    max_replicas: int = 10
+    # targetCPUUtilizationPercentage: desired avg usage / request percent
+    target_cpu_utilization: int = 80
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    current_cpu_utilization: Optional[int] = None
+    last_scale_time: Optional[float] = None
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class PodMetrics:
+    """metrics.k8s.io PodMetrics stand-in (the metrics-server feed the HPA
+    reads): per-pod CPU usage in millicores, keyed like the pod."""
+    name: str
+    namespace: str = "default"
+    cpu_usage: int = 0                     # millicores
+    window: float = 30.0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class CronJob:
+    """Pruned batch/v1beta1.CronJob (reference: pkg/apis/batch/types.go;
+    controller pkg/controller/cronjob/cronjob_controller.go): creates Jobs
+    on a 5-field cron schedule."""
+    name: str
+    namespace: str = "default"
+    schedule: str = "* * * * *"
+    template: Optional[PodTemplate] = None
+    completions: int = 1
+    parallelism: int = 1
+    suspend: bool = False
+    # Allow | Forbid | Replace (cronjob_controller.go concurrencyPolicy)
+    concurrency_policy: str = "Allow"
+    starting_deadline_seconds: Optional[float] = None
+    # status
+    last_schedule_time: Optional[float] = None
+    creation_time: Optional[float] = None
     resource_version: int = 0
 
     @property

@@ -332,10 +332,13 @@ class NodeRestriction:
         node = self._node_of(user)
         if node is None:
             return
-        if kind == PODS and getattr(obj, "node_name", "") not in ("", node):
+        if kind == PODS and getattr(obj, "node_name", "") != node:
+            # ONLY pods bound to this node — an unbound pod is the
+            # scheduler's, not any kubelet's, so a stolen node credential
+            # can't drain the pending queue
             raise AdmissionError(
                 f"node {node!r} is not allowed to delete pods bound to "
-                f"node {obj.node_name!r}")
+                f"node {obj.node_name or '<none>'!r}")
         if kind == NODES and obj.name != node:
             raise AdmissionError(
                 f"node {node!r} is not allowed to delete node {obj.name!r}")

@@ -26,6 +26,10 @@ from kubernetes_tpu.controllers.namespace import (
     NamespaceController, ServiceAccountController,
 )
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
+from kubernetes_tpu.controllers.cronjob import CronJobController
+from kubernetes_tpu.controllers.ttl import TTLController
+from kubernetes_tpu.controllers.pvbinder import PersistentVolumeBinder
 
 # name -> constructor(store) (NewControllerInitializers analog,
 # controllermanager.go:372-412). Ordering matters for single-threaded
@@ -36,6 +40,10 @@ CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "disruption": DisruptionController,
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
+    "ttl": TTLController,
+    "persistentvolume-binder": PersistentVolumeBinder,
+    "horizontalpodautoscaling": HorizontalPodAutoscalerController,
+    "cronjob": CronJobController,
     "deployment": DeploymentController,
     "replicaset": ReplicaSetController,
     "job": JobController,

@@ -95,10 +95,6 @@ class NominatedPodMap:
     def pods_for_node(self, node_name: str) -> list[Pod]:
         return list(self._by_node.get(node_name, []))
 
-    def items(self):
-        """(node_name, pods) pairs — the device ghost-fold iterates these."""
-        return self._by_node.items()
-
     def has_any(self) -> bool:
         return bool(self._by_node)
 

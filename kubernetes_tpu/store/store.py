@@ -47,6 +47,9 @@ JOBS = "jobs"  # batch run-to-completion (controllers.job)
 DAEMONSETS = "daemonsets"  # one-pod-per-node (controllers.daemonset)
 STATEFULSETS = "statefulsets"  # ordinal identities (controllers.statefulset)
 NAMESPACES = "namespaces"  # lifecycle owned by controllers.namespace
+HPAS = "horizontalpodautoscalers"  # autoscaling (controllers.hpa)
+PODMETRICS = "podmetrics"  # metrics.k8s.io stand-in (HPA's usage source)
+CRONJOBS = "cronjobs"  # batch schedules (controllers.cronjob)
 CONFIGMAPS = "configmaps"
 SECRETS = "secrets"
 SERVICEACCOUNTS = "serviceaccounts"

@@ -219,11 +219,15 @@ class TestServedAuth:
         store.create(NODES, mknode("n2"))
         store.create(PODS, mkpod("mine", node="n1"))
         store.create(PODS, mkpod("theirs", node="n2"))
+        store.create(PODS, mkpod("pending"))   # unbound: the scheduler's
         with self._serve(store) as srv:
             kubelet = RemoteStore(srv.url, token="kubelet-n1")
             kubelet.delete(PODS, "default/mine")        # own pod: allowed
             with pytest.raises(APIStatusError) as ei:
                 kubelet.delete(PODS, "default/theirs")
+            assert ei.value.code == 422
+            with pytest.raises(APIStatusError) as ei:
+                kubelet.delete(PODS, "default/pending")  # unbound: denied
             assert ei.value.code == 422
             with pytest.raises(APIStatusError):
                 kubelet.delete(NODES, "n2")

@@ -1,0 +1,409 @@
+"""HPA / CronJob / TTL / PV-binder controller tests (VERDICT r4 next #6:
+the four missing reference controllers — horizontal.go,
+cronjob_controller.go, ttl_controller.go, pv_controller.go)."""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container, CronJob, Deployment, HorizontalPodAutoscaler, LabelSelector,
+    Node, PersistentVolume, PersistentVolumeClaim, Pod, PodMetrics,
+    PodTemplate,
+)
+from kubernetes_tpu.store.store import (
+    Store, CRONJOBS, DEPLOYMENTS, HPAS, JOBS, NODES, PODMETRICS, PODS, PVCS,
+    PVS,
+)
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.cron import CronSchedule, CronParseError
+
+GI = 1024 ** 3
+
+
+def mknode(name):
+    return Node(name=name,
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
+
+
+def mkdep(name="web", replicas=3, cpu=200):
+    return Deployment(
+        name=name, replicas=replicas,
+        selector=LabelSelector.from_dict({"app": name}),
+        template=PodTemplate(labels={"app": name},
+                             containers=(Container.make(
+                                 name="c", requests={"cpu": cpu}),)))
+
+
+class TestCronSchedule:
+    @pytest.mark.parametrize("expr,ts,want", [
+        ("* * * * *", 0, True),
+        ("*/15 * * * *", 15 * 60, True),
+        ("*/15 * * * *", 16 * 60, False),
+        ("30 2 * * *", 2 * 3600 + 30 * 60, True),       # 02:30 Jan 1 1970
+        ("30 2 * * *", 3 * 3600, False),
+        ("0 0 1 1 *", 0, True),                          # Jan 1 midnight
+        ("0-10/5 * * * *", 5 * 60, True),
+        ("0-10/5 * * * *", 7 * 60, False),
+        ("* * * * 4", 0, True),     # 1970-01-01 was a Thursday (dow 4)
+        ("* * * * 5", 0, False),
+        ("* * 1 * 5", 0, True),     # dom OR dow when both restricted
+    ])
+    def test_matches(self, expr, ts, want):
+        assert CronSchedule(expr).matches(ts) is want, (expr, ts)
+
+    def test_next_after(self):
+        s = CronSchedule("*/10 * * * *")
+        assert s.next_after(0) == 600.0
+        assert s.next_after(599) == 600.0
+        assert s.next_after(600) == 1200.0
+
+    @pytest.mark.parametrize("expr", ["* * * *", "61 * * * *", "a * * * *",
+                                      "*/0 * * * *", "5-1 * * * *"])
+    def test_parse_errors(self, expr):
+        with pytest.raises(CronParseError):
+            CronSchedule(expr)
+
+    def test_sunday_as_7(self):
+        s = CronSchedule("* * * * 7")
+        # 1970-01-04 was a Sunday
+        assert s.matches(3 * 86400)
+
+
+class TestCronJobController:
+    def _mk(self, store, t0=0.0):
+        from kubernetes_tpu.controllers.cronjob import CronJobController
+        clock = FakeClock(t0)
+        return CronJobController(store, clock=clock), clock
+
+    def test_fires_on_schedule(self):
+        store = Store()
+        ctl, clock = self._mk(store, t0=30.0)
+        ctl.sync()
+        store.create(CRONJOBS, CronJob(
+            name="tick", schedule="*/10 * * * *",
+            template=PodTemplate(labels={"app": "tick"},
+                                 containers=(Container.make(
+                                     name="c", requests={"cpu": 50}),))))
+        ctl.pump()                      # first sight: cursor starts
+        assert store.list(JOBS)[0] == []
+        clock.step(600.0)               # crosses 10:00
+        ctl.pump()
+        jobs = store.list(JOBS)[0]
+        assert len(jobs) == 1 and jobs[0].name.startswith("tick-")
+        ctl.pump()                      # same minute: no duplicate
+        assert len(store.list(JOBS)[0]) == 1
+        clock.step(600.0)
+        ctl.pump()
+        assert len(store.list(JOBS)[0]) == 2
+
+    def test_forbid_and_replace_policies(self):
+        from kubernetes_tpu.controllers.cronjob import CronJobController
+        for policy, want_jobs in (("Forbid", 1), ("Replace", 1), ("Allow", 2)):
+            store = Store()
+            ctl, clock = self._mk(store, t0=30.0)
+            ctl.sync()
+            store.create(CRONJOBS, CronJob(
+                name="t", schedule="*/10 * * * *",
+                concurrency_policy=policy,
+                template=PodTemplate(labels={"app": "t"},
+                                     containers=(Container.make(
+                                         name="c", requests={"cpu": 50}),))))
+            ctl.pump()
+            clock.step(600.0)
+            ctl.pump()                  # first run (stays active: no kubelet)
+            clock.step(600.0)
+            ctl.pump()                  # second tick against an active job
+            jobs = store.list(JOBS)[0]
+            assert len(jobs) == want_jobs, policy
+            if policy == "Replace":
+                # the active first job was deleted, the new one remains
+                assert jobs[0].name.endswith(str(int(clock.now()) // 60))
+
+    def test_too_many_missed_resets_cursor(self):
+        store = Store()
+        ctl, clock = self._mk(store, t0=30.0)
+        ctl.sync()
+        store.create(CRONJOBS, CronJob(
+            name="t", schedule="* * * * *",
+            template=PodTemplate(labels={"app": "t"},
+                                 containers=(Container.make(
+                                     name="c", requests={"cpu": 50}),))))
+        ctl.pump()
+        clock.step(200 * 60.0)          # 200 missed minutes
+        ctl.pump()
+        assert store.list(JOBS)[0] == []   # reset, no catch-up storm
+        clock.step(60.0)
+        ctl.pump()
+        assert len(store.list(JOBS)[0]) == 1
+
+    def test_prefix_named_sibling_not_adopted(self):
+        """'build' must not adopt (or Replace-delete) 'build-nightly's
+        jobs: ownership is by owner_ref, not name prefix."""
+        store = Store()
+        ctl, clock = self._mk(store, t0=30.0)
+        ctl.sync()
+        tmpl = PodTemplate(labels={"app": "b"},
+                           containers=(Container.make(
+                               name="c", requests={"cpu": 50}),))
+        store.create(CRONJOBS, CronJob(name="build", schedule="*/10 * * * *",
+                                       concurrency_policy="Replace",
+                                       template=tmpl))
+        store.create(CRONJOBS, CronJob(name="build-nightly",
+                                       schedule="*/10 * * * *",
+                                       template=tmpl))
+        ctl.pump()
+        clock.step(600.0)
+        ctl.pump()
+        names = sorted(j.name for j in store.list(JOBS)[0])
+        assert len(names) == 2
+        clock.step(600.0)
+        ctl.pump()      # build's Replace must only replace build's OWN job
+        jobs = store.list(JOBS)[0]
+        nightly = [j for j in jobs
+                   if j.owner_ref[:2] == ("CronJob", "build-nightly")]
+        mine = [j for j in jobs if j.owner_ref[:2] == ("CronJob", "build")]
+        assert len(nightly) == 2   # Allow policy ran twice, none replaced
+        assert len(mine) == 1      # Replace swapped build's own job only
+
+    def test_suspend(self):
+        store = Store()
+        ctl, clock = self._mk(store, t0=30.0)
+        ctl.sync()
+        store.create(CRONJOBS, CronJob(
+            name="t", schedule="* * * * *", suspend=True,
+            template=PodTemplate(labels={"app": "t"},
+                                 containers=(Container.make(
+                                     name="c", requests={"cpu": 50}),))))
+        ctl.pump()
+        clock.step(300.0)
+        ctl.pump()
+        assert store.list(JOBS)[0] == []
+
+
+class TestTTLController:
+    def _sizes(self, store, n, prefix="n"):
+        for i in range(n):
+            store.create(NODES, mknode(f"{prefix}{i}"))
+
+    def test_annotates_by_cluster_size(self):
+        from kubernetes_tpu.controllers.ttl import (TTLController,
+                                                    TTL_ANNOTATION)
+        store = Store()
+        self._sizes(store, 5)
+        ctl = TTLController(store)
+        ctl.sync()
+        assert all(n.annotations[TTL_ANNOTATION] == "0"
+                   for n in store.list(NODES)[0])
+        # grow past the first boundary (sizeMax 100)
+        self._sizes(store, 120, prefix="m")
+        ctl.pump()
+        assert all(n.annotations[TTL_ANNOTATION] == "15"
+                   for n in store.list(NODES)[0])
+
+    def test_hysteresis(self):
+        from kubernetes_tpu.controllers.ttl import (TTLController,
+                                                    TTL_ANNOTATION)
+        store = Store()
+        self._sizes(store, 120)
+        ctl = TTLController(store)
+        ctl.sync()
+        assert store.list(NODES)[0][0].annotations[TTL_ANNOTATION] == "15"
+        # dip to 95: inside the hysteresis band (sizeMin 90) — stays 15
+        for i in range(95, 120):
+            store.delete(NODES, f"n{i}")
+        ctl.pump()
+        assert store.list(NODES)[0][0].annotations[TTL_ANNOTATION] == "15"
+        # drop below sizeMin 90: steps back down to 0
+        for i in range(85, 95):
+            store.delete(NODES, f"n{i}")
+        ctl.pump()
+        assert store.list(NODES)[0][0].annotations[TTL_ANNOTATION] == "0"
+
+
+class TestPersistentVolumeBinder:
+    def _mk(self, store):
+        from kubernetes_tpu.controllers.pvbinder import PersistentVolumeBinder
+        return PersistentVolumeBinder(store)
+
+    def test_binds_smallest_fitting_pv(self):
+        store = Store()
+        store.create(PVS, PersistentVolume(name="big", capacity=100 * GI))
+        store.create(PVS, PersistentVolume(name="small", capacity=10 * GI))
+        ctl = self._mk(store)
+        ctl.sync()
+        store.create(PVCS, PersistentVolumeClaim(name="c1", request=5 * GI))
+        ctl.pump()
+        pvc = store.get(PVCS, "default/c1")
+        assert pvc.volume_name == "small"
+        assert store.get(PVS, "small").claim_ref == "default/c1"
+        assert store.get(PVS, "big").claim_ref == ""
+
+    def test_pending_until_pv_appears(self):
+        store = Store()
+        ctl = self._mk(store)
+        ctl.sync()
+        store.create(PVCS, PersistentVolumeClaim(name="c1", request=GI))
+        ctl.pump()
+        assert store.get(PVCS, "default/c1").volume_name == ""
+        store.create(PVS, PersistentVolume(name="pv1", capacity=2 * GI))
+        ctl.pump()     # the PV event re-dirties pending claims
+        assert store.get(PVCS, "default/c1").volume_name == "pv1"
+
+    def test_storage_class_and_capacity_filters(self):
+        store = Store()
+        store.create(PVS, PersistentVolume(name="fast", capacity=10 * GI,
+                                           storage_class="ssd"))
+        store.create(PVS, PersistentVolume(name="tiny", capacity=1 * GI))
+        ctl = self._mk(store)
+        ctl.sync()
+        store.create(PVCS, PersistentVolumeClaim(name="c1", request=5 * GI))
+        ctl.pump()
+        # no classless PV is big enough; the ssd one is class-mismatched
+        assert store.get(PVCS, "default/c1").volume_name == ""
+
+    def test_released_pv_not_rebound(self):
+        """Retain reclaim: a PV whose claim was deleted stays Released."""
+        store = Store()
+        store.create(PVS, PersistentVolume(name="pv1", capacity=2 * GI))
+        ctl = self._mk(store)
+        ctl.sync()
+        store.create(PVCS, PersistentVolumeClaim(name="c1", request=GI))
+        ctl.pump()
+        assert store.get(PVCS, "default/c1").volume_name == "pv1"
+        store.delete(PVCS, "default/c1")
+        store.create(PVCS, PersistentVolumeClaim(name="c2", request=GI))
+        ctl.pump()
+        assert store.get(PVCS, "default/c2").volume_name == ""
+        assert store.get(PVS, "pv1").claim_ref == "default/c1"  # Released
+
+    def test_pvc_binds_outside_scheduling_cycle(self):
+        """The VERDICT gap: nothing reconciled unbound PVCs outside a
+        scheduling cycle. Now a pod whose PVC the binder already bound
+        schedules via the BOUND path (NoVolumeZoneConflict et al.), no
+        scheduler-side assume needed."""
+        from kubernetes_tpu.api.types import VolumeSource
+        from kubernetes_tpu.scheduler import Scheduler
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        store.create(PVS, PersistentVolume(name="pv1", capacity=10 * GI))
+        ctl = self._mk(store)
+        ctl.sync()
+        store.create(PVCS, PersistentVolumeClaim(name="data", request=GI))
+        ctl.pump()
+        assert store.get(PVCS, "default/data").volume_name == "pv1"
+        sched = Scheduler(store, use_tpu=False,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        store.create(PODS, Pod(
+            name="p1", volumes=(VolumeSource(name="v", pvc="data"),),
+            containers=(Container.make(name="c", requests={"cpu": 100}),)))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert store.get(PODS, "default/p1").node_name == "n1"
+
+
+class TestHPAController:
+    def _mk(self, store, t0=1000.0):
+        from kubernetes_tpu.controllers.hpa import (
+            HorizontalPodAutoscalerController)
+        clock = FakeClock(t0)
+        return HorizontalPodAutoscalerController(store, clock=clock), clock
+
+    def _world(self, store, replicas=3, cpu_req=200):
+        store.create(DEPLOYMENTS, mkdep(replicas=replicas, cpu=cpu_req))
+        for i in range(replicas):
+            store.create(PODS, Pod(
+                name=f"web-{i}", labels={"app": "web"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": cpu_req}),)))
+        store.create(HPAS, HorizontalPodAutoscaler(
+            name="web", scale_target_ref=("Deployment", "web"),
+            min_replicas=1, max_replicas=10, target_cpu_utilization=50))
+
+    def _feed(self, store, usage_milli):
+        for i in range(len([p for p in store.list(PODS)[0]])):
+            key = f"web-{i}"
+            try:
+                store.get(PODMETRICS, f"default/{key}")
+                store.guaranteed_update(
+                    PODMETRICS, f"default/{key}",
+                    lambda m: (setattr(m, "cpu_usage", usage_milli), m)[1])
+            except Exception:
+                store.create(PODMETRICS, PodMetrics(name=key,
+                                                    cpu_usage=usage_milli))
+
+    def test_scales_up_on_high_utilization(self):
+        store = Store()
+        ctl, clock = self._mk(store)
+        ctl.sync()
+        self._world(store, replicas=3, cpu_req=200)
+        self._feed(store, 200)   # 100% of request vs 50% target -> ratio 2
+        ctl.pump()
+        dep = store.get(DEPLOYMENTS, "default/web")
+        assert dep.replicas == 6
+        hpa = store.get(HPAS, "default/web")
+        assert hpa.desired_replicas == 6
+        assert hpa.current_cpu_utilization == 100
+        assert hpa.last_scale_time == clock.now()
+
+    def test_tolerance_band_holds_replicas(self):
+        store = Store()
+        ctl, clock = self._mk(store)
+        ctl.sync()
+        self._world(store, replicas=4, cpu_req=200)
+        self._feed(store, 105)   # 52.5% vs 50% target: ratio 1.05 < 1.1
+        ctl.pump()
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 4
+
+    def test_scales_down_and_clamps(self):
+        store = Store()
+        ctl, clock = self._mk(store)
+        ctl.sync()
+        self._world(store, replicas=8, cpu_req=200)
+        self._feed(store, 10)    # 5% vs 50%: ratio 0.1 -> ceil(0.8) = 1
+        ctl.pump()
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 1
+        # and the max clamp
+        self._feed(store, 2000)  # ratio 20 -> clamped to max 10
+        ctl.pump()
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 10
+
+    def test_end_to_end_scale_then_schedule(self):
+        """The VERDICT done criterion: metrics source -> HPA scales the
+        Deployment -> the deployment/replicaset controllers stamp pods ->
+        the TPU burst schedules the delta."""
+        from kubernetes_tpu.controllers.manager import ControllerManager
+        from kubernetes_tpu.scheduler import Scheduler
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        mgr = ControllerManager(store, enabled=[
+            "horizontalpodautoscaling", "deployment", "replicaset"])
+        mgr.sync()
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+
+        def settle():
+            for _ in range(8):
+                mgr.pump()
+                sched.pump()
+                while sched.schedule_burst(max_pods=32):
+                    pass
+                sched.pump()
+        store.create(DEPLOYMENTS, mkdep(replicas=2, cpu=200))
+        store.create(HPAS, HorizontalPodAutoscaler(
+            name="web", scale_target_ref=("Deployment", "web"),
+            min_replicas=1, max_replicas=8, target_cpu_utilization=50))
+        settle()
+        pods = [p for p in store.list(PODS)[0] if not p.deleted]
+        assert len(pods) == 2 and all(p.node_name for p in pods)
+        # saturate: every pod at 150% of request
+        for p in pods:
+            store.create(PODMETRICS, PodMetrics(name=p.name,
+                                                cpu_usage=300))
+        settle()
+        pods = [p for p in store.list(PODS)[0] if not p.deleted]
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 6
+        assert len(pods) == 6
+        assert all(p.node_name for p in pods), "TPU burst scheduled the delta"
