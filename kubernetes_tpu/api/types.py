@@ -621,8 +621,9 @@ class Job:
     backoff_limit: int = 6
     ttl_seconds_after_finished: Optional[float] = None
     # controller owner reference (kind, name, uid) — the CronJob controller
-    # claims its Jobs through this, like pods carry owner_ref
-    owner_ref: Optional[tuple] = None
+    # claims its Jobs through this, like pods carry owner_ref; the typed
+    # tuple matters: serde rebuilds tuple[str, str, str] from JSON lists
+    owner_ref: Optional[tuple[str, str, str]] = None
     # status
     active: int = 0
     succeeded: int = 0

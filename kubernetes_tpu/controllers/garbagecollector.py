@@ -15,7 +15,7 @@ from __future__ import annotations
 from kubernetes_tpu.store.informer import InformerFactory
 from kubernetes_tpu.store.store import (
     Store, PODS, REPLICASETS, DEPLOYMENTS, JOBS, DAEMONSETS, STATEFULSETS,
-    NotFoundError,
+    CRONJOBS, NotFoundError,
 )
 
 # owner kind name (as written in owner_ref[0]) -> store kind
@@ -25,9 +25,10 @@ OWNER_KINDS = {
     "Job": JOBS,
     "DaemonSet": DAEMONSETS,
     "StatefulSet": STATEFULSETS,
+    "CronJob": CRONJOBS,
 }
 # kinds whose objects may carry owner_ref (the dependents we scan)
-DEPENDENT_KINDS = (PODS, REPLICASETS)
+DEPENDENT_KINDS = (PODS, REPLICASETS, JOBS)
 
 
 class GarbageCollector:

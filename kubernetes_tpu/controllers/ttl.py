@@ -31,6 +31,7 @@ class TTLController(DirtyKeyController):
     def __init__(self, store: Store, clock=None):
         super().__init__(store, clock=clock)
         self._boundary = 0   # current index; moves with hysteresis
+        self._want: int | None = None   # computed once per pump/sync
 
     def _desired_ttl(self) -> int:
         size = len(self.informers.informer(NODES).list())
@@ -62,7 +63,12 @@ class TTLController(DirtyKeyController):
         self.reconcile_dirty()
 
     def reconcile(self, node: Node) -> None:
-        want = str(getattr(self, "_want", self._desired_ttl()))
+        # pump()/sync() computed _want once; recompute only when reconcile
+        # is driven some other way (getattr's eager default would re-list
+        # all nodes per node — O(N^2) on a boundary step)
+        if self._want is None:
+            self._want = self._desired_ttl()
+        want = str(self._want)
         if node.annotations.get(TTL_ANNOTATION) == want:
             return
 

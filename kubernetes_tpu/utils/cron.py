@@ -43,11 +43,13 @@ def _parse_field(spec: str, lo: int, hi: int, dow: bool = False) -> frozenset:
                 a = b = int(part)
             except ValueError:
                 raise CronParseError(f"bad value {part!r}")
-        if dow:
-            a, b = (0 if a == 7 else a), (0 if b == 7 else b)
-        if not (lo <= a <= hi and lo <= b <= hi and a <= b):
+        top = 7 if dow else hi   # dow accepts 7 (= Sunday) anywhere
+        if not (lo <= a <= top and lo <= b <= top and a <= b):
             raise CronParseError(f"value out of range: {part!r}")
-        out.update(range(a, b + 1, step))
+        vals = range(a, b + 1, step)
+        # normalize AFTER expanding so ranges through 7 work ('1-7', '5-7',
+        # '0-7' all mean what vixie/robfig cron mean)
+        out.update(v % 7 for v in vals) if dow else out.update(vals)
     return frozenset(out)
 
 
@@ -63,8 +65,9 @@ class CronSchedule:
         self.dom = _parse_field(fields[2], *_BOUNDS[2])
         self.month = _parse_field(fields[3], *_BOUNDS[3])
         self.dow = _parse_field(fields[4], *_BOUNDS[4], dow=True)
-        self._dom_star = fields[2] == "*"
-        self._dow_star = fields[4] == "*"
+        # robfig/vixie treat '*' AND '*/n' as star for the dom/dow OR rule
+        self._dom_star = fields[2].split("/", 1)[0] == "*"
+        self._dow_star = fields[4].split("/", 1)[0] == "*"
 
     def matches(self, ts: float) -> bool:
         t = time.gmtime(ts)

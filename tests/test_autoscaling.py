@@ -66,6 +66,53 @@ class TestCronSchedule:
         # 1970-01-04 was a Sunday
         assert s.matches(3 * 86400)
 
+    def test_dow_ranges_through_seven(self):
+        # vixie semantics: 0-7 and 1-7 are every day; 5-7 is Fri/Sat/Sun
+        assert CronSchedule("* * * * 0-7").dow == frozenset(range(7))
+        assert CronSchedule("* * * * 1-7").dow == frozenset(range(7))
+        assert CronSchedule("* * * * 5-7").dow == frozenset({5, 6, 0})
+
+    def test_star_step_counts_as_star_for_or_rule(self):
+        # robfig: '*/2' in dom keeps AND semantics with a restricted dow
+        s = CronSchedule("0 0 */2 * 4")        # odd days AND Thursdays
+        assert s.matches(0)                    # Thu Jan 1 1970
+        assert not s.matches(86400)            # Fri Jan 2: dom ok, dow no
+
+    def test_job_owner_ref_survives_serde(self):
+        """The remote transport must preserve the typed owner tuple or
+        Forbid/Replace degrade to Allow over HTTP."""
+        from kubernetes_tpu.api import serde
+        from kubernetes_tpu.api.types import Job
+        j = Job(name="b-10", owner_ref=("CronJob", "b", ""))
+        back = serde.from_dict("jobs", serde.to_dict(j))
+        assert back.owner_ref == ("CronJob", "b", "")
+        assert isinstance(back.owner_ref, tuple)
+
+    def test_gc_cascades_cronjob_to_jobs(self):
+        """Deleting a CronJob garbage-collects its owned Jobs (and their
+        pods cascade through the existing Job edge)."""
+        from kubernetes_tpu.controllers.cronjob import CronJobController
+        from kubernetes_tpu.controllers.garbagecollector import (
+            GarbageCollector)
+        store = Store()
+        clock = FakeClock(30.0)
+        ctl = CronJobController(store, clock=clock)
+        ctl.sync()
+        gc = GarbageCollector(store)
+        gc.sync()
+        store.create(CRONJOBS, CronJob(
+            name="t", schedule="*/10 * * * *",
+            template=PodTemplate(labels={"app": "t"},
+                                 containers=(Container.make(
+                                     name="c", requests={"cpu": 50}),))))
+        ctl.pump()
+        clock.step(600.0)
+        ctl.pump()
+        assert len(store.list(JOBS)[0]) == 1
+        store.delete(CRONJOBS, "default/t")
+        gc.pump()
+        assert store.list(JOBS)[0] == []
+
 
 class TestCronJobController:
     def _mk(self, store, t0=0.0):

@@ -1,0 +1,292 @@
+"""Chaos tests — crash-recovery COMPOSITION (chaosmonkey-lite).
+
+The recovery mechanisms each have unit tests (assume-TTL expiry, backoff
+re-queue, leader election, watch resume); these prove they compose, the
+reference's crash contract (stateless rebuild: factory.go:643 re-queue,
+cache.go:632 TTL expiry, re-list on restart — test/e2e/chaosmonkey):
+
+- a scheduler killed BETWEEN assume and bind leaves no trace: a fresh
+  scheduler against the same store converges with every pod bound exactly
+  once and node capacity respected;
+- a failed bind write forgets the assumption and re-queues with backoff —
+  nothing lost, nothing double-bound;
+- leader failover mid-workload: the standby takes the lease after expiry
+  and finishes the job;
+- an apiserver restart mid-workload: the remote-attached scheduler's
+  watches resume and the workload completes.
+"""
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, NODES, PODS
+from kubernetes_tpu.utils.clock import FakeClock
+
+GI = 1024 ** 3
+
+
+def mknode(name, cpu=2000):
+    return Node(name=name,
+                allocatable={"cpu": cpu, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, cpu, priority=0):
+    return Pod(name=name, priority=priority,
+               containers=(Container.make(name="c", requests={"cpu": cpu}),))
+
+
+def assert_consistent(store, expect_bound=None):
+    """The no-lost/no-duplicate invariant: every pod has at most one
+    binding, bound pods' requests fit their node's allocatable, and (when
+    given) exactly `expect_bound` pods are bound."""
+    pods, _ = store.list(PODS)
+    nodes = {n.name: n for n in store.list(NODES)[0]}
+    used: dict[str, int] = {}
+    for p in pods:
+        if not p.node_name:
+            continue
+        assert p.node_name in nodes, f"{p.key} bound to unknown node"
+        req = sum(dict(c.requests).get("cpu", 0) for c in p.containers)
+        used[p.node_name] = used.get(p.node_name, 0) + req
+    for name, total in used.items():
+        assert total <= nodes[name].allocatable["cpu"], \
+            f"{name} oversubscribed: {total}"
+    if expect_bound is not None:
+        bound = sum(1 for p in pods if p.node_name)
+        assert bound == expect_bound, f"bound {bound} != {expect_bound}"
+
+
+def drain(sched, burst=0):
+    if burst:
+        while sched.schedule_burst(max_pods=burst):
+            pass
+    else:
+        while sched.schedule_one(timeout=0.0):
+            pass
+
+
+class TestCrashBetweenAssumeAndBind:
+    @pytest.mark.parametrize("use_tpu", [False, True])
+    @pytest.mark.parametrize("seed", [1, 9, 42])
+    def test_fresh_scheduler_converges(self, seed, use_tpu):
+        """Scheduler A assumes pods but dies before ANY bind write lands
+        (its in-memory cache vanishes with it). Scheduler B re-lists the
+        same store: every pod is still Pending there, so B schedules all
+        of them — exactly once, within capacity."""
+        rng = random.Random(seed)
+        store = Store(watch_log_size=65536)
+        n_nodes = rng.randint(3, 6)
+        for i in range(n_nodes):
+            store.create(NODES, mknode(f"n{i}"))
+        n_pods = rng.randint(6, 14)
+        for j in range(n_pods):
+            store.create(PODS, mkpod(f"p{j}", rng.choice([100, 300, 500])))
+
+        a = Scheduler(store, use_tpu=use_tpu,
+                      percentage_of_nodes_to_score=100)
+        a.sync()
+        a.pump()
+        # A dies between assume and bind: the bind write never happens
+        a._bind = lambda *args, **kw: None
+        for _ in range(rng.randint(1, n_pods)):
+            a.schedule_one(timeout=0.0)
+        assert any(not p.node_name for p in store.list(PODS)[0])
+        del a   # the crash: assumed state was only in A's cache
+
+        b = Scheduler(store, use_tpu=use_tpu,
+                      percentage_of_nodes_to_score=100)
+        b.sync()
+        b.pump()
+        drain(b, burst=16 if use_tpu else 0)
+        b.pump()
+        assert_consistent(store, expect_bound=n_pods)
+
+    def test_mixed_crash_states(self):
+        """Three pods die in three states: assumed-not-bound (no store
+        write), bound-but-not-finished (bind landed, FinishBinding never
+        ran), fully bound. The fresh scheduler binds only the first."""
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n0", cpu=4000))
+        for j in range(3):
+            store.create(PODS, mkpod(f"p{j}", 500))
+        a = Scheduler(store, use_tpu=False, percentage_of_nodes_to_score=100)
+        a.sync()
+        a.pump()
+        a.schedule_one(timeout=0.0)            # p? fully bound
+        orig_finish = a.cache.finish_binding
+        a.cache.finish_binding = lambda pod: None
+        a.schedule_one(timeout=0.0)            # bound, never finished
+        a.cache.finish_binding = orig_finish
+        a._bind = lambda *args, **kw: None
+        a.schedule_one(timeout=0.0)            # assumed only
+        bound_before = {p.name for p in store.list(PODS)[0] if p.node_name}
+        assert len(bound_before) == 2
+        del a
+
+        b = Scheduler(store, use_tpu=False, percentage_of_nodes_to_score=100)
+        b.sync()
+        b.pump()
+        drain(b)
+        b.pump()
+        assert_consistent(store, expect_bound=3)
+        # the two pods bound before the crash kept their bindings
+        for p in store.list(PODS)[0]:
+            if p.name in bound_before:
+                assert p.node_name == "n0"
+
+
+class TestFailedBindRecovery:
+    def test_bind_failure_forgets_and_requeues(self):
+        """The bind write fails once (store hiccup): ForgetPod releases
+        the assumption, the pod re-queues with backoff, and the retry
+        binds — nothing lost, capacity accounted once."""
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n0"))
+        store.create(PODS, mkpod("p0", 500))
+        sched = Scheduler(store, use_tpu=False, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+        real_bind = store.bind_pod
+        calls = {"n": 0}
+
+        def flaky_bind(key, node):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("store write failed")
+            return real_bind(key, node)
+        store.bind_pod = flaky_bind
+        drain(sched)
+        sched.pump()
+        # the pod waits in the unschedulableQ for the 60s leftover flush
+        # (scheduling_queue.go:52) plus backoff; step well past both
+        for _ in range(12):
+            clock.step(61.0)
+            sched.pump()
+            drain(sched)
+            sched.pump()
+            if store.get(PODS, "default/p0").node_name:
+                break
+        assert store.get(PODS, "default/p0").node_name == "n0"
+        assert calls["n"] >= 2      # the failed write really happened
+        assert_consistent(store, expect_bound=1)
+
+    def test_assume_ttl_releases_ghost_capacity(self):
+        """A binding whose store write was LOST after FinishBinding (so no
+        informer confirmation ever arrives) pins phantom capacity; the 30s
+        assume-TTL (cache.go:632) releases it so a later pod fits. (An
+        assumed pod whose binding never FINISHED deliberately never
+        expires — cache.go:644 skips it, exactly like the reference.)"""
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n0", cpu=1000))
+        store.create(PODS, mkpod("big", 800))
+        sched = Scheduler(store, use_tpu=False, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+
+        real_bind = sched._bind
+
+        def lost_write_bind(assumed, host, orig, cycle, ctx=None):
+            sched.cache.finish_binding(assumed)   # TTL starts...
+            # ...but the store write vanished: no confirm will ever come
+        sched._bind = lost_write_bind
+        drain(sched)
+        sched.pump()
+        sched._bind = real_bind                   # later binds are healthy
+        # phantom 800m assumed; a second 800m pod cannot fit now
+        store.create(PODS, mkpod("next", 800))
+        sched.pump()
+        drain(sched)
+        sched.pump()
+        assert store.get(PODS, "default/next").node_name == ""
+        clock.step(31.0)                       # TTL expiry
+        sched.cache.cleanup_assumed_pods()
+        sched.queue.move_all_to_active()
+        sched.pump()
+        drain(sched)
+        sched.pump()
+        assert store.get(PODS, "default/next").node_name == "n0"
+
+
+class TestLeaderFailoverMidWorkload:
+    def test_standby_finishes_the_job(self):
+        from kubernetes_tpu.utils.leader_election import (
+            LeaderElector, LeaderElectionConfig)
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        for j in range(12):
+            store.create(PODS, mkpod(f"p{j}", 300))
+
+        ea = LeaderElector(store, LeaderElectionConfig(
+            identity="a", lease_duration=15.0), clock=clock)
+        eb = LeaderElector(store, LeaderElectionConfig(
+            identity="b", lease_duration=15.0), clock=clock)
+        assert ea.try_acquire_or_renew()
+        assert not eb.try_acquire_or_renew()
+
+        a = Scheduler(store, use_tpu=False, percentage_of_nodes_to_score=100)
+        a.sync()
+        a.pump()
+        for _ in range(5):                      # half the workload...
+            a.schedule_one(timeout=0.0)
+        a.pump()
+        del a                                   # ...then A crashes
+
+        # b keeps polling; it only goes active once the lease expires
+        clock.step(10.0)
+        assert not eb.try_acquire_or_renew()
+        clock.step(10.0)
+        assert eb.try_acquire_or_renew()        # 20s > 15s lease: takeover
+
+        b = Scheduler(store, use_tpu=False, percentage_of_nodes_to_score=100)
+        b.sync()
+        b.pump()
+        drain(b)
+        b.pump()
+        assert_consistent(store, expect_bound=12)
+
+
+class TestApiserverRestartMidWorkload:
+    def test_remote_scheduler_survives_restart(self):
+        """chaosmonkey for the transport: the apiserver dies and comes
+        back mid-workload; the remote scheduler's watches resume from
+        their resourceVersions and the rest of the pods bind."""
+        import time as _t
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store.remote import RemoteStore
+        store = Store(watch_log_size=65536)
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        for j in range(6):
+            store.create(PODS, mkpod(f"p{j}", 300))
+        srv = APIServer(store, port=0).start()
+        port = int(srv.url.rsplit(":", 1)[1])
+        sched = Scheduler(RemoteStore(srv.url), use_tpu=False,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        sched.pump()
+        for _ in range(3):
+            sched.schedule_one(timeout=0.0)
+        srv.stop()                              # the apiserver dies
+        store.create(PODS, mkpod("late", 300))  # written while it's down
+        srv2 = APIServer(store, port=port).start()
+        try:
+            deadline = _t.monotonic() + 30.0
+            while _t.monotonic() < deadline:
+                sched.pump()
+                drain(sched)
+                sched.pump()
+                pods, _ = store.list(PODS)
+                if all(p.node_name for p in pods):
+                    break
+                _t.sleep(0.05)
+            assert_consistent(store, expect_bound=7)
+        finally:
+            srv2.stop()
