@@ -50,11 +50,18 @@ class Histogram:
         self.sum = 0.0
 
     def observe(self, seconds: float) -> None:
-        self.count += 1
-        self.sum += seconds
+        self.observe_many(seconds, 1)
+
+    def observe_many(self, seconds: float, count: int) -> None:
+        """`count` identical observations in one pass — the burst commit
+        records its per-pod share without 10k bucket walks."""
+        if count <= 0:
+            return
+        self.count += count
+        self.sum += seconds * count
         for i, b in enumerate(self.BOUNDS):
             if seconds <= b:
-                self.buckets[i] += 1
+                self.buckets[i] += count
 
     def render(self, name: str, labels: str = "") -> list[str]:
         sep = "," if labels else ""
@@ -87,14 +94,19 @@ class SchedulerMetrics:
     binding_duration: "Histogram" = field(default_factory=lambda: Histogram())
     e2e_duration: "Histogram" = field(default_factory=lambda: Histogram())
 
-    def observe(self, result: str) -> None:
-        self.schedule_attempts[result] = self.schedule_attempts.get(result, 0) + 1
+    def observe(self, result: str, count: int = 1) -> None:
+        self.schedule_attempts[result] = \
+            self.schedule_attempts.get(result, 0) + count
 
-    def observe_phase(self, phase: str, seconds: float) -> None:
+    def observe_phase(self, phase: str, seconds: float,
+                      count: int = 1) -> None:
         h = self.phase_duration.get(phase)
         if h is None:
             h = self.phase_duration[phase] = Histogram()
-        h.observe(seconds)
+        if count == 1:
+            h.observe(seconds)
+        else:
+            h.observe_many(seconds, count)
 
 
 class Scheduler:
@@ -671,18 +683,7 @@ class Scheduler:
             # preempt — nominating a node and deleting victims — state the
             # discarded kernel decisions never saw).
             kf = hosts.index(None)
-        note = getattr(self.algorithm, "note_burst_assumed", None)
-        for pod, host, cycle in zip(pods[:kf], hosts[:kf], cycles[:kf]):
-            assumed = pod.clone()
-            assumed.node_name = host
-            self.cache.assume_pod(assumed)
-            if note is not None:
-                # the device scan already folded this delta: sync the host
-                # mirror + generation map so the next encode() skips the row
-                gen = self.cache.node_generation(host)
-                if gen is not None:
-                    note(assumed, host, gen)
-            self._bind(assumed, host, pod, cycle)  # observes "scheduled"
+        self._commit_burst(pods[:kf], hosts[:kf], cycles[:kf])
         # serial semantics consume one NodeTree enumeration per pod; the
         # kernel modeled cycles 0..kf-1 on the segment's single
         # enumeration — fast-forward the rest of the committed prefix
@@ -699,6 +700,82 @@ class Scheduler:
             for k in range(kf, len(pods)):
                 self._process_one(pods[k], cycles[k],
                                   names=tail_names if k == kf else None)
+
+    def _commit_burst(self, pods: list[Pod], hosts: list[str],
+                      cycles: list[int]) -> None:
+        """Commit a burst's decided prefix: assume + device-mirror sync per
+        pod, then ONE batched store write for all bindings, one batched
+        event write, and aggregated metrics — the per-pod lock/call
+        overhead of the serial bind path amortized across the burst
+        (VERDICT r4 weak #4: the 38us/pod host bind ceiling). Pods an
+        extender binder manages keep the per-pod path (extender-owned
+        writes can't batch through our store)."""
+        if not pods:
+            return
+        eb = self._extender_binder
+        if eb is not None and any(eb.is_interested(p) for p in pods):
+            for pod, host, cycle in zip(pods, hosts, cycles):
+                assumed = self._assume_for_burst(pod, host)
+                self._bind(assumed, host, pod, cycle)
+            return
+        t_bind = self.clock.now()
+        assumed_list = [self._assume_for_burst(pod, host)
+                        for pod, host in zip(pods, hosts)]
+        try:
+            missing = set(self.store.bind_pods(
+                [(a.key, h) for a, h in zip(assumed_list, hosts)]))
+        except Exception:
+            # a mid-batch store failure may have partially committed:
+            # resolve each pod by what actually landed — bound pods finish,
+            # the rest forget + re-queue, exactly like the serial _bind's
+            # per-pod failure handling
+            missing = set()
+            for assumed, host in zip(assumed_list, hosts):
+                try:
+                    landed = self.store.get(PODS, assumed.key)
+                except NotFoundError:
+                    missing.add(assumed.key)
+                    continue
+                if landed.node_name != host:
+                    missing.add(assumed.key)
+        bound = []
+        for assumed, pod, host, cycle in zip(assumed_list, pods, hosts,
+                                             cycles):
+            if assumed.key in missing:
+                # vanished between decision and commit: same handling as a
+                # failed bind write (_bind's fail path)
+                self.cache.forget_pod(assumed)
+                self.metrics.observe("error")
+                self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR,
+                                     f"{PODS}/{assumed.key}")
+                continue
+            self.cache.finish_binding(assumed)
+            bound.append((assumed, host))
+        k = len(bound)
+        if not k:
+            return
+        dt = self.clock.now() - t_bind
+        self.metrics.binding_count += k
+        self.metrics.binding_duration.observe_many(dt / k, k)
+        self.metrics.observe_phase("binding", dt / k, count=k)
+        self.metrics.observe("scheduled", count=k)
+        # audit records land in one store write (scheduler.go:433 per pod)
+        self.recorder.pod_events_batch([
+            (a, NORMAL, "Scheduled",
+             f"Successfully assigned {a.key} to {h}") for a, h in bound])
+
+    def _assume_for_burst(self, pod: Pod, host: str) -> Pod:
+        assumed = pod.clone()
+        assumed.node_name = host
+        self.cache.assume_pod(assumed)
+        note = getattr(self.algorithm, "note_burst_assumed", None)
+        if note is not None:
+            # the device scan already folded this delta: sync the host
+            # mirror + generation map so the next encode() skips the row
+            gen = self.cache.node_generation(host)
+            if gen is not None:
+                note(assumed, host, gen)
+        return assumed
 
     def _try_pressure_tail(self, pods: list[Pod], cycles: list[int],
                            names: list[str]) -> bool:

@@ -259,6 +259,18 @@ class RemoteStore:
         return self._request("POST", f"/api/v1/{PODS}/{pod_key}/binding",
                              {"node": node_name})
 
+    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
+        """Batch contract of Store.bind_pods over the wire: one POST per
+        binding (the REST surface has no batch verb, matching the
+        reference), missing pods reported back instead of raised."""
+        missing = []
+        for pod_key, node_name in bindings:
+            try:
+                self.bind_pod(pod_key, node_name)
+            except NotFoundError:
+                missing.append(pod_key)
+        return missing
+
     def guaranteed_update(self, kind: str, key: str,
                           mutate: Callable[[Any], Any],
                           allow_skip: bool = False) -> Any:

@@ -237,27 +237,31 @@ class Store:
             return self._rv
 
     # -- writes -------------------------------------------------------------
+    def _create_locked(self, kind: str, obj: Any, move: bool) -> Any:
+        """Single-entry create body; caller holds the lock. One snapshot
+        serves the bucket, the event log, and the return value: the store
+        NEVER mutates a stored object in place (every write replaces the
+        bucket entry), and consumers receive store objects read-only —
+        anything that mutates must clone() first, which every caller
+        (cache, queue, scheduler) already does."""
+        bucket = self._objs.setdefault(kind, {})
+        key = _key_of(obj)
+        if key in bucket:
+            raise AlreadyExistsError(f"{kind}/{key}")
+        stored = obj if move else _clone(obj)
+        self._rv += 1
+        stored.resource_version = self._rv
+        bucket[key] = stored
+        self._record_entry(kind, key, stored)
+        self._emit(Event(ADDED, kind, stored, self._rv))
+        return stored
+
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
         """`move=True` transfers ownership: the caller promises never to
         touch `obj` again, skipping the write snapshot (the event recorder's
         fire-and-forget records use this)."""
         with self._lock:
-            bucket = self._objs.setdefault(kind, {})
-            key = _key_of(obj)
-            if key in bucket:
-                raise AlreadyExistsError(f"{kind}/{key}")
-            stored = obj if move else _clone(obj)
-            self._rv += 1
-            stored.resource_version = self._rv
-            bucket[key] = stored
-            self._record_entry(kind, key, stored)
-            # one snapshot serves the bucket, the event log, and the return
-            # value: the store NEVER mutates a stored object in place (every
-            # write replaces the bucket entry), and consumers receive store
-            # objects read-only — anything that mutates must clone() first,
-            # which every caller (cache, queue, scheduler) already does
-            self._emit(Event(ADDED, kind, stored, self._rv))
-            return stored
+            return self._create_locked(kind, obj, move)
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
         with self._lock:
@@ -318,18 +322,48 @@ class Store:
         no CAS retry loop — one clone, one lock, one event."""
         with self._lock:
             bucket = self._objs.setdefault(PODS, {})
-            current = bucket.get(pod_key)
-            if current is None:
+            if not self._bind_locked(bucket, pod_key, node_name):
                 raise NotFoundError(f"{PODS}/{pod_key}")
-            self._check_entry(PODS, pod_key, current)
-            stored = _clone(current)
-            stored.node_name = node_name
-            self._rv += 1
-            stored.resource_version = self._rv
-            bucket[pod_key] = stored
-            self._record_entry(PODS, pod_key, stored)
-            self._emit(Event(MODIFIED, PODS, stored, self._rv))
-            return stored
+            return bucket[pod_key]
+
+    def _bind_locked(self, bucket, pod_key: str, node_name: str) -> bool:
+        """Single-binding body shared by bind_pod/bind_pods; caller holds
+        the lock. Returns False when the pod is gone."""
+        current = bucket.get(pod_key)
+        if current is None:
+            return False
+        self._check_entry(PODS, pod_key, current)
+        stored = _clone(current)
+        stored.node_name = node_name
+        self._rv += 1
+        stored.resource_version = self._rv
+        bucket[pod_key] = stored
+        self._record_entry(PODS, pod_key, stored)
+        self._emit(Event(MODIFIED, PODS, stored, self._rv))
+        return True
+
+    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
+        """Batch form of bind_pod for the burst prefix commit: ONE lock
+        acquisition for the whole burst instead of one per pod (the
+        per-binding semantics are _bind_locked's, identical to bind_pod).
+        Returns the keys that were missing (deleted between decision and
+        commit); the caller handles those like failed binds."""
+        missing = []
+        with self._lock:
+            bucket = self._objs.setdefault(PODS, {})
+            for pod_key, node_name in bindings:
+                if not self._bind_locked(bucket, pod_key, node_name):
+                    missing.append(pod_key)
+        return missing
+
+    def create_many(self, kind: str, objs: list, move: bool = False) -> None:
+        """Batch create under one lock (event records from a burst commit);
+        per-object semantics are _create_locked's, identical to create().
+        Raises on the first duplicate — callers pass fresh uniquely-named
+        objects."""
+        with self._lock:
+            for obj in objs:
+                self._create_locked(kind, obj, move)
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,

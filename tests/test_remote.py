@@ -273,6 +273,33 @@ class TestRemoteScheduler:
         got = sorted((p.key, p.node_name) for p in s_remote.list(PODS)[0])
         assert got == want
 
+    def test_burst_commit_over_http(self):
+        """The batched burst commit degrades to per-pod binding POSTs on
+        the remote transport (RemoteStore.bind_pods) — a remote-attached
+        TPU-burst scheduler binds everything."""
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        for j in range(10):
+            store.create(PODS, mkpod(f"p{j}", cpu=100))
+        from kubernetes_tpu.scheduler import Scheduler
+        with APIServer(store) as srv:
+            sched = Scheduler(RemoteStore(srv.url), use_tpu=True,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+
+            def all_bound():
+                sched.pump()
+                while sched.schedule_burst(max_pods=16):
+                    pass
+                pods, _ = store.list(PODS)
+                return all(p.node_name for p in pods)
+            assert wait_until(all_bound, timeout=60.0)
+        from kubernetes_tpu.store.store import EVENTS
+        scheduled = [e for e in store.list(EVENTS)[0]
+                     if e.reason == "Scheduled"]
+        assert len(scheduled) == 10   # batched events landed per pod
+
     def test_controller_manager_attaches_over_http(self):
         """The controller manager's whole surface (list / get / create /
         update / delete / guaranteed_update + informers) works over the
