@@ -491,6 +491,19 @@ class AdmissionChain:
                 obj = p.admit(kind, obj, store)
         return obj
 
+    def register_webhooks(self, webhook_admission) -> None:
+        """Insert a WebhookAdmission BEFORE ResourceQuotaAdmission: quota
+        must stay last (its admit commits usage, and only a store-write
+        failure — refunded by the caller — may follow a successful
+        charge; a webhook denial after the charge would leak it). The
+        reference's recommended order also runs the admission webhooks
+        before ResourceQuota."""
+        for i, p in enumerate(self.plugins):
+            if isinstance(p, ResourceQuotaAdmission):
+                self.plugins.insert(i, webhook_admission)
+                return
+        self.plugins.append(webhook_admission)
+
     def admit_binding(self, pod: Any, node_name: str, store: Store,
                       user: Optional[str] = None) -> None:
         """Admission for the pods/binding subresource (the scheduler's

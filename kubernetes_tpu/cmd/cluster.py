@@ -48,6 +48,11 @@ class Cluster:
         self.controllers = ControllerManager(self.store)
         self.kubelets = [HollowKubelet(self.store, node.name)
                          for node in self.store.list(NODES)[0]]
+        # one virtual proxier per node (kube-proxy at kubemark fidelity:
+        # HollowProxy) — endpoints propagate into per-node forwarding tables
+        from kubernetes_tpu.proxy.proxier import VirtualProxier
+        self.proxies = [VirtualProxier(self.store, node.name)
+                        for node in self.store.list(NODES)[0]]
         self.kubelet_interval = kubelet_interval
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
@@ -58,6 +63,8 @@ class Cluster:
             self.api.start()
         self.scheduler.sync()
         self.controllers.sync()
+        for p in self.proxies:
+            p.sync()
         self.kubelet_tick()
 
         def sched_loop():
@@ -76,7 +83,13 @@ class Cluster:
                 self.kubelet_tick()
                 self._stop.wait(self.kubelet_interval)
 
-        for fn in (sched_loop, controller_loop, kubelet_loop):
+        def proxy_loop():
+            while not self._stop.is_set():
+                for p in self.proxies:
+                    p.pump()
+                self._stop.wait(0.05)
+
+        for fn in (sched_loop, controller_loop, kubelet_loop, proxy_loop):
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             self._threads.append(t)

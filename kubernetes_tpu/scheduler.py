@@ -103,10 +103,7 @@ class SchedulerMetrics:
         h = self.phase_duration.get(phase)
         if h is None:
             h = self.phase_duration[phase] = Histogram()
-        if count == 1:
-            h.observe(seconds)
-        else:
-            h.observe_many(seconds, count)
+        h.observe_many(seconds, count)
 
 
 class Scheduler:
@@ -709,9 +706,17 @@ class Scheduler:
         overhead of the serial bind path amortized across the burst
         (VERDICT r4 weak #4: the 38us/pod host bind ceiling). Pods an
         extender binder manages keep the per-pod path (extender-owned
-        writes can't batch through our store)."""
+        writes can't batch through our store).
+
+        Invariant: bursts only form when NO reserve/permit/prebind plugins
+        are configured (schedule_burst's can_burst gate routes plugin-ful
+        workloads to the serial _process_one/_bind path), so skipping the
+        framework points here cannot skip real plugin work."""
         if not pods:
             return
+        assert not (self.framework.reserve or self.framework.permit
+                    or self.framework.prebind), \
+            "burst commit reached with framework plugins configured"
         eb = self._extender_binder
         if eb is not None and any(eb.is_interested(p) for p in pods):
             for pod, host, cycle in zip(pods, hosts, cycles):
@@ -733,7 +738,10 @@ class Scheduler:
             for assumed, host in zip(assumed_list, hosts):
                 try:
                     landed = self.store.get(PODS, assumed.key)
-                except NotFoundError:
+                except Exception:
+                    # gone OR unreachable: either way the binding can't be
+                    # confirmed — forget + re-queue (a pod that did land
+                    # re-syncs as bound when the informer catches up)
                     missing.add(assumed.key)
                     continue
                 if landed.node_name != host:

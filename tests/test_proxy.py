@@ -1,0 +1,140 @@
+"""kube-proxy analog tests (VERDICT r4 next #9): the endpoints flow now
+has a CONSUMER — per-node VirtualProxiers materialize Service backends
+into forwarding tables (pkg/proxy/iptables/proxier.go syncProxyRules at
+kubemark fidelity) and route() spreads virtual connections round-robin."""
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Container, Endpoints, Node, Pod, PodCondition, Service,
+)
+from kubernetes_tpu.controllers.endpoints import EndpointsController
+from kubernetes_tpu.proxy.proxier import VirtualProxier
+from kubernetes_tpu.store.store import Store, ENDPOINTS, NODES, PODS, SERVICES
+
+GI = 1024 ** 3
+
+
+def mknode(name):
+    return Node(name=name,
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, node="", labels=None, ready=True):
+    p = Pod(name=name, node_name=node, labels=labels or {"app": "web"},
+            containers=(Container.make(name="c", requests={"cpu": 100}),))
+    if not ready:
+        p.conditions = (PodCondition(type="Ready", status="False"),)
+    return p
+
+
+class TestProxierTable:
+    def test_rules_follow_endpoints(self):
+        store = Store()
+        store.create(SERVICES, Service(name="web", selector={"app": "web"}))
+        store.create(ENDPOINTS, Endpoints(
+            name="web", addresses=(("default/p1", "n1"),
+                                   ("default/p2", "n2"))))
+        prox = VirtualProxier(store, "n1")
+        prox.sync()
+        assert prox.backends("default/web") == (("default/p1", "n1"),
+                                                ("default/p2", "n2"))
+        # endpoint churn resyncs the table
+        store.guaranteed_update(
+            ENDPOINTS, "default/web",
+            lambda e: (setattr(e, "addresses", (("default/p2", "n2"),)), e)[1])
+        prox.pump()
+        assert prox.backends("default/web") == (("default/p2", "n2"),)
+
+    def test_service_without_endpoints_rejects(self):
+        store = Store()
+        store.create(SERVICES, Service(name="web", selector={"app": "web"}))
+        prox = VirtualProxier(store, "n1")
+        prox.sync()
+        assert prox.backends("default/web") == ()
+        assert prox.route("default/web") is None   # REJECT, like iptables
+
+    def test_route_round_robin(self):
+        store = Store()
+        store.create(SERVICES, Service(name="web", selector={"app": "web"}))
+        store.create(ENDPOINTS, Endpoints(
+            name="web", addresses=(("default/a", "n1"), ("default/b", "n2"),
+                                   ("default/c", "n3"))))
+        prox = VirtualProxier(store, "n1")
+        prox.sync()
+        picks = [prox.route("default/web")[0] for _ in range(6)]
+        assert picks == ["default/a", "default/b", "default/c"] * 2
+
+    def test_full_resync_semantics(self):
+        """Service deletion drops its chain entirely (the reference's
+        rebuild-everything sync, not incremental patching)."""
+        store = Store()
+        store.create(SERVICES, Service(name="web", selector={"app": "web"}))
+        store.create(ENDPOINTS, Endpoints(
+            name="web", addresses=(("default/a", "n1"),)))
+        prox = VirtualProxier(store, "n1")
+        prox.sync()
+        assert "default/web" in prox.rules()
+        store.delete(SERVICES, "default/web")
+        store.delete(ENDPOINTS, "default/web")
+        prox.pump()
+        assert prox.rules() == {}
+        assert prox.route("default/web") is None
+
+
+class TestEndpointsToProxyFlow:
+    def test_propagation_through_controller(self):
+        """Service -> ready pods -> endpoints controller -> every node's
+        forwarding table, including readiness filtering and pod removal."""
+        store = Store()
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        epc = EndpointsController(store)
+        epc.sync()
+        proxies = [VirtualProxier(store, f"n{i}") for i in range(3)]
+        for p in proxies:
+            p.sync()
+        store.create(SERVICES, Service(name="web", selector={"app": "web"}))
+        store.create(PODS, mkpod("p1", node="n0"))
+        store.create(PODS, mkpod("p2", node="n1"))
+        store.create(PODS, mkpod("unready", node="n2", ready=False))
+        store.create(PODS, mkpod("other", node="n2", labels={"app": "db"}))
+        epc.pump()
+        for p in proxies:
+            p.pump()
+            assert p.backends("default/web") == (("default/p1", "n0"),
+                                                 ("default/p2", "n1")), \
+                f"node {p.node_name} table diverged"
+        # pod deletion propagates to every table
+        store.delete(PODS, "default/p1")
+        epc.pump()
+        for p in proxies:
+            p.pump()
+            assert p.backends("default/web") == (("default/p2", "n1"),)
+
+    def test_cluster_in_a_process_flow(self):
+        """The whole pipeline through cluster.py: a Deployment's pods are
+        scheduled, run by hollow kubelets, collected into Endpoints, and
+        appear in every node's proxier — then route() balances across
+        them."""
+        from kubernetes_tpu.cmd.cluster import Cluster
+        from kubernetes_tpu.api.types import (Deployment, LabelSelector,
+                                              PodTemplate)
+        from kubernetes_tpu.store.store import DEPLOYMENTS
+        with Cluster(n_nodes=4, api_port=-1, use_tpu=False,
+                     kubelet_interval=0.02) as cluster:
+            cluster.store.create(SERVICES, Service(
+                name="web", selector={"app": "web"}))
+            cluster.store.create(DEPLOYMENTS, Deployment(
+                name="web", replicas=3,
+                selector=LabelSelector.from_dict({"app": "web"}),
+                template=PodTemplate(labels={"app": "web"},
+                                     containers=(Container.make(
+                                         name="c", requests={"cpu": 100}),))))
+
+            def propagated():
+                return all(len(p.backends("default/web")) == 3
+                           for p in cluster.proxies)
+            assert cluster.wait_for(propagated, timeout=30.0)
+            prox = cluster.proxies[0]
+            picks = {prox.route("default/web")[0] for _ in range(3)}
+            assert len(picks) == 3   # spread across all three backends
