@@ -384,6 +384,8 @@ class Pod:
     priority: int = 0            # resolved PriorityClass value
     priority_class_name: str = ""   # resolved by the priority admission plugin
     scheduler_name: str = "default-scheduler"
+    # defaulted to "default" by the serviceaccount admission plugin
+    service_account_name: str = ""
     volumes: tuple[VolumeSource, ...] = ()
     # status
     nominated_node_name: str = ""

@@ -434,6 +434,43 @@ class AntiAffinityAdmission:
         return self.admit(kind, new, store)
 
 
+class ServiceAccountAdmission:
+    """plugin/pkg/admission/serviceaccount/admission.go: default every pod
+    to the namespace's 'default' ServiceAccount and reject pods naming an
+    account that doesn't exist (the reference also mounts token volumes —
+    no volume dataplane exists in this model, so the identity half is the
+    faithful subset)."""
+
+    def admit(self, kind: str, obj: Any, store: Store,
+              user: Optional[str] = None) -> Any:
+        from kubernetes_tpu.store.store import SERVICEACCOUNTS, NotFoundError
+        if kind != PODS:
+            return obj
+        if not obj.service_account_name:
+            obj.service_account_name = "default"
+        try:
+            store.get(SERVICEACCOUNTS,
+                      f"{obj.namespace}/{obj.service_account_name}")
+        except NotFoundError:
+            # the reference retries for a short window to ride out the SA
+            # controller's default creation; our controller creates
+            # 'default' on namespace sight, so only a truly missing named
+            # account rejects (and a missing 'default' in a namespace the
+            # controller never saw admits — matching the reference's
+            # bootstrapping tolerance for the default account)
+            if obj.service_account_name != "default":
+                raise AdmissionError(
+                    f"service account {obj.namespace}/"
+                    f"{obj.service_account_name} does not exist")
+        return obj
+
+    def admit_update(self, kind: str, old: Any, new: Any, store: Store,
+                     user: Optional[str] = None) -> Any:
+        # a PUT must not smuggle in a nonexistent account (the chain runs
+        # admission on every write verb)
+        return self.admit(kind, new, store, user=user)
+
+
 class EventRateLimit:
     """plugin/pkg/admission/eventratelimit: a token bucket over event
     creates (server scope) so an event storm cannot swamp the store."""
@@ -478,8 +515,9 @@ class AdmissionChain:
         # the POD'S tolerations, not the cluster-injected NoExecute defaults
         self.plugins = plugins if plugins is not None else [
             NodeRestriction(), PriorityAdmission(),
-            PodTolerationRestriction(), AntiAffinityAdmission(),
-            EventRateLimit(), DefaultTolerationSeconds(), LimitRanger(),
+            ServiceAccountAdmission(), PodTolerationRestriction(),
+            AntiAffinityAdmission(), EventRateLimit(),
+            DefaultTolerationSeconds(), LimitRanger(),
             ResourceQuotaAdmission()]
 
     def admit(self, kind: str, obj: Any, store: Store,

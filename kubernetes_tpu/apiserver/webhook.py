@@ -78,7 +78,16 @@ class Webhook:
                 f"admission webhook {self.config.name!r} denied the "
                 f"request: {resp.get('message', '')}")
         if self.mutating and resp.get("patchedObject") is not None:
-            return serde.from_dict(kind, resp["patchedObject"])
+            patched = serde.from_dict(kind, resp["patchedObject"])
+            # a patch may not move or re-version the object: identity
+            # metadata is re-pinned from the pre-patch object (the
+            # reference rejects webhook mutations of immutable metadata;
+            # a zeroed resource_version would silently disable the PUT's
+            # optimistic-concurrency check)
+            for attr in ("name", "namespace", "resource_version"):
+                if hasattr(patched, attr):
+                    setattr(patched, attr, getattr(obj, attr))
+            return patched
         return obj
 
 
@@ -114,3 +123,12 @@ class WebhookAdmission:
     def admit_update(self, kind: str, old: Any, new: Any, store,
                      user: Optional[str] = None) -> Any:
         return self._run(kind, "UPDATE", new, old)
+
+    def admit_delete(self, kind: str, obj: Any, store,
+                     user: Optional[str] = None) -> None:
+        # DELETE reviews are validating-only (nothing to patch: the object
+        # is going away); a mutating registration matching DELETE is
+        # treated as validating, like the reference's DELETE reviews
+        for w in self.mutating + self.validating:
+            if w.config.matches(kind, "DELETE"):
+                w.review(kind, "DELETE", obj)

@@ -639,3 +639,40 @@ class TestAdmissionPutBypassesClosed:
         code, _ = helper._put(url, "pods", shrink)
         assert code == 200
         assert store.get(RESOURCEQUOTAS, "default/q").used["cpu"] == 300
+
+
+class TestServiceAccountAdmission:
+    """plugin/pkg/admission/serviceaccount: pods default to the namespace's
+    'default' account; a named account must exist."""
+
+    def _serve(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        store = Store()
+        return store, APIServer(store)
+
+    def test_defaults_to_default_account(self):
+        from kubernetes_tpu.store.remote import RemoteStore
+        store, srv = self._serve()
+        with srv:
+            RemoteStore(srv.url).create(PODS, Pod(
+                name="p1", containers=(Container.make(
+                    name="c", requests={"cpu": 100}),)))
+        assert store.get(PODS, "default/p1").service_account_name == "default"
+
+    def test_named_account_must_exist(self):
+        from kubernetes_tpu.store.remote import RemoteStore, APIStatusError
+        from kubernetes_tpu.store.store import SERVICEACCOUNTS
+        from kubernetes_tpu.api.types import ServiceAccount
+        import pytest as _pytest
+        store, srv = self._serve()
+        with srv:
+            remote = RemoteStore(srv.url)
+            bad = Pod(name="bad", service_account_name="robot",
+                      containers=(Container.make(
+                          name="c", requests={"cpu": 100}),))
+            with _pytest.raises(APIStatusError) as ei:
+                remote.create(PODS, bad)
+            assert ei.value.code == 422
+            store.create(SERVICEACCOUNTS, ServiceAccount(name="robot"))
+            remote.create(PODS, bad)
+        assert store.get(PODS, "default/bad").service_account_name == "robot"

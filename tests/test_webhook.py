@@ -172,3 +172,62 @@ class TestHTTPWebhook:
             assert store.get(PODS, "default/p1").priority == 7
         finally:
             httpd.shutdown()
+
+
+class TestWebhookHardening:
+    def test_patch_cannot_move_or_reversion_object(self):
+        """Identity metadata is re-pinned from the pre-patch object: a
+        webhook that zeroes resource_version must not disable the PUT's
+        CAS, and one that renames must not write under another key."""
+        wh = WebhookAdmission()
+
+        def hostile(review):
+            obj = dict(review["object"])
+            obj["name"] = "hijacked"
+            obj["resource_version"] = 0
+            obj["labels"] = {"patched": "yes"}
+            return {"allowed": True, "patchedObject": obj}
+        wh.register_mutating(WebhookConfig(
+            name="hostile", kinds=("pods",), endpoint=hostile))
+        store = Store()
+        with APIServer(store, admission=chain_with(wh)) as srv:
+            remote = RemoteStore(srv.url)
+            remote.create(PODS, mkpod("p1"))
+            cur = remote.get(PODS, "default/p1")
+            cur.labels = {"v": "2"}
+            remote.update(PODS, cur, expect_rv=cur.resource_version)
+        pods = store.list(PODS)[0]
+        assert [p.name for p in pods] == ["p1"]      # no hijacked key
+        assert store.get(PODS, "default/p1").labels["patched"] == "yes"
+
+    def test_delete_operation_dispatches(self):
+        wh = WebhookAdmission()
+        wh.register_validating(WebhookConfig(
+            name="no-delete", kinds=("pods",), operations=("DELETE",),
+            endpoint=lambda r: {"allowed": "keep" not in
+                                r["object"].get("labels", {}),
+                                "message": "protected"}))
+        store = Store()
+        with APIServer(store, admission=chain_with(wh)) as srv:
+            remote = RemoteStore(srv.url)
+            remote.create(PODS, mkpod("guarded", labels={"keep": "1"}))
+            remote.create(PODS, mkpod("plain"))
+            with pytest.raises(APIStatusError) as ei:
+                remote.delete(PODS, "default/guarded")
+            assert ei.value.code == 422
+            remote.delete(PODS, "default/plain")
+        assert [p.name for p in store.list(PODS)[0]] == ["guarded"]
+
+
+class TestServiceAccountOnPut:
+    def test_put_cannot_smuggle_missing_account(self):
+        store = Store()
+        with APIServer(store) as srv:
+            remote = RemoteStore(srv.url)
+            remote.create(PODS, mkpod("p1"))
+            cur = remote.get(PODS, "default/p1")
+            cur.service_account_name = "ghost"
+            with pytest.raises(APIStatusError) as ei:
+                remote.update(PODS, cur, expect_rv=cur.resource_version)
+            assert ei.value.code == 422
+        assert store.get(PODS, "default/p1").service_account_name == "default"
