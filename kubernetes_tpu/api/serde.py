@@ -48,6 +48,13 @@ KIND_TYPES = {
 from kubernetes_tpu.utils.leader_election import Lease as _Lease  # noqa: E402
 KIND_TYPES[store_mod.LEASES] = _Lease
 
+# rbac.authorization.k8s.io policy objects: the store-backed authorizer
+# and the clusterrole-aggregation controller read these
+from kubernetes_tpu.apiserver.auth import (  # noqa: E402
+    Role as _Role, RoleBinding as _RoleBinding)
+KIND_TYPES[store_mod.CLUSTERROLES] = _Role
+KIND_TYPES[store_mod.CLUSTERROLEBINDINGS] = _RoleBinding
+
 # kinds whose objects key by bare name (Node.key etc.); everything else
 # keys by namespace/name — the single owner of REST path scoping
 CLUSTER_SCOPED_KINDS = frozenset(
@@ -72,7 +79,13 @@ _HINTS_CACHE: dict[type, dict] = {}
 def _hints(cls: type) -> dict:
     h = _HINTS_CACHE.get(cls)
     if h is None:
-        h = _HINTS_CACHE[cls] = get_type_hints(cls, vars(T),
+        # resolve stringified annotations in the class's OWN module (types
+        # registered from other modules — Lease, RBAC — name their own
+        # neighbors), with api.types as fallback vocabulary
+        import sys
+        ns = dict(vars(T))
+        ns.update(vars(sys.modules.get(cls.__module__, T)))
+        h = _HINTS_CACHE[cls] = get_type_hints(cls, ns,
                                                {"Optional": Optional})
     return h
 

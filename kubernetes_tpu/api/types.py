@@ -484,6 +484,7 @@ class Node:
     # spec
     taints: tuple[Taint, ...] = ()
     unschedulable: bool = False
+    pod_cidr: str = ""        # allocated by controllers.nodeipam
     # scheduler.alpha.kubernetes.io/preferAvoidPods annotation, reduced to
     # the controller UIDs it names (reference: node_prefer_avoid_pods.go)
     prefer_avoid_pod_uids: tuple[str, ...] = ()

@@ -84,15 +84,53 @@ class PolicyRule:
 
 @dataclass
 class Role:
+    """rbac.ClusterRole (cluster-scoped, like everything in this flat
+    authorization model). `aggregation_labels` is the ClusterRole
+    aggregationRule reduced to match-labels: the clusterrole-aggregation
+    controller unions the rules of every role whose `labels` match."""
     name: str
     rules: tuple[PolicyRule, ...] = ()
+    labels: dict = field(default_factory=dict)
+    aggregation_labels: dict = field(default_factory=dict)
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "Role":
+        import copy
+        out = copy.copy(self)
+        out.labels = dict(self.labels)
+        out.aggregation_labels = dict(self.aggregation_labels)
+        return out
 
 
 @dataclass
 class RoleBinding:
+    """rbac.ClusterRoleBinding: role name + user/group subjects.
+
+    `name` defaults to the role name for the common one-binding-per-role
+    case; give EXPLICIT distinct names when storing multiple bindings for
+    one role, or their store keys collide (the reference requires
+    distinct binding names)."""
     role: str
+    name: str = ""
     users: tuple[str, ...] = ()
     groups: tuple[str, ...] = ()
+    resource_version: int = 0
+
+    def __post_init__(self):
+        if not self.name:
+            self.name = self.role
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "RoleBinding":
+        import copy
+        return copy.copy(self)
 
     def matches(self, user: UserInfo) -> bool:
         return user.name in self.users or any(
@@ -100,20 +138,38 @@ class RoleBinding:
 
 
 class RBACAuthorizer:
-    """VisitRulesFor over bindings -> roles -> rules (rbac.go:1)."""
+    """VisitRulesFor over bindings -> roles -> rules (rbac.go:1).
+
+    Static form: pass `roles`/`bindings` literals. Store-backed form: pass
+    `store` — every authorize() reads the live clusterroles /
+    clusterrolebindings objects, so policy edits through the API take
+    effect immediately (the reference's RBAC informers with none of the
+    staleness window, affordable at this scale)."""
 
     def __init__(self, roles: Iterable[Role] = (),
-                 bindings: Iterable[RoleBinding] = ()):
+                 bindings: Iterable[RoleBinding] = (), store=None):
         self.roles = {r.name: r for r in roles}
         self.bindings = list(bindings)
+        self.store = store
+
+    def _policy(self) -> tuple[dict, list]:
+        if self.store is None:
+            return self.roles, self.bindings
+        from kubernetes_tpu.store.store import CLUSTERROLES, \
+            CLUSTERROLEBINDINGS
+        roles = {r.name: r for r in self.store.list(CLUSTERROLES)[0]}
+        roles.update(self.roles)           # bootstrap literals stay valid
+        bindings = self.bindings + self.store.list(CLUSTERROLEBINDINGS)[0]
+        return roles, bindings
 
     def authorize(self, attrs: Attributes) -> bool:
         if MASTERS_GROUP in attrs.user.groups:
             return True
-        for b in self.bindings:
+        roles, bindings = self._policy()
+        for b in bindings:
             if not b.matches(attrs.user):
                 continue
-            role = self.roles.get(b.role)
+            role = roles.get(b.role)
             if role is None:
                 continue
             if any(rule.allows(attrs) for rule in role.rules):

@@ -84,8 +84,8 @@ class Webhook:
             # reference rejects webhook mutations of immutable metadata;
             # a zeroed resource_version would silently disable the PUT's
             # optimistic-concurrency check)
-            for attr in ("name", "namespace", "resource_version"):
-                if hasattr(patched, attr):
+            for attr in ("name", "namespace", "uid", "resource_version"):
+                if hasattr(patched, attr) and hasattr(obj, attr):
                     setattr(patched, attr, getattr(obj, attr))
             return patched
         return obj

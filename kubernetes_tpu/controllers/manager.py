@@ -30,6 +30,10 @@ from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
 from kubernetes_tpu.controllers.cronjob import CronJobController
 from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeBinder
+from kubernetes_tpu.controllers.nodeipam import NodeIpamController
+from kubernetes_tpu.controllers.clusterrole_aggregation import (
+    ClusterRoleAggregationController,
+)
 
 # name -> constructor(store) (NewControllerInitializers analog,
 # controllermanager.go:372-412). Ordering matters for single-threaded
@@ -41,6 +45,8 @@ CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
     "ttl": TTLController,
+    "nodeipam": NodeIpamController,
+    "clusterrole-aggregation": ClusterRoleAggregationController,
     "persistentvolume-binder": PersistentVolumeBinder,
     "horizontalpodautoscaling": HorizontalPodAutoscalerController,
     "cronjob": CronJobController,

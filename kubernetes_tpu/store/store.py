@@ -48,6 +48,8 @@ DAEMONSETS = "daemonsets"  # one-pod-per-node (controllers.daemonset)
 STATEFULSETS = "statefulsets"  # ordinal identities (controllers.statefulset)
 NAMESPACES = "namespaces"  # lifecycle owned by controllers.namespace
 HPAS = "horizontalpodautoscalers"  # autoscaling (controllers.hpa)
+CLUSTERROLES = "clusterroles"  # rbac.authorization.k8s.io policy objects
+CLUSTERROLEBINDINGS = "clusterrolebindings"
 PODMETRICS = "podmetrics"  # metrics.k8s.io stand-in (HPA's usage source)
 CRONJOBS = "cronjobs"  # batch schedules (controllers.cronjob)
 CONFIGMAPS = "configmaps"
