@@ -311,11 +311,28 @@ def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
     return out
 
 
+def run_matrix_only(repeat: int = 2) -> dict:
+    """`--mode matrix`: just the workload lanes plus each lane's
+    ratio-to-plain — the one-command regression check for the spread /
+    affinity encode-path cliffs (ISSUE 1 acceptance: spread >= 0.55x plain,
+    affinity >= 0.8x plain at the 1000n/1000existing/1000p cell)."""
+    out = run_matrix(repeat=repeat)
+    plain = out.get("plain")
+    ratios = {}
+    for lane in ("anti_affinity", "affinity", "node_affinity", "spread"):
+        v = out.get(lane)
+        ratios[lane] = (round(v / plain, 3)
+                        if plain and v is not None else None)
+    out["ratio_to_plain"] = ratios
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=15000)
     ap.add_argument("--pods", type=int, default=10000)
-    ap.add_argument("--mode", choices=["burst", "serial", "oracle", "preempt"],
+    ap.add_argument("--mode",
+                    choices=["burst", "serial", "oracle", "preempt", "matrix"],
                     default="burst")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
@@ -339,6 +356,11 @@ def main():
         result = retry_transient(
             lambda: run_preempt_bench(args.nodes, args.pods))
         print(json.dumps(result))
+        return
+    if args.mode == "matrix":
+        # just the matrix lanes + ratio-to-plain, one JSON line (transient
+        # isolation happens per lane inside run_matrix)
+        print(json.dumps(run_matrix_only(repeat=args.matrix_repeat)))
         return
     mesh = _make_mesh() if args.mesh else None
     # each timed repeat individually survives a dropped tunnel response

@@ -93,6 +93,12 @@ def _hints(cls: type) -> dict:
 def _build(hint: Any, value: Any) -> Any:
     if value is None:
         return None
+    if isinstance(hint, str):
+        # a quoted forward reference nested inside a builtin generic (e.g.
+        # tuple["PodCondition", ...]) survives get_type_hints as a plain
+        # string — types.GenericAlias neither wraps it in ForwardRef nor
+        # resolves it; look it up in the api.types vocabulary
+        hint = getattr(T, hint, Any)
     origin = get_origin(hint)
     if origin is typing.Union:
         args = [a for a in get_args(hint) if a is not type(None)]

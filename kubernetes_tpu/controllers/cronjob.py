@@ -6,7 +6,11 @@ recent one, and apply the concurrency policy against still-active owned
 Jobs (Allow runs them side by side, Forbid skips the new run, Replace
 deletes the active ones first). Too many missed runs (>100) emits the
 reference's warning and resets the cursor; the optional starting deadline
-drops runs that are already stale."""
+drops runs that are already stale.
+
+Schedules are evaluated in **UTC** (utils.cron.CronSchedule), a deliberate
+divergence from the reference controller-manager's local-time evaluation:
+firing times here never depend on the host's timezone."""
 from __future__ import annotations
 
 import time as _time

@@ -84,10 +84,13 @@ class HorizontalPodAutoscalerController(DirtyKeyController):
                     desired = math.ceil(n_all * ratio)
                 else:
                     # metric-less pods damp the move: they count as 0%
-                    # usage on the way up and as exactly-on-target on the
-                    # way down, and a move that flips direction (or lands
-                    # in tolerance) after the fill is discarded
-                    fill = 0.0 if ratio > 1.0 else float(target)
+                    # usage on the way up and as FULL request utilization
+                    # (100%) on the way down (replica_calculator.go:106) —
+                    # filling with the target instead over-shrinks during
+                    # rollouts whose fresh pods have no samples yet — and a
+                    # move that flips direction (or lands in tolerance)
+                    # after the fill is discarded
+                    fill = 0.0 if ratio > 1.0 else 100.0
                     avg_all = (sum(utilizations) + fill * missing) / n_all
                     new_ratio = avg_all / target
                     if abs(new_ratio - 1.0) > TOLERANCE and \

@@ -406,7 +406,8 @@ class TPUScheduler:
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
-                         volume_binder=self.volume_binder)
+                         volume_binder=self.volume_binder,
+                         state_encoder=self.encoder)
         feats = enc.encode(pod)
         pod_in = self._pod_arrays(feats, b.n_pad)
         n = b.n_real
@@ -737,7 +738,8 @@ class TPUScheduler:
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
-                         volume_binder=self.volume_binder)
+                         volume_binder=self.volume_binder,
+                         state_encoder=self.encoder)
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
@@ -980,7 +982,8 @@ class TPUScheduler:
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
-                         volume_binder=self.volume_binder)
+                         volume_binder=self.volume_binder,
+                         state_encoder=self.encoder)
         f = enc.encode(pod)
         if f.unknown_scalars:
             return None
@@ -1141,7 +1144,8 @@ class TPUScheduler:
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                          enabled=self.enabled_predicates,
                          volume_listers=self.volume_listers,
-                         volume_binder=self.volume_binder)
+                         volume_binder=self.volume_binder,
+                         state_encoder=self.encoder)
         feat_by_sig: dict = {}
         feats = []
         for p in pods:

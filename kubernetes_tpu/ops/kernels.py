@@ -170,7 +170,13 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
             # all counts 0 -> node and zone fractions are both max -> 10
             const += weights["selector_spread"] * MAX_PRIORITY
         else:
-            # SelectorSpread: node + zone blend (selector_spreading.go:99)
+            # SelectorSpread: node + zone blend (selector_spreading.go:99).
+            # Zone aggregation runs as dense one-hot [N, Z] reductions —
+            # z_pad is tiny and the former .at[zone_id].add/.max scatters +
+            # zone_counts[zone_id] gather serialize badly (XLA lowers them
+            # to scalar loops on CPU and slow scatter paths on TPU); inside
+            # the burst scan that cost repeated PER POD and was the
+            # dominant term of the spread lane's 0.27x-of-plain cliff
             zone_id = nodes["zone_id"]
             max_by_node = jnp.max(jnp.where(kept, sc, 0))
             f = jnp.where(max_by_node > 0,
@@ -178,12 +184,15 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
                                                  / jnp.maximum(max_by_node, 1)),
                           float(MAX_PRIORITY))
             in_zone = kept & (zone_id > 0)
-            zone_counts = jnp.zeros(z_pad, dtype=jnp.int64).at[zone_id].add(
-                jnp.where(in_zone, sc, 0))
-            zone_present = jnp.zeros(z_pad, dtype=bool).at[zone_id].max(in_zone)
+            zh = zone_id[:, None] == jnp.arange(z_pad, dtype=zone_id.dtype)[None, :]
+            izh = zh & in_zone[:, None]                       # [N, Z]
+            zone_counts = jnp.sum(jnp.where(izh, sc[:, None], 0), axis=0)
+            zone_present = jnp.any(izh, axis=0)
             have_zones = jnp.any(in_zone)
             max_by_zone = jnp.max(jnp.where(zone_present, zone_counts, 0))
-            zc = zone_counts[zone_id]
+            # each row has exactly one true lane in zh -> the sum IS the
+            # node's zone count (the gather, without the gather)
+            zc = jnp.sum(jnp.where(zh, zone_counts[None, :], 0), axis=1)
             zs = jnp.where(max_by_zone > 0,
                            float(MAX_PRIORITY) * ((max_by_zone - zc)
                                                   / jnp.maximum(max_by_zone, 1)),

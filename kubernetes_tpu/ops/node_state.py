@@ -26,11 +26,11 @@ from kubernetes_tpu.api.types import (
 )
 from kubernetes_tpu.cache.node_info import NodeInfo, normalized_image_name
 from kubernetes_tpu.oracle.predicates import (
-    pod_matches_node_selector_and_affinity, InterPodAffinityChecker,
+    pod_matches_node_selector_and_affinity, pod_matches_term_props,
+    pod_matches_term_props_mask, selector_match_mask,
+    InterPodAffinityChecker,
 )
-from kubernetes_tpu.oracle.priorities import (
-    get_selectors, _selector_matches,
-)
+from kubernetes_tpu.oracle.priorities import get_selectors
 
 
 def _pad_capacity(n: int, minimum: int = 8) -> int:
@@ -85,6 +85,13 @@ class NodeStateEncoder:
         self._generations: dict[str, int] = {}
         self._scalar_vocab: list[str] = []
         self._zone_vocab: list[str] = [""]
+        # columnar pod-table cache (pod_table): per-node blocks keyed by
+        # NodeInfo generation; vocabs grow monotonically so ids are stable
+        self._pt_blocks: dict[str, tuple] = {}
+        self._pt_ns_vocab: dict[str, int] = {}
+        self._pt_key_vocab: dict[str, int] = {}
+        self._pt_val_vocab: dict[str, int] = {}
+        self._pt_val_ints: list[float] = []
 
     def _collect_vocab(self, node_infos: dict[str, NodeInfo]) -> None:
         known = set(self._scalar_vocab)
@@ -117,8 +124,17 @@ class NodeStateEncoder:
             or b.names != node_order
         )
         if rebuild:
-            b = self._fresh(node_order, n_real, n_pad, s)
-            self._generations = {}
+            if (b is not None and b.n_pad == n_pad and b.n_real == n_real
+                    and len(b.scalar_names) == len(self._scalar_vocab)
+                    and set(b.names) == set(node_order)):
+                # same nodes, new enumeration order (uneven-zone clusters
+                # rotate between bursts): permute the mirror rows instead
+                # of re-extracting every NodeInfo through _write_row —
+                # generations are name-keyed, so they stay valid
+                b = self._permuted(b, node_order, n_real)
+            else:
+                b = self._fresh(node_order, n_real, n_pad, s)
+                self._generations = {}
             self._batch = b
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
         zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
@@ -141,6 +157,37 @@ class NodeStateEncoder:
         elif b.dirty_rows is not None:
             b.dirty_rows.extend(dirty)
         return b
+
+    def _permuted(self, b: NodeBatch, node_order: list[str],
+                  n_real: int) -> NodeBatch:
+        """Reorder an existing mirror to a new enumeration of the SAME node
+        set: one numpy gather per field. Returned as a fresh NodeBatch
+        (dirty_rows=None) so the device mirror re-uploads — row positions
+        moved, the delta path can't express that."""
+        perm = np.fromiter((b.index[nm] for nm in node_order), np.int64,
+                           n_real)
+
+        def take(arr):
+            out = arr.copy()
+            out[:n_real] = arr[perm]
+            return out
+
+        return NodeBatch(
+            names=list(node_order),
+            index={name: i for i, name in enumerate(node_order)},
+            n_real=n_real, n_pad=b.n_pad,
+            scalar_names=list(self._scalar_vocab),
+            zone_names=list(self._zone_vocab),
+            valid=b.valid.copy(),
+            alloc_cpu=take(b.alloc_cpu), alloc_mem=take(b.alloc_mem),
+            alloc_eph=take(b.alloc_eph), allowed_pods=take(b.allowed_pods),
+            req_cpu=take(b.req_cpu), req_mem=take(b.req_mem),
+            req_eph=take(b.req_eph),
+            nz_cpu=take(b.nz_cpu), nz_mem=take(b.nz_mem),
+            pod_count=take(b.pod_count),
+            alloc_scalar=take(b.alloc_scalar), req_scalar=take(b.req_scalar),
+            zone_id=take(b.zone_id),
+        )
 
     def _fresh(self, node_order: list[str], n_real: int, n_pad: int, s: int) -> NodeBatch:
         z = lambda dt=np.int64: np.zeros(n_pad, dtype=dt)
@@ -200,6 +247,110 @@ class NodeStateEncoder:
             setf(b.zone_id, zone_idx[get_zone_key(ni.node)])
         return changed
 
+    # -- columnar pod table --------------------------------------------------
+    def _pt_val_id(self, v: str) -> int:
+        vid = self._pt_val_vocab.get(v)
+        if vid is None:
+            vid = self._pt_val_vocab[v] = len(self._pt_val_ints)
+            try:
+                self._pt_val_ints.append(float(int(v)))
+            except ValueError:
+                self._pt_val_ints.append(float("nan"))
+        return vid
+
+    def _pt_block(self, ni: NodeInfo):
+        """One node's pods as dictionary-encoded rows. Vocab ids are
+        monotonic (never reassigned) so cached blocks stay valid across
+        encodes."""
+        pods = list(ni.pods)
+        p = len(pods)
+        aff_ids = set(map(id, ni.pods_with_affinity))
+        lmax = max((len(pd.labels) for pd in pods), default=0)
+        kid = np.full((p, max(lmax, 1)), -1, np.int32)
+        vid = np.full((p, max(lmax, 1)), -1, np.int32)
+        ns = np.empty(p, np.int32)
+        deleted = np.empty(p, bool)
+        has_aff = np.empty(p, bool)
+        names = []
+        nsv, kvoc = self._pt_ns_vocab, self._pt_key_vocab
+        for j, pd in enumerate(pods):
+            nid = nsv.get(pd.namespace)
+            if nid is None:
+                nid = nsv[pd.namespace] = len(nsv)
+            ns[j] = nid
+            deleted[j] = pd.deleted
+            has_aff[j] = id(pd) in aff_ids
+            names.append(pd.node_name)
+            for l, (k, v) in enumerate(pd.labels.items()):
+                kk = kvoc.get(k)
+                if kk is None:
+                    kk = kvoc[k] = len(kvoc)
+                kid[j, l] = kk
+                vid[j, l] = self._pt_val_id(v)
+        return (pods, ns, kid, vid, deleted, has_aff, names)
+
+    def pod_table(self, node_infos: dict[str, NodeInfo],
+                  b: NodeBatch) -> "PodTable":
+        """Columnar table of every snapshot pod, cached per node by the
+        NodeInfo generation exactly like the dirty-row encode: only nodes
+        whose generation moved re-extract their pods' label rows; assembly
+        of the cached blocks is pure numpy. Callers that feed the table to
+        the vectorized matchers assume the batch axis covers the snapshot
+        (node_infos keys ⊆ batch names), which is how every encoder
+        consumer builds it."""
+        blocks = []
+        new_cache = {}
+        for name, ni in node_infos.items():
+            cached = self._pt_blocks.get(name)
+            if cached is not None and cached[0] == ni.generation:
+                blk = cached[1]
+            else:
+                blk = self._pt_block(ni)
+            new_cache[name] = (ni.generation, blk)
+            blocks.append((name, blk))
+        self._pt_blocks = new_cache   # prunes nodes that left the snapshot
+        total = sum(len(blk[0]) for _, blk in blocks)
+        lmax = max((blk[2].shape[1] for _, blk in blocks if len(blk[0])),
+                   default=1)
+        pods: list = []
+        holder_row = np.full(total, -1, np.int32)
+        holder_has_obj = np.zeros(total, bool)
+        name_row = np.full(total, -1, np.int32)
+        ns_id = np.empty(total, np.int32)
+        deleted = np.empty(total, bool)
+        has_aff = np.empty(total, bool)
+        key_ids = np.full((total, lmax), -1, np.int32)
+        val_ids = np.full((total, lmax), -1, np.int32)
+        off = 0
+        for name, blk in blocks:
+            bpods, ns, kid, vid, dele, haff, names = blk
+            p = len(bpods)
+            if not p:
+                continue
+            pods.extend(bpods)
+            sl = slice(off, off + p)
+            hrow = b.index.get(name, -1)
+            holder_row[sl] = hrow
+            holder_has_obj[sl] = node_infos[name].node is not None
+            ns_id[sl] = ns
+            deleted[sl] = dele
+            has_aff[sl] = haff
+            key_ids[sl, : kid.shape[1]] = kid
+            val_ids[sl, : vid.shape[1]] = vid
+            for j, nm in enumerate(names):
+                if nm == name:
+                    name_row[off + j] = hrow
+                elif nm in node_infos:
+                    name_row[off + j] = b.index.get(nm, -1)
+            off += p
+        return PodTable(
+            pods=pods, holder_row=holder_row, holder_has_obj=holder_has_obj,
+            name_row=name_row, has_affinity=has_aff, deleted=deleted,
+            ns_id=ns_id, key_ids=key_ids, val_ids=val_ids,
+            ns_vocab=self._pt_ns_vocab, key_vocab=self._pt_key_vocab,
+            val_vocab=self._pt_val_vocab,
+            val_ints=np.asarray(self._pt_val_ints, dtype=np.float64))
+
     def note_assumed(self, b: NodeBatch, node_name: str, pod: Pod,
                      generation: Optional[int] = None,
                      mark_dirty: bool = True) -> None:
@@ -231,6 +382,38 @@ class NodeStateEncoder:
             self._generations[node_name] = generation
         if mark_dirty and b.dirty_rows is not None:
             b.dirty_rows.append(i)
+
+
+@dataclass
+class PodTable:
+    """Columnar snapshot pod table: one row per pod of every NodeInfo, with
+    namespaces and label (key, value) pairs dictionary-encoded — the
+    existing-pod axis twin of the node matrix (SURVEY §2.3 applied to
+    selector matching). Consumed through the shared vectorized matchers in
+    oracle.predicates (selector_match_mask / pod_matches_term_props_mask),
+    so the per-existing-pod Python of selector-spread counting and
+    inter-pod affinity scans becomes one boolean mask per selector/term.
+    """
+    pods: list                  # row -> Pod
+    holder_row: np.ndarray      # [P] i32 batch row of the holding NodeInfo (-1 off-axis)
+    holder_has_obj: np.ndarray  # [P] bool: holder NodeInfo.node is not None
+    name_row: np.ndarray        # [P] i32 batch row of the node named pod.node_name (-1 unknown)
+    has_affinity: np.ndarray    # [P] bool (mirrors NodeInfo.pods_with_affinity)
+    deleted: np.ndarray         # [P] bool
+    ns_id: np.ndarray           # [P] i32
+    key_ids: np.ndarray         # [P, L] i32, -1 padding
+    val_ids: np.ndarray         # [P, L] i32, -1 padding
+    ns_vocab: dict
+    key_vocab: dict
+    val_vocab: dict
+    val_ints: np.ndarray        # [V] f64 parsed-integer value (NaN unparseable)
+
+
+def build_pod_table(node_infos: dict[str, NodeInfo], b: NodeBatch) -> PodTable:
+    """Uncached one-shot table build (standalone PodEncoder uses); the
+    scheduler path goes through NodeStateEncoder.pod_table for the
+    generation cache."""
+    return NodeStateEncoder().pod_table(node_infos, b)
 
 
 # ---------------------------------------------------------------------------
@@ -294,7 +477,8 @@ class PodEncoder:
                  services=None, replicasets=None, total_num_nodes: Optional[int] = None,
                  hard_pod_affinity_weight: int = 1,
                  enabled: Optional[set] = None,
-                 volume_listers=None, volume_binder=None):
+                 volume_listers=None, volume_binder=None,
+                 state_encoder: Optional[NodeStateEncoder] = None):
         self.node_infos = node_infos
         self.batch = batch
         # predicate names enabled by the provider/policy; None = all
@@ -305,7 +489,14 @@ class PodEncoder:
         self.replicasets = replicasets or []
         self.total_num_nodes = total_num_nodes or max(1, batch.n_real)
         self.hard_weight = hard_pod_affinity_weight
+        # columnar pod table: generation-cached when the scheduler's
+        # NodeStateEncoder is supplied, one-shot otherwise (lazy either way)
+        self.state_encoder = state_encoder
+        self._ptable: Optional[PodTable] = None
+        self._taint_rows: Optional[dict] = None
+        self._image_locality_rows: Optional[dict] = None
         self._ipa = InterPodAffinityChecker(node_infos)
+        self._ipa.set_table_source(self._table, self._topo_values)
         # cluster-wide feature flags: skip whole mask families when inert
         self._any_taints = any(ni.taints for ni in node_infos.values())
         self._any_unschedulable = any(
@@ -323,6 +514,15 @@ class PodEncoder:
         b = self.batch
         for i in range(b.n_real):
             yield i, self.node_infos[b.names[i]]
+
+    def _table(self) -> PodTable:
+        if self._ptable is None:
+            if self.state_encoder is not None:
+                self._ptable = self.state_encoder.pod_table(
+                    self.node_infos, self.batch)
+            else:
+                self._ptable = build_pod_table(self.node_infos, self.batch)
+        return self._ptable
 
     def _on(self, *names: str) -> bool:
         return self.enabled is None or any(n in self.enabled for n in names)
@@ -399,18 +599,50 @@ class PodEncoder:
             or pod.affinity.pod_anti_affinity is not None)
         if (self._any_affinity_pods or has_own_terms) \
                 and self._on("MatchInterPodAffinity"):
-            codes = np.zeros(b.n_pad, dtype=np.int8)
-            for i, ni in self._nodes():
-                ok, reasons = self._ipa.check(pod, ni)
-                if not ok:
-                    from kubernetes_tpu.oracle import predicates as P
-                    if P.ERR_EXISTING_PODS_ANTI_AFFINITY_RULES_NOT_MATCH in reasons:
-                        codes[i] = IPA_EXISTING_ANTI
-                    elif P.ERR_POD_AFFINITY_RULES_NOT_MATCH in reasons:
-                        codes[i] = IPA_OWN_AFFINITY
-                    else:
-                        codes[i] = IPA_OWN_ANTI
-            f.interpod_code = codes
+            f.interpod_code = self._interpod_codes(pod)
+
+    def _interpod_codes(self, pod: Pod) -> np.ndarray:
+        """Vectorized MatchInterPodAffinity over the node axis: the same
+        (topologyKey, value) metadata the oracle's per-node check reads
+        (predicates.InterPodAffinityChecker._metadata, itself vectorized
+        over the pod table), resolved against the dictionary-encoded node
+        label values — one membership mask per term instead of a Python
+        check per node. Codes keep the oracle's first-failure precedence:
+        existing-pods anti-affinity, then own affinity, then own anti."""
+        b = self.batch
+        violating, aff_terms, anti_terms = self._ipa._metadata(pod)
+        fail_exist = np.zeros(b.n_pad, dtype=bool)
+        for (key, value) in violating:
+            ids, vocab = self._topo_values(key)
+            vid = vocab.get(value)
+            if vid is not None:
+                fail_exist |= ids == vid
+        fail_aff = np.zeros(b.n_pad, dtype=bool)
+        for term, values, total in aff_terms:
+            if not values:
+                # first-pod-in-cluster waiver (predicates.go:1454-1464) is
+                # node-independent: no pod anywhere matches the term
+                if total[0] == 0 and pod_matches_term_props(pod, pod, term):
+                    continue
+                fail_aff[:] = True
+                continue
+            ids, vocab = self._topo_values(term.topology_key)
+            vids = [vocab[v] for v in values if v in vocab]
+            member = np.isin(ids, vids) if vids \
+                else np.zeros(b.n_pad, dtype=bool)
+            fail_aff |= ~member
+        fail_anti = np.zeros(b.n_pad, dtype=bool)
+        for term, values, _total in anti_terms:
+            ids, vocab = self._topo_values(term.topology_key)
+            vids = [vocab[v] for v in values if v in vocab]
+            if vids:
+                fail_anti |= np.isin(ids, vids)
+        codes = np.where(
+            fail_exist, IPA_EXISTING_ANTI,
+            np.where(fail_aff, IPA_OWN_AFFINITY,
+                     np.where(fail_anti, IPA_OWN_ANTI, 0))).astype(np.int8)
+        codes[b.n_real:] = 0   # padding rows carry no verdict
+        return codes
 
     def _encode_volumes(self, pod: Pod, f: PodFeatures) -> None:
         """Volume predicate masks, via the oracle implementations per node
@@ -465,28 +697,38 @@ class PodEncoder:
                 counts[i] = c
             f.node_aff_counts = counts
         if self._any_taints:
+            # group by unique taint (cached per snapshot): each distinct
+            # PreferNoSchedule taint is toleration-checked ONCE, its node
+            # rows incremented in one scatter — instead of the old
+            # per-node × per-taint Python walk
             tols = [t for t in pod.tolerations
                     if not t.effect or t.effect == PREFER_NO_SCHEDULE]
             counts = np.zeros(b.n_pad, dtype=np.int64)
-            for i, ni in self._nodes():
-                c = 0
-                for taint in ni.taints:
-                    if taint.effect == PREFER_NO_SCHEDULE and \
-                            not tolerations_tolerate_taint(tols, taint):
-                        c += 1
-                counts[i] = c
+            for taint, rows in self._prefer_taint_rows().items():
+                if not tolerations_tolerate_taint(tols, taint):
+                    np.add.at(counts, rows, 1)
             f.taint_counts = counts
         selectors = get_selectors(pod, self.services, self.replicasets)
         if selectors:
+            # selector-spread counting (selector_spreading.go:66): one
+            # vectorized selector-match over the columnar pod table plus a
+            # segment-sum by holder node, replacing the per-existing-pod
+            # Python that made the spread lane the encode-side cliff
+            t = self._table()
+            nsid = t.ns_vocab.get(pod.namespace)
+            if nsid is None:
+                m = np.zeros(len(t.pods), dtype=bool)
+            else:
+                m = (t.ns_id == nsid) & ~t.deleted
+            for s in selectors:
+                if not m.any():
+                    break
+                m &= selector_match_mask(s, t)
             counts = np.zeros(b.n_pad, dtype=np.int64)
-            for i, ni in self._nodes():
-                c = 0
-                for existing in ni.pods:
-                    if existing.namespace != pod.namespace or existing.deleted:
-                        continue
-                    if all(_selector_matches(s, existing.labels) for s in selectors):
-                        c += 1
-                counts[i] = c
+            rows = t.holder_row[m]
+            rows = rows[rows >= 0]
+            if rows.size:
+                counts += np.bincount(rows, minlength=b.n_pad)
             f.spread_counts = counts
         has_pref_terms = a is not None and (
             (a.pod_affinity is not None and a.pod_affinity.preferred)
@@ -495,14 +737,11 @@ class PodEncoder:
             f.interpod_counts, f.interpod_tracked = self._interpod_pref_counts(pod)
         if self._any_images:
             sums = np.zeros(b.n_pad, dtype=np.int64)
-            for i, ni in self._nodes():
-                total = 0
-                for c in pod.containers:
-                    state = ni.image_states.get(normalized_image_name(c.image))
-                    if state is not None:
-                        spread = state.num_nodes / self.total_num_nodes
-                        total += int(state.size_bytes * spread)
-                sums[i] = total
+            img_rows = self._image_rows()
+            for c in pod.containers:
+                ent = img_rows.get(normalized_image_name(c.image))
+                if ent is not None:
+                    np.add.at(sums, ent[0], ent[1])
             f.image_sums = sums
         if self._any_prefer_avoid:
             scores = np.full(b.n_pad, 10, dtype=np.int64)
@@ -512,6 +751,40 @@ class PodEncoder:
                     if ni.node is not None and owner[2] in ni.node.prefer_avoid_pod_uids:
                         scores[i] = 0
             f.prefer_avoid = scores
+
+    def _prefer_taint_rows(self) -> dict:
+        """{unique PreferNoSchedule taint -> np node rows}, built once per
+        snapshot (taints are per-node state, not per-pod)."""
+        got = self._taint_rows
+        if got is None:
+            d: dict = {}
+            for i, ni in self._nodes():
+                for taint in ni.taints:
+                    if taint.effect == PREFER_NO_SCHEDULE:
+                        d.setdefault(taint, []).append(i)
+            got = self._taint_rows = {
+                t: np.asarray(r, dtype=np.int64) for t, r in d.items()}
+        return got
+
+    def _image_rows(self) -> dict:
+        """{normalized image name -> (node rows, int64 contributions)} with
+        the reference's exact per-(node, image) truncation
+        (image_locality.go:42: int(size_bytes * num_nodes/total))."""
+        got = self._image_locality_rows
+        if got is None:
+            rows: dict = {}
+            for i, ni in self._nodes():
+                for name, state in ni.image_states.items():
+                    rows.setdefault(name, ([], []))
+                    rows[name][0].append(i)
+                    rows[name][1].append(
+                        int(state.size_bytes
+                            * (state.num_nodes / self.total_num_nodes)))
+            got = self._image_locality_rows = {
+                name: (np.asarray(r, dtype=np.int64),
+                       np.asarray(c, dtype=np.int64))
+                for name, (r, c) in rows.items()}
+        return got
 
     def _topo_values(self, key: str):
         """Dictionary-encode node label values for one topology key:
@@ -543,21 +816,42 @@ class PodEncoder:
         processTerm (:215); the old mirror of that walk was the
         O(events x nodes) host bottleneck of the affinity lanes."""
         b = self.batch
-        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
+        t = self._table()
         a = pod.affinity
         has_aff = a is not None and a.pod_affinity is not None
         has_anti = a is not None and a.pod_anti_affinity is not None
         trk = np.zeros(b.n_pad, dtype=bool)
-        for name, ni in self.node_infos.items():
-            if has_aff or has_anti or ni.pods_with_affinity:
-                i = b.index.get(name)
-                if i is not None:
-                    trk[i] = True
+        if has_aff or has_anti:
+            trk[: b.n_real] = True
+        else:
+            rows = t.holder_row[t.has_affinity]
+            trk[rows[rows >= 0]] = True
         acc: dict[str, np.ndarray] = {}
 
         def node_of(p: Pod):
             ni = self.node_infos.get(p.node_name)
             return ni.node if ni else None
+
+        def bucket_add_mask(term, mask, weight):
+            """All of one term's (existing-pod) events at once: each
+            matching pod adds `weight` to the (topologyKey, value) bucket
+            its node's label value fixes."""
+            key = term.topology_key
+            if not key or not mask.any():
+                return
+            ids, vocab = self._topo_values(key)
+            rows = t.name_row[mask]
+            rows = rows[rows >= 0]          # fixed node unknown
+            if not rows.size:
+                return
+            vids = ids[rows]
+            vids = vids[vids >= 0]          # fixed node lacks the label
+            if not vids.size:
+                return
+            buckets = acc.get(key)
+            if buckets is None:
+                buckets = acc[key] = np.zeros(len(vocab), np.int64)
+            buckets += np.bincount(vids, minlength=len(vocab)) * weight
 
         def process_term(term, defining, to_check, fixed_node, weight):
             key = term.topology_key
@@ -577,31 +871,43 @@ class PodEncoder:
                 buckets = acc[key] = np.zeros(len(vocab), np.int64)
             buckets[vid] += weight
 
-        def process_pod(existing: Pod):
+        # the incoming pod's preferred terms, vectorized over the
+        # existing-pod axis (reference interpod_affinity.go:215 processTerm
+        # walked every node per matching pod; the old mirror walked every
+        # pod in Python): one mask per term. The reference only processes
+        # pods held by nodes with objects — holder_has_obj gates that.
+        on_node = t.holder_has_obj
+        if has_aff:
+            for wt in a.pod_affinity.preferred:
+                bucket_add_mask(
+                    wt.term,
+                    on_node & pod_matches_term_props_mask(pod, wt.term, t),
+                    wt.weight)
+        if has_anti:
+            for wt in a.pod_anti_affinity.preferred:
+                bucket_add_mask(
+                    wt.term,
+                    on_node & pod_matches_term_props_mask(pod, wt.term, t),
+                    -wt.weight)
+        # existing pods' own terms check the single incoming pod (O(terms)
+        # each): only affinity-carrying pods can contribute, so walk exactly
+        # those rows instead of every pod
+        for r in np.nonzero(t.has_affinity & on_node)[0].tolist():
+            existing = t.pods[r]
             existing_node = node_of(existing)
             ea = existing.affinity
-            if has_aff:
-                for wt in a.pod_affinity.preferred:
-                    process_term(wt.term, pod, existing, existing_node, wt.weight)
-            if has_anti:
-                for wt in a.pod_anti_affinity.preferred:
-                    process_term(wt.term, pod, existing, existing_node, -wt.weight)
-            if ea is not None and ea.pod_affinity is not None:
+            if ea.pod_affinity is not None:
                 if self.hard_weight > 0:
                     for term in ea.pod_affinity.required:
-                        process_term(term, existing, pod, existing_node, self.hard_weight)
+                        process_term(term, existing, pod, existing_node,
+                                     self.hard_weight)
                 for wt in ea.pod_affinity.preferred:
-                    process_term(wt.term, existing, pod, existing_node, wt.weight)
-            if ea is not None and ea.pod_anti_affinity is not None:
+                    process_term(wt.term, existing, pod, existing_node,
+                                 wt.weight)
+            if ea.pod_anti_affinity is not None:
                 for wt in ea.pod_anti_affinity.preferred:
-                    process_term(wt.term, existing, pod, existing_node, -wt.weight)
-
-        for ni in self.node_infos.values():
-            if ni.node is None:
-                continue
-            pods = ni.pods if (has_aff or has_anti) else ni.pods_with_affinity
-            for existing in pods:
-                process_pod(existing)
+                    process_term(wt.term, existing, pod, existing_node,
+                                 -wt.weight)
 
         arr = np.zeros(b.n_pad, dtype=np.int64)
         for key, buckets in acc.items():

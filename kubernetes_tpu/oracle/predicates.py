@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import numpy as np
+
 from kubernetes_tpu.api.types import (
     Pod, Node, Taint,
     get_resource_request, get_container_ports,
@@ -15,6 +17,7 @@ from kubernetes_tpu.api.types import (
     NO_SCHEDULE, NO_EXECUTE,
     TAINT_NODE_UNSCHEDULABLE, find_intolerable_taint,
     RESOURCE_CPU, RESOURCE_MEMORY, RESOURCE_PODS, RESOURCE_EPHEMERAL_STORAGE,
+    IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT,
 )
 from kubernetes_tpu.cache.node_info import NodeInfo
 
@@ -271,6 +274,111 @@ def nodes_same_topology(a: Optional[Node], b: Optional[Node], key: str) -> bool:
     return key in a.labels and key in b.labels and a.labels[key] == b.labels[key]
 
 
+# ---------------------------------------------------------------------------
+# Vectorized selector matching over a columnar pod table
+# ---------------------------------------------------------------------------
+# The table (ops.node_state.PodTable, duck-typed here to keep the oracle
+# import-free of the device stack) dictionary-encodes every snapshot pod's
+# namespace and label pairs:
+#   ns_id[P] i32; key_ids/val_ids[P, L] i32 (-1 padding);
+#   ns_vocab/key_vocab/val_vocab: str -> id; val_ints[V] f64 (parsed integer
+#   value of each vocab entry, NaN when unparseable — Gt/Lt support).
+# These are the SHARED vectorized twins of _selector_matches /
+# LabelSelector.matches / pod_matches_term_props: one boolean mask over the
+# existing-pod axis instead of a Python call per pod. Every mask must stay
+# bit-identical to a row-by-row scalar evaluation — the encoder parity
+# fuzzes enforce it.
+
+
+def _pair_mask(table, k: str, v: str) -> np.ndarray:
+    """[P] bool: pod labels contain the exact (k, v) pair."""
+    kid = table.key_vocab.get(k)
+    vid = table.val_vocab.get(v)
+    if kid is None or vid is None:
+        return np.zeros(len(table.pods), dtype=bool)
+    return ((table.key_ids == kid) & (table.val_ids == vid)).any(axis=1)
+
+
+def _requirement_mask(table, req) -> np.ndarray:
+    """Vectorized twin of Requirement.matches over the pod axis."""
+    n = len(table.pods)
+    kid = table.key_vocab.get(req.key)
+    if req.op == IN:
+        if kid is None:
+            return np.zeros(n, dtype=bool)
+        vids = [table.val_vocab[v] for v in req.values
+                if v in table.val_vocab]
+        if not vids:
+            return np.zeros(n, dtype=bool)
+        return ((table.key_ids == kid)
+                & np.isin(table.val_ids, vids)).any(axis=1)
+    if req.op == NOT_IN:
+        # scalar twin: matches when the key is absent OR the value differs
+        if kid is None:
+            return np.ones(n, dtype=bool)
+        vids = [table.val_vocab[v] for v in req.values
+                if v in table.val_vocab]
+        if not vids:
+            return np.ones(n, dtype=bool)
+        return ~((table.key_ids == kid)
+                 & np.isin(table.val_ids, vids)).any(axis=1)
+    if req.op == EXISTS:
+        if kid is None:
+            return np.zeros(n, dtype=bool)
+        return (table.key_ids == kid).any(axis=1)
+    if req.op == DOES_NOT_EXIST:
+        if kid is None:
+            return np.ones(n, dtype=bool)
+        return ~(table.key_ids == kid).any(axis=1)
+    if req.op in (GT, LT):
+        # both sides must parse as integers (Requirement.matches)
+        if kid is None:
+            return np.zeros(n, dtype=bool)
+        try:
+            rv = int(req.values[0])
+        except (ValueError, IndexError):
+            return np.zeros(n, dtype=bool)
+        has = table.key_ids == kid
+        # label keys are unique per pod, so at most one lane carries the key
+        vsel = np.where(has, table.val_ids, -1).max(axis=1)
+        vals = np.full(n, np.nan)
+        ok = vsel >= 0
+        vals[ok] = table.val_ints[vsel[ok]]
+        with np.errstate(invalid="ignore"):
+            return vals > rv if req.op == GT else vals < rv
+    raise ValueError(f"unknown selector op {req.op!r}")
+
+
+def selector_match_mask(selector, table) -> np.ndarray:
+    """[P] bool twin of priorities._selector_matches: dict selectors match
+    by exact pairs; LabelSelector adds match_expressions."""
+    n = len(table.pods)
+    m = np.ones(n, dtype=bool)
+    if isinstance(selector, dict):
+        for k, v in selector.items():
+            m &= _pair_mask(table, k, v)
+        return m
+    for k, v in selector.match_labels:
+        m &= _pair_mask(table, k, v)
+    for req in selector.match_expressions:
+        m &= _requirement_mask(table, req)
+    return m
+
+
+def pod_matches_term_props_mask(defining_pod: Pod, term, table) -> np.ndarray:
+    """[P] bool twin of pod_matches_term_props(target, defining_pod, term)
+    evaluated for every table row as `target` at once."""
+    n = len(table.pods)
+    if term.label_selector is None:
+        return np.zeros(n, dtype=bool)
+    ns_ids = [table.ns_vocab[x] for x in term_namespaces(defining_pod, term)
+              if x in table.ns_vocab]
+    if not ns_ids:
+        return np.zeros(n, dtype=bool)
+    m = np.isin(table.ns_id, ns_ids)
+    return m & selector_match_mask(term.label_selector, table)
+
+
 class InterPodAffinityChecker:
     """MatchInterPodAffinity over a full snapshot {node name -> NodeInfo}.
 
@@ -291,6 +399,18 @@ class InterPodAffinityChecker:
         self.node_infos = node_infos
         self._meta_uid: Optional[str] = None
         self._meta = None
+        # optional columnar acceleration (set_table_source): the metadata's
+        # whole-cluster term scans then run as one mask over the pod axis
+        self._table_fn = None
+        self._topo_fn = None
+
+    def set_table_source(self, table_fn, topo_fn) -> None:
+        """Enable vectorized metadata scans: `table_fn()` returns the
+        columnar pod table, `topo_fn(key)` the per-node dictionary-encoded
+        label values (ids[N] i32 over the table's node axis, value->id
+        vocab). Results are bit-identical to the scalar scan."""
+        self._table_fn = table_fn
+        self._topo_fn = topo_fn
 
     def invalidate(self) -> None:
         """Drop the per-pod metadata cache (whole-snapshot change, or a
@@ -359,6 +479,8 @@ class InterPodAffinityChecker:
         # topology value plus the total match count ([mutable] so deltas
         # apply in place).
         def term_values(term) -> tuple[dict[str, int], list[int]]:
+            if self._table_fn is not None:
+                return self._term_values_vec(pod, term)
             values: dict[str, int] = {}
             total = [0]
             for ni in self.node_infos.values():
@@ -383,6 +505,28 @@ class InterPodAffinityChecker:
         self._meta = (violating, aff_terms, anti_terms)
         self._meta_uid = pod.uid
         return self._meta
+
+    def _term_values_vec(self, pod: Pod, term) -> tuple[dict[str, int], list[int]]:
+        """Columnar twin of the scalar term_values scan: one mask over the
+        pod axis, counts grouped by the matching pods' node label values."""
+        table = self._table_fn()
+        m = pod_matches_term_props_mask(pod, term, table)
+        total = [int(np.count_nonzero(m))]
+        values: dict[str, int] = {}
+        if total[0]:
+            ids, vocab = self._topo_fn(term.topology_key)
+            rows = table.name_row[m]
+            rows = rows[rows >= 0]          # node_name outside the snapshot
+            if rows.size:
+                vids = ids[rows]
+                vids = vids[vids >= 0]      # node object/label absent
+                if vids.size:
+                    cnt = np.bincount(vids, minlength=len(vocab))
+                    for v, vid in vocab.items():
+                        c = int(cnt[vid])
+                        if c:
+                            values[v] = c
+        return values, total
 
     def check(self, pod: Pod, node_info: NodeInfo) -> tuple[bool, list[str]]:
         node = node_info.node

@@ -77,6 +77,10 @@ class SharedInformer:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = threading.RLock()
+        # terminal background-mode failure (revoked/denied credentials):
+        # recorded by _safe_relist before it stops the informer, so the
+        # operator sees WHY the informer died instead of a silent stall
+        self.last_error: Optional[Exception] = None
 
     # -- registration -------------------------------------------------------
     def add_event_handler(self,
@@ -198,14 +202,25 @@ class SharedInformer:
         """Background-mode re-list: transient transport failures (a remote
         apiserver mid-restart) must not kill the informer thread — retry
         until the list+watch lands or the informer stops. The synchronous
-        pump() path propagates transport errors to its caller instead."""
+        pump() path propagates transport errors to its caller instead.
+
+        Authentication/authorization failures are NOT transient: a revoked
+        or denied token will 401/403 forever, so retrying silently turns a
+        credential problem into an invisible stall. Record the error and
+        stop the informer instead (the reference reflector likewise
+        surfaces Unauthorized instead of hot-looping on it)."""
         while not self._stop.is_set():
             try:
                 self._relist()
                 return
             except ExpiredError:
                 continue
-            except Exception:
+            except Exception as e:
+                code = getattr(e, "code", None)
+                if code in (401, 403):
+                    self.last_error = e
+                    self._stop.set()
+                    return
                 if self._stop.wait(0.2):
                     return
 

@@ -145,10 +145,20 @@ class RemoteWatch:
                 d = json.loads(line)
             except ValueError:
                 continue
-            rv = int(d.get("resourceVersion", 0))
-            obj = serde.from_dict(self.kind, d["object"])
+            try:
+                rv = int(d.get("resourceVersion", 0))
+                obj = serde.from_dict(self.kind, d["object"])
+                etype = d["type"]
+            except Exception as e:   # noqa: BLE001 — schema drift
+                # an event the client cannot decode means the stream is no
+                # longer trustworthy (server/client schema drift, not a
+                # transport blip): mark the watch expired so next() raises
+                # and the informer re-lists, instead of the reader thread
+                # dying and next() hanging forever
+                self._expired = f"watch decode failed for {self.kind}: {e!r}"
+                return
             self._last_rv = rv
-            self._queue.put(Event(d["type"], self.kind, obj, rv))
+            self._queue.put(Event(etype, self.kind, obj, rv))
 
     def _check_expired(self) -> None:
         if self._expired is not None and self._queue.empty():

@@ -5,6 +5,14 @@ CronJob schedules: numbers, `*`, lists (`a,b`), ranges (`a-b`), and steps
 (`*/n`, `a-b/n`) across minute / hour / day-of-month / month / day-of-week
 (0-6, Sunday=0; 7 also accepted as Sunday). Day-of-month and day-of-week
 are OR'd when both are restricted, per cron convention.
+
+Timezone: schedules are evaluated in **UTC** (`time.gmtime`), NOT the
+process's local timezone. This is a deliberate divergence from the
+reference's kube-controller-manager, which evaluates CronJob schedules in
+its own local time (cronjob_controller.go — a documented footgun that
+makes firing times depend on where the controller-manager pod runs).
+Pinning UTC keeps `0 12 * * *` meaning 12:00 UTC on every host;
+`TestCronSchedule.test_schedule_is_utc_not_localtime` enforces it.
 """
 from __future__ import annotations
 
@@ -70,6 +78,8 @@ class CronSchedule:
         self._dow_star = fields[4].split("/", 1)[0] == "*"
 
     def matches(self, ts: float) -> bool:
+        """True when the UTC wall-clock minute containing `ts` matches
+        (schedules are UTC by contract — see the module docstring)."""
         t = time.gmtime(ts)
         if t.tm_min not in self.minute or t.tm_hour not in self.hour \
                 or t.tm_mon not in self.month:

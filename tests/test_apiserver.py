@@ -63,6 +63,24 @@ class TestSerde:
             serde.to_dict(node))))
         assert back == node
 
+    def test_quoted_forward_ref_fields_rebuild(self):
+        """tuple[\"PodCondition\", ...] style annotations: the nested quoted
+        name survives get_type_hints as a bare string inside the builtin
+        generic — decode must still rebuild the dataclass, not hand back
+        raw dicts (regression: PodScheduled conditions arrived as dicts
+        over the remote transport)."""
+        from kubernetes_tpu.api.types import (PodCondition, POD_SCHEDULED,
+                                              CONDITION_FALSE)
+        pod = Pod(name="p")
+        pod.conditions = (PodCondition(type=POD_SCHEDULED,
+                                       status=CONDITION_FALSE,
+                                       reason="Unschedulable", message="m"),)
+        back = serde.from_dict(PODS, json.loads(json.dumps(
+            serde.to_dict(pod))))
+        assert isinstance(back.conditions[0], PodCondition)
+        assert back.conditions[0].reason == "Unschedulable"
+        assert back == pod
+
 
 class TestRESTSurface:
     def test_crud_and_binding(self, server):

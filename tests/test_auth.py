@@ -84,6 +84,45 @@ class TestRBACAuthorizer:
         assert not authz.authorize(
             Attributes(UserInfo("other", ()), "get", "pods", ""))
 
+    @pytest.mark.parametrize("verb", ["list", "watch", "create"])
+    def test_resource_names_deny_collection_verbs(self, verb):
+        """resourceNames narrow a rule to SPECIFIC objects (auth.py
+        PolicyRule.allows): collection verbs carry no object name
+        (attrs.name == \"\"), so a name-scoped rule can never satisfy
+        list/watch — and create (name unknown at authorization time) is
+        denied the same way, matching the reference's RuleAllows where
+        resourceNames simply never match the empty name. Pinned here
+        because the controller-manager's */* grant otherwise hides a
+        regression in this rule entirely."""
+        authz = RBACAuthorizer(
+            roles=[Role("one-node", rules=(
+                PolicyRule(verbs=("*",), resources=("nodes",),
+                           resource_names=("special",)),))],
+            bindings=[RoleBinding("one-node", users=("carol",))])
+        carol = UserInfo("carol", ())
+        # the named object itself stays reachable through object verbs
+        assert authz.authorize(Attributes(carol, "get", "nodes", "special"))
+        assert authz.authorize(Attributes(carol, "update", "nodes", "special"))
+        # collection verbs (empty name) are denied by the same rule
+        assert not authz.authorize(Attributes(carol, verb, "nodes", ""))
+        # and an unlisted name stays denied for any verb
+        assert not authz.authorize(Attributes(carol, verb, "nodes", "other"))
+
+    def test_resource_names_collection_deny_not_masked_by_union(self):
+        """The same pinning through a union with the node authorizer (the
+        server's real stack shape): the deny must survive stacking, not
+        just the single-authorizer unit."""
+        authz = union(RBACAuthorizer(
+            roles=[Role("one-node", rules=(
+                PolicyRule(verbs=("*",), resources=("nodes",),
+                           resource_names=("special",)),))],
+            bindings=[RoleBinding("one-node", users=("carol",))]),
+            NodeAuthorizer())
+        carol = UserInfo("carol", ())
+        assert not authz.authorize(Attributes(carol, "list", "nodes", ""))
+        assert not authz.authorize(Attributes(carol, "watch", "nodes", ""))
+        assert not authz.authorize(Attributes(carol, "create", "nodes", ""))
+
 
 KUBELET1 = UserInfo("system:node:n1", ("system:nodes",))
 IMPOSTOR = UserInfo("system:node:n1", ())   # right name, not in the group

@@ -72,6 +72,29 @@ class TestCronSchedule:
         assert CronSchedule("* * * * 1-7").dow == frozenset(range(7))
         assert CronSchedule("* * * * 5-7").dow == frozenset({5, 6, 0})
 
+    def test_schedule_is_utc_not_localtime(self):
+        """Pin the documented UTC contract (utils/cron.py): a schedule
+        matches the UTC wall clock regardless of the process TZ. Evaluated
+        under a shifted TZ so a localtime regression cannot pass."""
+        import os
+        import time as _t
+        s = CronSchedule("0 12 * * *")
+        noon_utc = 12 * 3600            # 1970-01-01 12:00:00 UTC
+        old = os.environ.get("TZ")
+        os.environ["TZ"] = "America/Los_Angeles"   # UTC-8 on that date
+        _t.tzset()
+        try:
+            assert s.matches(noon_utc)                   # 04:00 local
+            assert not s.matches(noon_utc + 8 * 3600)    # 12:00 local
+            # next_after stays UTC-anchored too
+            assert s.next_after(0) == float(noon_utc)
+        finally:
+            if old is None:
+                os.environ.pop("TZ", None)
+            else:
+                os.environ["TZ"] = old
+            _t.tzset()
+
     def test_star_step_counts_as_star_for_or_rule(self):
         # robfig: '*/2' in dom keeps AND semantics with a restricted dow
         s = CronSchedule("0 0 */2 * 4")        # odd days AND Thursdays
@@ -414,6 +437,37 @@ class TestHPAController:
         self._feed(store, 2000)  # ratio 20 -> clamped to max 10
         ctl.pump()
         assert store.get(DEPLOYMENTS, "default/web").replicas == 10
+
+    def test_scale_down_fills_missing_metrics_with_full_utilization(self):
+        """replica_calculator.go:106: on the way DOWN a metric-less pod
+        counts as 100% of its request — filling with the target value
+        over-shrinks during rollouts (the fresh pods have no samples yet).
+        Here 2 of 4 pods report 10% utilization: the 100% fill lands the
+        rebased average at exactly the tolerance edge, so the move is
+        discarded; the old target-fill would have shrunk to 3."""
+        store = Store()
+        ctl, clock = self._mk(store)
+        ctl.sync()
+        self._world(store, replicas=4, cpu_req=200)
+        for i in range(2):   # only the first two pods have samples
+            store.create(PODMETRICS, PodMetrics(name=f"web-{i}",
+                                                cpu_usage=20))
+        ctl.pump()
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 4
+
+    def test_scale_down_with_missing_metrics_still_moves_when_warranted(self):
+        """Deep over-provisioning scales down even after the conservative
+        100% fill: 3 idle pods + 1 metric-less -> (0*3 + 100)/4 = 25% vs
+        the 50% target -> ceil(4 * 0.5) = 2 replicas."""
+        store = Store()
+        ctl, clock = self._mk(store)
+        ctl.sync()
+        self._world(store, replicas=4, cpu_req=200)
+        for i in range(3):
+            store.create(PODMETRICS, PodMetrics(name=f"web-{i}",
+                                                cpu_usage=0))
+        ctl.pump()
+        assert store.get(DEPLOYMENTS, "default/web").replicas == 2
 
     def test_end_to_end_scale_then_schedule(self):
         """The VERDICT done criterion: metrics source -> HPA scales the

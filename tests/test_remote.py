@@ -166,6 +166,91 @@ class TestRemoteWatch:
             w.stop()
 
 
+class TestWatchDecodeFailure:
+    def test_malformed_event_marks_watch_expired(self, served, monkeypatch):
+        """Schema drift: an event the client cannot decode must surface as
+        ExpiredError from next() (informer re-lists) — the reader thread
+        dying silently used to leave next() hanging forever."""
+        store, remote = served
+        w = remote.watch(PODS)
+        try:
+            def drifted(kind, d):
+                raise ValueError("unknown field shape")
+            monkeypatch.setattr(
+                "kubernetes_tpu.store.remote.serde.from_dict", drifted)
+            store.create(PODS, mkpod("p1"))
+
+            def sees_expiry():
+                try:
+                    w.next(timeout=0.05)
+                    return False
+                except ExpiredError:
+                    return True
+            assert wait_until(sees_expiry)
+            # terminal: every subsequent next() keeps raising
+            with pytest.raises(ExpiredError):
+                w.next(timeout=0.01)
+        finally:
+            w.stop()
+
+
+class TestInformerAuthFailure:
+    def test_background_relist_stops_on_revoked_token(self):
+        """A 401/403 during the background re-list is not transient: the
+        informer must record the error and stop instead of silently
+        retrying a revoked token forever (store/informer._safe_relist)."""
+        from kubernetes_tpu.store.informer import SharedInformer
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n1"))
+        inf = SharedInformer(store, NODES)
+        inf.sync()
+
+        class Revoked:
+            calls = 0
+
+            def list(self, kind):
+                Revoked.calls += 1
+                raise APIStatusError(401, "Unauthorized", "token revoked")
+
+            def watch(self, kind, since_rv=None):
+                raise AssertionError("watch must not open after 401")
+
+        inf.store = Revoked()
+        inf._safe_relist()
+        assert isinstance(inf.last_error, APIStatusError)
+        assert inf.last_error.code == 401
+        assert inf._stop.is_set()          # the informer thread loop exits
+        assert Revoked.calls == 1          # no retry storm
+
+    def test_background_relist_still_retries_transient_errors(self):
+        """The transient path is unchanged: a transport blip retries and
+        the informer stays alive once the list lands."""
+        from kubernetes_tpu.store.informer import SharedInformer
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n1"))
+        inf = SharedInformer(store, NODES)
+        inf.sync()
+        real = inf.store
+
+        class Blippy:
+            calls = 0
+
+            def list(self, kind):
+                Blippy.calls += 1
+                if Blippy.calls == 1:
+                    raise OSError("connection reset")
+                return real.list(kind)
+
+            def watch(self, kind, since_rv=None):
+                return real.watch(kind, since_rv=since_rv)
+
+        inf.store = Blippy()
+        inf._safe_relist()
+        assert inf.last_error is None
+        assert not inf._stop.is_set()
+        assert Blippy.calls == 2
+
+
 class TestInformerRelist:
     def test_replace_semantics_on_relist(self, served):
         """DeltaFIFO Replace (delta_fifo.go:96): after an expired-window

@@ -582,7 +582,7 @@ class TestMixedWorkloadShellFuzz:
     burst segmentation, uniform/ELIM/ban kernels, rotation replay, refusals,
     and the serial fallback together."""
 
-    @pytest.mark.parametrize("seed", [11, 23, 47])
+    @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
     def test_bindings_identical(self, seed):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
@@ -680,7 +680,7 @@ class TestPreemptionPressureShellFuzz:
     nominations must match between the TPU shell and the oracle shell under
     an identical deterministic round structure."""
 
-    @pytest.mark.parametrize("seed", [3, 5, 17])
+    @pytest.mark.parametrize("seed", [3, 5, 17, 7, 29])
     def test_preemptive_convergence_identical(self, seed):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
@@ -778,6 +778,72 @@ class TestSpreadBurstParity:
                 s.create(PODS, Pod(name=f"p{j}", labels={"app": "web"},
                                    containers=(Container.make(
                                        name="c", requests={"cpu": 300,
+                                                           "memory": GI}),)))
+            sched.pump()
+            if use_tpu:
+                while sched.schedule_burst(max_pods=16):
+                    pass
+            else:
+                while sched.schedule_one(timeout=0.0):
+                    pass
+            sched.pump()
+            outs.append(sorted((p.key, p.node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1]
+
+    @pytest.mark.parametrize("seed", [13, 37, 71])
+    def test_burst_matches_oracle_with_existing_pods(self, seed):
+        """The vectorized spread encode counts pre-existing pods through
+        the columnar table: some existing pods match the Service selector
+        (non-zero spread0 carried into the burst), some differ only in
+        namespace or a second label — exactly the row filters the table
+        encodes."""
+        import random
+        from kubernetes_tpu.store.store import Store, PODS, NODES, SERVICES
+        from kubernetes_tpu.scheduler import Scheduler
+        rng = random.Random(seed)
+        GI = 1024 ** 3
+        n_nodes = rng.randint(6, 12)
+        zones = rng.choice([2, 3])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={LABEL_HOSTNAME: f"n{i}",
+                            "failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % zones}",
+                            "failure-domain.beta.kubernetes.io/region": "r1"},
+                    allocatable={"cpu": 8000, "memory": 32 * GI,
+                                 "pods": 110}))
+            s.create(SERVICES, Service(name="svc",
+                                       selector={"app": "web"}))
+            for j in range(rng.randint(5, 15)):
+                labels = rng.choice([{"app": "web"},
+                                     {"app": "web", "tier": "x"},
+                                     {"app": "other"}])
+                ns = rng.choice(["default", "default", "team-a"])
+                s.create(PODS, Pod(name=f"e{j}", namespace=ns,
+                                   labels=dict(labels),
+                                   node_name=f"n{j % n_nodes}",
+                                   containers=(Container.make(
+                                       name="c",
+                                       requests={"cpu": 100}),)))
+            return s
+
+        rng_state = rng.getstate()
+        outs = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            sched.sync()
+            for j in range(rng.randint(15, 30)):
+                s.create(PODS, Pod(name=f"p{j}", labels={"app": "web"},
+                                   containers=(Container.make(
+                                       name="c", requests={"cpu": 200,
                                                            "memory": GI}),)))
             sched.pump()
             if use_tpu:
