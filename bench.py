@@ -112,6 +112,12 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
                 break
             bound += n
     elapsed = time.perf_counter() - t0
+    # one parent span over the timed loop — the per-launch encode /
+    # dispatch / fetch spans the TPU pipeline records nest under it in the
+    # trace viewer (bench.py --trace)
+    from kubernetes_tpu.obs import trace as obs_trace
+    obs_trace.add_span(f"bench.schedule_loop.{mode}", t0, t0 + elapsed,
+                       args={"bound": bound, "nodes": n_nodes})
     sched.pump()  # confirm bindings
 
     throughput = bound / elapsed if elapsed > 0 else 0.0
@@ -349,18 +355,34 @@ def main():
     ap.add_argument("--no-matrix", dest="matrix", action="store_false",
                     help="skip the workload-lane matrix")
     ap.add_argument("--matrix-repeat", type=int, default=2)
+    ap.add_argument("--trace", metavar="PATH", default=None,
+                    help="write the run's spans as Chrome trace-event JSON "
+                         "(load in Perfetto / chrome://tracing); host-encode "
+                         "vs device dispatch+readback separate by span "
+                         "category")
     args = ap.parse_args()
+
+    def finish(result: dict) -> None:
+        if args.trace:
+            from kubernetes_tpu.obs import trace as obs_trace
+            result["trace"] = {"path": args.trace,
+                               "spans": obs_trace.export(args.trace)}
+        print(json.dumps(result))
+
+    if args.trace:
+        from kubernetes_tpu.obs import trace as obs_trace
+        obs_trace.clear()   # only this run's spans land in the file
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     if args.mode == "preempt":
         result = retry_transient(
             lambda: run_preempt_bench(args.nodes, args.pods))
-        print(json.dumps(result))
+        finish(result)
         return
     if args.mode == "matrix":
         # just the matrix lanes + ratio-to-plain, one JSON line (transient
         # isolation happens per lane inside run_matrix)
-        print(json.dumps(run_matrix_only(repeat=args.matrix_repeat)))
+        finish(run_matrix_only(repeat=args.matrix_repeat))
         return
     mesh = _make_mesh() if args.mesh else None
     # each timed repeat individually survives a dropped tunnel response
@@ -416,7 +438,7 @@ def main():
         # run_matrix handles transient isolation per lane internally and
         # re-raises real bugs — no wrapper here
         result["matrix"] = run_matrix(repeat=args.matrix_repeat)
-    print(json.dumps(result))
+    finish(result)
 
 
 if __name__ == "__main__":

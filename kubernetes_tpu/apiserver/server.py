@@ -25,9 +25,12 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import urlparse, parse_qs
 
+from kubernetes_tpu import obs
+from kubernetes_tpu.obs import trace as obs_trace
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
@@ -38,6 +41,24 @@ from kubernetes_tpu.store.store import (
 
 API_PREFIX = "/api/v1"
 
+# request metrics (apiserver_request_total / ..._duration_seconds /
+# ..._longrunning analogs, staging/src/k8s.io/apiserver metrics.go) —
+# registered at import so /metrics always exposes the families
+REQUEST_TOTAL = obs.counter(
+    "apiserver_request_total",
+    "Requests served, by verb, resource, and HTTP code.",
+    ("verb", "resource", "code"))
+REQUEST_DURATION = obs.histogram(
+    "apiserver_request_duration_seconds",
+    "Request latency by verb (long-running watch streams excluded).",
+    ("verb",))
+IN_FLIGHT = obs.gauge(
+    "apiserver_requests_in_flight",
+    "Requests currently being served.")
+ACTIVE_WATCHES = obs.gauge(
+    "apiserver_active_watches",
+    "Currently open watch streams, by resource.", ("resource",))
+
 
 def make_handler(store: Store, admission: AdmissionChain,
                  authenticator=None, authorizer=None):
@@ -46,6 +67,63 @@ def make_handler(store: Store, admission: AdmissionChain,
 
         def log_message(self, *a):   # quiet
             pass
+
+        # -- instrumentation ------------------------------------------------
+        def send_response(self, code, message=None):
+            self._last_code = code
+            super().send_response(code, message)
+
+        def _classify(self) -> tuple[str, str]:
+            """(verb, resource) for the request-metric labels — REST verbs
+            for API paths, the raw method for operational endpoints."""
+            u = urlparse(self.path)
+            parts = [p for p in u.path.split("/") if p]
+            method = self.command
+            if len(parts) >= 3 and "/".join(parts[:2]) == "api/v1":
+                resource = parts[2]
+                if method == "GET":
+                    if len(parts) == 3:
+                        q = parse_qs(u.query)
+                        verb = ("watch"
+                                if q.get("watch", ["false"])[0] == "true"
+                                else "list")
+                    else:
+                        verb = "get"
+                else:
+                    verb = {"POST": "create", "PUT": "update",
+                            "DELETE": "delete"}.get(method, method.lower())
+                return verb, resource
+            return method.lower(), (parts[0] if parts else "/")
+
+        def _instrumented(self, inner) -> None:
+            verb, resource = self._classify()
+            self._last_code = 0
+            t0 = time.perf_counter()
+            IN_FLIGHT.inc()
+            try:
+                inner()
+            finally:
+                IN_FLIGHT.dec()
+                REQUEST_TOTAL.labels(verb, resource,
+                                     str(self._last_code or 0)).inc()
+                # long-running requests skip the duration histogram (the
+                # reference excludes watches the same way) — a watch's
+                # lifetime would swamp the latency signal
+                if verb != "watch":
+                    REQUEST_DURATION.labels(verb).observe(
+                        time.perf_counter() - t0)
+
+        def do_GET(self):
+            self._instrumented(self._serve_GET)
+
+        def do_POST(self):
+            self._instrumented(self._serve_POST)
+
+        def do_PUT(self):
+            self._instrumented(self._serve_PUT)
+
+        def do_DELETE(self):
+            self._instrumented(self._serve_DELETE)
 
         # -- authn/authz ----------------------------------------------------
         def _authenticate(self):
@@ -111,7 +189,7 @@ def make_handler(store: Store, admission: AdmissionChain,
             return json.loads(self.rfile.read(n) or b"{}")
 
         # -- verbs ----------------------------------------------------------
-        def do_GET(self):
+        def _serve_GET(self):
             path, parts, q = self._route()
             if path in ("/healthz", "/readyz", "/livez"):
                 self.send_response(200)
@@ -119,6 +197,23 @@ def make_handler(store: Store, admission: AdmissionChain,
                 self.send_header("Content-Length", "2")
                 self.end_headers()
                 self.wfile.write(b"ok")
+                return
+            if path == "/metrics":
+                # one scrape of the process-global registry: apiserver
+                # request families plus whatever components (workqueues,
+                # informers, device pipeline) registered in this process
+                body = obs.render_global().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path == "/debug/traces":
+                # Chrome trace-event JSON of the span ring buffer —
+                # loadable in Perfetto / chrome://tracing
+                self._send(200, obs_trace.to_chrome())
                 return
             if path == "/version":
                 self._send(200, {"gitVersion": "v0.3.0-kubernetes-tpu"})
@@ -163,6 +258,7 @@ def make_handler(store: Store, admission: AdmissionChain,
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            ACTIVE_WATCHES.labels(kind).inc()
 
             def emit(line: bytes) -> bool:
                 try:
@@ -187,6 +283,7 @@ def make_handler(store: Store, admission: AdmissionChain,
                     if not emit(line):
                         break
             finally:
+                ACTIVE_WATCHES.labels(kind).dec()
                 w.stop()
                 try:
                     self.wfile.write(b"0\r\n\r\n")
@@ -194,7 +291,7 @@ def make_handler(store: Store, admission: AdmissionChain,
                     pass
                 self.close_connection = True
 
-        def do_POST(self):
+        def _serve_POST(self):
             path, parts, q = self._route()
             user = self._authenticate()
             # binding subresource: POST /api/v1/pods/{ns}/{name}/binding
@@ -244,7 +341,7 @@ def make_handler(store: Store, admission: AdmissionChain,
                 return
             self._send(201, serde.to_dict(created))
 
-        def do_PUT(self):
+        def _serve_PUT(self):
             path, parts, q = self._route()
             if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
                 self._error(404, "NotFound", path)
@@ -284,7 +381,7 @@ def make_handler(store: Store, admission: AdmissionChain,
                 return
             self._send(200, serde.to_dict(updated))
 
-        def do_DELETE(self):
+        def _serve_DELETE(self):
             path, parts, q = self._route()
             if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
                 self._error(404, "NotFound", path)

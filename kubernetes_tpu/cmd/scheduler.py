@@ -85,7 +85,17 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             self._send(200, "ok")
         elif self.path == "/metrics":
-            self._send(200, render_metrics(self.scheduler))
+            # scheduler families + the process-global registry (device
+            # pipeline, informers, workqueues) in one scrape — name sets
+            # are disjoint, so the concatenation stays lintable
+            from kubernetes_tpu import obs
+            self._send(200, render_metrics(self.scheduler)
+                       + obs.render_global(),
+                       "text/plain; version=0.0.4")
+        elif self.path == "/debug/traces":
+            from kubernetes_tpu.obs import trace as obs_trace
+            self._send(200, json.dumps(obs_trace.to_chrome()),
+                       "application/json")
         elif self.path == "/configz":
             self._send(200, json.dumps(self.scheduler_config.to_dict()),
                        "application/json")

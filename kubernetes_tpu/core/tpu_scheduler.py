@@ -31,9 +31,47 @@ from kubernetes_tpu.ops.node_state import (
     IPA_EXISTING_ANTI, IPA_OWN_AFFINITY, IPA_OWN_ANTI,
 )
 from kubernetes_tpu.ops import kernels as K
+from kubernetes_tpu import obs
+from kubernetes_tpu.obs import trace as obs_trace
 
 import jax
 import jax.numpy as jnp
+
+# device-pipeline counters (the /metrics view of PROFILE.md's cost model:
+# every dispatch pays the tunnel RTT, every fetch ships bytes, and every
+# fallback/refusal moves work back to host Python)
+DEVICE_DISPATCH = obs.counter(
+    "tpu_device_dispatch_total",
+    "Device program dispatches, by op.", ("op",))
+DEVICE_FETCHED_BYTES = obs.counter(
+    "tpu_device_fetched_bytes_total",
+    "Bytes fetched device-to-host, by op.", ("op",))
+ORACLE_FALLBACKS = obs.counter(
+    "tpu_oracle_fallback_total",
+    "Decisions routed off the device path (host twin / serial rerun), "
+    "by reason.", ("reason",))
+PRESSURE_GATES = obs.counter(
+    "tpu_pressure_gate_rejections_total",
+    "preempt_pressure_burst refusals, by gate.", ("gate",))
+DISCARDED_FOLDS = obs.counter(
+    "tpu_burst_folds_discarded_total",
+    "Device-resident burst folds dropped after a mid-burst failure.")
+
+# span names for the burst phase markers ("kernel" is the async dispatch;
+# "fetch" is where device time is actually PAID — CLAUDE.md: the tunnel's
+# block_until_ready doesn't block, so readback timing IS device timing)
+_PHASE_SPANS = {"encode": ("burst.encode", "host"),
+                "kernel": ("burst.dispatch", "device"),
+                "fetch": ("burst.fetch", "device")}
+
+
+def _fetched_nbytes(obj) -> int:
+    """Total nbytes of a fetched pytree (dict/list/tuple of ndarrays)."""
+    if isinstance(obj, dict):
+        return sum(_fetched_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(_fetched_nbytes(v) for v in obj)
+    return int(getattr(obj, "nbytes", 8))
 
 
 def _pad_pow2(n: int, minimum: int = 1) -> int:
@@ -162,6 +200,7 @@ class TPUScheduler:
                 self._dev_nodes = S.shard_node_arrays(self.mesh, host)
             else:
                 self._dev_nodes = {k: jnp.asarray(v) for k, v in host.items()}
+            DEVICE_DISPATCH.labels("upload").inc()
             self._dev_key = key
             b.dirty_rows = []   # host state fully mirrored; start tracking
             return self._dev_nodes
@@ -175,6 +214,7 @@ class TPUScheduler:
                 [rows, np.full(bucket - len(rows), rows[0], dtype=np.int32)])
             upd = {k: getattr(b, k)[rows] for k in self._NODE_FIELDS}
             self._dev_nodes = _scatter_rows(self._dev_nodes, rows, upd)
+            DEVICE_DISPATCH.labels("scatter").inc()
             b.dirty_rows = []
         return self._dev_nodes
 
@@ -379,10 +419,15 @@ class TPUScheduler:
         self._serial_cycles += 1
         if self.nominated is not None and self.nominated.has_any():
             use_twin = True     # two-pass ghost-pod fitting lives on the twin
+            reason = "nominated-ghosts"
         elif self.serial_path == "adaptive":
             use_twin = self._serial_pick_host_twin()
+            reason = "adaptive-twin-faster"
         else:
             use_twin = self.serial_path == "host"
+            reason = "serial-path-host"
+        if use_twin:
+            ORACLE_FALLBACKS.labels(reason).inc()
         import time as _time
         t0 = _time.perf_counter()
         try:
@@ -442,7 +487,12 @@ class TPUScheduler:
             fetch.update(kept=out["kept"], total=out["total"],
                          fail_first=out["fail_first"],
                          general_bits=out["general_bits"])
+        t_fetch = obs_trace.now()
         h = jax.device_get(fetch)
+        DEVICE_DISPATCH.labels("cycle").inc()
+        DEVICE_FETCHED_BYTES.labels("cycle").inc(_fetched_nbytes(h))
+        obs_trace.add_span("cycle.fetch", t_fetch, obs_trace.now(),
+                           cat="device")
         found = int(h["found"])
         evaluated = int(h["evaluated"])
         start = self.last_index
@@ -731,6 +781,8 @@ class TPUScheduler:
             now = _time.perf_counter()
             if self.metrics is not None:
                 self.metrics.observe_phase(phase, now - t_start)
+            name, cat = _PHASE_SPANS[phase]
+            obs_trace.add_span(name, t_start, now, cat=cat)
             return now
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
@@ -777,8 +829,10 @@ class TPUScheduler:
                     extra_ok=extra_ok, ban=ban, mesh=self.mesh)
                 self._dev_nodes = {**self._dev_nodes, **rows}
                 nodes = self._dev_nodes
+                DEVICE_DISPATCH.labels("burst_uniform").inc()
                 _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
                 h = np.asarray(packed)   # ONE fetch: selections + lni delta
+                DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
                 _t = _obs("fetch", _t)
                 self.last_node_index += int(h[K.B_CAP])
                 sel.extend(h[:chunk].tolist())
@@ -798,6 +852,7 @@ class TPUScheduler:
             # whose masks depend on in-burst placements (affinity/ports)
             # are only safe on the uniform path above — refuse, the shell
             # runs them serially
+            ORACLE_FALLBACKS.labels("burst-affinity-mixed").inc()
             return None
         # spec-identical pods produce identical encoder output against a
         # fixed snapshot: encode ONE pod and share (the O(N) python feature
@@ -813,6 +868,7 @@ class TPUScheduler:
         # scan carries them only for spec-identical pods (one selector set)
         carry_spread = any(f.spread_counts is not None for f in feats)
         if carry_spread and not uniform_spec:
+            ORACLE_FALLBACKS.labels("burst-spread-mixed").inc()
             return None
         rotation = None
         rotation_pos = None
@@ -855,7 +911,9 @@ class TPUScheduler:
         stacked = self._stack_pods(per_pod)
         if carry_spread and (spread0 is None
                              or spread0.shape[-1] != b.n_pad):
-            return None   # inert/dense mix — shouldn't happen, stay exact
+            # inert/dense mix — shouldn't happen, stay exact
+            ORACLE_FALLBACKS.labels("burst-spread-shape").inc()
+            return None
         z_pad = _pad_pow2(len(b.zone_names), 4)
         _t = _obs("encode", _t0)
         if self.mesh is not None:
@@ -867,10 +925,13 @@ class TPUScheduler:
                 seq = (rotation[2] if rotation is not None
                        else rotation_pos[1])
                 if np.asarray(seq[:len(pods)]).any():
+                    ORACLE_FALLBACKS.labels("burst-sharded-rotation").inc()
                     return None
                 rotation = rotation_pos = None
             if carry_spread:
-                return None   # the sharded scan doesn't model this yet
+                # the sharded scan doesn't model this yet
+                ORACLE_FALLBACKS.labels("burst-sharded-spread").inc()
+                return None
             from kubernetes_tpu.parallel import sharding as S
             if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
                 self._sharded_batch = (z_pad, S.sharded_batch_fn(
@@ -885,9 +946,11 @@ class TPUScheduler:
                 num_to_find, n, z_pad, weights=self.weights,
                 rotation=rotation, spread0=spread0,
                 rotation_pos=rotation_pos)
+        DEVICE_DISPATCH.labels("burst_scan").inc()
         _t = _obs("kernel", _t)
         selected = np.asarray(outs["selected"])[: len(pods)]
         li, lni = int(li), int(lni)
+        DEVICE_FETCHED_BYTES.labels("burst_scan").inc(selected.nbytes + 16)
         _obs("fetch", _t)
         if (selected < 0).any():
             # burst contract: everything from the first failure on is
@@ -945,11 +1008,14 @@ class TPUScheduler:
         if not all_node_names:
             return None
         if self.nominated is not None and self.nominated.has_any():
+            ORACLE_FALLBACKS.labels("preempt-nominated-ghosts").inc()
             return None
         if pod.volumes:
+            ORACLE_FALLBACKS.labels("preempt-pod-volumes").inc()
             return None
         req = get_resource_request(pod)
         if req.scalar:
+            ORACLE_FALLBACKS.labels("preempt-scalar-request").inc()
             return None
         pod_ports = bool(get_container_ports(pod))
         a = pod.affinity
@@ -975,6 +1041,7 @@ class TPUScheduler:
                                       pdbs, pod=pod, pod_ports=pod_ports,
                                       pod_terms=pod_terms)
         if packed is None:
+            ORACLE_FALLBACKS.labels("preempt-victims-not-inert").inc()
             return None
         vic, slots = packed
         enc = PodEncoder(node_infos, b, self.services_fn(),
@@ -986,6 +1053,7 @@ class TPUScheduler:
                          state_encoder=self.encoder)
         f = enc.encode(pod)
         if f.unknown_scalars:
+            ORACLE_FALLBACKS.labels("preempt-unknown-scalars").inc()
             return None
         n_pad = b.n_pad
         feas = np.zeros(n_pad, bool)
@@ -1006,9 +1074,14 @@ class TPUScheduler:
         pod_in = {"req_cpu": np.int64(req.milli_cpu),
                   "req_mem": np.int64(req.memory),
                   "req_eph": np.int64(req.ephemeral_storage)}
+        t_scan = obs_trace.now()
         out = np.asarray(K.preemption_scan(
             nodes, vic, pod_in, feas, order_rank, b.n_real,
             self.check_resources, f.has_request))
+        DEVICE_DISPATCH.labels("preempt_scan").inc()
+        DEVICE_FETCHED_BYTES.labels("preempt_scan").inc(out.nbytes)
+        obs_trace.add_span("preempt.scan", t_scan, obs_trace.now(),
+                           cat="device")
         winner = int(out[0])
         if winner < 0:
             return PreemptionResult(None, [], [])
@@ -1122,20 +1195,27 @@ class TPUScheduler:
         if not pods or not all_node_names:
             return None
         if self.mesh is not None:
+            PRESSURE_GATES.labels("mesh-mode").inc()
             return None
         if self.nominated is not None and self.nominated.has_any():
+            PRESSURE_GATES.labels("nominated-ghosts").inc()
             return None
         if self._tree_rotates():
+            PRESSURE_GATES.labels("tree-rotation").inc()
             return None
         prios = [p.priority for p in pods]
         if any(a < bb for a, bb in zip(prios, prios[1:])):
+            PRESSURE_GATES.labels("priority-order").inc()
             return None
         for p in pods:
             if p.volumes or p.nominated_node_name:
+                PRESSURE_GATES.labels("pod-features").inc()
                 return None
             if has_pod_affinity_terms(p) or get_container_ports(p):
+                PRESSURE_GATES.labels("pod-features").inc()
                 return None
             if get_resource_request(p).scalar:
+                PRESSURE_GATES.labels("pod-features").inc()
                 return None
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
@@ -1156,14 +1236,17 @@ class TPUScheduler:
             feats.append(f)
         for f in feats:
             if f.unknown_scalars:
+                PRESSURE_GATES.labels("pod-features").inc()
                 return None
             if f.spread_counts is not None:
                 # selector-spread scoring depends on in-burst placements;
                 # the pressure scan doesn't carry spread counts
+                PRESSURE_GATES.labels("spread-selectors").inc()
                 return None
         packed = self._encode_victims(node_infos, b, all_node_names,
                                       prios[0], pdbs)
         if packed is None:
+            PRESSURE_GATES.labels("victims-not-inert").inc()
             return None
         vic, slots = packed
         per_pod = []
@@ -1191,9 +1274,15 @@ class TPUScheduler:
             mut0, ghost0, li, lni, outs = K.pressure_batch(
                 nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find, n,
                 z_pad, weights=self.weights)
+            DEVICE_DISPATCH.labels("pressure_batch").inc()
             outs_chunks.append(outs)
         # ONE fetch for every chunk's outputs + the final counters
+        t_fetch = obs_trace.now()
         h_chunks, li, lni = jax.device_get((outs_chunks, li, lni))
+        DEVICE_FETCHED_BYTES.labels("pressure_batch").inc(
+            _fetched_nbytes(h_chunks))
+        obs_trace.add_span("pressure.fetch", t_fetch, obs_trace.now(),
+                           cat="device")
         outcomes = []
         k = 0
         for h in h_chunks:
@@ -1227,6 +1316,8 @@ class TPUScheduler:
         decisions the shell discarded (the serial tail after a mid-burst
         failure) must not leak into later cycles — the next use re-uploads
         from the host mirror, which only reflects consumed decisions."""
+        if self._dev_nodes is not None:
+            DISCARDED_FOLDS.inc()
         self._dev_nodes = None
 
     def note_burst_assumed(self, pod: Pod, host: str, generation: int) -> None:

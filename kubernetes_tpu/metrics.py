@@ -1,13 +1,22 @@
-"""Metrics exposition — pkg/scheduler/metrics/metrics.go analog.
+"""Scheduler metrics exposition — pkg/scheduler/metrics/metrics.go analog.
 
 Renders the scheduler's counters and queue gauges in Prometheus text
-exposition format (the /metrics endpoint payload, server.go:284-295).
-The metric names mirror the reference's set: schedule_attempts_total,
-binding totals, preemption counters, pending_pods by queue.
+exposition format (the /metrics endpoint payload, server.go:284-295),
+built on the shared obs registry (kubernetes_tpu/obs) instead of the old
+hand-rolled string renderer — label values are escaped per the text
+format now, and the family set is lintable (obs.lint).
+
+The scheduler's live counters stay in SchedulerMetrics (scheduler.py);
+each scrape snapshots them into a fresh Registry so concurrent scrapes
+and resets never tear a family mid-render. The metric names mirror the
+reference's set: schedule_attempts_total, binding totals, preemption
+counters, pending_pods by queue.
 """
 from __future__ import annotations
 
 from typing import TYPE_CHECKING
+
+from kubernetes_tpu.obs.registry import Registry
 
 if TYPE_CHECKING:
     from kubernetes_tpu.scheduler import Scheduler
@@ -15,78 +24,64 @@ if TYPE_CHECKING:
 PREFIX = "scheduler"
 
 
-def render_metrics(sched: "Scheduler") -> str:
-    """One scrape of the scheduler's metric families."""
+def _copy_histogram(fam, src, *labels) -> None:
+    """Snapshot one scheduler.Histogram into a registry child (same
+    cumulative-bucket layout, reference ExponentialBuckets(0.001, 2, 15))."""
+    child = fam.labels(*labels)
+    child.buckets = list(src.buckets)
+    child.count = src.count
+    child.sum = src.sum
+
+
+def build_registry(sched: "Scheduler") -> Registry:
+    """One scrape of the scheduler's metric families as a Registry."""
     m = sched.metrics
     pending = sched.queue.pending_pods()
-    lines = [
-        f"# HELP {PREFIX}_schedule_attempts_total Number of attempts to schedule pods, by result.",
-        f"# TYPE {PREFIX}_schedule_attempts_total counter",
-    ]
-    for result, count in sorted(m.schedule_attempts.items()):
-        lines.append(
-            f'{PREFIX}_schedule_attempts_total{{result="{result}"}} {count}')
-    lines += [
-        f"# HELP {PREFIX}_binding_total Number of successful pod bindings.",
-        f"# TYPE {PREFIX}_binding_total counter",
-        f"{PREFIX}_binding_total {m.binding_count}",
-        f"# HELP {PREFIX}_total_preemption_attempts Total preemption attempts.",
-        f"# TYPE {PREFIX}_total_preemption_attempts counter",
-        f"{PREFIX}_total_preemption_attempts {m.preemption_attempts}",
-        f"# HELP {PREFIX}_pod_preemption_victims Number of preemption victims.",
-        f"# TYPE {PREFIX}_pod_preemption_victims counter",
-        f"{PREFIX}_pod_preemption_victims {m.preemption_victims}",
-    ]
+    r = Registry()
+    attempts = r.counter(
+        f"{PREFIX}_schedule_attempts_total",
+        "Number of attempts to schedule pods, by result.", ("result",))
+    for result, count in m.schedule_attempts.items():
+        attempts.labels(result).inc(count)
+    r.counter(f"{PREFIX}_binding_total",
+              "Number of successful pod bindings.").inc(m.binding_count)
+    r.counter(f"{PREFIX}_total_preemption_attempts",
+              "Total preemption attempts.").inc(m.preemption_attempts)
+    r.counter(f"{PREFIX}_pod_preemption_victims",
+              "Number of preemption victims.").inc(m.preemption_victims)
     # per-phase duration histograms (metrics.go:67-169
     # scheduling_duration_seconds / binding_duration_seconds /
     # e2e_scheduling_duration_seconds) — phases here are the TPU pipeline's:
     # encode/kernel/fetch plus algorithm/preemption/binding
-    lines += [
-        f"# HELP {PREFIX}_scheduling_duration_seconds Scheduling phase latency, by operation.",
-        f"# TYPE {PREFIX}_scheduling_duration_seconds histogram",
-    ]
+    phases = r.histogram(
+        f"{PREFIX}_scheduling_duration_seconds",
+        "Scheduling phase latency, by operation.", ("operation",))
     for phase in sorted(m.phase_duration):
-        lines += m.phase_duration[phase].render(
-            f"{PREFIX}_scheduling_duration_seconds",
-            labels=f'operation="{phase}"')
-    lines += [
-        f"# HELP {PREFIX}_binding_duration_seconds Binding latency.",
-        f"# TYPE {PREFIX}_binding_duration_seconds histogram",
-    ]
-    lines += m.binding_duration.render(f"{PREFIX}_binding_duration_seconds")
-    lines += [
-        f"# HELP {PREFIX}_e2e_scheduling_duration_seconds End-to-end scheduling latency.",
-        f"# TYPE {PREFIX}_e2e_scheduling_duration_seconds histogram",
-    ]
-    lines += m.e2e_duration.render(f"{PREFIX}_e2e_scheduling_duration_seconds")
-    lines += [
-        f"# HELP {PREFIX}_pending_pods Pending pods by queue.",
-        f"# TYPE {PREFIX}_pending_pods gauge",
-    ]
+        _copy_histogram(phases, m.phase_duration[phase], phase)
+    binding = r.histogram(f"{PREFIX}_binding_duration_seconds",
+                          "Binding latency.")
+    _copy_histogram(binding, m.binding_duration)
+    e2e = r.histogram(f"{PREFIX}_e2e_scheduling_duration_seconds",
+                      "End-to-end scheduling latency.")
+    _copy_histogram(e2e, m.e2e_duration)
+    pend = r.gauge(f"{PREFIX}_pending_pods", "Pending pods by queue.",
+                   ("queue",))
     for queue_name in ("active", "backoff", "unschedulable"):
-        lines.append(
-            f'{PREFIX}_pending_pods{{queue="{queue_name}"}} '
-            f'{len(pending[queue_name])}')
-    lines += [
-        f"# HELP {PREFIX}_cache_nodes Nodes tracked by the scheduler cache.",
-        f"# TYPE {PREFIX}_cache_nodes gauge",
-        f"{PREFIX}_cache_nodes {sched.cache.node_count()}",
-        f"# HELP {PREFIX}_cache_pods Pods tracked by the scheduler cache.",
-        f"# TYPE {PREFIX}_cache_pods gauge",
-        f"{PREFIX}_cache_pods {sched.cache.pod_count()}",
-    ]
-    return "\n".join(lines) + "\n"
+        pend.labels(queue_name).set(len(pending[queue_name]))
+    r.gauge(f"{PREFIX}_cache_nodes",
+            "Nodes tracked by the scheduler cache.").set(
+        sched.cache.node_count())
+    r.gauge(f"{PREFIX}_cache_pods",
+            "Pods tracked by the scheduler cache.").set(
+        sched.cache.pod_count())
+    return r
+
+
+def render_metrics(sched: "Scheduler") -> str:
+    """One scrape of the scheduler's metric families."""
+    return build_registry(sched).render()
 
 
 def reset_metrics(sched: "Scheduler") -> None:
     """DELETE /metrics analog (metrics.Reset, metrics.go:242)."""
-    m = sched.metrics
-    from kubernetes_tpu.scheduler import Histogram
-    m.schedule_attempts = {"scheduled": 0, "unschedulable": 0, "error": 0}
-    m.binding_count = 0
-    m.preemption_attempts = 0
-    m.preemption_victims = 0
-    m.e2e_latency_sum = 0.0
-    m.phase_duration = {}
-    m.binding_duration = Histogram()
-    m.e2e_duration = Histogram()
+    sched.metrics.reset()

@@ -1,0 +1,39 @@
+"""kubernetes_tpu.obs — shared observability layer.
+
+One process-global metrics `Registry` (the component-base/metrics analog:
+every layer registers labeled Counter/Gauge/Histogram families into it,
+and any component's /metrics endpoint scrapes them all), plus span
+tracing with Chrome trace-event export (`obs.trace`) and an exposition
+lint helper (`obs.lint`).
+
+Module-level helpers `counter()` / `gauge()` / `histogram()` are
+get-or-create against the global registry, so modules declare their
+families at import time and multiple component instances share children.
+"""
+from __future__ import annotations
+
+from kubernetes_tpu.obs.registry import (   # noqa: F401
+    Counter, Gauge, Histogram, MetricFamily, Registry,
+    DEFAULT_BUCKETS, escape_help, escape_label_value, format_value,
+)
+from kubernetes_tpu.obs import trace        # noqa: F401
+
+#: the process-global registry every component wires into
+REGISTRY = Registry()
+
+
+def counter(name, help, labelnames=()):
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name, help, labelnames=()):
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
+    return REGISTRY.histogram(name, help, labelnames, buckets=buckets)
+
+
+def render_global() -> str:
+    """One scrape of the global registry (every registered component)."""
+    return REGISTRY.render()

@@ -1,0 +1,164 @@
+"""Exposition-format lint — the promlint analog for the repro's /metrics.
+
+`lint_exposition(text)` parses one Prometheus text-format scrape and
+returns a list of problems (empty = clean). Checked invariants:
+
+- line syntax: every sample parses as `name{labels} value`;
+- HELP/TYPE precede their family's samples, at most one of each, TYPE is a
+  known type, and a family's samples are contiguous (no interleaving);
+- label syntax: valid label names, quoted values with only legal escapes
+  (\\\\, \\", \\n) — an unescaped quote/newline shows up here as a parse
+  failure;
+- histogram consistency: per child, bucket counts monotonically
+  non-decreasing as `le` ascends, a `+Inf` bucket present and equal to
+  `_count`, `_sum` and `_count` present.
+
+Used by the tier-1 exposition tests (a live APIServer scrape runs through
+this) so a regression in any family's rendering fails `pytest tests/ -q`.
+"""
+from __future__ import annotations
+
+import re
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) (.*)$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (\w+)$")
+_SAMPLE_RE = re.compile(rf"^({_NAME})(\{{.*\}})? (.+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"')
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_HIST_SUFFIX = re.compile(r"^(.*)_(bucket|sum|count)$")
+
+
+def _parse_labels(block: str):
+    """`{k="v",...}` -> dict or None on malformed/partially-escaped input."""
+    inner = block[1:-1]
+    out = {}
+    pos = 0
+    while pos < len(inner):
+        m = _LABEL_RE.match(inner, pos)
+        if m is None:
+            return None
+        out[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(inner):
+            if inner[pos] != ",":
+                return None
+            pos += 1
+    return out
+
+
+def _family_of(name: str, types: dict) -> str:
+    """Map a sample name to its family (histogram suffixes fold in)."""
+    m = _HIST_SUFFIX.match(name)
+    if m and types.get(m.group(1)) == "histogram":
+        return m.group(1)
+    return name
+
+
+def lint_exposition(text: str) -> list[str]:
+    problems: list[str] = []
+    helps: dict[str, int] = {}
+    types: dict[str, str] = {}
+    closed: set[str] = set()        # families whose sample run ended
+    current: str | None = None
+    # histogram state: family -> {labelkey -> {"buckets": [(le, v)],
+    #                                          "sum": x, "count": n}}
+    hist: dict[str, dict] = {}
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            hm, tm = _HELP_RE.match(line), _TYPE_RE.match(line)
+            if hm is None and tm is None:
+                if line.startswith(("# HELP", "# TYPE")):
+                    problems.append(f"line {lineno}: malformed comment: "
+                                    f"{line!r}")
+                continue
+            name = (hm or tm).group(1)
+            if hm is not None:
+                if name in helps:
+                    problems.append(f"line {lineno}: duplicate HELP for "
+                                    f"{name}")
+                helps[name] = lineno
+            else:
+                if name in types:
+                    problems.append(f"line {lineno}: duplicate TYPE for "
+                                    f"{name}")
+                elif tm.group(2) not in _TYPES:
+                    problems.append(f"line {lineno}: unknown TYPE "
+                                    f"{tm.group(2)!r} for {name}")
+                types[name] = tm.group(2)
+            if name in closed:
+                problems.append(f"line {lineno}: HELP/TYPE for {name} after "
+                                f"its samples ended")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {lineno}: unparseable sample: {line!r}")
+            continue
+        name, labels_block, value = m.group(1), m.group(2), m.group(3)
+        labels = {}
+        if labels_block:
+            labels = _parse_labels(labels_block)
+            if labels is None:
+                problems.append(f"line {lineno}: malformed/unescaped labels "
+                                f"in {line!r}")
+                continue
+        try:
+            val = float(value)
+        except ValueError:
+            problems.append(f"line {lineno}: non-numeric value {value!r}")
+            continue
+        family = _family_of(name, types)
+        if family != current:
+            if family in closed:
+                problems.append(f"line {lineno}: samples for {family} are "
+                                f"not contiguous")
+            if current is not None:
+                closed.add(current)
+            current = family
+        if types.get(family) == "histogram":
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            st = hist.setdefault(family, {}).setdefault(
+                key, {"buckets": [], "sum": None, "count": None})
+            if name.endswith("_bucket"):
+                le = labels.get("le")
+                if le is None:
+                    problems.append(f"line {lineno}: {name} without le label")
+                else:
+                    st["buckets"].append(
+                        (float("inf") if le == "+Inf" else float(le), val))
+            elif name.endswith("_sum"):
+                st["sum"] = val
+            elif name.endswith("_count"):
+                st["count"] = val
+            else:
+                problems.append(f"line {lineno}: stray sample {name} in "
+                                f"histogram family {family}")
+
+    for family, children in hist.items():
+        for key, st in children.items():
+            where = f"{family}{dict(key) if key else ''}"
+            bks = st["buckets"]
+            if not bks:
+                problems.append(f"{where}: histogram child with no buckets")
+                continue
+            les = [le for le, _ in bks]
+            if les != sorted(les):
+                problems.append(f"{where}: bucket le values not ascending")
+            vals = [v for _, v in sorted(bks)]
+            if any(prev > nxt for prev, nxt in zip(vals, vals[1:])):
+                problems.append(f"{where}: bucket counts not monotonic")
+            if les[-1] != float("inf"):
+                problems.append(f"{where}: missing +Inf bucket")
+            if st["count"] is None:
+                problems.append(f"{where}: missing _count")
+            elif les[-1] == float("inf") and bks[-1][1] != st["count"]:
+                problems.append(f"{where}: +Inf bucket {bks[-1][1]} != "
+                                f"_count {st['count']}")
+            if st["sum"] is None:
+                problems.append(f"{where}: missing _sum")
+
+    return problems

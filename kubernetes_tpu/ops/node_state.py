@@ -31,6 +31,22 @@ from kubernetes_tpu.oracle.predicates import (
     InterPodAffinityChecker,
 )
 from kubernetes_tpu.oracle.priorities import get_selectors
+from kubernetes_tpu import obs
+
+# mirror-maintenance counters: how often the host mirror pays a per-row
+# re-extract vs the cheap whole-mirror permute vs a full rebuild (the
+# encode-path cost hierarchy PR 1 optimized; /metrics now shows which
+# branch a workload actually takes)
+ROW_REENCODES = obs.counter(
+    "tpu_encoder_dirty_row_reencodes_total",
+    "Mirror rows re-extracted because their NodeInfo generation moved.")
+MIRROR_PERMUTES = obs.counter(
+    "tpu_encoder_mirror_permutes_total",
+    "Whole-mirror permutations for a rotated enumeration of the same "
+    "node set (instead of per-row re-encodes).")
+MIRROR_REBUILDS = obs.counter(
+    "tpu_encoder_mirror_rebuilds_total",
+    "Full mirror rebuilds (capacity, vocab, or node-membership change).")
 
 
 def _pad_capacity(n: int, minimum: int = 8) -> int:
@@ -132,24 +148,30 @@ class NodeStateEncoder:
                 # of re-extracting every NodeInfo through _write_row —
                 # generations are name-keyed, so they stay valid
                 b = self._permuted(b, node_order, n_real)
+                MIRROR_PERMUTES.inc()
             else:
                 b = self._fresh(node_order, n_real, n_pad, s)
                 self._generations = {}
+                MIRROR_REBUILDS.inc()
             self._batch = b
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
         zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
         dirty = []
+        reencoded = 0
         gens = self._generations
         for i, name in enumerate(node_order):
             ni = node_infos[name]
             if gens.get(name) == ni.generation:
                 continue
             gens[name] = ni.generation
+            reencoded += 1
             # value-compare: a generation bump with identical aggregates
             # (assume→confirm, status-only updates, folds already applied on
             # device) must not trigger a device re-upload
             if self._write_row(b, i, ni, scalar_idx, zone_idx):
                 dirty.append(i)
+        if reencoded:
+            ROW_REENCODES.inc(reencoded)
         # accumulate until the device mirror consumes (resets) the list;
         # None = full re-upload required
         if rebuild:

@@ -34,6 +34,7 @@ from kubernetes_tpu.framework.v1alpha1 import (
     Framework, Registry, PluginContext, UNSCHEDULABLE as FW_UNSCHEDULABLE,
 )
 from kubernetes_tpu.utils.clock import Clock, RealClock
+from kubernetes_tpu.utils.tracing import Trace, SLOW_CYCLE_THRESHOLD
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
 
@@ -62,6 +63,11 @@ class Histogram:
         for i, b in enumerate(self.BOUNDS):
             if seconds <= b:
                 self.buckets[i] += count
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Histogram)
+                and self.buckets == other.buckets
+                and self.count == other.count and self.sum == other.sum)
 
     def render(self, name: str, labels: str = "") -> list[str]:
         sep = "," if labels else ""
@@ -105,9 +111,23 @@ class SchedulerMetrics:
             h = self.phase_duration[phase] = Histogram()
         h.observe_many(seconds, count)
 
+    def reset(self) -> None:
+        """DELETE /metrics analog. Re-derives every field from the
+        dataclass defaults, so a newly added field can never be silently
+        missed the way the old hand-copied reset_metrics field list could
+        (a fresh instance IS the definition of 'reset')."""
+        import dataclasses
+        fresh = type(self)()
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, getattr(fresh, f.name))
+
 
 class Scheduler:
     """One scheduler instance: queue + cache + algorithm + binder."""
+
+    # slow-cycle trace threshold (generic_scheduler.go:186 uses 100ms): a
+    # serial cycle slower than this logs its step timeline via utils.Trace
+    slow_cycle_threshold = SLOW_CYCLE_THRESHOLD
 
     def __init__(self, store: Store,
                  scheduler_name: str = DEFAULT_SCHEDULER_NAME,
@@ -348,7 +368,22 @@ class Scheduler:
         already-consumed NodeTree enumeration (burst bookkeeping) instead of
         consuming a fresh one."""
         start = self.clock.now()
+        # utiltrace analog (generic_scheduler.go:185): per-cycle step
+        # timeline, logged only when the cycle is slow. Spans for the
+        # cycle land in the obs ring buffer regardless (bounded, cheap).
+        cycle_trace = Trace(f"scheduling cycle {pod.key}",
+                            threshold=self.slow_cycle_threshold)
+        try:
+            self._process_one_traced(pod, cycle, names, start, cycle_trace)
+        finally:
+            if cycle_trace.log_if_long():
+                cycle_trace.emit_spans()
+
+    def _process_one_traced(self, pod: Pod, cycle: int,
+                            names: Optional[list[str]], start: float,
+                            cycle_trace: Trace) -> None:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
+        cycle_trace.step("snapshot updated")
         if names is None:
             names = self.cache.node_tree.list_names()
         self._last_names = names
@@ -359,6 +394,7 @@ class Scheduler:
             finally:
                 self.metrics.observe_phase("algorithm",
                                            self.clock.now() - t_alg)
+                cycle_trace.step("scheduling algorithm")
         except FitError as err:
             self.metrics.observe("unschedulable")
             if not self.disable_preemption:
@@ -366,6 +402,7 @@ class Scheduler:
                 self._preempt(pod, err)
                 self.metrics.observe_phase("preemption",
                                            self.clock.now() - t_pre)
+                cycle_trace.step("preemption")
             self._record_failure(pod, cycle, REASON_UNSCHEDULABLE, str(err))
             return
         except Exception as err:
@@ -396,6 +433,7 @@ class Scheduler:
             self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
             return
         self.queue.nominated.delete(pod)
+        cycle_trace.step("pod assumed")
         # Permit may WAIT: when permit plugins exist, bind runs off the
         # scheduling thread like the reference's bind goroutine
         # (scheduler.go:523) so allow()/reject() can come from this loop
@@ -406,8 +444,10 @@ class Scheduler:
                 daemon=True)
             t.start()
             self._bind_threads.append(t)
+            cycle_trace.step("binding dispatched")
         else:
             self._bind(assumed, result.suggested_host, pod, cycle, ctx)
+            cycle_trace.step("binding")
         e2e = self.clock.now() - start
         self.metrics.e2e_latency_sum += e2e
         self.metrics.e2e_duration.observe(e2e)

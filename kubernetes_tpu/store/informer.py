@@ -17,9 +17,20 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Optional
 
+from kubernetes_tpu import obs
 from kubernetes_tpu.store.store import (
     Store, Watch, Event, ADDED, MODIFIED, DELETED, ExpiredError,
 )
+
+# reflector metrics (client-go reflector_metrics.go analog)
+RELISTS = obs.counter(
+    "informer_relists_total",
+    "List+watch re-establishments (initial sync and 410-Gone resumes), "
+    "by kind.", ("kind",))
+WATCH_EXPIRATIONS = obs.counter(
+    "informer_watch_expirations_total",
+    "Watches that outran the server's event log (410 Gone), by kind.",
+    ("kind",))
 
 Handler = Callable[[Any], None]
 UpdateHandler = Callable[[Any, Any], None]
@@ -115,6 +126,7 @@ class SharedInformer:
         deletes, changed keys updates, new keys adds — so a 410-Gone resume
         (reflector.go:159) never replays spurious adds or loses deletes
         that happened inside the expired window."""
+        RELISTS.labels(self.kind).inc()
         if self._watch is not None:
             self._watch.stop()
         while True:
@@ -151,6 +163,7 @@ class SharedInformer:
             except ExpiredError:
                 # the watch outran the server's event log: re-list
                 # (reflector 410 contract)
+                WATCH_EXPIRATIONS.labels(self.kind).inc()
                 self._relist()
                 continue
             if ev is None:
@@ -193,6 +206,7 @@ class SharedInformer:
             try:
                 ev = self._watch.next(timeout=0.05)
             except ExpiredError:
+                WATCH_EXPIRATIONS.labels(self.kind).inc()
                 self._safe_relist()
                 continue
             if ev is not None:

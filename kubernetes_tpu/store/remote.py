@@ -30,11 +30,27 @@ import urllib.error
 import urllib.request
 from typing import Any, Callable, Optional
 
+from kubernetes_tpu import obs
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.store.store import (
     Event, PODS, AlreadyExistsError, ConflictError, ExpiredError,
     NotFoundError, nominated_node_mutator, pod_condition_mutator,
 )
+
+# client-runtime metrics (rest_client_requests_total /
+# reflector short-watch analogs)
+WATCH_RECONNECTS = obs.counter(
+    "remote_watch_reconnects_total",
+    "Dropped watch streams reopened from the last seen resourceVersion, "
+    "by kind.", ("kind",))
+WATCH_DECODE_FAILURES = obs.counter(
+    "remote_watch_decode_failures_total",
+    "Watch events the client could not decode (schema drift -> watch "
+    "marked expired), by kind.", ("kind",))
+TRANSIENT_RETRIES = obs.counter(
+    "remote_transient_retries_total",
+    "Transient transport failures retried during watch re-open, by kind.",
+    ("kind",))
 
 
 class APIStatusError(Exception):
@@ -120,6 +136,7 @@ class RemoteWatch:
                 except OSError:
                     pass
                 try:
+                    WATCH_RECONNECTS.labels(self.kind).inc()
                     resp = self._resp = self._open(self._last_rv)
                 except ExpiredError as e:
                     self._expired = str(e)
@@ -132,9 +149,11 @@ class RemoteWatch:
                         # of a silent forever-retry.
                         self._expired = str(e)
                         return
+                    TRANSIENT_RETRIES.labels(self.kind).inc()
                     if self._stop.wait(self._RECONNECT_DELAY):
                         return
                 except (urllib.error.URLError, OSError, NotFoundError):
+                    TRANSIENT_RETRIES.labels(self.kind).inc()
                     if self._stop.wait(self._RECONNECT_DELAY):
                         return
                 continue
@@ -155,6 +174,7 @@ class RemoteWatch:
                 # transport blip): mark the watch expired so next() raises
                 # and the informer re-lists, instead of the reader thread
                 # dying and next() hanging forever
+                WATCH_DECODE_FAILURES.labels(self.kind).inc()
                 self._expired = f"watch decode failed for {self.kind}: {e!r}"
                 return
             self._last_rv = rv
