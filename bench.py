@@ -365,8 +365,16 @@ def main():
     def finish(result: dict) -> None:
         if args.trace:
             from kubernetes_tpu.obs import trace as obs_trace
-            result["trace"] = {"path": args.trace,
-                               "spans": obs_trace.export(args.trace)}
+            from kubernetes_tpu.core.tpu_scheduler import PIPELINE_OVERLAP
+            result["trace"] = {
+                "path": args.trace,
+                "spans": obs_trace.export(args.trace),
+                # host commit seconds that ran while a later burst wave was
+                # in flight on the device (tpu_pipeline_overlap_seconds_total
+                # — the wave pipeline's win; the per-wave spans show it as
+                # burst.wave.commit[k] inside burst.wave.device[k+1])
+                "pipeline_overlap_seconds": round(PIPELINE_OVERLAP.value, 4),
+            }
         print(json.dumps(result))
 
     if args.trace:

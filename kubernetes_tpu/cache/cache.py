@@ -107,6 +107,21 @@ class SchedulerCache:
             self._pod_states[pod.uid] = _PodState(pod)
             self._assumed.add(pod.uid)
 
+    def assume_pods(self, pods: list) -> None:
+        """Batched assume_pod for a committed burst wave: ONE lock
+        acquisition for the wave instead of one per pod. Per-pod semantics
+        are assume_pod's exactly (same placeholder creation, same recency
+        touch, same already-assumed error — raised after the earlier pods
+        of the batch landed, matching what the serial loop would have
+        done)."""
+        with self._lock:
+            for pod in pods:
+                if pod.uid in self._pod_states:
+                    raise CacheError(f"pod {pod.key} already assumed/added")
+                self._touch(pod.node_name).add_pod(pod)
+                self._pod_states[pod.uid] = _PodState(pod)
+                self._assumed.add(pod.uid)
+
     def finish_binding(self, pod: Pod, now: Optional[float] = None) -> None:
         """Reference: cache.go:295 — start the expiry TTL."""
         with self._lock:
@@ -115,6 +130,18 @@ class SchedulerCache:
                 return
             state.binding_finished = True
             state.deadline = (now if now is not None else self.clock.now()) + self.ttl
+
+    def finish_bindings(self, pods: list, now: Optional[float] = None) -> None:
+        """Batched finish_binding: one lock, one clock read for the wave."""
+        now = now if now is not None else self.clock.now()
+        deadline = now + self.ttl
+        with self._lock:
+            for pod in pods:
+                state = self._pod_states.get(pod.uid)
+                if state is None or pod.uid not in self._assumed:
+                    continue
+                state.binding_finished = True
+                state.deadline = deadline
 
     def forget_pod(self, pod: Pod) -> None:
         """Reference: cache.go:319 — undo a failed assume."""
@@ -238,6 +265,12 @@ class SchedulerCache:
         with self._lock:
             item = self._nodes.get(name)
             return item.info.generation if item is not None else None
+
+    def node_generations(self, names: list) -> list:
+        """Batched node_generation (one lock for a committed burst wave)."""
+        with self._lock:
+            return [item.info.generation if item is not None else None
+                    for item in map(self._nodes.get, names)]
 
     # -- snapshot -----------------------------------------------------------
     def update_snapshot(self, snapshot: Snapshot) -> Snapshot:

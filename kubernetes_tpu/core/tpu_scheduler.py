@@ -46,6 +46,18 @@ DEVICE_DISPATCH = obs.counter(
 DEVICE_FETCHED_BYTES = obs.counter(
     "tpu_device_fetched_bytes_total",
     "Bytes fetched device-to-host, by op.", ("op",))
+DEVICE_FETCHES = obs.counter(
+    "tpu_device_fetches_total",
+    "Device-to-host fetch synchronizations, by op — the tunnel contract "
+    "says each one pays a full round trip, so per-launch fetch counts are "
+    "load-bearing (one per wave/launch, never per pod).", ("op",))
+PIPELINE_OVERLAP = obs.counter(
+    "tpu_pipeline_overlap_seconds_total",
+    "Seconds of host commit work performed while a later burst wave was "
+    "in flight on the device (the pipelined-wave overlap win).")
+BURST_WAVES = obs.counter(
+    "tpu_burst_waves_total",
+    "Pipelined burst waves dispatched, by path.", ("path",))
 ORACLE_FALLBACKS = obs.counter(
     "tpu_oracle_fallback_total",
     "Decisions routed off the device path (host twin / serial rerun), "
@@ -175,6 +187,9 @@ class TPUScheduler:
         self._false = np.bool_(False)
         self._zero_i64 = np.int64(0)
         self._zero_scalars: dict[int, np.ndarray] = {}
+        # single-worker readback executor for the pipelined burst waves
+        # (lazy: serial-only configurations never start the thread)
+        self._fetch_pool = None
 
     def _shared_zero_scalar(self, n: int) -> np.ndarray:
         arr = self._zero_scalars.get(n)
@@ -490,6 +505,7 @@ class TPUScheduler:
         t_fetch = obs_trace.now()
         h = jax.device_get(fetch)
         DEVICE_DISPATCH.labels("cycle").inc()
+        DEVICE_FETCHES.labels("cycle").inc()
         DEVICE_FETCHED_BYTES.labels("cycle").inc(_fetched_nbytes(h))
         obs_trace.add_span("cycle.fetch", t_fetch, obs_trace.now(),
                            cat="device")
@@ -760,9 +776,52 @@ class TPUScheduler:
             inv[l, perms[l]] = np.arange(n_pad, dtype=np.int32)
         return perms, inv, seq
 
+    # -- pipelined burst waves ----------------------------------------------
+    # Two-stage pipeline (the GPipe-style overlap of PAPERS.md applied to
+    # the scheduler; cf. the reference's async bind goroutine,
+    # scheduler.go:433): a burst is split into waves of `wave_size` pods,
+    # and wave k+1's kernel launch is dispatched — async on the tunnel;
+    # only the fetch blocks — BEFORE wave k's decisions are fetched and
+    # committed, so the host commit of wave k runs while the device
+    # executes wave k+1. The carried state (folded rows, lastNodeIndex,
+    # spread counts) chains device-side between launches, and the NodeTree
+    # rotation seq is sliced per wave from one burst-wide walk, so
+    # enumeration order stays serial-exact across wave boundaries.
+    wave_size = 4096
+    # the shell passes a per-wave commit callback when the algorithm
+    # advertises this (Scheduler._burst_segment)
+    supports_wave_commit = True
+
+    def _fetch_pool_get(self):
+        pool = self._fetch_pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            # two workers = the pipeline's in-flight window: wave k+1's
+            # readback round trip can start while wave k's is still on the
+            # wire (per-wave results are consumed strictly in wave order
+            # via their own futures, so completion order doesn't matter)
+            pool = self._fetch_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="tpu-fetch")
+        return pool
+
+    def _submit_fetch(self, tree):
+        """Start the device->host readback of `tree` in the background:
+        kick the async copy where the backend supports it, then hand the
+        blocking sync to a fetch worker so the main thread stays free to
+        commit the previous wave."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            cth = getattr(leaf, "copy_to_host_async", None)
+            if cth is not None:
+                try:
+                    cth()
+                except Exception:
+                    pass   # backend without async copy: the worker blocks
+        return self._fetch_pool_get().submit(jax.device_get, tree)
+
     def schedule_burst(self, pods: list[Pod], node_infos: dict[str, NodeInfo],
                        all_node_names: list[str],
-                       bucket: Optional[int] = None) -> Optional[list[Optional[str]]]:
+                       bucket: Optional[int] = None,
+                       commit=None) -> Optional[list[Optional[str]]]:
         """Schedule `pods` against one snapshot; returns per-pod host (or
         None when unschedulable). Decisions are serially equivalent to
         calling schedule() per pod with cache assumes in between. Returns
@@ -771,7 +830,19 @@ class TPUScheduler:
 
         The folded state persists on device: the caller MUST apply the
         returned placements to its cache (as the scheduler shell does via
-        assume + note_burst_assumed) before the next cycle."""
+        assume + note_burst_assumed) before the next cycle.
+
+        `commit(lo, hosts) -> bool` (optional) is the pipelined-wave sink:
+        it is called once per wave with consecutive windows of DECIDED
+        hosts (never None) while the next wave executes on the device; the
+        caller must commit them immediately. Returning False signals a
+        commit failure — the algorithm discards the in-flight wave's
+        decisions and its device folds (the host mirror is authoritative
+        again) and returns the committed prefix with a None tail, exactly
+        like the mid-burst-failure rewind contract. Decisions passed to
+        `commit` are never re-returned as the caller's responsibility
+        twice: the returned list still contains them, but the caller knows
+        how far its own callback committed."""
         if not all_node_names or not pods:
             return [None] * len(pods)
         import time as _time
@@ -813,37 +884,10 @@ class TPUScheduler:
             cls, extra_ok, ban = uniform
             rotation = self._burst_rotation(b, len(pods))
             _t = _obs("encode", _t0)
-            sel: list[int] = []
-            for lo in range(0, len(pods), K.B_CAP):
-                chunk = min(K.B_CAP, len(pods) - lo)
-                rot = rotation
-                if rotation is not None:
-                    win = np.empty(K.B_CAP + K.K_BATCH, dtype=np.int32)
-                    piece = rotation[1][lo: lo + len(win)]
-                    win[: len(piece)] = piece
-                    win[len(piece):] = piece[-1] if len(piece) else 0
-                    rot = (rotation[0], win)
-                rows, packed = K.schedule_batch_uniform(
-                    nodes, dict(cls), chunk, self.last_node_index, n,
-                    self.check_resources, weights=self.weights, rotation=rot,
-                    extra_ok=extra_ok, ban=ban, mesh=self.mesh)
-                self._dev_nodes = {**self._dev_nodes, **rows}
-                nodes = self._dev_nodes
-                DEVICE_DISPATCH.labels("burst_uniform").inc()
-                _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
-                h = np.asarray(packed)   # ONE fetch: selections + lni delta
-                DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
-                _t = _obs("fetch", _t)
-                self.last_node_index += int(h[K.B_CAP])
-                sel.extend(h[:chunk].tolist())
-                if any(s < 0 for s in h[:chunk]):
-                    # failures are a frozen-state SUFFIX (feasibility only
-                    # shrinks as folds accumulate, so F==0 persists): the
-                    # kernel's counters/folds reflect exactly the non-None
-                    # prefix already — stop launching further chunks
-                    break
-            sel.extend([-1] * (len(pods) - len(sel)))
-            return [b.names[s] if s >= 0 else None for s in sel]
+            sel = self._uniform_waves(pods, b, cls, extra_ok, ban, rotation,
+                                      n, commit, _obs, _t)
+            return [b.names[s] for s in sel] \
+                + [None] * (len(pods) - len(sel))
         from kubernetes_tpu.api.types import (
             has_pod_affinity_terms, get_container_ports)
         if any(has_pod_affinity_terms(p) or get_container_ports(p)
@@ -902,20 +946,12 @@ class TPUScheduler:
         else:
             per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
                        for p, f in zip(pods, feats)]
-        # pad the burst to a power-of-two bucket so lax.scan compiles once
-        # per bucket instead of once per burst length
-        if len(per_pod) < bucket:
-            pad = dict(per_pod[-1])
-            pad["skip"] = self._true
-            per_pod.extend([pad] * (bucket - len(per_pod)))
-        stacked = self._stack_pods(per_pod)
         if carry_spread and (spread0 is None
                              or spread0.shape[-1] != b.n_pad):
             # inert/dense mix — shouldn't happen, stay exact
             ORACLE_FALLBACKS.labels("burst-spread-shape").inc()
             return None
         z_pad = _pad_pow2(len(b.zone_names), 4)
-        _t = _obs("encode", _t0)
         if self.mesh is not None:
             if rotation is not None or rotation_pos is not None:
                 # identity-only rotation (the zone cursor sits at a fixed
@@ -932,6 +968,14 @@ class TPUScheduler:
                 # the sharded scan doesn't model this yet
                 ORACLE_FALLBACKS.labels("burst-sharded-spread").inc()
                 return None
+            # pad the burst to a power-of-two bucket so lax.scan compiles
+            # once per bucket instead of once per burst length
+            if len(per_pod) < bucket:
+                pad = dict(per_pod[-1])
+                pad["skip"] = self._true
+                per_pod.extend([pad] * (bucket - len(per_pod)))
+            stacked = self._stack_pods(per_pod)
+            _t = _obs("encode", _t0)
             from kubernetes_tpu.parallel import sharding as S
             if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
                 self._sharded_batch = (z_pad, S.sharded_batch_fn(
@@ -940,42 +984,271 @@ class TPUScheduler:
             state, li, lni, outs = self._sharded_batch[1](
                 nodes, pods_sharded, K._i64(self.last_index),
                 K._i64(self.last_node_index), K._i64(num_to_find), K._i64(n))
-        else:
-            state, li, lni, outs = K.schedule_batch(
-                nodes, stacked, self.last_index, self.last_node_index,
-                num_to_find, n, z_pad, weights=self.weights,
-                rotation=rotation, spread0=spread0,
-                rotation_pos=rotation_pos)
-        DEVICE_DISPATCH.labels("burst_scan").inc()
-        _t = _obs("kernel", _t)
-        selected = np.asarray(outs["selected"])[: len(pods)]
-        li, lni = int(li), int(lni)
-        DEVICE_FETCHED_BYTES.labels("burst_scan").inc(selected.nbytes + 16)
-        _obs("fetch", _t)
-        if (selected < 0).any():
-            # burst contract: everything from the first failure on is
-            # returned undecided (None) and counters/folds rewind to the
-            # prefix — the shell commits the prefix and reruns the tail
-            # serially (a failed pod's serial rerun may preempt, which the
-            # post-failure kernel decisions never saw)
-            kf = int(np.argmax(selected < 0))
-            ev = np.asarray(outs["evaluated"])[:kf]
-            fo = np.asarray(outs["found"])[:kf]
-            self.last_index = int((self.last_index + ev.sum()) % max(n, 1))
-            self.last_node_index += int((fo > 1).sum())
-            # the device matrix holds folds from post-failure successes the
-            # serial tail may invalidate: drop it (the host mirror reflects
-            # exactly the committed prefix after note_burst_assumed)
+            DEVICE_DISPATCH.labels("burst_scan").inc()
+            _t = _obs("kernel", _t)
+            selected = np.asarray(outs["selected"])[: len(pods)]
+            li, lni = int(li), int(lni)
+            DEVICE_FETCHES.labels("burst_scan").inc()
+            DEVICE_FETCHED_BYTES.labels("burst_scan").inc(selected.nbytes + 16)
+            _obs("fetch", _t)
+            if (selected < 0).any():
+                # burst contract: everything from the first failure on is
+                # returned undecided (None) and counters/folds rewind to the
+                # prefix — the shell commits the prefix and reruns the tail
+                # serially (a failed pod's serial rerun may preempt, which
+                # the post-failure kernel decisions never saw)
+                kf = int(np.argmax(selected < 0))
+                ev = np.asarray(outs["evaluated"])[:kf]
+                fo = np.asarray(outs["found"])[:kf]
+                self.last_index = int((self.last_index + ev.sum())
+                                      % max(n, 1))
+                self.last_node_index += int((fo > 1).sum())
+                # the device matrix holds folds from post-failure successes
+                # the serial tail may invalidate: drop it (the host mirror
+                # reflects exactly the committed prefix after
+                # note_burst_assumed)
+                self.discard_burst_folds()
+                return [b.names[s] if i < kf else None
+                        for i, s in enumerate(selected.tolist())]
+            # persist the folds: the device-resident matrix is authoritative
+            # for rows the scan mutated (the host mirror catches up via
+            # note_burst_assumed; external changes still arrive via dirty
+            # rows)
+            self._dev_nodes = {**self._dev_nodes, **state}
+            self.last_index = int(li)
+            self.last_node_index = int(lni)
+            return [b.names[s] if s >= 0 else None
+                    for s in selected.tolist()]
+        _t = _obs("encode", _t0)
+        return self._scan_waves(pods, b, per_pod, spread0, rotation,
+                                rotation_pos, num_to_find, n, z_pad, bucket,
+                                commit, _obs, _t)
+
+    def _uniform_waves(self, pods: list[Pod], b: NodeBatch, cls, extra_ok,
+                       ban: bool, rotation, n: int, commit, _obs,
+                       _t: float) -> list[int]:
+        """Pipelined wave driver for the uniform kernel: dispatch wave k+1
+        (chained off wave k's device-resident folds + lastNodeIndex), then
+        fetch + commit wave k while k+1 executes. Returns the decided
+        selection prefix (device axis indices, all >= 0); the caller pads
+        the undecided tail with None.
+
+        Rewind contract: a failed (F==0) wave freezes device state — every
+        later identical pod fails too, so the in-flight wave folds nothing
+        and is discarded unfetched. A commit failure (callback returned
+        False) additionally drops the resident matrix: the host mirror,
+        which reflects exactly the committed decisions minus forgotten
+        pods, re-uploads on next use."""
+        # one fixed power-of-two cap serves every wave: the kernel's output
+        # buffer (and so the per-wave fetch payload) is cap+1 int32s, and
+        # the static shape means one compile per wave_size, not per burst
+        W = _pad_pow2(max(1, min(int(self.wave_size), K.B_CAP)), 4)
+        n_pods = len(pods)
+        waves = [(lo, min(W, n_pods - lo)) for lo in range(0, n_pods, W)]
+        lni_dev = self.last_node_index   # device scalar after wave 0
+        sel: list[int] = []
+        inflight: list[tuple] = []
+
+        def dispatch(widx: int) -> None:
+            nonlocal lni_dev, _t
+            lo, chunk = waves[widx]
+            rot = rotation
+            if rotation is not None:
+                win = np.empty(W + K.K_BATCH, dtype=np.int32)
+                piece = rotation[1][lo: lo + len(win)]
+                win[: len(piece)] = piece
+                win[len(piece):] = piece[-1] if len(piece) else 0
+                rot = (rotation[0], win)
+            t_d = obs_trace.now()
+            rows, packed, lni_out = K.schedule_batch_uniform(
+                self._dev_nodes, dict(cls), chunk, lni_dev, n,
+                self.check_resources, weights=self.weights, rotation=rot,
+                extra_ok=extra_ok, ban=ban, mesh=self.mesh, cap=W)
+            lni_dev = lni_out
+            self._dev_nodes = {**self._dev_nodes, **rows}
+            DEVICE_DISPATCH.labels("burst_uniform").inc()
+            BURST_WAVES.labels("uniform").inc()
+            _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
+            inflight.append((widx, lo, chunk, self._submit_fetch(packed),
+                             t_d))
+
+        dispatch(0)
+        aborted = False
+        while inflight:
+            if len(inflight) == 1 and inflight[0][0] + 1 < len(waves):
+                dispatch(inflight[0][0] + 1)   # keep one wave in flight
+            widx, lo, chunk, fut, t_d = inflight.pop(0)
+            h = fut.result()   # ONE fetch per wave: selections + lni delta
+            t_done = obs_trace.now()
+            DEVICE_FETCHES.labels("burst_uniform").inc()
+            DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
+            obs_trace.add_span("burst.wave.device", t_d, t_done,
+                               cat="device", args={"wave": widx})
+            _t = _obs("fetch", _t)
+            self.last_node_index += int(h[W])
+            wave_sel = h[:chunk].tolist()
+            bad = next((i for i, s in enumerate(wave_sel) if s < 0), chunk)
+            sel.extend(wave_sel[:bad])
+            if commit is not None and bad:
+                t_c0 = obs_trace.now()
+                ok = commit(lo, [b.names[s] for s in wave_sel[:bad]])
+                t_c1 = obs_trace.now()
+                obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
+                                   cat="host", args={"wave": widx})
+                if inflight:
+                    PIPELINE_OVERLAP.inc(t_c1 - t_c0)
+                _t = t_c1
+                if not ok:
+                    aborted = True
+            if bad < chunk or aborted:
+                for item in inflight:
+                    item[3].cancel()
+                inflight.clear()
+                if aborted:
+                    self.discard_burst_folds()
+                break
+        return sel
+
+    def _scan_waves(self, pods: list[Pod], b: NodeBatch, per_pod: list,
+                    spread0, rotation, rotation_pos, num_to_find: int,
+                    n: int, z_pad: int, bucket: int, commit, _obs,
+                    _t: float) -> list[Optional[str]]:
+        """Pipelined wave driver for the generic lax.scan burst: the mutable
+        node state, spread counts, and last_index/lastNodeIndex chain
+        device-side between launches (kernels.schedule_batch carry_in), the
+        rotation oid walk is sliced per wave from the burst-wide sequence,
+        and wave k's fetch + commit overlap wave k+1's execution.
+
+        Unlike the uniform kernel, the scan keeps deciding after a failed
+        pod, so on a mid-wave failure the post-failure folds — and the
+        whole in-flight wave — are invalid: the device matrix is dropped
+        and host counters advance only over the committed prefix (the
+        fetched evaluated/found vectors), exactly the single-launch rewind
+        contract."""
+        # one FIXED scan length serves every wave of a workload: the wave
+        # bucket is the smaller of wave_size and the caller's burst bucket,
+        # so the warmup burst and every wave (including the padded last
+        # one) hit one compiled program — a per-wave _pad_pow2(chunk) here
+        # once put a fresh XLA compile inside the timed loop
+        W = _pad_pow2(max(1, min(int(self.wave_size), bucket)), 4)
+        n_pods = len(pods)
+        waves = [(lo, min(W, n_pods - lo)) for lo in range(0, n_pods, W)]
+        carry_spread = spread0 is not None
+        seq = None
+        if rotation is not None:
+            perms, inv_perms, seq = rotation
+        elif rotation_pos is not None:
+            pos_arr, seq = rotation_pos
+        carry = None              # (mut_state, spread) after the last wave
+        li_dev, lni_dev = self.last_index, self.last_node_index
+        li_host, lni_host = self.last_index, self.last_node_index
+        sel: list[int] = []
+        inflight: list[tuple] = []
+
+        def dispatch(widx: int) -> None:
+            nonlocal carry, li_dev, lni_dev, _t
+            lo, chunk = waves[widx]
+            wave = list(per_pod[lo: lo + chunk])
+            if len(wave) < W:
+                pad = dict(wave[-1])
+                pad["skip"] = self._true
+                wave.extend([pad] * (W - len(wave)))
+            stacked = self._stack_pods(wave)
+            rot = rotp = None
+            if seq is not None:
+                # cycle t's order id, t counted from the burst's first pod:
+                # slicing the one walk keeps rotation serial-exact across
+                # wave boundaries (pad rows skip, so the fill is inert)
+                wseq = np.empty(W, dtype=np.int32)
+                piece = seq[lo: lo + W]
+                wseq[: len(piece)] = piece
+                wseq[len(piece):] = piece[-1] if len(piece) else 0
+                if rotation is not None:
+                    rot = (perms, inv_perms, wseq)
+                else:
+                    rotp = (pos_arr, wseq)
+            t_d = obs_trace.now()
+            state, li_out, lni_out, spread, outs = K.schedule_batch(
+                self._dev_nodes, stacked, li_dev, lni_dev, num_to_find, n,
+                z_pad, weights=self.weights, rotation=rot,
+                spread0=(spread0 if carry is None and carry_spread
+                         else None),
+                rotation_pos=rotp, carry_in=carry)
+            carry = (state, spread if carry_spread else None)
+            li_dev, lni_dev = li_out, lni_out
+            DEVICE_DISPATCH.labels("burst_scan").inc()
+            BURST_WAVES.labels("scan").inc()
+            _t = _obs("kernel", _t)
+            # the common-path fetch ships selections + the two counters;
+            # the per-cycle evaluated/found vectors are only needed to
+            # rewind a FAILED wave, so they stay device-resident (outs)
+            # and cost a second fetch only on that rare path
+            fut = self._submit_fetch({
+                "selected": outs["selected"], "li": li_out, "lni": lni_out})
+            inflight.append((widx, lo, chunk, fut, t_d, outs))
+
+        dispatch(0)
+        failed = aborted = False
+        while inflight:
+            if len(inflight) == 1 and inflight[0][0] + 1 < len(waves):
+                dispatch(inflight[0][0] + 1)
+            widx, lo, chunk, fut, t_d, outs = inflight.pop(0)
+            h = fut.result()
+            t_done = obs_trace.now()
+            DEVICE_FETCHES.labels("burst_scan").inc()
+            DEVICE_FETCHED_BYTES.labels("burst_scan").inc(_fetched_nbytes(h))
+            obs_trace.add_span("burst.wave.device", t_d, t_done,
+                               cat="device", args={"wave": widx})
+            _t = _obs("fetch", _t)
+            wave_sel = np.asarray(h["selected"])[:chunk]
+            neg = wave_sel < 0
+            bad = int(np.argmax(neg)) if neg.any() else chunk
+            if bad < chunk:
+                # rewind the committed-prefix counters from the per-cycle
+                # vectors (the wave-final scalars include the discarded
+                # post-failure cycles); failure path only, so the extra
+                # round trip never taxes the steady state
+                ev, fo = jax.device_get((outs["evaluated"], outs["found"]))
+                DEVICE_FETCHES.labels("burst_scan").inc()
+                DEVICE_FETCHED_BYTES.labels("burst_scan").inc(
+                    _fetched_nbytes((ev, fo)))
+                ev, fo = np.asarray(ev)[:bad], np.asarray(fo)[:bad]
+                li_host = int((li_host + ev.sum()) % max(n, 1))
+                lni_host += int((fo > 1).sum())
+                failed = True
+            else:
+                li_host, lni_host = int(h["li"]), int(h["lni"])
+            sel.extend(wave_sel[:bad].tolist())
+            if commit is not None and bad:
+                t_c0 = obs_trace.now()
+                ok = commit(lo, [b.names[s] for s in wave_sel[:bad]])
+                t_c1 = obs_trace.now()
+                obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
+                                   cat="host", args={"wave": widx})
+                if inflight:
+                    PIPELINE_OVERLAP.inc(t_c1 - t_c0)
+                _t = t_c1
+                if not ok:
+                    aborted = True
+            if failed or aborted:
+                for item in inflight:
+                    item[3].cancel()
+                inflight.clear()
+                break
+        self.last_index = li_host
+        self.last_node_index = lni_host
+        if failed or aborted:
+            # post-failure scan folds (and the in-flight wave) never became
+            # decisions: drop the device matrix — the host mirror reflects
+            # exactly the committed prefix after note_burst_assumed
             self.discard_burst_folds()
-            return [b.names[s] if i < kf else None
-                    for i, s in enumerate(selected.tolist())]
-        # persist the folds: the device-resident matrix is authoritative for
-        # rows the scan mutated (the host mirror catches up via
-        # note_burst_assumed; external changes still arrive via dirty rows)
-        self._dev_nodes = {**self._dev_nodes, **state}
-        self.last_index = int(li)
-        self.last_node_index = int(lni)
-        return [b.names[s] if s >= 0 else None for s in selected.tolist()]
+        else:
+            # persist the folds: the device-resident matrix is
+            # authoritative for rows the scan mutated (the host mirror
+            # catches up via note_burst_assumed; external changes still
+            # arrive via dirty rows)
+            self._dev_nodes = {**self._dev_nodes, **carry[0]}
+        return [b.names[s] for s in sel] + [None] * (n_pods - len(sel))
 
     # -- device preemption ---------------------------------------------------
     def preempt(self, pod: Pod, node_infos: dict[str, NodeInfo],
@@ -1079,6 +1352,7 @@ class TPUScheduler:
             nodes, vic, pod_in, feas, order_rank, b.n_real,
             self.check_resources, f.has_request))
         DEVICE_DISPATCH.labels("preempt_scan").inc()
+        DEVICE_FETCHES.labels("preempt_scan").inc()
         DEVICE_FETCHED_BYTES.labels("preempt_scan").inc(out.nbytes)
         obs_trace.add_span("preempt.scan", t_scan, obs_trace.now(),
                            cat="device")
@@ -1279,6 +1553,9 @@ class TPUScheduler:
         # ONE fetch for every chunk's outputs + the final counters
         t_fetch = obs_trace.now()
         h_chunks, li, lni = jax.device_get((outs_chunks, li, lni))
+        # ONE synchronization for the whole wave regardless of chunk count —
+        # the tunnel contract the preemption-lane test pins
+        DEVICE_FETCHES.labels("pressure_batch").inc()
         DEVICE_FETCHED_BYTES.labels("pressure_batch").inc(
             _fetched_nbytes(h_chunks))
         obs_trace.add_span("pressure.fetch", t_fetch, obs_trace.now(),
@@ -1330,3 +1607,20 @@ class TPUScheduler:
             return
         self.encoder.note_assumed(b, host, pod, generation=generation,
                                   mark_dirty=False)
+
+    def note_burst_assumed_many(self, pods: list[Pod], hosts: list[str],
+                                generations: list) -> None:
+        """Batched note_burst_assumed for a committed wave: one vectorized
+        mirror scatter + one generation-map update instead of a Python call
+        chain per pod (encoder.note_assumed_many). Entries whose node left
+        the mirror or the cache (generation None) are skipped, matching the
+        per-pod path's guard."""
+        b = self.encoder._batch
+        if b is None:
+            return
+        keep = [(p, h, g) for p, h, g in zip(pods, hosts, generations)
+                if g is not None and h in b.index]
+        if not keep:
+            return
+        kp, kh, kg = zip(*keep)
+        self.encoder.note_assumed_many(b, list(kp), list(kh), list(kg))

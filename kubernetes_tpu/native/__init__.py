@@ -28,10 +28,15 @@ def _so_path(name: str) -> str:
     return os.path.join(_DIR, f"_{name}{tag}")
 
 
-def _build(name: str) -> str:
+def _build(name: str, force: bool = False) -> str:
+    """Compile `name`.cpp to its .so when the source is newer than the
+    cached artifact (or unconditionally with `force`, for a cached .so
+    that exists but won't import — stale or ABI-mismatched on this
+    machine, e.g. checked in from a different Python build)."""
     src = os.path.join(_DIR, f"{name}.cpp")
     out = _so_path(name)
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    if not force and os.path.exists(out) \
+            and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
     include = sysconfig.get_paths()["include"]
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
@@ -40,21 +45,31 @@ def _build(name: str) -> str:
     return out
 
 
+def _import_so(name: str, path: str):
+    loader = importlib.machinery.ExtensionFileLoader(f"_{name}", path)
+    spec = importlib.util.spec_from_file_location(
+        f"_{name}", path, loader=loader)
+    mod = importlib.util.module_from_spec(spec)
+    loader.exec_module(mod)
+    return mod
+
+
 def load(name: str):
-    """Import native module `_name`, building it first if needed.
-    Returns the module, or None when building/loading fails."""
+    """Import native module `_name`, building it first if needed. An
+    import failure of an up-to-date-looking .so forces one rebuild from
+    source and retries (mtime can't see ABI mismatches). Returns the
+    module, or None when building/loading fails — g++ absence included —
+    so every consumer degrades to its pure-Python twin."""
     with _lock:
         if name in _cache:
             return _cache[name]
         mod = None
         try:
-            path = _build(name)
-            loader = importlib.machinery.ExtensionFileLoader(f"_{name}", path)
-            spec = importlib.util.spec_from_file_location(
-                f"_{name}", path, loader=loader)
-            mod = importlib.util.module_from_spec(spec)
-            loader.exec_module(mod)
+            mod = _import_so(name, _build(name))
         except Exception:
-            mod = None
+            try:
+                mod = _import_so(name, _build(name, force=True))
+            except Exception:
+                mod = None
         _cache[name] = mod
         return mod

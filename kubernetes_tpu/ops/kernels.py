@@ -478,9 +478,9 @@ def _fold_state(state, pod, sel, hit):
 
 @partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
                                    "carry_spread", "rotate_pos"))
-def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
-                        n_real, perms, inv_perms, oid_seq, spread0, z_pad,
-                        weights_tuple, rotate, carry_spread,
+def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
+                        num_to_find, n_real, perms, inv_perms, oid_seq,
+                        spread0, z_pad, weights_tuple, rotate, carry_spread,
                         rotate_pos=False):
     weights = dict(weights_tuple)
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
@@ -525,15 +525,14 @@ def _schedule_batch_jit(nodes, pods, last_index, last_node_index, num_to_find,
     if carry_spread:
         pods = {k: v for k, v in pods.items() if k != "spread_counts"}
     xs = (pods, oid_seq) if (rotate or rotate_pos) else pods
-    init = ({k: nodes[k] for k in _MUTABLE}, last_index, last_node_index,
-            spread0)
-    (state, li, lni, _spread), outs = jax.lax.scan(step, init, xs)
-    return state, li, lni, outs
+    init = (mut0, last_index, last_node_index, spread0)
+    (state, li, lni, spread), outs = jax.lax.scan(step, init, xs)
+    return state, li, lni, spread, outs
 
 
 def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
                    z_pad, weights=None, rotation=None, spread0=None,
-                   rotation_pos=None):
+                   rotation_pos=None, carry_in=None):
     """Schedule a burst of pods against one snapshot, decisions serially
     equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays.
 
@@ -545,7 +544,14 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     num_to_find >= n_real): pos_arr[l][j] = node j's enumeration position
     under order l (the inverse permutation). Mutually exclusive with
     `rotation`. `spread0` [n_pad] carries selector-spread counts across the
-    burst (requires spec-identical pods — one shared selector set)."""
+    burst (requires spec-identical pods — one shared selector set).
+
+    `carry_in` = (mut_state, spread) chains a pipelined wave straight off
+    the previous wave's device-resident carry (no host round trip):
+    mut_state is the prior return's `state` dict (the _MUTABLE rows),
+    spread its carried count vector. `last_index`/`last_node_index` may
+    likewise be the prior launch's device scalars. Returns
+    (state, li, lni, spread, outs)."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -559,13 +565,20 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     else:
         perms, inv_perms, oid_seq = (jnp.asarray(a, jnp.int32)
                                      for a in rotation)
-    carry_spread = spread0 is not None
-    s0 = jnp.asarray(spread0, jnp.int64) if carry_spread \
-        else jnp.zeros((), jnp.int64)
+    carry_spread = spread0 is not None or (
+        carry_in is not None and carry_in[1] is not None)
+    if carry_in is not None:
+        mut0, s0 = carry_in
+        if s0 is None:
+            s0 = jnp.zeros((), jnp.int64)
+    else:
+        mut0 = {k: nodes[k] for k in _MUTABLE}
+        s0 = jnp.asarray(spread0, jnp.int64) if spread0 is not None \
+            else jnp.zeros((), jnp.int64)
     return _schedule_batch_jit(
-        nodes, pods, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
-        _i64(n_real), perms, inv_perms, oid_seq, s0, z_pad, weights_tuple,
-        rotation is not None, carry_spread,
+        nodes, mut0, pods, _i64(last_index), _i64(last_node_index),
+        _i64(num_to_find), _i64(n_real), perms, inv_perms, oid_seq, s0,
+        z_pad, weights_tuple, rotation is not None, carry_spread,
         rotate_pos=rotation_pos is not None)
 
 
@@ -854,7 +867,10 @@ def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
         for jj, s in enumerate(carried_s):
             rs = rs.at[:, s].set(unpad(st[isc0 + jj]))
         out_rows["req_scalar"] = rs
-    return out_rows, out[: b_cap + 1]
+    # the absolute lastNodeIndex stays DEVICE-RESIDENT so a pipelined wave
+    # k+1 can launch from wave k's counter without a host round trip (the
+    # packed delta above still lets the host track it from the fetch)
+    return out_rows, out[: b_cap + 1], lni
 
 
 @partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap", "k_batch",
@@ -869,14 +885,22 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
 
 def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
                            check_resources, weights=None, rotation=None,
-                           extra_ok=None, ban=False, mesh=None):
+                           extra_ok=None, ban=False, mesh=None, cap=None):
     """Uniform-class burst (see block comment above). `cls` holds the shared
     per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
     upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
-    (folded_state_rows, packed[B_CAP+1]) where packed[:n_pods] are per-pod
-    node indices (-1 = unschedulable) and packed[B_CAP] is the
-    lastNodeIndex advance — one array, one host fetch. `n_pods` must be
-    <= B_CAP; chunk larger bursts.
+    (folded_state_rows, packed[B_CAP+1], lni_device) where packed[:n_pods]
+    are per-pod node indices (-1 = unschedulable), packed[B_CAP] is the
+    lastNodeIndex advance — one array, one host fetch — and lni_device is
+    the absolute post-burst lastNodeIndex as a device scalar, so a
+    pipelined wave can pass it straight into the next launch
+    (`last_node_index` accepts a device scalar or a host int). `n_pods`
+    must be <= B_CAP; chunk larger bursts.
+
+    `cap` (static, default B_CAP) sizes the packed output buffer: wave
+    callers pass their fixed wave bucket so the per-wave fetch ships
+    cap+1 int32s instead of the full 16K buffer (the lni-advance slot
+    moves to packed[cap]).
 
     `rotation` = None when the per-cycle NodeTree enumeration is stable and
     equals the device axis; otherwise (perm[L, n_pad+1] int32 — the <= L
@@ -888,8 +912,9 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     feasibility; `ban=True` makes every placement ban its own node for the
     rest of the burst (identical pods with host ports / self-matching
     hostname anti-affinity)."""
-    if n_pods > B_CAP:
-        raise ValueError(f"uniform burst of {n_pods} exceeds B_CAP={B_CAP}")
+    cap = B_CAP if cap is None else int(cap)
+    if n_pods > cap:
+        raise ValueError(f"uniform burst of {n_pods} exceeds cap={cap}")
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     has_req = bool(cls.pop("has_request"))
     carry_eph = bool(cls["upd_eph"] != 0)
@@ -914,13 +939,13 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
         # north-star multi-chip config: node-axis state sharded over the
         # mesh, tie-walk epilogue replicated (parallel/sharding.py)
         from kubernetes_tpu.parallel import sharding as S
-        fn = S.sharded_uniform_fn(mesh, weights_tuple, flags, B_CAP, K_BATCH,
+        fn = S.sharded_uniform_fn(mesh, weights_tuple, flags, cap, K_BATCH,
                                   rotation is not None, bool(ban), has_extra)
         return fn(nodes, cls, _i64(n_pods), _i64(last_node_index),
                   _i64(n_real), perm, oid_seq, extra)
     return _schedule_batch_uniform_jit(
         nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
-        perm, oid_seq, extra, weights_tuple, flags, B_CAP, K_BATCH,
+        perm, oid_seq, extra, weights_tuple, flags, cap, K_BATCH,
         rotation is not None, bool(ban), has_extra)
 
 

@@ -405,6 +405,60 @@ class NodeStateEncoder:
         if mark_dirty and b.dirty_rows is not None:
             b.dirty_rows.append(i)
 
+    def note_assumed_many(self, b: NodeBatch, pods: list, hosts: list,
+                          generations: list) -> None:
+        """Vectorized note_assumed for a committed burst wave: the per-pod
+        deltas land in the mirror via bincount-style scatters (np.add.at —
+        duplicate hosts accumulate) and the generation map syncs in one
+        dict.update, replacing one Python call chain per pod with one per
+        wave. Never marks rows dirty: callers use this exactly when the
+        device already folded the same deltas in-scan (the burst commit
+        path), making the resident matrix authoritative.
+
+        Delta extraction is memoized by the containers tuple — a uniform
+        wave of spec-identical pods computes calculate_resource once."""
+        from kubernetes_tpu.cache.node_info import calculate_resource
+        k = len(pods)
+        if not k:
+            return
+        rows = np.fromiter((b.index[h] for h in hosts), np.int64, k)
+        cache: dict = {}
+        cpu = np.empty(k, np.int64)
+        mem = np.empty(k, np.int64)
+        eph = np.empty(k, np.int64)
+        ncpu = np.empty(k, np.int64)
+        nmem = np.empty(k, np.int64)
+        scalar_pods = []
+        for j, pod in enumerate(pods):
+            key = pod.containers
+            got = cache.get(key)
+            if got is None:
+                req = calculate_resource(pod)
+                got = cache[key] = (req, get_pod_nonzero_requests(pod))
+            req, (nc, nm) = got
+            cpu[j] = req.milli_cpu
+            mem[j] = req.memory
+            eph[j] = req.ephemeral_storage
+            ncpu[j] = nc
+            nmem[j] = nm
+            if req.scalar:
+                scalar_pods.append((j, req.scalar))
+        np.add.at(b.req_cpu, rows, cpu)
+        np.add.at(b.req_mem, rows, mem)
+        np.add.at(b.req_eph, rows, eph)
+        np.add.at(b.nz_cpu, rows, ncpu)
+        np.add.at(b.nz_mem, rows, nmem)
+        np.add.at(b.pod_count, rows, 1)
+        if scalar_pods:
+            scalar_idx = {name: j for j, name in enumerate(b.scalar_names)}
+            for j, scal in scalar_pods:
+                for name, q in scal.items():
+                    b.req_scalar[rows[j], scalar_idx[name]] += q
+        # generations are read once per wave AFTER every assume, so the
+        # name-keyed map lands at each touched node's final generation
+        self._generations.update(
+            (h, g) for h, g in zip(hosts, generations) if g is not None)
+
 
 @dataclass
 class PodTable:

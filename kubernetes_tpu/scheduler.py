@@ -363,10 +363,11 @@ class Scheduler:
         return True
 
     def _process_one(self, pod: Pod, cycle: int,
-                     names: Optional[list[str]] = None) -> None:
+                     names: Optional[list[str]] = None) -> bool:
         """Schedule + assume + bind one already-popped pod. `names` reuses an
         already-consumed NodeTree enumeration (burst bookkeeping) instead of
-        consuming a fresh one."""
+        consuming a fresh one. Returns True when the pod was bound (or its
+        bind was dispatched to a permit-waiting bind thread)."""
         start = self.clock.now()
         # utiltrace analog (generic_scheduler.go:185): per-cycle step
         # timeline, logged only when the cycle is slow. Spans for the
@@ -374,14 +375,15 @@ class Scheduler:
         cycle_trace = Trace(f"scheduling cycle {pod.key}",
                             threshold=self.slow_cycle_threshold)
         try:
-            self._process_one_traced(pod, cycle, names, start, cycle_trace)
+            return self._process_one_traced(pod, cycle, names, start,
+                                            cycle_trace)
         finally:
             if cycle_trace.log_if_long():
                 cycle_trace.emit_spans()
 
     def _process_one_traced(self, pod: Pod, cycle: int,
                             names: Optional[list[str]], start: float,
-                            cycle_trace: Trace) -> None:
+                            cycle_trace: Trace) -> bool:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         cycle_trace.step("snapshot updated")
         if names is None:
@@ -404,7 +406,7 @@ class Scheduler:
                                            self.clock.now() - t_pre)
                 cycle_trace.step("preemption")
             self._record_failure(pod, cycle, REASON_UNSCHEDULABLE, str(err))
-            return
+            return False
         except Exception as err:
             self.metrics.observe("error")
             self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
@@ -424,14 +426,14 @@ class Scheduler:
             self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
             self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, st.message)
-            return
+            return False
         try:
             self.cache.assume_pod(assumed)
         except Exception as err:
             self.framework.run_unreserve_plugins(ctx, assumed, result.suggested_host)
             self.metrics.observe("error")
             self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
-            return
+            return False
         self.queue.nominated.delete(pod)
         cycle_trace.step("pod assumed")
         # Permit may WAIT: when permit plugins exist, bind runs off the
@@ -445,12 +447,15 @@ class Scheduler:
             t.start()
             self._bind_threads.append(t)
             cycle_trace.step("binding dispatched")
+            bound = True   # outcome unknown until the thread resolves
         else:
-            self._bind(assumed, result.suggested_host, pod, cycle, ctx)
+            bound = self._bind(assumed, result.suggested_host, pod, cycle,
+                               ctx)
             cycle_trace.step("binding")
         e2e = self.clock.now() - start
         self.metrics.e2e_latency_sum += e2e
         self.metrics.e2e_duration.observe(e2e)
+        return bound
 
     def wait_for_binds(self, timeout: float = 5.0) -> None:
         """Join outstanding async bind threads (test/shutdown helper)."""
@@ -475,10 +480,11 @@ class Scheduler:
         return self.algorithm.schedule(pod, self._snapshot.node_infos, names)
 
     def _bind(self, assumed: Pod, host: str, orig: Pod, cycle: int,
-              ctx: Optional[PluginContext] = None) -> None:
+              ctx: Optional[PluginContext] = None) -> bool:
         """Reference: the bind goroutine (scheduler.go:523) — Permit (may
         wait) + Prebind + store write + FinishBinding; on failure
-        ForgetPod + Unreserve + re-queue."""
+        ForgetPod + Unreserve + re-queue. Returns True when the binding
+        landed."""
         ctx = ctx or PluginContext()
         t_bind = self.clock.now()
 
@@ -499,11 +505,11 @@ class Scheduler:
         st = self.framework.run_permit_plugins(ctx, assumed, host)
         if not st.is_success():
             fail(st.code == FW_UNSCHEDULABLE, st.message)
-            return
+            return False
         st = self.framework.run_prebind_plugins(ctx, assumed, host)
         if not st.is_success():
             fail(st.code == FW_UNSCHEDULABLE, st.message)
-            return
+            return False
         try:
             try:
                 self.volume_binder.bind_pod_volumes(
@@ -526,8 +532,10 @@ class Scheduler:
             self.recorder.pod_event(
                 assumed, NORMAL, "Scheduled",
                 f"Successfully assigned {assumed.key} to {host}")
+            return True
         except Exception as err:
             fail(False, str(err))
+            return False
 
     def _record_failure(self, pod: Pod, cycle: int,
                         reason: str = REASON_SCHEDULER_ERROR,
@@ -651,7 +659,9 @@ class Scheduler:
     def schedule_burst(self, max_pods: int = 1024) -> int:
         """Drain up to max_pods from the queue and schedule them with device
         bursts where safe, serially otherwise — decisions identical to the
-        serial loop. Returns pods bound."""
+        serial loop. Returns pods bound, derived from the commit paths'
+        actual bound counts (not a schedule_attempts metric delta, which a
+        concurrent metric observer — or reset() — could skew)."""
         pods = []
         cycles = []
         for pod, cycle in self.queue.pop_burst(max_pods):
@@ -665,7 +675,6 @@ class Scheduler:
             cycles.append(cycle)
         if not pods:
             return 0
-        before = self.metrics.schedule_attempts["scheduled"]
         # the burst fold skips the per-pod Reserve/Permit/Prebind points, so
         # any configured plugin forces the serial path (decisions and plugin
         # side effects must not differ by path)
@@ -675,13 +684,15 @@ class Scheduler:
                      and not self.framework.prebind)
         services = self._services_fn()
         replicasets = self._replicasets_fn()
+        bound = 0
         i = 0
         while i < len(pods):
             # serial path for mask-stale pods and under active nominations
             # (the two-pass ghost check lives on the oracle path)
             if not can_burst or self.queue.nominated.has_any() \
                     or not self._pod_is_burstable(pods[i], services, replicasets):
-                self._process_one(pods[i], cycles[i])
+                if self._process_one(pods[i], cycles[i]):
+                    bound += 1
                 i += 1
                 continue
             seg_class = self._burst_class(pods[i], services, replicasets)
@@ -691,25 +702,54 @@ class Scheduler:
                     and self._burst_class(pods[j], services,
                                           replicasets) == seg_class:
                 j += 1
-            self._burst_segment(pods[i:j], cycles[i:j], max_pods)
+            bound += self._burst_segment(pods[i:j], cycles[i:j], max_pods)
             i = j
-        return self.metrics.schedule_attempts["scheduled"] - before
+        return bound
 
     def _burst_segment(self, pods: list[Pod], cycles: list[int],
-                       bucket: int) -> None:
+                       bucket: int) -> int:
+        """Schedule one burst segment; returns pods bound."""
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
         self._last_names = names
-        hosts = self.algorithm.schedule_burst(pods, self._snapshot.node_infos,
-                                              names, bucket=bucket)
+        # pipelined-wave sink (tpu_scheduler.schedule_burst `commit`): the
+        # algorithm calls back with consecutive windows of DECIDED hosts
+        # while the next wave executes on the device — the host commit of
+        # wave k overlaps wave k+1's device time. A short commit (pods that
+        # vanished between decision and commit) returns False, which makes
+        # the algorithm discard the in-flight wave's decisions and rewind.
+        progress = {"committed": 0, "bound": 0, "failed": False}
+
+        def commit_wave(lo: int, hosts: list) -> bool:
+            k = len(hosts)
+            n_bound = self._commit_burst(pods[lo:lo + k], hosts,
+                                         cycles[lo:lo + k])
+            progress["committed"] = lo + k
+            progress["bound"] += n_bound
+            if n_bound < k:
+                progress["failed"] = True
+                return False
+            return True
+
+        if getattr(self.algorithm, "supports_wave_commit", False):
+            hosts = self.algorithm.schedule_burst(
+                pods, self._snapshot.node_infos, names, bucket=bucket,
+                commit=commit_wave)
+        else:
+            hosts = self.algorithm.schedule_burst(
+                pods, self._snapshot.node_infos, names, bucket=bucket)
         if hosts is None:
             # the algorithm refused the whole burst (it can't reproduce the
-            # serial walk for this cluster/workload) — run pods one by one;
+            # serial walk for this cluster/workload; refusals happen before
+            # any wave is dispatched or committed) — run pods one by one;
             # pod 0 rides the enumeration list_names() above already consumed
             # so every pod sees exactly its serial-loop node order
+            bound = 0
             for i, (pod, cycle) in enumerate(zip(pods, cycles)):
-                self._process_one(pod, cycle, names=names if i == 0 else None)
-            return
+                if self._process_one(pod, cycle,
+                                     names=names if i == 0 else None):
+                    bound += 1
+            return bound
         kf = len(pods)
         if any(host is None for host in hosts):
             # burst contract (tpu_scheduler.schedule_burst): decisions from
@@ -720,52 +760,90 @@ class Scheduler:
             # preempt — nominating a node and deleting victims — state the
             # discarded kernel decisions never saw).
             kf = hosts.index(None)
-        self._commit_burst(pods[:kf], hosts[:kf], cycles[:kf])
+        done = progress["committed"]   # waves already committed in-flight
+        bound = progress["bound"]
+        if done < kf:
+            bound += self._commit_burst(pods[done:kf], hosts[done:kf],
+                                        cycles[done:kf])
         # serial semantics consume one NodeTree enumeration per pod; the
         # kernel modeled cycles 0..kf-1 on the segment's single
         # enumeration — fast-forward the rest of the committed prefix
         if kf > 0:
             self.cache.node_tree.advance_enumerations(kf - 1)
         if kf < len(pods):
+            if progress["failed"]:
+                # wave-commit failure: the algorithm discarded the in-flight
+                # wave's decisions and its device folds (rewind contract) —
+                # schedule the remainder as a fresh segment against a fresh
+                # snapshot and enumeration (the forgotten pods re-queued)
+                return bound + self._burst_segment(pods[kf:], cycles[kf:],
+                                                   bucket)
             # the tail's first pod rides one fresh enumeration (or the
             # segment's own when the kernel decided nothing) whether it runs
             # batched or serial
             tail_names = names if kf == 0 \
                 else self.cache.node_tree.list_names()
-            if self._try_pressure_tail(pods[kf:], cycles[kf:], tail_names):
-                return
+            tail_bound = self._try_pressure_tail(pods[kf:], cycles[kf:],
+                                                 tail_names)
+            if tail_bound is not None:
+                return bound + tail_bound
             for k in range(kf, len(pods)):
-                self._process_one(pods[k], cycles[k],
-                                  names=tail_names if k == kf else None)
+                if self._process_one(pods[k], cycles[k],
+                                     names=tail_names if k == kf else None):
+                    bound += 1
+        return bound
 
     def _commit_burst(self, pods: list[Pod], hosts: list[str],
-                      cycles: list[int]) -> None:
-        """Commit a burst's decided prefix: assume + device-mirror sync per
-        pod, then ONE batched store write for all bindings, one batched
-        event write, and aggregated metrics — the per-pod lock/call
-        overhead of the serial bind path amortized across the burst
-        (VERDICT r4 weak #4: the 38us/pod host bind ceiling). Pods an
-        extender binder manages keep the per-pod path (extender-owned
-        writes can't batch through our store).
+                      cycles: list[int]) -> int:
+        """Commit a burst's decided prefix (or one pipelined wave of it):
+        ONE batched cache assume + vectorized device-mirror sync, then ONE
+        batched store write for all bindings, one batched finish, one
+        batched event write, and aggregated metrics — the per-pod
+        lock/call overhead of the serial bind path amortized across the
+        wave (VERDICT r4 weak #4: the 38us/pod host bind ceiling; the wave
+        pipeline then hides what remains behind the next wave's device
+        time). Pods an extender binder manages keep the per-pod path
+        (extender-owned writes can't batch through our store). Returns the
+        number of pods actually bound.
 
         Invariant: bursts only form when NO reserve/permit/prebind plugins
         are configured (schedule_burst's can_burst gate routes plugin-ful
         workloads to the serial _process_one/_bind path), so skipping the
         framework points here cannot skip real plugin work."""
         if not pods:
-            return
+            return 0
         assert not (self.framework.reserve or self.framework.permit
                     or self.framework.prebind), \
             "burst commit reached with framework plugins configured"
         eb = self._extender_binder
         if eb is not None and any(eb.is_interested(p) for p in pods):
+            n_bound = 0
             for pod, host, cycle in zip(pods, hosts, cycles):
                 assumed = self._assume_for_burst(pod, host)
-                self._bind(assumed, host, pod, cycle)
-            return
+                if self._bind(assumed, host, pod, cycle):
+                    n_bound += 1
+            return n_bound
         t_bind = self.clock.now()
-        assumed_list = [self._assume_for_burst(pod, host)
-                        for pod, host in zip(pods, hosts)]
+        assumed_list = []
+        for pod, host in zip(pods, hosts):
+            assumed = pod.clone()
+            assumed.node_name = host
+            assumed_list.append(assumed)
+        self.cache.assume_pods(assumed_list)    # one lock for the wave
+        note_many = getattr(self.algorithm, "note_burst_assumed_many", None)
+        if note_many is not None:
+            # the device scan already folded these deltas: sync the host
+            # mirror + generation map in one vectorized pass (generations
+            # read once, after every assume of the wave landed)
+            note_many(assumed_list, hosts,
+                      self.cache.node_generations(hosts))
+        else:
+            note = getattr(self.algorithm, "note_burst_assumed", None)
+            if note is not None:
+                for assumed, host in zip(assumed_list, hosts):
+                    gen = self.cache.node_generation(host)
+                    if gen is not None:
+                        note(assumed, host, gen)
         try:
             missing = set(self.store.bind_pods(
                 [(a.key, h) for a, h in zip(assumed_list, hosts)]))
@@ -797,11 +875,11 @@ class Scheduler:
                 self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR,
                                      f"{PODS}/{assumed.key}")
                 continue
-            self.cache.finish_binding(assumed)
             bound.append((assumed, host))
         k = len(bound)
         if not k:
-            return
+            return 0
+        self.cache.finish_bindings([a for a, _h in bound])  # one lock
         dt = self.clock.now() - t_bind
         self.metrics.binding_count += k
         self.metrics.binding_duration.observe_many(dt / k, k)
@@ -811,6 +889,7 @@ class Scheduler:
         self.recorder.pod_events_batch([
             (a, NORMAL, "Scheduled",
              f"Successfully assigned {a.key} to {h}") for a, h in bound])
+        return k
 
     def _assume_for_burst(self, pod: Pod, host: str) -> Pod:
         assumed = pod.clone()
@@ -826,26 +905,27 @@ class Scheduler:
         return assumed
 
     def _try_pressure_tail(self, pods: list[Pod], cycles: list[int],
-                           names: list[str]) -> bool:
+                           names: list[str]) -> Optional[int]:
         """Run a failed burst tail through the batched schedule-else-preempt
         launch (algorithm.preempt_pressure_burst) instead of one serial
-        cycle + victim scan per pod. Returns False when the batch isn't
-        applicable — the caller falls back to the serial loop. Decisions and
-        store/queue side effects are identical to the serial path (the
-        batched-kernel gates + shared _apply_preemption_result guarantee
-        it; the pressure parity fuzzes are the tripwire)."""
+        cycle + victim scan per pod. Returns None when the batch isn't
+        applicable — the caller falls back to the serial loop — else the
+        number of pods bound. Decisions and store/queue side effects are
+        identical to the serial path (the batched-kernel gates + shared
+        _apply_preemption_result guarantee it; the pressure parity fuzzes
+        are the tripwire)."""
         fn = getattr(self.algorithm, "preempt_pressure_burst", None)
         if fn is None or self.disable_preemption or self.extenders:
-            return False
+            return None
         if self.queue.nominated.has_any():
-            return False
+            return None
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         self._last_names = names
         t_launch = self.clock.now()
         outcomes = fn(pods, self._snapshot.node_infos, names,
                       self.informers.informer(PDBS).list())
         if outcomes is None:
-            return False
+            return None
         # metric-shape parity with the serial loop: every pod gets an
         # "algorithm" phase sample (its share of the one launch), failed
         # pods a "preemption" sample, bound pods an e2e sample — so the
@@ -855,6 +935,7 @@ class Scheduler:
         from kubernetes_tpu.oracle.preemption import PreemptionResult
         note = getattr(self.algorithm, "note_burst_assumed", None)
         n = len(names)
+        n_bound = 0
         for pod, cycle, oc in zip(pods, cycles, outcomes):
             t_pod = self.clock.now()
             self.metrics.observe_phase("algorithm", share)
@@ -868,7 +949,8 @@ class Scheduler:
                     if gen is not None:
                         note(assumed, host, gen)
                 self.queue.nominated.delete(pod)
-                self._bind(assumed, host, pod, cycle)
+                if self._bind(assumed, host, pod, cycle):
+                    n_bound += 1
                 e2e = share + (self.clock.now() - t_pod)
                 self.metrics.e2e_latency_sum += e2e
                 self.metrics.e2e_duration.observe(e2e)
@@ -896,7 +978,7 @@ class Scheduler:
         # the kernel modeled one enumeration per pod on the axis order
         # (identity rotation is a batch gate); consume the remainder
         self.cache.node_tree.advance_enumerations(len(pods) - 1)
-        return True
+        return n_bound
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""

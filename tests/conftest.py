@@ -19,3 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running bench/e2e tests, excluded from tier-1 "
+        "(-m 'not slow')")

@@ -1,0 +1,61 @@
+"""Bench regression floors (slow; excluded from tier-1's `-m 'not slow'`).
+
+Runs the real `bench.py --mode matrix` as a subprocess on the CPU backend
+and asserts per-lane `ratio_to_plain` floors, so the next spread-lane-style
+cliff (PR 1's 0.17x regression lived in self-reported numbers for a full
+round) fails CI instead of landing silently. Floors are deliberately below
+the currently measured ratios (spread ~0.7x, affinity ~1.5x on CPU) —
+they catch cliffs, not variance.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# lane -> (min ratio_to_plain, min absolute pods/s on the CPU backend).
+# A lane fails only when it misses BOTH: the ratio catches a lane-local
+# cliff, the absolute floor keeps the check robust to the plain lane's
+# own scheduler-machine variance (plain has been observed swinging 13k..
+# 29k pods/s run to run on loaded CI boxes, which would whipsaw a pure
+# ratio). Historic cliffs both checks catch: spread at 0.11-0.17x /
+# ~1.6k pods/s (PR 1's encode cliff and a round-7 recompile-in-loop
+# bug), affinity at ~4.7k pods/s.
+LANE_FLOORS = {
+    "spread": (0.5, 3500.0),
+    "affinity": (1.0, 5000.0),
+    "anti_affinity": (0.15, 2000.0),
+    "node_affinity": (0.5, 6000.0),
+}
+
+
+@pytest.mark.slow
+def test_matrix_ratio_to_plain_floors():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)   # single CPU device: the bench's own shape
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "matrix",
+         "--matrix-repeat", "2"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # ONE JSON line on stdout (bench contract); warnings go to stderr
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert "errors" not in out, out["errors"]
+    plain = out.get("plain")
+    assert plain and plain > 0, out
+    ratios = out.get("ratio_to_plain") or {}
+    for lane, (ratio_floor, abs_floor) in LANE_FLOORS.items():
+        ratio = ratios.get(lane)
+        absolute = out.get(lane)
+        assert ratio is not None and absolute is not None, \
+            f"lane {lane} missing from {out}"
+        assert ratio >= ratio_floor or absolute >= abs_floor, \
+            (f"{lane} cliffed: {ratio}x plain (floor {ratio_floor}x) AND "
+             f"{absolute} pods/s (floor {abs_floor}) — matrix: {out}")
+    # the preemption lane must have run and beaten the serial oracle
+    assert out.get("preempt_scans_per_s"), out
+    assert out.get("preempt_vs_oracle") and out["preempt_vs_oracle"] > 1.0

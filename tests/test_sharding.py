@@ -132,7 +132,7 @@ class TestShardedBurstParity:
         pods = _mk_pods(n_burst, seed=seed + 20)
         node_arrays, _, stacked, batch = _encode(infos, names, pods)
         z_pad = 4
-        state1, li1, lni1, outs1 = K.schedule_batch(
+        state1, li1, lni1, _spread1, outs1 = K.schedule_batch(
             node_arrays, stacked, 0, 0, batch.n_real, batch.n_real, z_pad)
         nodes_s = S.shard_node_arrays(mesh, node_arrays)
         pods_s = S.shard_pod_batch(mesh, stacked)
@@ -164,7 +164,7 @@ class TestShardedBurstParity:
                 for j in range(48)]
         node_arrays, _, stacked, batch = _encode(infos, names, pods)
         z_pad = 4
-        _, _, _, outs1 = K.schedule_batch(
+        _, _, _, _, outs1 = K.schedule_batch(
             node_arrays, stacked, 0, 0, batch.n_real, batch.n_real, z_pad)
         nodes_s = S.shard_node_arrays(mesh, node_arrays)
         pods_s = S.shard_pod_batch(mesh, stacked)

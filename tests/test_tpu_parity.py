@@ -582,8 +582,13 @@ class TestMixedWorkloadShellFuzz:
     burst segmentation, uniform/ELIM/ban kernels, rotation replay, refusals,
     and the serial fallback together."""
 
+    # wave_size=4 forces every burst segment of >= 8 pods across >= 2
+    # pipelined wave boundaries (the new seam: device-chained lni/folds,
+    # rotation-walk slicing, per-wave commit) — the same differential soak
+    # must stay bit-identical with and without the pipeline
+    @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
-    def test_bindings_identical(self, seed):
+    def test_bindings_identical(self, seed, wave_size):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -655,6 +660,8 @@ class TestMixedWorkloadShellFuzz:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
                               percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
             sched.sync()
             for j in range(rng.randint(25, 50)):
                 s.create(PODS, make_pod(j))
@@ -680,8 +687,12 @@ class TestPreemptionPressureShellFuzz:
     nominations must match between the TPU shell and the oracle shell under
     an identical deterministic round structure."""
 
+    # wave_size=3 pushes every 8-pod burst across wave boundaries so the
+    # failed-tail handoff (waves -> pressure batch / serial preemption)
+    # crosses the new seam too
+    @pytest.mark.parametrize("wave_size", [None, 3])
     @pytest.mark.parametrize("seed", [3, 5, 17, 7, 29])
-    def test_preemptive_convergence_identical(self, seed):
+    def test_preemptive_convergence_identical(self, seed, wave_size):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -710,6 +721,8 @@ class TestPreemptionPressureShellFuzz:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
                               percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
             sched.sync()
             for j in range(rng.randint(10, 25)):
                 s.create(PODS, Pod(
@@ -743,12 +756,15 @@ class TestSpreadBurstParity:
     counts and per-cycle rotation orders; bindings must match the oracle
     including the zone blend and uneven-zone rotation."""
 
+    # wave_size=4 drives the generic scan's carried spread counts and
+    # rotation walk across wave boundaries (device-chained carry_in)
+    @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("n_nodes,zones,n_pods", [
         (7, 3, 20),     # uneven zones -> rotated orders in-burst
         (12, 2, 30),    # even zones -> stable axis order
         (5, 1, 40),     # deep stacking on few nodes
     ])
-    def test_burst_matches_oracle(self, n_nodes, zones, n_pods):
+    def test_burst_matches_oracle(self, n_nodes, zones, n_pods, wave_size):
         from kubernetes_tpu.store.store import Store, PODS, NODES, SERVICES
         from kubernetes_tpu.scheduler import Scheduler
         from kubernetes_tpu.api.types import Service
@@ -773,6 +789,8 @@ class TestSpreadBurstParity:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
                               percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
             sched.sync()
             for j in range(n_pods):
                 s.create(PODS, Pod(name=f"p{j}", labels={"app": "web"},
@@ -791,8 +809,9 @@ class TestSpreadBurstParity:
                                for p in s.list(PODS)[0]))
         assert outs[0] == outs[1]
 
+    @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [13, 37, 71])
-    def test_burst_matches_oracle_with_existing_pods(self, seed):
+    def test_burst_matches_oracle_with_existing_pods(self, seed, wave_size):
         """The vectorized spread encode counts pre-existing pods through
         the columnar table: some existing pods match the Service selector
         (non-zero spread0 carried into the burst), some differ only in
@@ -839,6 +858,8 @@ class TestSpreadBurstParity:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
                               percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
             sched.sync()
             for j in range(rng.randint(15, 30)):
                 s.create(PODS, Pod(name=f"p{j}", labels={"app": "web"},
@@ -1056,3 +1077,66 @@ class TestBurstFailurePrefixCommit:
         tpu = self._run_world(build, mk_pods, True)
         ser = self._run_world(build, mk_pods, False)
         assert tpu == ser
+
+
+class TestDeviceFetchContract:
+    """The tunnel contract (CLAUDE.md): every device->host synchronization
+    pays a full dispatch+readback round trip, so batched launches must
+    fetch ONE packed result per wave regardless of how many kernel chunks
+    they dispatch. Pinned via tpu_device_dispatch_total{op} /
+    tpu_device_fetches_total{op} deltas — a per-chunk (or per-pod) fetch
+    sneaking in fails here before it lands as a 100ms-per-pod cliff."""
+
+    def _pressure_world(self, n_nodes=4, victims_per_node=2):
+        infos = {}
+        names = []
+        for i in range(n_nodes):
+            node = Node(name=f"n{i}",
+                        allocatable={"cpu": 2000, "memory": 8 * GI,
+                                     "pods": 110})
+            ni = NodeInfo(node)
+            for v in range(victims_per_node):
+                ni.add_pod(Pod(name=f"v{i}-{v}", priority=1,
+                               node_name=node.name,
+                               containers=(Container.make(
+                                   name="c", requests={"cpu": 900}),)))
+            infos[node.name] = ni
+            names.append(node.name)
+        return infos, names
+
+    def test_pressure_burst_one_fetch_across_chunks(self):
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        infos, names = self._pressure_world()
+        preemptors = [Pod(name=f"hi-{k}", priority=10,
+                          containers=(Container.make(
+                              name="c", requests={"cpu": 900}),))
+                      for k in range(10)]
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        tpu.PRESSURE_B_CAP = 4      # force 3 launches in one wave
+        d0 = DEVICE_DISPATCH.labels("pressure_batch").value
+        f0 = DEVICE_FETCHES.labels("pressure_batch").value
+        out = tpu.preempt_pressure_burst(preemptors, infos, names, [])
+        assert out is not None and len(out) == 10
+        assert DEVICE_DISPATCH.labels("pressure_batch").value - d0 == 3
+        # 3 launches, ONE round trip: the chunk outputs ride one device_get
+        assert DEVICE_FETCHES.labels("pressure_batch").value - f0 == 1
+
+    def test_preempt_victim_scan_one_fetch(self):
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        from kubernetes_tpu.oracle import predicates as P
+        infos, names = self._pressure_world()
+        pod = Pod(name="hi", priority=10,
+                  containers=(Container.make(
+                      name="c", requests={"cpu": 900}),))
+        err = FitError(pod, len(names),
+                       {nm: [P.insufficient_resource("cpu")]
+                        for nm in names})
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        d0 = DEVICE_DISPATCH.labels("preempt_scan").value
+        f0 = DEVICE_FETCHES.labels("preempt_scan").value
+        res = tpu.preempt(pod, infos, names, err, [])
+        assert res is not None and res.node is not None
+        assert DEVICE_DISPATCH.labels("preempt_scan").value - d0 == 1
+        assert DEVICE_FETCHES.labels("preempt_scan").value - f0 == 1
