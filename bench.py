@@ -246,6 +246,84 @@ def run_preempt_bench(n_nodes: int, n_victims: int,
     }
 
 
+def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
+                   gang_sizes: tuple = (8, 64, 512)) -> dict:
+    """`--mode gang`: all-or-nothing PodGroup throughput over the same
+    cell as the headline bench. Gangs of 8/64/512 spec-identical members
+    (the SPMD-rank shape) split `pods_budget` three ways; every group must
+    land whole — the run FAILS if any group is partially bound (the gang
+    atomicity contract, driver-checked). Prints the same one-line JSON."""
+    from kubernetes_tpu.api.types import Pod, Container
+    from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+    from kubernetes_tpu.store.store import Store, PODS, PODGROUPS
+    from kubernetes_tpu.scheduler import Scheduler
+    MI = 1024 ** 2
+    per_size = max(pods_budget // len(gang_sizes), max(gang_sizes))
+    plan = []   # (group name, size)
+    for size in gang_sizes:
+        for g in range(max(1, per_size // size)):
+            plan.append((f"gang-{size}-{g}", size))
+    n_pods = sum(size for _, size in plan)
+    store = Store(watch_log_size=max(65536, 4 * (n_nodes + n_pods)))
+    build_cluster(store, n_nodes)
+    sched = Scheduler(store, use_tpu=True, percentage_of_nodes_to_score=100)
+    sched.sync()
+
+    def create_gangs(tag: str, the_plan) -> int:
+        total = 0
+        for gname, size in the_plan:
+            name = f"{tag}{gname}"
+            store.create(PODGROUPS, PodGroup(name=name, min_member=size))
+            for r in range(size):
+                store.create(PODS, Pod(
+                    name=f"{name}-r{r}",
+                    labels={LABEL_POD_GROUP: name, "app": "gang"},
+                    containers=(Container.make(
+                        name="c",
+                        requests={"cpu": 100, "memory": 500 * MI}),)))
+            total += size
+        return total
+
+    # warmup: one small gang per size compiles every wave bucket
+    create_gangs("warm-", [(f"w{s}", s) for s in gang_sizes])
+    sched.pump()
+    while sched.schedule_burst(max_pods=10000):
+        pass
+    sched.pump()
+
+    create_gangs("", plan)
+    sched.pump()
+    bound = 0
+    t0 = time.perf_counter()
+    while True:
+        n = sched.schedule_burst(max_pods=10000)
+        if n == 0:
+            break
+        bound += n
+    elapsed = time.perf_counter() - t0
+    sched.pump()
+    # atomicity audit: every group is bound whole or not at all
+    by_group: dict[str, list] = {}
+    for p in store.list(PODS)[0]:
+        g = p.labels.get(LABEL_POD_GROUP)
+        if g:
+            by_group.setdefault(g, []).append(bool(p.node_name))
+    partial = sorted(g for g, flags in by_group.items()
+                     if any(flags) and not all(flags))
+    assert not partial, f"partially bound gangs: {partial[:5]}"
+    throughput = bound / elapsed if elapsed > 0 else 0.0
+    return {
+        "metric": f"gang_throughput_{n_nodes}n_{n_pods}p",
+        "value": round(throughput, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(throughput / 100.0, 2),
+        "gangs": {str(s): sum(1 for _g, sz in plan if sz == s)
+                  for s in gang_sizes},
+        "pods_bound": bound,
+        "all_or_nothing": True,
+    }
+
+
 # the non-plain lanes of the benchmark matrix at the reference's 1000-node /
 # 1000-existing cell (scheduler_bench_test.go:61-118) plus the spread lane
 MATRIX_LANES = ("plain", "anti-affinity", "affinity", "node-affinity",
@@ -304,6 +382,22 @@ def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
         lane_median(lane.replace("-", "_"),
                     PerfConfig(nodes=nodes, existing_pods=existing,
                                pods=pods, workload=lane))
+    # gang (PodGroup) cell: all-or-nothing groups of 64 at the same
+    # nodes/pods shape (perf.harness.run_gang_cell asserts the atomicity
+    # contract before reporting)
+    from kubernetes_tpu.perf.harness import run_gang_cell
+
+    def gang_lane():
+        vals: list = []
+
+        def runs():
+            for _ in range(max(repeat, 1)):
+                vals.append(retry_transient(
+                    lambda: run_gang_cell(nodes=nodes, gang_size=64,
+                                          pods=pods).throughput))
+        isolate("gang", runs)
+        out["gang"] = median_low(vals)
+    gang_lane()
     # BASELINE configs[2]: InterPodAffinity at 5000 nodes
     # (scheduler_bench_test.go:86-91's largest affinity cell)
     lane_median("affinity_5000n",
@@ -325,7 +419,8 @@ def run_matrix_only(repeat: int = 2) -> dict:
     out = run_matrix(repeat=repeat)
     plain = out.get("plain")
     ratios = {}
-    for lane in ("anti_affinity", "affinity", "node_affinity", "spread"):
+    for lane in ("anti_affinity", "affinity", "node_affinity", "spread",
+                 "gang"):
         v = out.get(lane)
         ratios[lane] = (round(v / plain, 3)
                         if plain and v is not None else None)
@@ -338,7 +433,8 @@ def main():
     ap.add_argument("--nodes", type=int, default=15000)
     ap.add_argument("--pods", type=int, default=10000)
     ap.add_argument("--mode",
-                    choices=["burst", "serial", "oracle", "preempt", "matrix"],
+                    choices=["burst", "serial", "oracle", "preempt", "matrix",
+                             "gang"],
                     default="burst")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
@@ -385,6 +481,11 @@ def main():
     if args.mode == "preempt":
         result = retry_transient(
             lambda: run_preempt_bench(args.nodes, args.pods))
+        finish(result)
+        return
+    if args.mode == "gang":
+        result = retry_transient(
+            lambda: run_gang_bench(args.nodes, pods_budget=args.pods))
         finish(result)
         return
     if args.mode == "matrix":

@@ -55,6 +55,11 @@ from kubernetes_tpu.apiserver.auth import (  # noqa: E402
 KIND_TYPES[store_mod.CLUSTERROLES] = _Role
 KIND_TYPES[store_mod.CLUSTERROLEBINDINGS] = _RoleBinding
 
+# co-scheduling gangs (scheduling.sigs.k8s.io PodGroup analog): served by
+# the apiserver + /status subresource, mirrored by RemoteStore
+from kubernetes_tpu.coscheduling.types import PodGroup as _PodGroup  # noqa: E402
+KIND_TYPES[store_mod.PODGROUPS] = _PodGroup
+
 # kinds whose objects key by bare name (Node.key etc.); everything else
 # keys by namespace/name — the single owner of REST path scoping
 CLUSTER_SCOPED_KINDS = frozenset(

@@ -177,10 +177,22 @@ class RBACAuthorizer:
         return False
 
 
+# kinds a node identity may NOT read wholesale: the graph-based reference
+# authorizer scopes secrets/configmaps/serviceaccounts to the objects
+# referenced by pods BOUND to that node (node_authorizer.go:151-186,
+# "no relationship found" -> deny); this model keeps no reference graph,
+# so the collapse is an outright deny — a compromised kubelet credential
+# must not be a read-everything credential for cluster secrets. The
+# kubemark-fidelity kubelet reads none of these.
+NODE_RESTRICTED_READS = frozenset(
+    ("secrets", "configmaps", "serviceaccounts"))
+
+
 class NodeAuthorizer:
     """node_authorizer.go collapsed to ownership rules: a kubelet identity
-    may read cluster state (its informers), write only its own Node, touch
-    only pods bound to it, and post events."""
+    may read cluster state (its informers) EXCEPT secret-bearing kinds,
+    write only its own Node, touch only pods bound to it, and post
+    events."""
 
     def authorize(self, attrs: Attributes) -> bool:
         u = attrs.user
@@ -188,6 +200,8 @@ class NodeAuthorizer:
                 not u.name.startswith(NODE_USER_PREFIX):
             return False
         node_name = u.name[len(NODE_USER_PREFIX):]
+        if attrs.resource in NODE_RESTRICTED_READS:
+            return False
         if attrs.verb in ("get", "list", "watch"):
             return True
         if attrs.resource == "nodes":

@@ -35,7 +35,7 @@ from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
 from kubernetes_tpu.store.store import (
-    Store, PODS, AlreadyExistsError, ConflictError, NotFoundError,
+    Store, PODS, PODGROUPS, AlreadyExistsError, ConflictError, NotFoundError,
     ExpiredError,
 )
 
@@ -343,6 +343,30 @@ def make_handler(store: Store, admission: AdmissionChain,
 
         def _serve_PUT(self):
             path, parts, q = self._route()
+            # status subresource: PUT /api/v1/podgroups/{ns}/{name}/status
+            # {"phase": ..., "members": ..., "scheduled": ...} — status-only
+            # write (spec fields untouched), the controller/scheduler verb
+            if len(parts) == 6 and parts[2] == PODGROUPS \
+                    and parts[5] == "status":
+                key = f"{parts[3]}/{parts[4]}"
+                user = self._authenticate()
+                if not self._authorized(user, "update", PODGROUPS, key):
+                    return
+                body = self._body()
+                try:
+                    updated = store.update_pod_group_status(
+                        key, phase=body.get("phase"),
+                        members=body.get("members"),
+                        scheduled=body.get("scheduled"),
+                        now=body.get("last_transition_time"))
+                except NotFoundError:
+                    self._error(404, "NotFound", f"{PODGROUPS}/{key}")
+                    return
+                except (TypeError, ValueError) as e:
+                    self._error(400, "BadRequest", str(e))
+                    return
+                self._send(200, serde.to_dict(updated))
+                return
             if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
                 self._error(404, "NotFound", path)
                 return

@@ -133,6 +133,22 @@ class NodeTree:
     def zone_index(self) -> int:
         return self._zone_index
 
+    # -- gang checkpoint/rewind ----------------------------------------------
+    def checkpoint(self) -> tuple:
+        """Snapshot the enumeration cursor (zone index + per-zone cursors +
+        exhausted set). A discarded gang trial restores it so the rotation
+        walk replays EXACTLY as if the gang was never attempted — the next
+        cycle (gang retry or the singleton behind it) sees the same
+        interleaved order either way. Only valid across a window with no
+        membership changes (the single-threaded scheduling loop's case)."""
+        return (self._zone_index, dict(self._last_index),
+                set(self._exhausted))
+
+    def restore(self, chk: tuple) -> None:
+        self._zone_index = chk[0]
+        self._last_index = dict(chk[1])
+        self._exhausted = set(chk[2])
+
     def advance_enumerations(self, count: int) -> None:
         """Fast-forward the tree as if `count` more full enumerations ran.
         Valid only in the post-enumeration state (i.e. after at least one

@@ -28,6 +28,7 @@ from kubernetes_tpu.controllers.namespace import (
 from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
 from kubernetes_tpu.controllers.hpa import HorizontalPodAutoscalerController
 from kubernetes_tpu.controllers.cronjob import CronJobController
+from kubernetes_tpu.controllers.podgroup import PodGroupController
 from kubernetes_tpu.controllers.ttl import TTLController
 from kubernetes_tpu.controllers.pvbinder import PersistentVolumeBinder
 from kubernetes_tpu.controllers.nodeipam import NodeIpamController
@@ -42,6 +43,7 @@ from kubernetes_tpu.controllers.clusterrole_aggregation import (
 # earlier loops cascade in the same pump).
 CONTROLLER_INITIALIZERS: dict[str, Callable[[Store], object]] = {
     "disruption": DisruptionController,
+    "podgroup": PodGroupController,
     "nodelifecycle": NodeLifecycleController,
     "podgc": PodGCController,
     "ttl": TTLController,

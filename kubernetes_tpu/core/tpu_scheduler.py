@@ -68,6 +68,11 @@ PRESSURE_GATES = obs.counter(
 DISCARDED_FOLDS = obs.counter(
     "tpu_burst_folds_discarded_total",
     "Device-resident burst folds dropped after a mid-burst failure.")
+GANG_REWIND_FOLDS = obs.counter(
+    "gang_rewind_folds_total",
+    "Device-resident fold sets discarded by a gang (PodGroup) rewind — a "
+    "trial-placed gang that missed minMember dropped its in-flight folds "
+    "and the carries rewound to the pre-gang checkpoint.")
 
 # span names for the burst phase markers ("kernel" is the async dispatch;
 # "fetch" is where device time is actually PAID — CLAUDE.md: the tunnel's
@@ -172,6 +177,11 @@ class TPUScheduler:
         # scatter otherwise (SURVEY §2.4 delta uploader)
         self._dev_nodes: Optional[dict] = None
         self._dev_key = None
+        # upload/scatter epoch: bumps whenever HOST data lands in the
+        # device matrix (burst folds do NOT bump it) — a gang checkpoint
+        # whose epoch still matches can restore its pinned matrix without
+        # a re-upload (kernels.gang_carry_checkpoint's zero-copy rewind)
+        self._dev_epoch = 0
         # inert per-pod fields are shape [1] and broadcast in the kernel —
         # the common case uploads ~nothing (vs [N] per field per pod)
         self._defaults = {
@@ -216,6 +226,7 @@ class TPUScheduler:
             else:
                 self._dev_nodes = {k: jnp.asarray(v) for k, v in host.items()}
             DEVICE_DISPATCH.labels("upload").inc()
+            self._dev_epoch += 1
             self._dev_key = key
             b.dirty_rows = []   # host state fully mirrored; start tracking
             return self._dev_nodes
@@ -230,6 +241,7 @@ class TPUScheduler:
             upd = {k: getattr(b, k)[rows] for k in self._NODE_FIELDS}
             self._dev_nodes = _scatter_rows(self._dev_nodes, rows, upd)
             DEVICE_DISPATCH.labels("scatter").inc()
+            self._dev_epoch += 1
             b.dirty_rows = []
         return self._dev_nodes
 
@@ -1587,6 +1599,40 @@ class TPUScheduler:
         self.last_index = int(li)
         self.last_node_index = int(lni)
         return outcomes
+
+    # -- gang (PodGroup) checkpoint/rewind -----------------------------------
+    # PR 3's rewind contract generalized from per-wave to per-GROUP: a gang
+    # trial runs through the ordinary wave machinery (schedule_burst with no
+    # commit callback, so nothing reaches the cache/store), and either the
+    # WHOLE gang's folds persist or the carries — li, lni, the device-resident
+    # node matrix, and (via the shell) the NodeTree rotation cursor — rewind
+    # to this checkpoint as if the gang was never attempted.
+    def gang_checkpoint(self) -> dict:
+        """Snapshot the device carries at a group boundary. The matrix
+        snapshot is kernels.gang_carry_checkpoint's zero-copy pin: trial
+        folds build new arrays, so the pre-gang rows stay resident and a
+        same-epoch rewind restores them without a re-upload."""
+        return {"li": self.last_index, "lni": self.last_node_index,
+                "dev": K.gang_carry_checkpoint(self._dev_nodes),
+                "key": self._dev_key, "epoch": self._dev_epoch}
+
+    def gang_rewind(self, chk: dict) -> None:
+        """Discard everything since `chk`: in-flight folds are dropped and
+        last_index/lastNodeIndex rewind to the pre-gang prefix. When no
+        host upload/scatter happened since the checkpoint (the epoch
+        matches), the pinned pre-gang matrix is restored in place — the
+        common case pays ZERO device traffic for a rejected gang; otherwise
+        the matrix is discarded and re-uploads from the host mirror (which
+        never saw the trial: gang folds only commit on success)."""
+        self.last_index = chk["li"]
+        self.last_node_index = chk["lni"]
+        if self._dev_nodes is not None:
+            GANG_REWIND_FOLDS.inc()
+        if chk["dev"] is not None and self._dev_epoch == chk["epoch"]:
+            self._dev_nodes = chk["dev"]
+            self._dev_key = chk["key"]
+        else:
+            self.discard_burst_folds()
 
     def discard_burst_folds(self) -> None:
         """Forget the device-resident node matrix: in-scan folds for burst

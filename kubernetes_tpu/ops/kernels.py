@@ -456,6 +456,20 @@ _MUTABLE = ("req_cpu", "req_mem", "req_eph", "req_scalar",
             "nz_cpu", "nz_mem", "pod_count")
 
 
+def gang_carry_checkpoint(dev_nodes):
+    """Group-boundary checkpoint of the device-resident carry (the gang
+    generalization of the per-wave rewind contract). Device arrays are
+    immutable: every in-trial fold builds NEW arrays (`state.at[...]` /
+    `{**dev, **rows}`), leaving the checkpointed rows untouched on device —
+    so a shallow dict copy pins the pre-gang matrix, and restoring it is a
+    ZERO-COPY rewind (no host re-upload, no dispatch). The copy guards
+    against in-place dict mutation only; the arrays themselves cannot be
+    written. Invalidated by any dirty-row scatter or full re-upload between
+    checkpoint and rewind (the caller tracks that with an epoch counter and
+    falls back to discarding the matrix)."""
+    return None if dev_nodes is None else dict(dev_nodes)
+
+
 def _fold_state(state, pod, sel, hit):
     """Fold one decision's resource delta into the mutable node state.
 

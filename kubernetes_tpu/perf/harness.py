@@ -206,7 +206,63 @@ BENCHMARK_MATRIX = {
     "anti-affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
     "affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
     "node-affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
+    # gang (PodGroup) cells: (nodes, gang_size) — run via run_gang_cell
+    "gang": [(1000, 8), (1000, 64), (5000, 512)],
 }
+
+
+def run_gang_cell(nodes: int = 1000, gang_size: int = 64,
+                  pods: int = 1000, existing: int = 0,
+                  use_tpu: bool = True, burst: int = 1024) -> PerfResult:
+    """Gang matrix cell: `pods // gang_size` PodGroups of spec-identical
+    members scheduled all-or-nothing through the burst path; throughput
+    counts member pods. Asserts the atomicity contract (no partially
+    bound group) before reporting — a gang-path regression fails the cell
+    rather than reporting corrupt numbers."""
+    from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+    from kubernetes_tpu.store.store import PODGROUPS
+    cfg = PerfConfig(nodes=nodes, existing_pods=existing, pods=pods,
+                     use_tpu=use_tpu, burst=burst)
+    store, sched = setup(cfg)
+    MI = 1024 ** 2
+    from kubernetes_tpu.api.types import Pod, Container
+
+    def create_gangs(tag: str, count: int, size: int) -> None:
+        for g in range(count):
+            name = f"{tag}-{size}-{g}"
+            store.create(PODGROUPS, PodGroup(name=name, min_member=size))
+            for r in range(size):
+                store.create(PODS, Pod(
+                    name=f"{name}-r{r}",
+                    labels={LABEL_POD_GROUP: name, "app": "gang"},
+                    containers=(Container.make(
+                        name="c",
+                        requests={"cpu": 100, "memory": 500 * MI}),)))
+
+    create_gangs("warmup", 1, gang_size)   # compile outside the window
+    sched.pump()
+    _drain(sched, cfg)
+    sched.pump()
+    n_groups = max(1, pods // gang_size)
+    create_gangs("measured", n_groups, gang_size)
+    sched.pump()
+    before = sched.metrics.schedule_attempts["scheduled"]
+    t0 = time.perf_counter()
+    _drain(sched, cfg)
+    elapsed = time.perf_counter() - t0
+    sched.pump()
+    by_group: dict[str, list] = {}
+    for p in store.list(PODS)[0]:
+        g = p.labels.get(LABEL_POD_GROUP)
+        if g:
+            by_group.setdefault(g, []).append(bool(p.node_name))
+    partial = [g for g, flags in by_group.items()
+               if any(flags) and not all(flags)]
+    assert not partial, f"partially bound gangs: {partial[:5]}"
+    scheduled = sched.metrics.schedule_attempts["scheduled"] - before
+    throughput = scheduled / elapsed if elapsed > 0 else 0.0
+    return PerfResult(scheduled, elapsed, throughput, throughput,
+                      dict(sched.metrics.schedule_attempts))
 
 
 def run_benchmark_cell(workload: str, nodes: int, existing: int,

@@ -19,12 +19,19 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from kubernetes_tpu.api.types import Pod, has_pod_affinity_terms
+from kubernetes_tpu.coscheduling.types import pod_group_key
 from kubernetes_tpu.utils.clock import Clock, RealClock
 from kubernetes_tpu.utils.heap import KeyedHeap, NumericKeyedHeap
 
 INITIAL_BACKOFF = 1.0          # seconds (scheduling_queue.go:184)
 MAX_BACKOFF = 10.0
 UNSCHEDULABLE_TIMEOUT = 60.0   # seconds (scheduling_queue.go:52)
+
+# gang members share their group's (priority, timestamp, seq) sort anchor
+# so they pop ADJACENTLY; the member's own enqueue order survives as a
+# fraction below the inter-pod seq resolution (seqs are integers >= 1
+# apart, so members can never interleave with a neighboring group)
+_GROUP_MEMBER_STEP = 2.0 ** -20
 
 
 @dataclass
@@ -111,11 +118,18 @@ class PriorityQueue:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._seq = itertools.count()
+        # gang sort anchors: group key -> (priority, timestamp, seq) of the
+        # first member seen, so later members sort adjacent to it
+        # (coscheduling: gangs form contiguous segments in pop order)
+        self._group_anchor: dict[str, tuple[int, float, int]] = {}
+        # per-GROUP exponential backoff — a failed gang parks as a unit so
+        # queued singletons behind it are not starved by hot re-attempts
+        self._gang_backoff = PodBackoffMap(initial_backoff, max_backoff)
         # both orderings are numeric triples -> native heap core when built
         # (utils/heap.NumericKeyedHeap; Python twin otherwise)
         self._active = NumericKeyedHeap(
             key_fn=lambda q: q.pod.key,
-            triple_fn=lambda q: (-q.pod.priority, q.timestamp, q.seq))
+            triple_fn=self._active_triple)
         self._backoffq = NumericKeyedHeap(
             key_fn=lambda q: q.pod.key,
             triple_fn=lambda q: (q.expiry, q.seq, 0.0))
@@ -127,6 +141,24 @@ class PriorityQueue:
         self._move_request_cycle = -1
         self._closed = False
         self._last_backoff_sweep = self.clock.now()
+
+    def _active_triple(self, q: _QueuedPod) -> tuple:
+        """activeQ ordering (priority desc, timestamp asc, seq asc) with
+        gang adjacency: a pod group's members all ride the anchor of the
+        group's FIRST-seen member — group priority/creation, per the gang
+        ordering contract — so a drained burst sees each gang as one
+        contiguous run. Member order inside the group stays enqueue order
+        (the sub-integer seq fraction)."""
+        gk = pod_group_key(q.pod)
+        if gk is None:
+            return (-q.pod.priority, q.timestamp, q.seq)
+        anchor = self._group_anchor.get(gk)
+        if anchor is None:
+            anchor = self._group_anchor[gk] = (q.pod.priority, q.timestamp,
+                                               q.seq)
+        prio, ts, seq0 = anchor
+        frac = min((q.seq - seq0) * _GROUP_MEMBER_STEP, 0.999999)
+        return (-prio, ts, seq0 + frac)
 
     # -- basic ops ----------------------------------------------------------
     def add(self, pod: Pod) -> None:
@@ -204,6 +236,58 @@ class PriorityQueue:
                 self._scheduling_cycle += 1
                 out.append((q.pod, self._scheduling_cycle))
             return out
+
+    # -- gang (coscheduling) ops --------------------------------------------
+    def pop_group(self, group_key: str,
+                  limit: int = 1 << 16) -> list[tuple[Pod, int]]:
+        """Drain every ACTIVE member of `group_key` (up to `limit`), in the
+        order the activeQ would have popped them — the shell uses this to
+        complete a gang whose tail the burst drain limit cut off, so gangs
+        are always attempted whole. Non-blocking; backoff/unschedulable
+        members stay put (they rejoin at their own expiry)."""
+        with self._cond:
+            self._flush_locked()
+            members = [q for q in self._active.list()
+                       if pod_group_key(q.pod) == group_key]
+            members.sort(key=self._active_triple)
+            out: list[tuple[Pod, int]] = []
+            for q in members[:limit]:
+                self._active.delete(q.pod.key)
+                self._scheduling_cycle += 1
+                out.append((q.pod, self._scheduling_cycle))
+            return out
+
+    def park_group(self, group_key: str, pods: list[Pod]) -> float:
+        """A gang attempt failed (or the group is still incomplete): park
+        every given member in the backoffQ under ONE per-group exponential
+        backoff window, so the members leave the activeQ together, re-enter
+        together when the window expires, and queued singletons behind the
+        gang are not starved by hot re-attempts. Returns the window's
+        expiry time."""
+        with self._cond:
+            now = self.clock.now()
+            self._gang_backoff.backoff_pod(group_key, now)
+            expiry = self._gang_backoff.backoff_expiry(group_key)
+            for pod in pods:
+                self._active.delete(pod.key)
+                self._unschedulable.pop(pod.key, None)
+                self._backoffq.delete(pod.key)
+                self._backoffq.add(_QueuedPod(pod, now, next(self._seq),
+                                              expiry=expiry))
+                self.nominated.add(pod)
+            return expiry
+
+    def clear_group(self, group_key: str) -> None:
+        """Forget a group's backoff + sort anchor (its gang committed, or
+        the group object was deleted)."""
+        with self._cond:
+            self._gang_backoff.clear(group_key)
+            self._group_anchor.pop(group_key, None)
+
+    def group_backoff_remaining(self, group_key: str) -> float:
+        with self._lock:
+            return max(0.0, self._gang_backoff.backoff_expiry(group_key)
+                       - self.clock.now())
 
     @staticmethod
     def _is_pod_updated(old: Optional[Pod], new: Pod) -> bool:

@@ -15,18 +15,24 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from kubernetes_tpu import obs
 from kubernetes_tpu.api.types import (
     Pod, Node, PodCondition, POD_SCHEDULED, CONDITION_FALSE,
     REASON_UNSCHEDULABLE, REASON_SCHEDULER_ERROR,
 )
+from kubernetes_tpu.coscheduling.types import (
+    PHASE_PRESCHEDULING, pod_group_key,
+)
 from kubernetes_tpu.store.record import EventRecorder, NORMAL, WARNING
 from kubernetes_tpu.cache.cache import SchedulerCache, Snapshot
+from kubernetes_tpu.oracle.gang import GangTrial
 from kubernetes_tpu.oracle.generic_scheduler import (
     GenericScheduler, FitError, ScheduleResult, default_priority_configs,
 )
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
 from kubernetes_tpu.store.store import (
-    Store, PODS, NODES, SERVICES, REPLICASETS, PDBS, PVS, PVCS, NotFoundError,
+    Store, PODS, NODES, PODGROUPS, SERVICES, REPLICASETS, PDBS, PVS, PVCS,
+    NotFoundError,
 )
 from kubernetes_tpu.oracle.volumes import VolumeListers, VolumeBinder
 from kubernetes_tpu.store.informer import InformerFactory
@@ -37,6 +43,22 @@ from kubernetes_tpu.utils.clock import Clock, RealClock
 from kubernetes_tpu.utils.tracing import Trace, SLOW_CYCLE_THRESHOLD
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+# gang (PodGroup) scheduling observability — the obs catalogue additions:
+# attempts by outcome, and how long a gang waited from group creation (or
+# first sighting) to its committed placement
+GANG_ATTEMPTS = obs.counter(
+    "gang_attempts_total",
+    "Atomic PodGroup placement attempts, by outcome: scheduled (whole "
+    "gang committed), rejected (a member found no node — everything "
+    "rewound, group parked), incomplete (fewer than minMember members "
+    "queued), degraded (plugins/volumes force the per-pod path), "
+    "error (members vanished between trial and commit).", ("outcome",))
+GANG_WAIT = obs.histogram(
+    "gang_wait_duration_seconds",
+    "Seconds from PodGroup creation (or first scheduler sighting) to the "
+    "gang's committed placement.",
+    buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600))
 
 
 class Histogram:
@@ -165,6 +187,11 @@ class Scheduler:
             pvcs_fn=self.informers.informer(PVCS).list,
             pvs_fn=self.informers.informer(PVS).list)
         self.volume_binder = VolumeBinder(self.volume_listers, store=store)
+        # gang scheduling: the PodGroup informer (registered here so
+        # sync()/pump() carry it) + first-sighting times for the
+        # wait-duration histogram when a group has no creation timestamp
+        self._podgroups = self.informers.informer(PODGROUPS)
+        self._gang_first_seen: dict[str, float] = {}
         self._predicate_names = predicate_names
         self._priority_weights = priority_weights
         self.extenders = extenders or []
@@ -358,6 +385,15 @@ class Scheduler:
             # reference: scheduler.go:447 skip-deleting-pod event
             self.recorder.pod_event(pod, WARNING, "FailedScheduling",
                                     f"skip schedule deleting pod: {pod.key}")
+            return True
+        gk = pod_group_key(pod)
+        if gk is not None:
+            # a gang member must never schedule alone: gather the rest of
+            # its group from the activeQ and run the atomic gang segment
+            # (the serial loop and the burst loop share one gang path)
+            members = [(pod, self.queue.scheduling_cycle)]
+            members += self.queue.pop_group(gk)
+            self._gang_segment(gk, members, bucket=len(members))
             return True
         self._process_one(pod, self.queue.scheduling_cycle)
         return True
@@ -659,11 +695,29 @@ class Scheduler:
     def schedule_burst(self, max_pods: int = 1024) -> int:
         """Drain up to max_pods from the queue and schedule them with device
         bursts where safe, serially otherwise — decisions identical to the
-        serial loop. Returns pods bound, derived from the commit paths'
-        actual bound counts (not a schedule_attempts metric delta, which a
-        concurrent metric observer — or reset() — could skew)."""
-        pods = []
-        cycles = []
+        serial loop. PodGroup members collapse into atomic gang segments
+        (all-or-nothing placement; see _gang_segment). Returns pods bound,
+        derived from the commit paths' actual bound counts (not a
+        schedule_attempts metric delta, which a concurrent metric observer
+        — or reset() — could skew)."""
+        total = 0
+        for _pass in range(64):
+            bound, drained = self._schedule_burst_pass(max_pods)
+            total += bound
+            if bound > 0 or drained == 0:
+                return total
+            # the pass drained pods but bound none — e.g. a rejected gang
+            # consumed the whole drain window and parked: every drained pod
+            # left the activeQ (parked/backed off), so ready singletons
+            # behind the gang drain on the next pass instead of waiting for
+            # the caller's next call. The activeQ strictly shrinks across
+            # zero-bound passes (a real-clock backoff expiring mid-call can
+            # re-admit a gang, hence the pass cap rather than `while True`).
+        return total
+
+    def _schedule_burst_pass(self, max_pods: int) -> tuple[int, int]:
+        """One drain+schedule pass; returns (pods bound, pods drained)."""
+        drained = []
         for pod, cycle in self.queue.pop_burst(max_pods):
             if pod.deleted:
                 # same audit record as the serial path (scheduler.go:447)
@@ -671,10 +725,48 @@ class Scheduler:
                     pod, WARNING, "FailedScheduling",
                     f"skip schedule deleting pod: {pod.key}")
                 continue
-            pods.append(pod)
-            cycles.append(cycle)
-        if not pods:
-            return 0
+            drained.append((pod, cycle))
+        if not drained:
+            return 0, 0
+        # gang gathering: a group's members collapse into ONE atomic item at
+        # the position of the group's first member (the queue's group-anchor
+        # ordering makes them adjacent; collapsing is robust to interleaving
+        # regardless), and members the drain limit cut off are pulled from
+        # the activeQ so gangs are always attempted whole
+        items: list = []
+        gang_at: dict[str, int] = {}
+        for pod, cycle in drained:
+            gk = pod_group_key(pod)
+            if gk is None:
+                items.append((pod, cycle))
+                continue
+            idx = gang_at.get(gk)
+            if idx is None:
+                gang_at[gk] = len(items)
+                items.append([gk, [(pod, cycle)]])
+            else:
+                items[idx][1].append((pod, cycle))
+        for gk, idx in gang_at.items():
+            items[idx][1].extend(self.queue.pop_group(gk))
+        bound = 0
+        run: list = []
+        for it in items:
+            if isinstance(it, list):
+                if run:
+                    bound += self._schedule_singletons_burst(run, max_pods)
+                    run = []
+                bound += self._gang_segment(it[0], it[1], bucket=max_pods)
+            else:
+                run.append(it)
+        if run:
+            bound += self._schedule_singletons_burst(run, max_pods)
+        return bound, len(drained)
+
+    def _schedule_singletons_burst(self, pairs: list, bucket: int) -> int:
+        """Schedule a run of non-gang pods: device burst segments where
+        safe, serial cycles otherwise (the pre-gang schedule_burst body)."""
+        pods = [p for p, _ in pairs]
+        cycles = [c for _, c in pairs]
         # the burst fold skips the per-pod Reserve/Permit/Prebind points, so
         # any configured plugin forces the serial path (decisions and plugin
         # side effects must not differ by path)
@@ -702,9 +794,180 @@ class Scheduler:
                     and self._burst_class(pods[j], services,
                                           replicasets) == seg_class:
                 j += 1
-            bound += self._burst_segment(pods[i:j], cycles[i:j], max_pods)
+            bound += self._burst_segment(pods[i:j], cycles[i:j], bucket)
             i = j
         return bound
+
+    # -- gang scheduling (coscheduling.PodGroup) ------------------------------
+    def _gang_segment(self, group_key: str, members: list,
+                      bucket: int) -> int:
+        """All-or-nothing placement of one PodGroup's gathered members.
+
+        The gang is trial-placed as ONE atomic burst segment through the
+        existing wave machinery (schedule_burst with NO per-wave commit
+        callback, so nothing reaches the cache or store mid-trial); the
+        commit happens only when EVERY member found a node and the group's
+        minMember is covered. Otherwise the in-flight device folds are
+        discarded and li/lni + the NodeTree rotation cursor rewind to the
+        pre-gang checkpoint (TPUScheduler.gang_rewind — PR 3's wave rewind
+        contract generalized to per-group), no partial bind is ever
+        observable, and the group parks in the queue's gang backoff map so
+        queued singletons behind it are not starved. When the kernels
+        refuse the gang's feature mix, the serial referee trial
+        (oracle.gang.GangTrial) runs the SAME semantics pod by pod —
+        decisions are bit-identical either way, which the gang parity fuzz
+        pins. Returns pods bound."""
+        pods = [p for p, _ in members]
+        cycles = [c for _, c in members]
+        try:
+            group = self.store.get(PODGROUPS, group_key)
+        except NotFoundError:
+            group = None
+        if group is None:
+            # membership label without a PodGroup object: there is no gang
+            # contract to enforce — members schedule as ordinary singletons
+            # (create the PodGroup BEFORE its pods to get atomicity)
+            self.queue.clear_group(group_key)
+            return self._schedule_singletons_burst(members, bucket)
+        now = self.clock.now()
+        self._gang_first_seen.setdefault(group_key, now)
+        if self.framework.reserve or self.framework.permit \
+                or self.framework.prebind or any(p.volumes for p in pods):
+            # per-pod extension points and volume reservations cannot be
+            # rewound atomically: degrade to the per-pod path (documented
+            # limitation — gangs compose with neither plugins nor volumes)
+            GANG_ATTEMPTS.labels("degraded").inc()
+            return self._schedule_singletons_burst(members, bucket)
+        min_member = max(group.min_member, 1)
+        from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP
+        already_bound = sum(
+            1 for p in self.informers.informer(PODS).list()
+            if p.node_name and p.namespace == group.namespace
+            and p.labels.get(LABEL_POD_GROUP) == group.name)
+        if len(pods) + already_bound < min_member:
+            # incomplete: not enough members exist/queued yet — park what is
+            # here (phase PreScheduling; the PodGroup controller times the
+            # group out to Unschedulable if it never fills)
+            GANG_ATTEMPTS.labels("incomplete").inc()
+            self._set_group_phase(group_key, PHASE_PRESCHEDULING, now)
+            self._park_gang(group, pods,
+                            f"waiting for minMember={min_member}: "
+                            f"{already_bound} bound + {len(pods)} queued")
+            return 0
+        self._set_group_phase(group_key, PHASE_PRESCHEDULING, now)
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        tree = self.cache.node_tree
+        hosts = None
+        committed = 0
+        can_trial_burst = (hasattr(self.algorithm, "schedule_burst")
+                           and not self.queue.nominated.has_any()
+                           and all(self._pod_is_burstable(p) for p in pods))
+        if can_trial_burst:
+            has_gchk = hasattr(self.algorithm, "gang_checkpoint")
+            chk = self.algorithm.gang_checkpoint() if has_gchk else (
+                getattr(self.algorithm, "last_index", 0),
+                getattr(self.algorithm, "last_node_index", 0))
+            tree_chk = tree.checkpoint()
+            names = tree.list_names()
+            self._last_names = names
+            hosts = self.algorithm.schedule_burst(
+                pods, self._snapshot.node_infos, names, bucket=bucket)
+            if hosts is not None and all(h is not None for h in hosts):
+                committed = self._commit_burst(pods, hosts, cycles)
+                tree.advance_enumerations(len(pods) - 1)
+            elif hosts is not None:
+                # a member found no node: the gang is REJECTED — discard the
+                # in-flight folds and rewind every carry to the pre-gang
+                # checkpoint; nothing was committed
+                if has_gchk:
+                    self.algorithm.gang_rewind(chk)
+                else:
+                    # generic burst algorithm without the device checkpoint:
+                    # rewind the walk counters and drop any resident folds
+                    self.algorithm.last_index = chk[0]
+                    self.algorithm.last_node_index = chk[1]
+                    discard = getattr(self.algorithm,
+                                      "discard_burst_folds", None)
+                    if discard is not None:
+                        discard()
+                tree.restore(tree_chk)
+                self._reject_gang(group, pods, hosts)
+                return 0
+            else:
+                # kernels refused this gang's feature mix: undo the consumed
+                # enumeration and run the serial referee trial instead
+                tree.restore(tree_chk)
+        if hosts is None:
+            trial = GangTrial(self.cache, self.algorithm)
+
+            def refresh():
+                self._snapshot = self.cache.update_snapshot(self._snapshot)
+            hosts = trial.run(pods, self._schedule, refresh)
+            if hosts is None:
+                self._reject_gang(group, pods, None)
+                return 0
+            committed = self._commit_burst(pods, hosts, cycles,
+                                           assume=False)
+        if committed < len(pods):
+            # members vanished between trial and commit (deleted from the
+            # store): the survivors are bound, the rest were forgotten and
+            # re-queued by the commit path; the controller re-evaluates the
+            # group against its live members
+            GANG_ATTEMPTS.labels("error").inc()
+        else:
+            GANG_ATTEMPTS.labels("scheduled").inc()
+        created = group.creation_timestamp \
+            or self._gang_first_seen.get(group_key, now)
+        GANG_WAIT.observe(max(0.0, self.clock.now() - created))
+        self._gang_first_seen.pop(group_key, None)
+        self.queue.clear_group(group_key)
+        return committed
+
+    def _set_group_phase(self, group_key: str, phase: str,
+                         now: float) -> None:
+        fn = getattr(self.store, "update_pod_group_status", None)
+        if fn is None:
+            return
+        try:
+            fn(group_key, phase=phase, now=now)
+        except NotFoundError:
+            pass
+
+    def _reject_gang(self, group, pods: list, hosts) -> None:
+        """Book a rejected gang attempt: every member is unschedulable (the
+        trial rewound, so none is bound) and the group parks as a unit."""
+        placed = sum(1 for h in (hosts or []) if h is not None)
+        GANG_ATTEMPTS.labels("rejected").inc()
+        self.metrics.observe("unschedulable", count=len(pods))
+        self._park_gang(
+            group, pods,
+            f"gang rejected: {placed}/{len(pods)} members found nodes "
+            f"(minMember={group.min_member}); trial rewound")
+
+    def _park_gang(self, group, pods: list, message: str) -> None:
+        """Park a gang's still-pending members under the group backoff
+        window, with the same failure observability the serial path gives
+        one pod (FailedScheduling event + PodScheduled=False condition)."""
+        alive = []
+        for pod in pods:
+            try:
+                current = self.store.get(PODS, pod.key)
+            except NotFoundError:
+                self.queue.delete(pod)
+                continue
+            if current.node_name:
+                continue
+            alive.append(current)
+        self.queue.park_group(group.key, alive)
+        msg = f"pod group {group.key}: {message}"
+        for p in alive:
+            self.recorder.pod_event(p, WARNING, "FailedScheduling", msg)
+            try:
+                self.store.update_pod_condition(p.key, PodCondition(
+                    type=POD_SCHEDULED, status=CONDITION_FALSE,
+                    reason=REASON_UNSCHEDULABLE, message=msg))
+            except NotFoundError:
+                pass
 
     def _burst_segment(self, pods: list[Pod], cycles: list[int],
                        bucket: int) -> int:
@@ -794,7 +1057,7 @@ class Scheduler:
         return bound
 
     def _commit_burst(self, pods: list[Pod], hosts: list[str],
-                      cycles: list[int]) -> int:
+                      cycles: list[int], assume: bool = True) -> int:
         """Commit a burst's decided prefix (or one pipelined wave of it):
         ONE batched cache assume + vectorized device-mirror sync, then ONE
         batched store write for all bindings, one batched finish, one
@@ -809,7 +1072,13 @@ class Scheduler:
         Invariant: bursts only form when NO reserve/permit/prebind plugins
         are configured (schedule_burst's can_burst gate routes plugin-ful
         workloads to the serial _process_one/_bind path), so skipping the
-        framework points here cannot skip real plugin work."""
+        framework points here cannot skip real plugin work.
+
+        `assume=False` is the serial-gang-trial commit: the members were
+        already assumed one by one (oracle.gang.GangTrial), and nothing was
+        folded on device, so both the batched cache assume AND the device-
+        mirror sync are skipped — the cache generation bumps from the trial
+        re-encode the touched rows on the next cycle instead."""
         if not pods:
             return 0
         assert not (self.framework.reserve or self.framework.permit
@@ -819,7 +1088,11 @@ class Scheduler:
         if eb is not None and any(eb.is_interested(p) for p in pods):
             n_bound = 0
             for pod, host, cycle in zip(pods, hosts, cycles):
-                assumed = self._assume_for_burst(pod, host)
+                if assume:
+                    assumed = self._assume_for_burst(pod, host)
+                else:
+                    assumed = pod.clone()
+                    assumed.node_name = host
                 if self._bind(assumed, host, pod, cycle):
                     n_bound += 1
             return n_bound
@@ -829,15 +1102,17 @@ class Scheduler:
             assumed = pod.clone()
             assumed.node_name = host
             assumed_list.append(assumed)
-        self.cache.assume_pods(assumed_list)    # one lock for the wave
-        note_many = getattr(self.algorithm, "note_burst_assumed_many", None)
+        if assume:
+            self.cache.assume_pods(assumed_list)    # one lock for the wave
+        note_many = getattr(self.algorithm, "note_burst_assumed_many", None) \
+            if assume else None
         if note_many is not None:
             # the device scan already folded these deltas: sync the host
             # mirror + generation map in one vectorized pass (generations
             # read once, after every assume of the wave landed)
             note_many(assumed_list, hosts,
                       self.cache.node_generations(hosts))
-        else:
+        elif assume:
             note = getattr(self.algorithm, "note_burst_assumed", None)
             if note is not None:
                 for assumed, host in zip(assumed_list, hosts):

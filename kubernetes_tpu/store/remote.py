@@ -329,3 +329,19 @@ class RemoteStore:
         return self.guaranteed_update(PODS, pod_key,
                                       pod_condition_mutator(condition),
                                       allow_skip=True)
+
+    def update_pod_group_status(self, group_key: str,
+                                phase: Optional[str] = None,
+                                members: Optional[int] = None,
+                                scheduled: Optional[int] = None,
+                                now: Optional[float] = None) -> Any:
+        """PodGroup /status subresource over the wire (the server applies
+        the SAME pod_group_status_mutator the embedded store uses, so both
+        transports produce identical writes). 404 maps to NotFoundError
+        exactly like the embedded verb raising on a missing group."""
+        from kubernetes_tpu.store.store import PODGROUPS
+        d = self._request(
+            "PUT", f"/api/v1/{PODGROUPS}/{group_key}/status",
+            {"phase": phase, "members": members, "scheduled": scheduled,
+             "last_transition_time": now})
+        return serde.from_dict(PODGROUPS, d)

@@ -55,6 +55,7 @@ CRONJOBS = "cronjobs"  # batch schedules (controllers.cronjob)
 CONFIGMAPS = "configmaps"
 SECRETS = "secrets"
 SERVICEACCOUNTS = "serviceaccounts"
+PODGROUPS = "podgroups"  # co-scheduling gangs (coscheduling.types.PodGroup)
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
 
@@ -370,6 +371,22 @@ class Store:
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,
                                       nominated_node_mutator(node_name))
+
+    def update_pod_group_status(self, group_key: str,
+                                phase: Optional[str] = None,
+                                members: Optional[int] = None,
+                                scheduled: Optional[int] = None,
+                                now: Optional[float] = None) -> Any:
+        """PodGroup /status subresource analog: phase + member counts only
+        (spec fields untouched); no-op writes are skipped. The mutate
+        closure is shared with RemoteStore so both transports write
+        identical objects (the CLAUDE.md sync rule)."""
+        from kubernetes_tpu.coscheduling.types import pod_group_status_mutator
+        return self.guaranteed_update(
+            PODGROUPS, group_key,
+            pod_group_status_mutator(phase=phase, members=members,
+                                     scheduled=scheduled, now=now),
+            allow_skip=True)
 
     def update_pod_condition(self, pod_key: str, condition) -> Any:
         """UpdateStatus analog for one condition (reference: factory.go:715

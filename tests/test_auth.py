@@ -138,6 +138,20 @@ NODE_CASES = [
     (KUBELET1, "update", "pods", "default/p", True),  # body checked by
     (IMPOSTOR, "update", "nodes", "n1", False),       # NodeRestriction
     (KUBELET1, "create", "pods", "", False),   # binding = scheduler verb
+    # secret-bearing kinds: the graph-based reference scopes these to
+    # objects referenced by pods bound to the node; without the graph the
+    # collapse is an outright deny — a kubelet credential must not read
+    # cluster secrets wholesale (ADVICE r5)
+    (KUBELET1, "get", "secrets", "default/s1", False),
+    (KUBELET1, "list", "secrets", "", False),
+    (KUBELET1, "watch", "secrets", "", False),
+    (KUBELET1, "get", "configmaps", "default/cm", False),
+    (KUBELET1, "list", "configmaps", "", False),
+    (KUBELET1, "watch", "serviceaccounts", "", False),
+    (KUBELET1, "create", "secrets", "", False),
+    (KUBELET1, "update", "configmaps", "default/cm", False),
+    # the pod-group kind is ordinary cluster state: reads stay allowed
+    (KUBELET1, "get", "podgroups", "default/g", True),
 ]
 
 
@@ -147,6 +161,45 @@ class TestNodeAuthorizer:
         got = NodeAuthorizer().authorize(Attributes(user, verb, resource,
                                                     name))
         assert got is want, (user.name, verb, resource, name)
+
+    def test_secret_deny_survives_union_stack(self):
+        """The deny must hold through the server's real authorizer shape
+        (RBAC ∪ node): the scheduler/controller roles keep their access,
+        the kubelet identity stays denied."""
+        roles, bindings = default_roles()
+        stack = union(RBACAuthorizer(roles=roles, bindings=bindings),
+                      NodeAuthorizer())
+        for verb in ("get", "list", "watch"):
+            assert not stack.authorize(
+                Attributes(KUBELET1, verb, "secrets", ""))
+            assert not stack.authorize(
+                Attributes(KUBELET1, verb, "configmaps", ""))
+        sched = UserInfo("system:kube-scheduler")
+        assert stack.authorize(Attributes(sched, "list", "secrets", ""))
+
+    def test_served_kubelet_cannot_read_secrets(self):
+        """End to end over HTTP: a kubelet token listing secrets /
+        configmaps / serviceaccounts gets 403; its ordinary informer
+        reads (pods, nodes) still work."""
+        from kubernetes_tpu.api.types import Secret
+        from kubernetes_tpu.store.store import (CONFIGMAPS, SECRETS,
+                                                SERVICEACCOUNTS)
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        store.create(SECRETS, Secret(name="s1", data={"k": "dmFs"}))
+        authn = TokenAuthenticator({
+            "kubelet-n1": UserInfo("system:node:n1", ("system:nodes",))})
+        with APIServer(store, authenticator=authn,
+                       authorizer=NodeAuthorizer()) as srv:
+            kubelet = RemoteStore(srv.url, token="kubelet-n1")
+            assert [n.name for n in kubelet.list(NODES)[0]] == ["n1"]
+            for kind in (SECRETS, CONFIGMAPS, SERVICEACCOUNTS):
+                with pytest.raises(APIStatusError) as ei:
+                    kubelet.list(kind)
+                assert ei.value.code == 403, kind
+            with pytest.raises(APIStatusError) as ei:
+                kubelet.get(SECRETS, "default/s1")
+            assert ei.value.code == 403
 
 
 class TestServedAuth:

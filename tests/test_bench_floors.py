@@ -29,6 +29,11 @@ LANE_FLOORS = {
     "affinity": (1.0, 5000.0),
     "anti_affinity": (0.15, 2000.0),
     "node_affinity": (0.5, 6000.0),
+    # gang (PodGroup) lane: groups of 64 spec-identical members placed
+    # all-or-nothing through the burst trial + commit path; the per-group
+    # gather/commit overhead must stay a bounded tax on the plain lane
+    # (measured ~0.5-0.8x plain on CPU at the 1000n/1000p cell)
+    "gang": (0.25, 2000.0),
 }
 
 
@@ -59,3 +64,24 @@ def test_matrix_ratio_to_plain_floors():
     # the preemption lane must have run and beaten the serial oracle
     assert out.get("preempt_scans_per_s"), out
     assert out.get("preempt_vs_oracle") and out["preempt_vs_oracle"] > 1.0
+
+
+@pytest.mark.slow
+def test_gang_mode_floor():
+    """`bench.py --mode gang` (the gang lane's standalone entry): one JSON
+    line, the atomicity audit passed (all_or_nothing — the bench itself
+    asserts no partially bound group), and throughput above a
+    cliff-catching floor at a small cell."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "gang",
+         "--nodes", "500", "--pods", "1500"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["all_or_nothing"] is True
+    assert set(out["gangs"]) == {"8", "64", "512"}
+    assert out["pods_bound"] > 0
+    # cliff floor, not a variance tripwire (plain runs 10k+ pods/s here)
+    assert out["value"] >= 1000.0, out

@@ -1,0 +1,613 @@
+"""Gang scheduling (coscheduling.PodGroup) — the all-or-nothing placement
+subsystem end to end: API object + serde, apiserver verbs + /status
+subresource, queue group ordering + gang backoff, the shell's atomic gang
+segment (device burst trial AND the serial referee trial), the
+checkpoint/rewind contract, the PodGroup controller, and the
+TestGangBurstParity long-range fuzz (burst gang decisions bit-identical to
+the serial oracle path; no partial gang ever observable — including under
+injected crashes between trial and commit)."""
+import random
+
+import pytest
+
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.coscheduling.types import (
+    LABEL_POD_GROUP, PHASE_PENDING, PHASE_PRESCHEDULING, PHASE_SCHEDULED,
+    PHASE_UNSCHEDULABLE, PodGroup, pod_group_key,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import (
+    Store, EVENTS, NODES, PODGROUPS, PODS, NotFoundError,
+)
+from kubernetes_tpu.utils.clock import FakeClock
+
+GI = 1024 ** 3
+LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+def mknode(name, cpu=4000, zone=None, pods=110):
+    labels = {LABEL_HOSTNAME: name}
+    if zone is not None:
+        labels[LABEL_ZONE] = zone
+    return Node(name=name, labels=labels,
+                allocatable={"cpu": cpu, "memory": 32 * GI, "pods": pods})
+
+
+def member(name, group, cpu=100, **kw):
+    labels = dict(kw.pop("labels", {}))
+    labels[LABEL_POD_GROUP] = group
+    containers = kw.pop("containers", (
+        Container.make(name="c", requests={"cpu": cpu}),))
+    return Pod(name=name, labels=labels, containers=containers, **kw)
+
+
+def singleton(name, cpu=100, **kw):
+    return Pod(name=name,
+               containers=(Container.make(name="c", requests={"cpu": cpu}),),
+               **kw)
+
+
+def drain_burst(sched, max_pods=16):
+    while sched.schedule_burst(max_pods=max_pods):
+        pass
+
+
+def assert_no_partial_gang(store, min_members=None):
+    """The atomicity invariant: among a group's LIVE member pods, either
+    none is bound or none is pending (all-or-nothing at bind time; deleted
+    members — preemption victims — don't count against it)."""
+    by_group = {}
+    for p in store.list(PODS)[0]:
+        g = p.labels.get(LABEL_POD_GROUP)
+        if g:
+            by_group.setdefault(g, []).append(bool(p.node_name))
+    for g, flags in by_group.items():
+        assert all(flags) or not any(flags), \
+            f"partially bound gang {g}: {sum(flags)}/{len(flags)}"
+
+
+class TestPodGroupAPI:
+    def test_serde_round_trip(self):
+        from kubernetes_tpu.api import serde
+        g = PodGroup(name="g", namespace="ns", min_member=4,
+                     schedule_timeout_seconds=30.0,
+                     phase=PHASE_PRESCHEDULING, members=3, scheduled=1)
+        back = serde.from_dict(PODGROUPS, serde.to_dict(g))
+        assert back == g
+        # namespaced kind: keys as namespace/name
+        assert back.key == "ns/g"
+        assert PODGROUPS not in serde.CLUSTER_SCOPED_KINDS
+
+    def test_store_status_verb_skips_noop_writes(self):
+        store = Store()
+        store.create(PODGROUPS, PodGroup(name="g", min_member=2))
+        rv0 = store.get(PODGROUPS, "default/g").resource_version
+        store.update_pod_group_status("default/g", phase=PHASE_PENDING)
+        assert store.get(PODGROUPS, "default/g").resource_version == rv0
+        updated = store.update_pod_group_status(
+            "default/g", phase=PHASE_PRESCHEDULING, members=2, now=12.5)
+        assert updated.phase == PHASE_PRESCHEDULING
+        assert updated.members == 2
+        assert updated.last_transition_time == 12.5
+        assert updated.resource_version > rv0
+        # spec untouched by the status subresource
+        assert updated.min_member == 2
+        with pytest.raises(NotFoundError):
+            store.update_pod_group_status("default/missing",
+                                          phase=PHASE_SCHEDULED)
+
+
+class TestGangQueueOrdering:
+    def _q(self):
+        from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+        return PriorityQueue(clock=FakeClock(100.0))
+
+    def test_members_pop_adjacently(self):
+        q = self._q()
+        # interleave two gangs and singletons; members must pop as
+        # contiguous runs anchored at each group's first member
+        q.add(member("a0", "ga"))
+        q.add(singleton("s0"))
+        q.add(member("b0", "gb"))
+        q.add(member("a1", "ga"))
+        q.add(singleton("s1"))
+        q.add(member("b1", "gb"))
+        q.add(member("a2", "ga"))
+        order = [p.name for p, _c in q.pop_burst(16)]
+        assert order == ["a0", "a1", "a2", "s0", "b0", "b1", "s1"]
+
+    def test_group_priority_anchors_at_first_member(self):
+        q = self._q()
+        q.add(member("a0", "ga", priority=5))
+        q.add(singleton("mid", priority=3))
+        q.add(member("a1", "ga", priority=5))
+        order = [p.name for p, _c in q.pop_burst(16)]
+        assert order == ["a0", "a1", "mid"]
+
+    def test_pop_group_drains_only_that_group(self):
+        q = self._q()
+        for j in range(3):
+            q.add(member(f"m{j}", "g"))
+        q.add(singleton("s"))
+        got = [p.name for p, _c in q.pop_group("default/g")]
+        assert got == ["m0", "m1", "m2"]
+        assert q.num_pending() == 1
+        assert q.pop(timeout=0.0).name == "s"
+
+    def test_park_group_leaves_activeq_and_returns_together(self):
+        q = self._q()
+        pods = [member(f"m{j}", "g") for j in range(3)]
+        for p in pods:
+            q.add(p)
+        q.add(singleton("behind"))
+        expiry = q.park_group("default/g", pods)
+        assert expiry > q.clock.now()
+        # parked members left the activeQ: the singleton is NOT starved
+        assert q.pop(timeout=0.0).name == "behind"
+        assert q.pop(timeout=0.0) is None
+        # backoff window passes -> the whole gang re-enters together
+        q.clock.step(1.1)
+        names = [p.name for p, _c in q.pop_burst(16)]
+        assert sorted(names) == ["m0", "m1", "m2"]
+
+    def test_gang_backoff_doubles_until_cleared(self):
+        q = self._q()
+        pods = [member("m0", "g")]
+        q.park_group("default/g", pods)
+        assert q.group_backoff_remaining("default/g") == pytest.approx(1.0)
+        q.clock.step(1.1)
+        q.pop_burst(16)
+        q.park_group("default/g", pods)
+        assert q.group_backoff_remaining("default/g") == pytest.approx(2.0)
+        q.clear_group("default/g")
+        assert q.group_backoff_remaining("default/g") == 0.0
+
+
+@pytest.fixture(params=["oracle", "tpu"])
+def make_sched(request):
+    def _make(store, **kw):
+        return Scheduler(store, use_tpu=(request.param == "tpu"),
+                         percentage_of_nodes_to_score=100, **kw)
+    return _make
+
+
+class TestGangShell:
+    """The shell's atomic gang segment — identical behavior on the device
+    burst trial (use_tpu) and the serial referee trial (oracle)."""
+
+    def test_feasible_gang_binds_whole(self, make_sched):
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=4))
+        sched = make_sched(store)
+        sched.sync()
+        for j in range(4):
+            store.create(PODS, member(f"m{j}", "g"))
+        sched.pump()
+        assert sched.schedule_burst(max_pods=16) == 4
+        sched.pump()
+        assert all(store.get(PODS, f"default/m{j}").node_name
+                   for j in range(4))
+        assert_no_partial_gang(store)
+
+    def test_infeasible_gang_binds_nothing_and_parks(self, make_sched):
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=5))
+        sched = make_sched(store, clock=clock)
+        sched.sync()
+        # 5 members of 3 CPU over 4 nodes of 4 CPU: member 5 can never fit
+        for j in range(5):
+            store.create(PODS, member(f"m{j}", "g", cpu=3000))
+        store.create(PODS, singleton("behind"))
+        sched.pump()
+        drain_burst(sched)
+        sched.pump()
+        # all-or-nothing: NO member bound, the singleton behind is not
+        # starved, and every member re-queued under the group backoff
+        assert not any(store.get(PODS, f"default/m{j}").node_name
+                       for j in range(5))
+        assert store.get(PODS, "default/behind").node_name
+        assert sched.queue.num_pending() == 5
+        assert sched.queue.group_backoff_remaining("default/g") > 0
+        # failure observability: FailedScheduling events + conditions
+        events, _ = store.list(EVENTS)
+        gang_events = [e for e in events if "gang rejected" in e.message]
+        assert gang_events
+        conds = store.get(PODS, "default/m0").conditions
+        assert any(c.status == "False" and "gang rejected" in c.message
+                   for c in conds)
+
+    def test_serial_loop_is_also_atomic(self, make_sched):
+        """schedule_one must never bind a lone gang member: popping one
+        member gathers the whole group through the same gang segment."""
+        store = Store(watch_log_size=65536)
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=4))
+        sched = make_sched(store)
+        sched.sync()
+        for j in range(4):
+            store.create(PODS, member(f"m{j}", "g", cpu=3000))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert not any(store.get(PODS, f"default/m{j}").node_name
+                       for j in range(4))
+        # feasible group binds whole through the serial loop too
+        store.create(PODGROUPS, PodGroup(name="ok", min_member=3))
+        for j in range(3):
+            store.create(PODS, member(f"ok{j}", "ok"))
+        sched.pump()
+        while sched.schedule_one(timeout=0.0):
+            pass
+        sched.pump()
+        assert all(store.get(PODS, f"default/ok{j}").node_name
+                   for j in range(3))
+        assert_no_partial_gang(store)
+
+    def test_incomplete_group_waits_for_min_member(self, make_sched):
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=3))
+        sched = make_sched(store, clock=clock)
+        sched.sync()
+        for j in range(2):   # only 2 of 3 members exist
+            store.create(PODS, member(f"m{j}", "g"))
+        sched.pump()
+        drain_burst(sched)
+        sched.pump()
+        assert not any(store.get(PODS, f"default/m{j}").node_name
+                       for j in range(2))
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_PRESCHEDULING
+        # the third member arrives; after the gang backoff, all bind
+        store.create(PODS, member("m2", "g"))
+        sched.pump()
+        clock.step(1.1)
+        drain_burst(sched)
+        sched.pump()
+        assert all(store.get(PODS, f"default/m{j}").node_name
+                   for j in range(3))
+
+    def test_label_without_group_object_schedules_singletons(self, make_sched):
+        store = Store(watch_log_size=65536)
+        store.create(NODES, mknode("n0"))
+        sched = make_sched(store)
+        sched.sync()
+        store.create(PODS, member("m0", "ghost"))
+        sched.pump()
+        assert sched.schedule_burst(max_pods=4) == 1
+        sched.pump()
+        assert store.get(PODS, "default/m0").node_name == "n0"
+
+    def test_gang_metrics_outcomes(self, make_sched):
+        from kubernetes_tpu.scheduler import GANG_ATTEMPTS, GANG_WAIT
+        store = Store(watch_log_size=65536)
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="ok", min_member=3))
+        store.create(PODGROUPS, PodGroup(name="bad", min_member=3))
+        sched = make_sched(store)
+        sched.sync()
+        ok0 = GANG_ATTEMPTS.labels("scheduled").value
+        rej0 = GANG_ATTEMPTS.labels("rejected").value
+        wait0 = GANG_WAIT._default().count
+        for j in range(3):
+            store.create(PODS, member(f"ok{j}", "ok"))
+        for j in range(3):
+            store.create(PODS, member(f"bad{j}", "bad", cpu=4100))
+        sched.pump()
+        drain_burst(sched)
+        sched.pump()
+        assert GANG_ATTEMPTS.labels("scheduled").value == ok0 + 1
+        assert GANG_ATTEMPTS.labels("rejected").value >= rej0 + 1
+        assert GANG_WAIT._default().count == wait0 + 1
+
+
+class TestGangRewindParity:
+    """The checkpoint/rewind contract: after a rejected gang, EVERY carry
+    (last_index, lastNodeIndex, device folds, spread counts, NodeTree
+    rotation cursor) is back at the pre-gang state — so subsequent
+    singleton decisions are bit-identical to a world where the gang never
+    existed. Uneven zones force the rotation machinery; small wave sizes
+    force the trial across pipelined wave boundaries."""
+
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("use_tpu", [True, False])
+    def test_rejected_gang_leaves_no_trace(self, use_tpu, wave_size):
+        def run(with_gang):
+            store = Store(watch_log_size=65536)
+            for i in range(7):   # 3/3/1 zones: rotation active
+                store.create(NODES, mknode(f"n{i}", zone=f"z{i % 3 if i < 6 else 0}"))
+            if with_gang:
+                store.create(PODGROUPS, PodGroup(name="g", min_member=8))
+            sched = Scheduler(store, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
+            sched.sync()
+            if with_gang:
+                # members 0..6 fit in trial (one per node); member 7 cannot
+                # -> the whole 8-member gang rewinds across wave boundaries
+                for j in range(8):
+                    store.create(PODS, member(f"g{j}", "g", cpu=3000))
+            for j in range(12):
+                store.create(PODS, singleton(f"s{j}", cpu=300))
+            sched.pump()
+            drain_burst(sched, max_pods=8)
+            sched.pump()
+            bound = {p.name: p.node_name for p in store.list(PODS)[0]}
+            assert not any(v for k, v in bound.items()
+                           if k.startswith("g")), bound
+            return {k: v for k, v in bound.items() if k.startswith("s")}
+
+        assert run(True) == run(False)
+
+    def test_device_rewind_restores_pinned_matrix(self):
+        """The zero-copy rewind: when nothing re-uploaded between
+        checkpoint and rewind, gang_rewind restores the pinned pre-gang
+        matrix instead of discarding it (no fresh upload next cycle)."""
+        from kubernetes_tpu.core.tpu_scheduler import GANG_REWIND_FOLDS
+        store = Store(watch_log_size=65536)
+        for i in range(3):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=4))
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        # a successful warmup resides the matrix on device
+        store.create(PODS, singleton("warm"))
+        sched.pump()
+        drain_burst(sched)
+        alg = sched.algorithm
+        dev_before = alg._dev_nodes
+        assert dev_before is not None
+        rewinds0 = GANG_REWIND_FOLDS.value
+        for j in range(4):
+            store.create(PODS, member(f"g{j}", "g", cpu=3000))
+        sched.pump()
+        drain_burst(sched)
+        assert GANG_REWIND_FOLDS.value == rewinds0 + 1
+        # the pre-gang matrix was restored in place, not dropped
+        assert alg._dev_nodes is not None
+        assert all(alg._dev_nodes[k] is dev_before[k] for k in dev_before)
+
+
+class TestGangCrashInjection:
+    """No partially-bound gang is ever visible in the store — including
+    under injected crashes between the gang trial and its commit
+    (test_chaos.py style)."""
+
+    @pytest.mark.parametrize("use_tpu", [True, False])
+    def test_commit_write_crash_never_partial(self, use_tpu):
+        """store.bind_pods dies (transport crash) AFTER the trial decided:
+        the gang's assumes are rolled back per the commit failure path and
+        the store never shows a partial gang; the retry lands it whole."""
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=4))
+        sched = Scheduler(store, use_tpu=use_tpu, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        for j in range(4):
+            store.create(PODS, member(f"m{j}", "g"))
+        sched.pump()
+        real_bind_pods = store.bind_pods
+        calls = {"n": 0}
+
+        def crashing_bind_pods(bindings):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("store write failed mid-commit")
+            return real_bind_pods(bindings)
+
+        store.bind_pods = crashing_bind_pods
+        for _round in range(80):
+            sched.pump()
+            drain_burst(sched)
+            sched.pump()
+            assert_no_partial_gang(store)
+            if all(p.node_name for p in store.list(PODS)[0]):
+                break
+            clock.step(61.0)
+            sched.queue.flush()
+        assert calls["n"] >= 2
+        assert all(p.node_name for p in store.list(PODS)[0])
+        assert sched.cache.pod_count() == 4
+
+    @pytest.mark.parametrize("use_tpu", [True, False])
+    def test_scheduler_death_between_trial_and_commit(self, use_tpu):
+        """Scheduler A trial-places the gang but dies before ANY bind write
+        (its commit never runs). The store never saw the trial, so a fresh
+        scheduler B converges with the gang bound whole."""
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=4))
+        a = Scheduler(store, use_tpu=use_tpu,
+                      percentage_of_nodes_to_score=100)
+        a.sync()
+        for j in range(4):
+            store.create(PODS, member(f"m{j}", "g"))
+        a.pump()
+        a._commit_burst = lambda *args, **kw: 0   # the crash point
+        a.schedule_burst(max_pods=16)
+        assert_no_partial_gang(store)
+        assert not any(p.node_name for p in store.list(PODS)[0])
+        del a
+        b = Scheduler(store, use_tpu=use_tpu,
+                      percentage_of_nodes_to_score=100)
+        b.sync()
+        b.pump()
+        drain_burst(b)
+        b.pump()
+        assert_no_partial_gang(store)
+        assert all(p.node_name for p in store.list(PODS)[0])
+
+
+class TestPodGroupController:
+    def _ctl(self, store, clock):
+        from kubernetes_tpu.controllers.podgroup import PodGroupController
+        return PodGroupController(store, clock=clock)
+
+    def test_phase_progression_and_counts(self):
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        store.create(PODGROUPS, PodGroup(name="g", min_member=2,
+                                         creation_timestamp=100.0))
+        ctl = self._ctl(store, clock)
+        ctl.sync()
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_PENDING
+        store.create(PODS, member("m0", "g"))
+        ctl.pump()
+        g = store.get(PODGROUPS, "default/g")
+        assert g.phase == PHASE_PENDING and g.members == 1
+        store.create(PODS, member("m1", "g"))
+        ctl.pump()
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_PRESCHEDULING
+        # members bind -> Scheduled with live counts
+        for j in range(2):
+            store.bind_pod(f"default/m{j}", "n0")
+        ctl.pump()
+        g = store.get(PODGROUPS, "default/g")
+        assert g.phase == PHASE_SCHEDULED
+        assert g.members == 2 and g.scheduled == 2
+        # a member deleted (evicted) drops it back below minMember
+        store.delete(PODS, "default/m1")
+        ctl.pump()
+        g = store.get(PODGROUPS, "default/g")
+        assert g.phase == PHASE_PRESCHEDULING and g.scheduled == 1
+
+    def test_timeout_marks_unschedulable_with_event(self):
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        store.create(PODGROUPS, PodGroup(name="g", min_member=3,
+                                         schedule_timeout_seconds=30.0,
+                                         creation_timestamp=100.0))
+        ctl = self._ctl(store, clock)
+        ctl.sync()
+        store.create(PODS, member("m0", "g"))
+        ctl.pump()
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_PENDING
+        clock.step(31.0)
+        store.create(PODS, member("m1", "g"))   # still short of minMember
+        ctl.pump()
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_UNSCHEDULABLE
+        events, _ = store.list(EVENTS)
+        assert any(e.reason == "GangTimeout" for e in events)
+        # a late successful placement recovers the group
+        for j in range(2):
+            store.bind_pod(f"default/m{j}", "n0")
+        store.create(PODS, member("m2", "g", node_name="n1"))
+        ctl.pump()
+        assert store.get(PODGROUPS, "default/g").phase == PHASE_SCHEDULED
+
+    def test_manager_hosts_podgroup_controller(self):
+        from kubernetes_tpu.controllers.manager import (
+            CONTROLLER_INITIALIZERS, ControllerManager)
+        assert "podgroup" in CONTROLLER_INITIALIZERS
+        store = Store()
+        mgr = ControllerManager(store, enabled=["podgroup"])
+        mgr.sync()
+
+
+class TestGangBurstParity:
+    """Long-range differential fuzz: mixed gangs (feasible, infeasible,
+    heterogeneous, anti-affinity, host-port) + singletons + preemption
+    pressure, scheduled by the TPU burst path vs the pure-oracle shell —
+    final bindings and nominations must be identical, and the atomicity
+    invariant must hold EVERY round in both worlds. Forced wave_size 3/4
+    pushes gang trials across pipelined wave boundaries (the new
+    checkpoint/rewind seam)."""
+
+    @pytest.mark.parametrize("wave_size", [None, 3, 4])
+    @pytest.mark.parametrize("seed", [2, 13, 29, 41])
+    def test_gang_parity(self, seed, wave_size):
+        from kubernetes_tpu.api.types import (
+            Affinity, ContainerPort, PodAntiAffinity, PodAffinityTerm,
+            LabelSelector)
+        rng = random.Random(seed)
+        n_nodes = rng.randint(5, 12)
+        zones = rng.choice([1, 2, 3])
+        cap = rng.choice([2000, 4000])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, mknode(f"n{i}", cpu=cap,
+                                       zone=f"z{i % zones}"))
+            return s
+
+        def make_workload(s):
+            n_groups = rng.randint(2, 4)
+            for g in range(n_groups):
+                size = rng.randint(2, 5)
+                kind = rng.choice(["plain", "plain", "big", "hetero",
+                                   "anti", "port"])
+                s.create(PODGROUPS, PodGroup(name=f"g{g}", min_member=size))
+                for r in range(size):
+                    kw = {}
+                    cpu = rng.choice([100, 300, 500])
+                    if kind == "big":
+                        cpu = cap    # only one per node; size may exceed nodes
+                    elif kind == "hetero":
+                        cpu = rng.choice([100, 700, 1100])
+                    elif kind == "anti":
+                        kw["labels"] = {"color": f"c{g}"}
+                        kw["affinity"] = Affinity(
+                            pod_anti_affinity=PodAntiAffinity(required=(
+                                PodAffinityTerm(
+                                    label_selector=LabelSelector(
+                                        match_labels=(("color", f"c{g}"),)),
+                                    topology_key=LABEL_HOSTNAME),)))
+                    if kind == "port":
+                        ports = (ContainerPort(host_port=7000 + g,
+                                               container_port=80),)
+                        kw["containers"] = (Container.make(
+                            name="c", requests={"cpu": cpu}, ports=ports),)
+                    s.create(PODS, member(f"g{g}r{r}", f"g{g}", cpu=cpu,
+                                          **kw))
+            for j in range(rng.randint(5, 15)):
+                s.create(PODS, singleton(
+                    f"s{j}", cpu=rng.choice([200, 400, 800]),
+                    priority=rng.choice([0, 0, 0, 5, 9])))
+
+        rng_state = rng.getstate()
+        outs = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            clock = FakeClock(100.0)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
+                              percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
+            sched.sync()
+            make_workload(s)
+            idle = 0
+            for _round in range(40):
+                sched.pump()
+                before = sched.metrics.schedule_attempts["scheduled"]
+                drain_burst(sched, max_pods=8)
+                sched.pump()
+                assert_no_partial_gang(s)
+                idle = 0 if sched.metrics.schedule_attempts["scheduled"] \
+                    > before else idle + 1
+                if idle >= 8:
+                    break
+                clock.step(2.0)
+            outs.append(sorted(
+                (p.key, p.node_name, p.nominated_node_name)
+                for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1], (
+            f"seed={seed} wave={wave_size}: gang decisions diverged: "
+            f"{[a for a, b in zip(*outs) if a != b][:6]}")

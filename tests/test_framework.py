@@ -165,6 +165,61 @@ class TestFrameworkPoints:
             assert sched.cache.pod_count() == 0  # forget rolled back
 
 
+class TestPermitRejectRecovery:
+    """The WaitingPod reject/timeout contract on the bind thread
+    (framework.go WaitOnPermit -> scheduler.go:523 bind goroutine failure
+    path): the pod must be UNRESERVED (unreserve plugins ran), FORGOTTEN
+    from the cache (no phantom capacity), and RE-QUEUED WITH BACKOFF (not
+    hot-looped) — then actually schedule once the gate opens."""
+
+    @pytest.mark.parametrize("decision", ["reject", "timeout"])
+    def test_rejected_waiting_pod_unreserved_forgotten_requeued(
+            self, decision):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        unres = RecordingUnreserve()
+        gate = GatePermit(decision=decision, timeout=0.2)
+        sched = make_scheduler(store, [gate, unres])
+        gate.framework = sched.framework
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        # not bound, and the reservation was fully rolled back
+        assert store.get(PODS, "default/p1").node_name == ""
+        assert unres.calls == [("p1", "n1")]          # Unreserve ran
+        assert sched.cache.pod_count() == 0           # ForgetPod ran
+        assert not sched.cache.is_assumed_pod(
+            store.get(PODS, "default/p1"))
+        # re-queued WITH backoff: the pod is pending but not immediately
+        # poppable (hot-looping a rejected pod would defeat backoff)
+        sched.pump()
+        assert sched.queue.num_pending() == 1
+        assert sched.queue.pop(timeout=0.0) is None
+        key = "default/p1"
+        assert sched.queue._backoff.backoff_time(key) > 0
+        # the failure was booked as unschedulable, not an internal error
+        assert sched.metrics.schedule_attempts["unschedulable"] == 1
+        assert sched.metrics.schedule_attempts["error"] == 0
+
+    def test_rejected_pod_schedules_after_backoff_when_allowed(self):
+        store = Store()
+        store.create(NODES, mknode("n1"))
+        gate = GatePermit(decision="reject", timeout=0.2)
+        sched = make_scheduler(store, [gate])
+        gate.framework = sched.framework
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        run_all(sched)
+        assert store.get(PODS, "default/p1").node_name == ""
+        # the gate opens; ride out the backoff + unschedulable flush
+        gate.decision = "allow"
+        sched.clock.step(61.0)
+        sched.queue.flush()
+        run_all(sched)
+        assert store.get(PODS, "default/p1").node_name == "n1"
+        assert sched.cache.pod_count() == 1
+
+
 class TestRegistry:
     def test_duplicate_registration_rejected(self):
         reg = Registry()

@@ -112,6 +112,72 @@ class TestRemoteStoreCRUD:
         assert store.get(PODS, "default/p1").resource_version == rv1
 
 
+class TestRemotePodGroup:
+    """PodGroup verbs + watch over the wire, pinning that the client's
+    error mapping matches the apiserver's status codes for the new kind
+    (the CLAUDE.md remote/apiserver sync rule)."""
+
+    def test_round_trip_and_status_subresource(self, served):
+        from kubernetes_tpu.coscheduling.types import (
+            PHASE_PRESCHEDULING, PodGroup)
+        from kubernetes_tpu.store.store import PODGROUPS
+        store, remote = served
+        g = PodGroup(name="g", min_member=4, schedule_timeout_seconds=30.0)
+        created = remote.create(PODGROUPS, g)
+        assert created.min_member == 4
+        assert created.schedule_timeout_seconds == 30.0
+        got = remote.get(PODGROUPS, "default/g")
+        assert got == created
+        objs, _rv = remote.list(PODGROUPS)
+        assert [o.key for o in objs] == ["default/g"]
+        # the /status subresource: status fields land, spec untouched, and
+        # the same write through BOTH transports produces the same object
+        updated = remote.update_pod_group_status(
+            "default/g", phase=PHASE_PRESCHEDULING, members=2, now=1.5)
+        assert updated.phase == PHASE_PRESCHEDULING
+        assert updated.members == 2 and updated.min_member == 4
+        assert store.get(PODGROUPS, "default/g") == updated
+        gone = remote.delete(PODGROUPS, "default/g")
+        assert gone.key == "default/g"
+
+    def test_error_mapping_matches_apiserver_codes(self, served):
+        from kubernetes_tpu.coscheduling.types import PodGroup
+        from kubernetes_tpu.store.store import PODGROUPS
+        _store, remote = served
+        with pytest.raises(NotFoundError):        # 404
+            remote.get(PODGROUPS, "default/missing")
+        with pytest.raises(NotFoundError):        # 404 on the subresource
+            remote.update_pod_group_status("default/missing", phase="X")
+        remote.create(PODGROUPS, PodGroup(name="g"))
+        with pytest.raises(AlreadyExistsError):   # 409 AlreadyExists
+            remote.create(PODGROUPS, PodGroup(name="g"))
+        g = remote.get(PODGROUPS, "default/g")
+        g.min_member = 2
+        remote.update(PODGROUPS, g, expect_rv=g.resource_version)
+        with pytest.raises(ConflictError):        # 409 Conflict (stale rv)
+            stale = g.clone()
+            stale.min_member = 9
+            remote.update(PODGROUPS, stale, expect_rv=g.resource_version)
+        with pytest.raises(NotFoundError):        # 404 on delete
+            remote.delete(PODGROUPS, "default/other")
+
+    def test_watch_streams_podgroup_events(self, served):
+        from kubernetes_tpu.coscheduling.types import PodGroup
+        from kubernetes_tpu.store.store import PODGROUPS
+        store, remote = served
+        w = remote.watch(PODGROUPS, since_rv=store.resource_version())
+        try:
+            store.create(PODGROUPS, PodGroup(name="g", min_member=3))
+            store.update_pod_group_status("default/g", phase="PreScheduling")
+            ev1 = w.next(timeout=5.0)
+            ev2 = w.next(timeout=5.0)
+            assert ev1.type == "ADDED" and ev1.obj.min_member == 3
+            assert ev2.type == "MODIFIED" \
+                and ev2.obj.phase == "PreScheduling"
+        finally:
+            w.stop()
+
+
 class TestRemoteWatch:
     def test_stream_resume_and_types(self, served):
         store, remote = served
