@@ -142,7 +142,7 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
 
 
 def run_preempt_bench(n_nodes: int, n_victims: int,
-                      n_preemptors: int = 16) -> dict:
+                      n_preemptors: int = 128) -> dict:
     """BASELINE.md configs[3]: preemption victim scans over `n_victims`
     lower-priority pods. A pressure wave of `n_preemptors` failed pods runs
     as ONE schedule-else-preempt launch on the device
@@ -151,98 +151,24 @@ def run_preempt_bench(n_nodes: int, n_victims: int,
     scan seeing the nominations before it (the reference fans
     selectVictimsOnNode over 16 goroutines PER pod,
     generic_scheduler.go:996; a tunneled chip pays ~100ms per launch, so
-    batching the wave is the only way the device can win). Decisions are
-    asserted identical before timing is reported."""
-    import time as _t
-    from kubernetes_tpu.api.types import Pod, Node, Container
-    from kubernetes_tpu.cache.node_info import NodeInfo
-    from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
-    from kubernetes_tpu.oracle import predicates as preds
-    from kubernetes_tpu.oracle.generic_scheduler import (FitError,
-                                                         GenericScheduler)
-    from kubernetes_tpu.oracle.preemption import Preemptor
-    GI = 1024 ** 3
-    per_node = max(1, n_victims // n_nodes)
-    cpu_each = 4000 // per_node
-    infos = {}
-    names = []
-    uid = 0
-    for i in range(n_nodes):
-        node = Node(name=f"node-{i}",
-                    allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
-        ni = NodeInfo(node)
-        for _ in range(per_node):
-            uid += 1
-            p = Pod(name=f"victim-{uid}", priority=1, node_name=node.name,
-                    containers=(Container.make(
-                        name="c", requests={"cpu": cpu_each}),))
-            ni.add_pod(p)
-        infos[node.name] = ni
-        names.append(node.name)
-    preemptors = [Pod(name=f"hi-{k}", priority=10, containers=(
-        Container.make(name="c", requests={"cpu": cpu_each}),))
-        for k in range(n_preemptors)]
-
-    def device_wave(tpu):
-        out = tpu.preempt_pressure_burst(preemptors, infos, names, [])
-        assert out is not None
-        return out
-
-    device_wave(TPUScheduler(percentage_of_nodes_to_score=100))  # compile
-    tpu = TPUScheduler(percentage_of_nodes_to_score=100)
-    t0 = _t.perf_counter()
-    got = device_wave(tpu)
-    dev = _t.perf_counter() - t0
-
-    def oracle_wave():
-        # the serial referee: schedule-else-preempt with nominated ghosts,
-        # successes folded — normalized to the same outcome tuples the
-        # device wave returns (a fit-able nodes/pods ratio must compare,
-        # not crash)
-        nominated: dict = {}
-        nom_fn = lambda n: list(nominated.get(n, []))
-        g = GenericScheduler(percentage_of_nodes_to_score=100,
-                             nominated_pods_fn=nom_fn)
-        world = dict(infos)
-        out = []
-        for pod in preemptors:
-            funcs = preds.default_predicate_set(world)
-            try:
-                r = g.schedule(pod, world, names, predicate_funcs=funcs)
-            except FitError as err:
-                res = Preemptor().preempt(pod, world, names, err,
-                                          nominated_pods_fn=nom_fn)
-                if res.node is None:
-                    out.append(("failed", not res.nominated_to_clear))
-                    continue
-                ghost = pod.clone()
-                ghost.node_name = res.node.name
-                nominated.setdefault(res.node.name, []).append(ghost)
-                out.append(("nominated", res.node.name,
-                            sorted(v.name for v in res.victims)))
-                continue
-            assumed = pod.clone()
-            assumed.node_name = r.suggested_host
-            ni = world[r.suggested_host].clone()
-            ni.add_pod(assumed)
-            world = {**world, r.suggested_host: ni}
-            out.append(("bound", r.suggested_host))
-        return out
-
-    t0 = _t.perf_counter()
-    want = oracle_wave()
-    ora = _t.perf_counter() - t0
-    norm = [("nominated", o[1], sorted(v.name for v in o[2]))
-            if o[0] == "nominated" else o for o in got]
-    assert norm == want, f"device/oracle preempt divergence: {norm} != {want}"
+    batching the wave is the only way the device can win). The device side
+    rides the WARM persistent victim table (the steady-state condition —
+    perf.harness.run_preempt_cell) and the JSON reports the per-wave
+    encode vs device-scan phase split, mirroring the matrix lanes.
+    Decisions are asserted identical before timing is reported."""
+    from kubernetes_tpu.perf.harness import run_preempt_cell
+    r = run_preempt_cell(n_nodes, n_victims, n_preemptors)
     return {
         "metric": f"preempt_scan_{n_nodes}n_{n_victims}victims",
-        "value": round(n_preemptors / dev, 2),
+        "value": r["scans_per_s"],
         "unit": "scans/s",
-        "vs_baseline": round(ora / dev, 2),
+        "vs_baseline": r["vs_oracle"],
         "preemptors_per_wave": n_preemptors,
-        "device_seconds": round(dev, 4),
-        "oracle_seconds": round(ora, 4),
+        "device_seconds": r["device_seconds"],
+        "oracle_seconds": r["oracle_seconds"],
+        "encode_seconds": r["encode_seconds"],
+        "scan_seconds": r["scan_seconds"],
+        "warm_victim_table": True,
     }
 
 
@@ -407,6 +333,9 @@ def run_matrix(repeat: int = 2, nodes: int = 1000, existing: int = 1000,
                 lambda: retry_transient(lambda: run_preempt_bench(1000, 10000)))
     out["preempt_scans_per_s"] = p["value"] if p else None
     out["preempt_vs_oracle"] = p["vs_baseline"] if p else None
+    out["preempt_phase_split"] = (
+        {"encode": p.get("encode_seconds"), "scan": p.get("scan_seconds")}
+        if p else None)
     out["cell"] = f"{nodes}n_{existing}existing_{pods}p"
     return out
 
@@ -430,8 +359,12 @@ def run_matrix_only(repeat: int = 2) -> dict:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--nodes", type=int, default=15000)
-    ap.add_argument("--pods", type=int, default=10000)
+    # None = per-mode default: the headline burst runs the 15000-node cell,
+    # `--mode preempt` the BASELINE configs[3] cell (1000 nodes — its serial
+    # oracle referee replays the whole wave, so the 15000-node default would
+    # spend minutes in the referee, not the device)
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
                              "gang"],
@@ -440,6 +373,13 @@ def main():
     # the uniform kernel's pod count is dynamic, so no padding waste at any
     # size — the cap is kernels.B_CAP per launch
     ap.add_argument("--burst", type=int, default=10000)
+    # `--mode preempt` wave width: failed pods per schedule-else-preempt
+    # launch (the serial oracle referee replays the same count). The
+    # default is one full PRESSURE_B_CAP chunk: per-wave fixed costs
+    # (encode residue, dispatch, the one fetch round trip) amortize over
+    # the wave exactly like the scheduling lanes' 10k-pod bursts — at 16
+    # the tunnel RTT alone caps the lane at ~160 scans/s
+    ap.add_argument("--preemptors", type=int, default=128)
     # the tunneled chip's dispatch latency varies +-15% run to run; report
     # the median of N timed runs (compiles are cached after the first)
     ap.add_argument("--repeat", type=int, default=3)
@@ -478,14 +418,17 @@ def main():
         obs_trace.clear()   # only this run's spans land in the file
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
+    n_nodes = args.nodes if args.nodes is not None \
+        else (1000 if args.mode == "preempt" else 15000)
+    n_pods = args.pods if args.pods is not None else 10000
     if args.mode == "preempt":
         result = retry_transient(
-            lambda: run_preempt_bench(args.nodes, args.pods))
+            lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors))
         finish(result)
         return
     if args.mode == "gang":
         result = retry_transient(
-            lambda: run_gang_bench(args.nodes, pods_budget=args.pods))
+            lambda: run_gang_bench(n_nodes, pods_budget=n_pods))
         finish(result)
         return
     if args.mode == "matrix":
@@ -498,7 +441,7 @@ def main():
     # (bounded retry on transient JaxRuntimeErrors only; real failures
     # still propagate — see perf.harness.retry_transient)
     runs = [retry_transient(
-                lambda: run_bench(args.nodes, args.pods, args.mode,
+                lambda: run_bench(n_nodes, n_pods, args.mode,
                                   args.burst, compare=False, mesh=mesh))
             for _ in range(max(args.repeat, 1))]
     runs.sort(key=lambda r: r["value"])
@@ -508,10 +451,10 @@ def main():
     result["runs"] = [r["value"] for r in runs]
     result["baseline_note"] = BASELINE_NOTE
     if args.mode != "oracle":
-        sample = min(args.pods, 100)
+        sample = min(n_pods, 100)
         try:
             oracle = retry_transient(
-                lambda: measure_oracle(args.nodes, sample))
+                lambda: measure_oracle(n_nodes, sample))
         except Exception as e:
             if not is_transient_error(e):
                 raise
@@ -529,7 +472,7 @@ def main():
             import jax
             m = _make_mesh()   # one mesh for all repeats (one compile)
             mesh_runs = [retry_transient(
-                             lambda: run_bench(args.nodes, args.pods,
+                             lambda: run_bench(n_nodes, n_pods,
                                                args.mode, args.burst,
                                                compare=False, mesh=m))["value"]
                          for _ in range(max(min(args.repeat, 2), 1))]

@@ -81,6 +81,14 @@ _PHASE_SPANS = {"encode": ("burst.encode", "host"),
                 "kernel": ("burst.dispatch", "device"),
                 "fetch": ("burst.fetch", "device")}
 
+# every reason the victim-table eligibility gate can refuse a preemption
+# for (the old single "victims-not-inert" label, split per class so
+# /metrics shows WHICH gate sends scans back to the oracle). `preempt`
+# prefixes with "preempt-victims-", preempt_pressure_burst with
+# "victims-"; test_obs pins the set.
+VICTIM_GATE_REASONS = ("affinity-terms", "ports", "scalar", "term-match",
+                       "overflow")
+
 
 def _fetched_nbytes(obj) -> int:
     """Total nbytes of a fetched pytree (dict/list/tuple of ndarrays)."""
@@ -177,6 +185,14 @@ class TPUScheduler:
         # scatter otherwise (SURVEY §2.4 delta uploader)
         self._dev_nodes: Optional[dict] = None
         self._dev_key = None
+        # device-resident victim table (the [N, P] slot planes preemption
+        # scans read): full upload on rebuild/permute, dirty-row scatter
+        # otherwise — same delta contract as the node matrix
+        self._dev_vic: Optional[dict] = None
+        self._dev_vic_key = None
+        # encode vs device-scan wall seconds of the last pressure launch
+        # (bench.py --mode preempt reports the split)
+        self.last_preempt_phases: Optional[dict] = None
         # upload/scatter epoch: bumps whenever HOST data lands in the
         # device matrix (burst folds do NOT bump it) — a gang checkpoint
         # whose epoch still matches can restore its pinned matrix without
@@ -200,6 +216,10 @@ class TPUScheduler:
         # single-worker readback executor for the pipelined burst waves
         # (lazy: serial-only configurations never start the thread)
         self._fetch_pool = None
+        # zero ghost-load vectors by n_pad (device arrays are immutable, so
+        # every pressure launch can share one set instead of re-creating
+        # four jnp.zeros per wave)
+        self._ghost_zeros: dict[int, dict] = {}
 
     def _shared_zero_scalar(self, n: int) -> np.ndarray:
         arr = self._zero_scalars.get(n)
@@ -1284,12 +1304,9 @@ class TPUScheduler:
         into the static feasibility vector."""
         from kubernetes_tpu.oracle.preemption import (
             pod_eligible_to_preempt_others, nodes_where_preemption_might_help,
-            pods_violating_pdbs, importance_key, PreemptionResult,
-            no_possible_victims)
-        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
+            PreemptionResult, no_possible_victims)
         from kubernetes_tpu.api.types import (
-            has_pod_affinity_terms, get_container_ports, get_resource_request)
-        from kubernetes_tpu.cache.node_info import calculate_resource
+            get_container_ports, get_resource_request)
         if not all_node_names:
             return None
         if self.nominated is not None and self.nominated.has_any():
@@ -1322,13 +1339,12 @@ class TPUScheduler:
             return PreemptionResult(None, [], [])
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
-        packed = self._encode_victims(node_infos, b, candidates, pod.priority,
-                                      pdbs, pod=pod, pod_ports=pod_ports,
-                                      pod_terms=pod_terms)
-        if packed is None:
-            ORACLE_FALLBACKS.labels("preempt-victims-not-inert").inc()
+        vic, slots, gate = self._victim_inputs(
+            node_infos, b, candidates, pod.priority, pdbs, pod=pod,
+            pod_ports=pod_ports, pod_terms=pod_terms)
+        if vic is None:
+            ORACLE_FALLBACKS.labels(f"preempt-victims-{gate}").inc()
             return None
-        vic, slots = packed
         enc = PodEncoder(node_infos, b, self.services_fn(),
                          self.replicasets_fn(),
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
@@ -1362,7 +1378,7 @@ class TPUScheduler:
         t_scan = obs_trace.now()
         out = np.asarray(K.preemption_scan(
             nodes, vic, pod_in, feas, order_rank, b.n_real,
-            self.check_resources, f.has_request))
+            self.check_resources, f.has_request, pod.priority))
         DEVICE_DISPATCH.labels("preempt_scan").inc()
         DEVICE_FETCHES.labels("preempt_scan").inc()
         DEVICE_FETCHED_BYTES.labels("preempt_scan").inc(out.nbytes)
@@ -1378,74 +1394,102 @@ class TPUScheduler:
         victims = [p for j, p in enumerate(slots.get(name, ())) if flags[j]]
         return PreemptionResult(node_infos[name].node, victims, [])
 
-    def _encode_victims(self, node_infos: dict[str, NodeInfo], b: NodeBatch,
-                        names, max_prio: int, pdbs: list,
-                        pod: Optional[Pod] = None, pod_ports: bool = False,
-                        pod_terms=()):
-        """[N, P] victim-slot arrays for every pod of priority < `max_prio`
-        on `names`, sorted per node into the reprieve processing order
-        (PDB-violating first, each group by descending importance —
-        preemption.py select_victims_on_node). P is bucketed to the
-        smallest power-of-two that fits the fullest node (one compile per
-        bucket; the old fixed 128-slot layout shipped 8x the bytes the
-        common case needs). Returns (vic dict, slots map) or None when any
-        potential victim is not mask-inert — removal of a non-inert victim
-        could change the incoming pod's masks, which the kernels treat as
-        static, so the caller must fall back to the oracle."""
-        from kubernetes_tpu.oracle.preemption import (pods_violating_pdbs,
-                                                      importance_key)
-        from kubernetes_tpu.oracle.predicates import pod_matches_term_props
-        from kubernetes_tpu.api.types import (has_pod_affinity_terms,
-                                              get_container_ports)
-        from kubernetes_tpu.cache.node_info import calculate_resource
-        per_node: list[tuple[int, list[Pod], set]] = []
-        maxp = 1
-        for name in names:
-            ni = node_infos[name]
-            pots = [p for p in ni.pods if p.priority < max_prio]
-            if not pots:
-                continue
-            if len(pots) > K.PREEMPT_P:
-                return None
-            violating = {p.uid for p in pods_violating_pdbs(pots, pdbs)}
-            pots.sort(key=lambda p: (0 if p.uid in violating else 1,
-                                     importance_key(p)))
-            per_node.append((b.index[name], pots, violating))
-            maxp = max(maxp, len(pots))
-        P = min(_pad_pow2(maxp, 8), K.PREEMPT_P)
-        n_pad = b.n_pad
-        vcpu = np.zeros((n_pad, P), np.int64)
-        vmem = np.zeros((n_pad, P), np.int64)
-        veph = np.zeros((n_pad, P), np.int64)
-        vprio = np.zeros((n_pad, P), np.int64)
-        vstart = np.full((n_pad, P), np.inf, np.float64)
-        vvalid = np.zeros((n_pad, P), bool)
-        vviol = np.zeros((n_pad, P), bool)
-        slots: dict[str, list[Pod]] = {}
-        for i, pots, violating in per_node:
-            for j, p in enumerate(pots):
-                if has_pod_affinity_terms(p):
-                    return None
-                if pod_ports and get_container_ports(p):
-                    return None
-                if pod_terms and any(pod_matches_term_props(p, pod, t)
-                                     for t in pod_terms):
-                    return None
-                r = calculate_resource(p)
-                if r.scalar:
-                    return None
-                vcpu[i, j] = r.milli_cpu
-                vmem[i, j] = r.memory
-                veph[i, j] = r.ephemeral_storage
-                vprio[i, j] = p.priority
-                if p.start_time is not None:
-                    vstart[i, j] = p.start_time
-                vvalid[i, j] = True
-                vviol[i, j] = p.uid in violating
-            slots[b.names[i]] = pots
-        vic = {"cpu": vcpu, "mem": vmem, "eph": veph, "prio": vprio,
-               "start": vstart, "valid": vvalid, "violating": vviol}
-        return vic, slots
+    # victim-table planes the kernels read, device key <- host field
+    _VIC_FIELDS = (("cpu", "cpu"), ("mem", "mem"), ("eph", "eph"),
+                   ("prio", "prio"), ("start", "start"),
+                   ("valid", "valid"), ("violating", "viol"))
+
+    def _victim_inputs(self, node_infos: dict[str, NodeInfo], b: NodeBatch,
+                       names, max_prio: int, pdbs: list,
+                       pod: Optional[Pod] = None, pod_ports: bool = False,
+                       pod_terms=()):
+        """Resident [N, P] victim planes + slots map for a preemption scan.
+
+        The table itself is persistent (encoder.victim_table: cached per
+        node generation, re-sorted only for dirty rows, permuted on
+        NodeTree rotation) and stays in HBM — a scan uploads only dirty
+        rows. The eligibility gates that used to abort a per-scan Python
+        encode midway are O(1) mask reads over the cached inertness-class
+        planes, checked over exactly the candidate set: a potential victim
+        (priority < max_prio on a candidate node) carrying affinity terms,
+        conflicting ports, scalar resources, or matching the incoming
+        pod's required terms — or a node the slot cap can't represent —
+        still refuses, per-reason (VICTIM_GATE_REASONS), and the caller
+        falls back to the oracle. Returns (vic dict, slots, None) or
+        (None, None, reason)."""
+        vt = self.encoder.victim_table(node_infos, b, pdbs,
+                                       cap=K.PREEMPT_P)
+        if len(names) == b.n_real and (names is b.names or
+                                       list(names) == b.names):
+            # whole-axis candidate set (the pressure path): skip the
+            # per-name index gather
+            cand = np.arange(b.n_real, dtype=np.int64)
+        else:
+            cand = np.fromiter((b.index[nm] for nm in names), np.int64,
+                               len(names))
+        # the overflow gate EXTENDS the old one: it fires on total pod
+        # count > cap, a superset of the old potential-victim count check —
+        # a dropped slot could be anyone's victim, so refuse outright
+        if bool(vt.overflow[cand].any()):
+            return None, None, "overflow"
+        pot = vt.valid[cand] & (vt.prio[cand] < max_prio)
+        if bool((pot & vt.aff[cand]).any()):
+            return None, None, "affinity-terms"
+        if pod_ports and bool((pot & vt.ports[cand]).any()):
+            return None, None, "ports"
+        if bool((pot & vt.scalar[cand]).any()):
+            return None, None, "scalar"
+        if pod_terms:
+            from kubernetes_tpu.oracle.predicates import (
+                pod_matches_any_term_mask)
+            t = vt.table
+            is_cand = np.zeros(b.n_pad, bool)
+            is_cand[cand] = True
+            hr = t.holder_row
+            on_cand = (hr >= 0) & is_cand[np.where(hr >= 0, hr, 0)]
+            pot_rows = on_cand & (t.prio < max_prio)
+            if bool(pot_rows.any()) and bool(
+                    (pod_matches_any_term_mask(pod, pod_terms, t)
+                     & pot_rows).any()):
+                return None, None, "term-match"
+        return self._upload_victims(vt), vt.slots, None
+
+    def _upload_victims(self, vt) -> dict:
+        """Sync the device-resident victim planes from the host table:
+        full upload on rebuild/permute (dirty_rows None), dirty-row scatter
+        otherwise, nothing at all in the steady state — the same delta
+        contract as the node matrix."""
+        key = (vt.P, vt.valid.shape[0])
+        if (self._dev_vic is None or self._dev_vic_key != key
+                or vt.dirty_rows is None):
+            self._dev_vic = {k: jnp.asarray(getattr(vt, f))
+                             for k, f in self._VIC_FIELDS}
+            self._dev_vic_key = key
+            DEVICE_DISPATCH.labels("vic_upload").inc()
+            vt.dirty_rows = []
+            return self._dev_vic
+        if vt.dirty_rows:
+            rows = np.asarray(sorted(set(vt.dirty_rows)), dtype=np.int32)
+            bucket = _pad_pow2(len(rows), 16)
+            rows = np.concatenate(
+                [rows, np.full(bucket - len(rows), rows[0], dtype=np.int32)])
+            upd = {k: getattr(vt, f)[rows] for k, f in self._VIC_FIELDS}
+            self._dev_vic = _scatter_rows(self._dev_vic, rows, upd)
+            DEVICE_DISPATCH.labels("vic_scatter").inc()
+            vt.dirty_rows = []
+        return self._dev_vic
+
+    def prewarm_preempt(self, node_infos: dict[str, NodeInfo],
+                        all_node_names: list[str], pdbs: list) -> None:
+        """Build + upload the node matrix and the persistent victim table
+        outside any timed/decision window — the steady-state condition:
+        in production the table is maintained incrementally across cycles,
+        so a preemption wave never pays the cold build. Consumes no
+        rotation state and folds nothing."""
+        b = self.encoder.encode(node_infos, all_node_names)
+        self._node_arrays(b)
+        self._upload_victims(
+            self.encoder.victim_table(node_infos, b, pdbs, cap=K.PREEMPT_P))
 
     # batched pressure chunks: bounds the [B, ...] upload and lets chunk
     # k+1's launch overlap chunk k's on-device execution
@@ -1480,6 +1524,8 @@ class TPUScheduler:
                                               get_resource_request)
         if not pods or not all_node_names:
             return None
+        import time as _time
+        _t0 = _time.perf_counter()
         if self.mesh is not None:
             PRESSURE_GATES.labels("mesh-mode").inc()
             return None
@@ -1529,12 +1575,11 @@ class TPUScheduler:
                 # the pressure scan doesn't carry spread counts
                 PRESSURE_GATES.labels("spread-selectors").inc()
                 return None
-        packed = self._encode_victims(node_infos, b, all_node_names,
-                                      prios[0], pdbs)
-        if packed is None:
-            PRESSURE_GATES.labels("victims-not-inert").inc()
+        vic, slots, gate = self._victim_inputs(node_infos, b, all_node_names,
+                                               prios[0], pdbs)
+        if vic is None:
+            PRESSURE_GATES.labels(f"victims-{gate}").inc()
             return None
-        vic, slots = packed
         per_pod = []
         for p, f in zip(pods, feats):
             d = self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
@@ -1545,9 +1590,17 @@ class TPUScheduler:
             n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
         mut0 = {k: nodes[k] for k in K._MUTABLE}
-        ghost0 = {k: jnp.zeros(b.n_pad, jnp.int64)
-                  for k in ("cpu", "mem", "eph", "cnt")}
+        ghost0 = self._ghost_zeros.get(b.n_pad)
+        if ghost0 is None:
+            ghost0 = self._ghost_zeros[b.n_pad] = {
+                k: jnp.zeros(b.n_pad, jnp.int64)
+                for k in ("cpu", "mem", "eph", "cnt")}
         li, lni = self.last_index, self.last_node_index
+        # encode vs device-scan phase boundary: everything above is host
+        # encode + delta upload; everything below is dispatch + the one
+        # fetch that pays the round trip (bench --mode preempt reports it)
+        _t_enc = _time.perf_counter()
+        obs_trace.add_span("pressure.encode", _t0, _t_enc, cat="host")
         outs_chunks = []
         for lo in range(0, len(per_pod), self.PRESSURE_B_CAP):
             chunk = per_pod[lo: lo + self.PRESSURE_B_CAP]
@@ -1572,6 +1625,10 @@ class TPUScheduler:
             _fetched_nbytes(h_chunks))
         obs_trace.add_span("pressure.fetch", t_fetch, obs_trace.now(),
                            cat="device")
+        self.last_preempt_phases = {
+            "encode": _t_enc - _t0,
+            "scan": _time.perf_counter() - _t_enc,
+        }
         outcomes = []
         k = 0
         for h in h_chunks:

@@ -1090,12 +1090,16 @@ def _pick_one_node(feas0, agg, order_rank):
 
 @partial(jax.jit, static_argnames=("check_res", "has_req"))
 def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
-                         check_res, has_req):
+                         max_prio, check_res, has_req):
     i32 = jnp.int32
     n_pad = nodes["alloc_cpu"].shape[0]
     in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
+    # the resident victim table holds EVERY snapshot pod in reprieve order;
+    # this preemptor's potential-victim mask is one device-side compare
+    # (the sort key is priority-monotone, so masking preserves the order)
+    valid_v = vic["valid"] & (vic["prio"] < max_prio)
     feas0, victims, agg = _victim_select(
-        nodes, vic, vic["valid"], pod["req_cpu"], pod["req_mem"],
+        nodes, vic, valid_v, pod["req_cpu"], pod["req_mem"],
         pod["req_eph"], None, feas_static & in_range, check_res, has_req)
     winner = _pick_one_node(feas0, agg, order_rank)
     w = jnp.maximum(winner, 0)
@@ -1107,15 +1111,17 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
 
 
 def preemption_scan(nodes, vic, pod, feas_static, order_rank, n_real,
-                    check_resources, has_request):
-    """One launch over all candidate nodes. `vic` arrays are [N, P] with
-    victims pre-sorted into processing order per node. Returns packed i32
+                    check_resources, has_request, max_prio):
+    """One launch over all candidate nodes. `vic` arrays are [N, P] slot
+    planes of the persistent victim table — ALL snapshot pods pre-sorted
+    into reprieve processing order per node; slots of priority >= `max_prio`
+    (the preemptor's) are masked out on device. Returns packed i32
     [3 + P]: winner node index (-1 = no candidate), its victim count and
     PDB-violation count, then the winner's per-slot victim flags (aligned
     to the sorted order the host supplied)."""
     return _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank,
-                                _i64(n_real), bool(check_resources),
-                                bool(has_request))
+                                _i64(n_real), _i64(max_prio),
+                                bool(check_resources), bool(has_request))
 
 
 # ---------------------------------------------------------------------------

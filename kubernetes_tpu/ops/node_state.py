@@ -22,7 +22,7 @@ from kubernetes_tpu.api.types import (
     Pod, Taint, NO_SCHEDULE, NO_EXECUTE, PREFER_NO_SCHEDULE,
     TAINT_NODE_UNSCHEDULABLE, get_resource_request, get_pod_nonzero_requests,
     get_container_ports, get_zone_key, tolerations_tolerate_taint,
-    find_intolerable_taint,
+    find_intolerable_taint, has_pod_affinity_terms,
 )
 from kubernetes_tpu.cache.node_info import NodeInfo, normalized_image_name
 from kubernetes_tpu.oracle.predicates import (
@@ -47,6 +47,13 @@ MIRROR_PERMUTES = obs.counter(
 MIRROR_REBUILDS = obs.counter(
     "tpu_encoder_mirror_rebuilds_total",
     "Full mirror rebuilds (capacity, vocab, or node-membership change).")
+VICTIM_ROW_RESORTS = obs.counter(
+    "tpu_victim_table_row_resorts_total",
+    "Victim-table node rows re-sorted (generation moved or the PDB set "
+    "changed); the steady state is zero — scans read the cached table.")
+VICTIM_REBUILDS = obs.counter(
+    "tpu_victim_table_rebuilds_total",
+    "Full victim-table rebuilds (capacity or node-membership change).")
 
 
 def _pad_capacity(n: int, minimum: int = 8) -> int:
@@ -108,19 +115,43 @@ class NodeStateEncoder:
         self._pt_key_vocab: dict[str, int] = {}
         self._pt_val_vocab: dict[str, int] = {}
         self._pt_val_ints: list[float] = []
+        # assembled-table memo: when no block re-extracted and the batch is
+        # the same object, the concatenated arrays are bit-identical — skip
+        # the O(total pods) reassembly (victim_table + the per-burst
+        # PodEncoder both read the table, often in the same cycle)
+        self._pt_built: Optional["PodTable"] = None
+        self._pt_built_key: Optional[tuple] = None
+        # calculate_resource memo keyed by the containers tuple: victim
+        # columns and uniform waves re-read the same specs constantly
+        self._cr_memo: dict = {}
+        # persistent victim table (victim_table): [N, P] reprieve-ordered
+        # slot columns cached per node by NodeInfo generation, permuted on
+        # NodeTree rotation with the mirror, all-dirty on a PDB-set change
+        self._vt: Optional[VictimStack] = None
+        self._vt_gens: dict[str, int] = {}
+        self._vt_pdb_key: Optional[tuple] = None
 
     def _collect_vocab(self, node_infos: dict[str, NodeInfo]) -> None:
+        """Grow the scalar/zone vocabs from nodes whose generation moved
+        since the last encode (vocab inputs are node state — allocatable/
+        requested scalars and zone labels — so an unchanged generation
+        contributed on a previous call). The steady state skips the whole
+        per-node walk, which at cluster scale was a full O(N) Python pass
+        per cycle."""
         known = set(self._scalar_vocab)
         zones = set(self._zone_vocab)
-        for ni in node_infos.values():
-            for name in ni.allocatable.scalar:
-                if name not in known:
-                    known.add(name)
-                    self._scalar_vocab.append(name)
-            for name in ni.requested.scalar:
-                if name not in known:
-                    known.add(name)
-                    self._scalar_vocab.append(name)
+        gens = self._generations
+        for name, ni in node_infos.items():
+            if gens.get(name) == ni.generation:
+                continue
+            for sname in ni.allocatable.scalar:
+                if sname not in known:
+                    known.add(sname)
+                    self._scalar_vocab.append(sname)
+            for sname in ni.requested.scalar:
+                if sname not in known:
+                    known.add(sname)
+                    self._scalar_vocab.append(sname)
             if ni.node is not None:
                 z = get_zone_key(ni.node)
                 if z not in zones:
@@ -146,12 +177,16 @@ class NodeStateEncoder:
                 # same nodes, new enumeration order (uneven-zone clusters
                 # rotate between bursts): permute the mirror rows instead
                 # of re-extracting every NodeInfo through _write_row —
-                # generations are name-keyed, so they stay valid
+                # generations are name-keyed, so they stay valid. The
+                # victim table's row planes ride the same permutation.
+                self._vt_permute(b, node_order, n_real)
                 b = self._permuted(b, node_order, n_real)
                 MIRROR_PERMUTES.inc()
             else:
                 b = self._fresh(node_order, n_real, n_pad, s)
                 self._generations = {}
+                self._vt = None           # rows realign on next victim scan
+                self._vt_gens = {}
                 MIRROR_REBUILDS.inc()
             self._batch = b
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
@@ -283,7 +318,12 @@ class NodeStateEncoder:
     def _pt_block(self, ni: NodeInfo):
         """One node's pods as dictionary-encoded rows. Vocab ids are
         monotonic (never reassigned) so cached blocks stay valid across
-        encodes."""
+        encodes. Alongside the label rows, each pod's VICTIM columns are
+        extracted here — priority, start time, calculate_resource sums
+        (memoized by the containers tuple), and the inertness-class flags
+        (affinity terms / container ports / scalar resources) — so the
+        preemption path reads cached per-generation facts instead of
+        re-deriving them per scan."""
         pods = list(ni.pods)
         p = len(pods)
         aff_ids = set(map(id, ni.pods_with_affinity))
@@ -293,8 +333,17 @@ class NodeStateEncoder:
         ns = np.empty(p, np.int32)
         deleted = np.empty(p, bool)
         has_aff = np.empty(p, bool)
+        prio = np.empty(p, np.int64)
+        start = np.empty(p, np.float64)
+        rcpu = np.empty(p, np.int64)
+        rmem = np.empty(p, np.int64)
+        reph = np.empty(p, np.int64)
+        rscalar = np.empty(p, bool)
+        aterms = np.empty(p, bool)
+        ports = np.empty(p, bool)
         names = []
         nsv, kvoc = self._pt_ns_vocab, self._pt_key_vocab
+        cr_memo = self._cr_memo
         for j, pd in enumerate(pods):
             nid = nsv.get(pd.namespace)
             if nid is None:
@@ -302,6 +351,18 @@ class NodeStateEncoder:
             ns[j] = nid
             deleted[j] = pd.deleted
             has_aff[j] = id(pd) in aff_ids
+            prio[j] = pd.priority
+            start[j] = pd.start_time if pd.start_time is not None else np.inf
+            key = pd.containers
+            got = cr_memo.get(key)
+            if got is None:
+                from kubernetes_tpu.cache.node_info import calculate_resource
+                r = calculate_resource(pd)
+                got = cr_memo[key] = (r.milli_cpu, r.memory,
+                                      r.ephemeral_storage, bool(r.scalar),
+                                      bool(get_container_ports(pd)))
+            rcpu[j], rmem[j], reph[j], rscalar[j], ports[j] = got
+            aterms[j] = has_pod_affinity_terms(pd)
             names.append(pd.node_name)
             for l, (k, v) in enumerate(pd.labels.items()):
                 kk = kvoc.get(k)
@@ -309,7 +370,8 @@ class NodeStateEncoder:
                     kk = kvoc[k] = len(kvoc)
                 kid[j, l] = kk
                 vid[j, l] = self._pt_val_id(v)
-        return (pods, ns, kid, vid, deleted, has_aff, names)
+        return (pods, ns, kid, vid, deleted, has_aff, names,
+                (prio, start, rcpu, rmem, reph, rscalar, aterms, ports))
 
     def pod_table(self, node_infos: dict[str, NodeInfo],
                   b: NodeBatch) -> "PodTable":
@@ -322,15 +384,25 @@ class NodeStateEncoder:
         consumer builds it."""
         blocks = []
         new_cache = {}
+        all_hit = True
         for name, ni in node_infos.items():
             cached = self._pt_blocks.get(name)
             if cached is not None and cached[0] == ni.generation:
                 blk = cached[1]
             else:
                 blk = self._pt_block(ni)
+                all_hit = False
             new_cache[name] = (ni.generation, blk)
             blocks.append((name, blk))
+        if len(new_cache) != len(self._pt_blocks):
+            all_hit = False              # a node left or joined the snapshot
         self._pt_blocks = new_cache   # prunes nodes that left the snapshot
+        key = (id(b), len(blocks))
+        if all_hit and self._pt_built is not None \
+                and self._pt_built_key == key:
+            # no block re-extracted against the same batch: the assembled
+            # arrays are bit-identical — reuse them
+            return self._pt_built
         total = sum(len(blk[0]) for _, blk in blocks)
         lmax = max((blk[2].shape[1] for _, blk in blocks if len(blk[0])),
                    default=1)
@@ -343,9 +415,17 @@ class NodeStateEncoder:
         has_aff = np.empty(total, bool)
         key_ids = np.full((total, lmax), -1, np.int32)
         val_ids = np.full((total, lmax), -1, np.int32)
+        prio = np.empty(total, np.int64)
+        start = np.empty(total, np.float64)
+        res_cpu = np.empty(total, np.int64)
+        res_mem = np.empty(total, np.int64)
+        res_eph = np.empty(total, np.int64)
+        has_scalar = np.empty(total, bool)
+        has_aff_terms = np.empty(total, bool)
+        has_ports = np.empty(total, bool)
         off = 0
         for name, blk in blocks:
-            bpods, ns, kid, vid, dele, haff, names = blk
+            bpods, ns, kid, vid, dele, haff, names, vcols = blk
             p = len(bpods)
             if not p:
                 continue
@@ -359,19 +439,162 @@ class NodeStateEncoder:
             has_aff[sl] = haff
             key_ids[sl, : kid.shape[1]] = kid
             val_ids[sl, : vid.shape[1]] = vid
+            (prio[sl], start[sl], res_cpu[sl], res_mem[sl], res_eph[sl],
+             has_scalar[sl], has_aff_terms[sl], has_ports[sl]) = vcols
             for j, nm in enumerate(names):
                 if nm == name:
                     name_row[off + j] = hrow
                 elif nm in node_infos:
                     name_row[off + j] = b.index.get(nm, -1)
             off += p
-        return PodTable(
+        out = PodTable(
             pods=pods, holder_row=holder_row, holder_has_obj=holder_has_obj,
             name_row=name_row, has_affinity=has_aff, deleted=deleted,
             ns_id=ns_id, key_ids=key_ids, val_ids=val_ids,
             ns_vocab=self._pt_ns_vocab, key_vocab=self._pt_key_vocab,
             val_vocab=self._pt_val_vocab,
-            val_ints=np.asarray(self._pt_val_ints, dtype=np.float64))
+            val_ints=np.asarray(self._pt_val_ints, dtype=np.float64),
+            prio=prio, start=start, res_cpu=res_cpu, res_mem=res_mem,
+            res_eph=res_eph, has_scalar=has_scalar,
+            has_aff_terms=has_aff_terms, has_ports=has_ports)
+        self._pt_built = out
+        self._pt_built_key = key
+        return out
+
+    # -- persistent victim table --------------------------------------------
+    def victim_table(self, node_infos: dict[str, NodeInfo], b: NodeBatch,
+                     pdbs: list, cap: int = 128) -> VictimStack:
+        """Build/refresh the persistent [N, P] victim table against `b`.
+
+        Incremental exactly like encode(): only nodes whose NodeInfo
+        generation moved since the last call re-sort their slots — one
+        vectorized np.lexsort over the dirty nodes' pod-table rows replaces
+        the per-node Python `importance_key` sorts of the old per-scan
+        encode. A PDB-set change (object identity or disruptionsAllowed)
+        dirties every node, since the violating flags feed the sort key.
+        The NodeTree rotation case never lands here: encode()'s permute
+        branch reorders the victim rows with the mirror rows.
+
+        Assumed pods arrive through the cache's generation bump (the
+        note_assumed hooks deliberately do NOT sync `_vt_gens`, unlike the
+        aggregate mirror: the mirror gets the delta applied manually, the
+        victim table needs the new pod's row — so the next call here
+        re-extracts exactly the bound-to nodes)."""
+        t = self.pod_table(node_infos, b)
+        pdb_key = tuple(sorted(
+            (id(p), p.namespace, int(p.disruptions_allowed),
+             p.selector is None) for p in pdbs))
+        n_pad = b.n_pad
+        hr = t.holder_row
+        on_axis = hr >= 0
+        counts = np.bincount(hr[on_axis], minlength=n_pad).astype(np.int64)
+        maxp = int(counts.max()) if counts.size else 0
+        P = min(_pad_capacity(max(maxp, 1), 8), cap)
+        vt = self._vt
+        if vt is not None and vt.valid.shape[0] == n_pad:
+            P = max(P, vt.P)   # never shrink: avoids rebuild thrash
+        if vt is None or vt.P != P or vt.valid.shape[0] != n_pad:
+            zeros2 = lambda dt: np.zeros((n_pad, P), dtype=dt)
+            vt = VictimStack(
+                P=P, cpu=zeros2(np.int64), mem=zeros2(np.int64),
+                eph=zeros2(np.int64), prio=zeros2(np.int64),
+                start=np.full((n_pad, P), np.inf, np.float64),
+                valid=zeros2(bool), viol=zeros2(bool), aff=zeros2(bool),
+                ports=zeros2(bool), scalar=zeros2(bool),
+                count=np.zeros(n_pad, np.int64),
+                overflow=np.zeros(n_pad, bool),
+                slots={}, table=t, dirty_rows=None)
+            self._vt = vt
+            self._vt_gens = {}
+            self._vt_pdb_key = None
+            VICTIM_REBUILDS.inc()
+        vt.table = t
+        if pdb_key != self._vt_pdb_key:
+            # the violating flags are part of the sort key: re-sort all
+            self._vt_gens = {}
+            self._vt_pdb_key = pdb_key
+        gens = self._vt_gens
+        dirty = []
+        for i, name in enumerate(b.names):
+            g = node_infos[name].generation
+            if gens.get(name) != g:
+                gens[name] = g
+                dirty.append(i)
+        if not dirty:
+            return vt
+        VICTIM_ROW_RESORTS.inc(len(dirty))
+        d = np.asarray(dirty, np.int64)
+        # reset the dirty rows, then scatter the re-sorted slots
+        for f in ("cpu", "mem", "eph", "prio"):
+            getattr(vt, f)[d] = 0
+        vt.start[d] = np.inf
+        for f in ("valid", "viol", "aff", "ports", "scalar"):
+            getattr(vt, f)[d] = False
+        vt.count[d] = counts[d]
+        vt.overflow[d] = counts[d] > P
+        for i in dirty:
+            vt.slots[b.names[i]] = []
+        is_dirty = np.zeros(n_pad, bool)
+        is_dirty[d] = True
+        rows = np.flatnonzero(on_axis & is_dirty[np.where(on_axis, hr, 0)])
+        if rows.size:
+            from kubernetes_tpu.oracle.preemption import \
+                pods_violating_pdbs_mask
+            viol = pods_violating_pdbs_mask(t, pdbs)[rows] if pdbs \
+                else np.zeros(rows.size, bool)
+            holder = hr[rows].astype(np.int64)
+            # reprieve processing order per node in ONE stable lexsort
+            # (last key is primary): group by node row, violating first,
+            # then descending importance = priority desc, start asc —
+            # np.lexsort is stable, so ties keep ni.pods order exactly
+            # like the old per-node Python sort
+            order = np.lexsort((t.start[rows], -t.prio[rows],
+                                (~viol).astype(np.int8), holder))
+            sr = rows[order]
+            h = holder[order]
+            viol_s = viol[order]
+            newgrp = np.r_[True, h[1:] != h[:-1]]
+            gstart = np.flatnonzero(newgrp)
+            slot = np.arange(len(h)) - gstart[np.cumsum(newgrp) - 1]
+            keep = slot < P
+            hs, ss = h[keep], slot[keep]
+            ks = sr[keep]
+            vt.cpu[hs, ss] = t.res_cpu[ks]
+            vt.mem[hs, ss] = t.res_mem[ks]
+            vt.eph[hs, ss] = t.res_eph[ks]
+            vt.prio[hs, ss] = t.prio[ks]
+            vt.start[hs, ss] = t.start[ks]
+            vt.valid[hs, ss] = True
+            vt.viol[hs, ss] = viol_s[keep]
+            vt.aff[hs, ss] = t.has_aff_terms[ks]
+            vt.ports[hs, ss] = t.has_ports[ks]
+            vt.scalar[hs, ss] = t.has_scalar[ks]
+            pods_list = t.pods
+            names_list = b.names
+            slots = vt.slots
+            for r, hi in zip(ks.tolist(), hs.tolist()):
+                slots[names_list[hi]].append(pods_list[r])
+        if vt.dirty_rows is not None:
+            vt.dirty_rows.extend(dirty)
+        return vt
+
+    def _vt_permute(self, b_old: NodeBatch, node_order: list[str],
+                    n_real: int) -> None:
+        """Reorder the victim table to a rotated enumeration of the same
+        node set — one gather per plane, mirroring _permuted. Row positions
+        moved, so the device copy needs a full re-upload (dirty_rows=None);
+        slot content and the name-keyed slots/generation maps stay valid."""
+        vt = self._vt
+        if vt is None:
+            return
+        perm = np.fromiter((b_old.index[nm] for nm in node_order), np.int64,
+                           n_real)
+        for f in VictimStack._ROW_FIELDS:
+            arr = getattr(vt, f)
+            out = arr.copy()
+            out[:n_real] = arr[perm]
+            setattr(vt, f, out)
+        vt.dirty_rows = None
 
     def note_assumed(self, b: NodeBatch, node_name: str, pod: Pod,
                      generation: Optional[int] = None,
@@ -483,6 +706,54 @@ class PodTable:
     key_vocab: dict
     val_vocab: dict
     val_ints: np.ndarray        # [V] f64 parsed-integer value (NaN unparseable)
+    # victim columns (cached per node generation in the same blocks): the
+    # facts preemption reads about every snapshot pod, so a victim scan
+    # never re-derives them per pod
+    prio: np.ndarray = None          # [P] i64 pod priority
+    start: np.ndarray = None         # [P] f64 start time (+inf when None)
+    res_cpu: np.ndarray = None       # [P] i64 calculate_resource milli-CPU
+    res_mem: np.ndarray = None       # [P] i64 bytes
+    res_eph: np.ndarray = None       # [P] i64 bytes
+    has_scalar: np.ndarray = None    # [P] bool — extended resources requested
+    has_aff_terms: np.ndarray = None  # [P] bool — any pod (anti-)affinity term
+    has_ports: np.ndarray = None     # [P] bool — declares container ports
+
+
+@dataclass
+class VictimStack:
+    """Persistent [N, P] victim table: every snapshot pod in its node's
+    reprieve processing order (PDB-violating first, each group by descending
+    importance — oracle.preemption.select_victims_on_node), maintained
+    incrementally alongside the node mirror instead of re-encoded per scan.
+
+    Slots hold ALL pods (not just one preemptor's potential victims): the
+    sort key (violating, -priority, start) is priority-monotone, so masking
+    to `prio < max_prio` on device preserves the per-preemptor reprieve
+    order exactly — one table serves every preemptor priority. The
+    inertness-class flag planes (aff/ports/scalar) make the eligibility
+    gates O(1) mask reads instead of per-pod Python, and `dirty_rows` feeds
+    the device mirror's sparse re-upload exactly like NodeBatch."""
+    P: int                      # slot bucket (power of two, <= kernel cap)
+    cpu: np.ndarray             # [N, P] i64 calculate_resource milli-CPU
+    mem: np.ndarray             # [N, P] i64
+    eph: np.ndarray             # [N, P] i64
+    prio: np.ndarray            # [N, P] i64
+    start: np.ndarray           # [N, P] f64 (+inf padding)
+    valid: np.ndarray           # [N, P] bool
+    viol: np.ndarray            # [N, P] bool — PDB-violating
+    aff: np.ndarray             # [N, P] bool — pod carries affinity terms
+    ports: np.ndarray           # [N, P] bool — pod declares container ports
+    scalar: np.ndarray          # [N, P] bool — pod requests scalar resources
+    count: np.ndarray           # [N] i64 total pods on the node
+    overflow: np.ndarray        # [N] bool — count exceeded the slot cap
+    slots: dict                 # node name -> ordered slot Pod list
+    table: PodTable             # the pod table the rows were built from
+    # rows rewritten since the device mirror last consumed the list;
+    # None = full re-upload required (rebuild or permute)
+    dirty_rows: Optional[list] = None
+
+    _ROW_FIELDS = ("cpu", "mem", "eph", "prio", "start", "valid", "viol",
+                   "aff", "ports", "scalar", "count", "overflow")
 
 
 def build_pod_table(node_infos: dict[str, NodeInfo], b: NodeBatch) -> PodTable:

@@ -379,6 +379,18 @@ def pod_matches_term_props_mask(defining_pod: Pod, term, table) -> np.ndarray:
     return m & selector_match_mask(term.label_selector, table)
 
 
+def pod_matches_any_term_mask(defining_pod: Pod, terms, table) -> np.ndarray:
+    """[P] bool: table rows matching ANY of `defining_pod`'s terms — the
+    vectorized twin of `any(pod_matches_term_props(p, defining_pod, t) for
+    t in terms)` per row. The preemption inertness gate uses this to find
+    potential victims whose removal would change the incoming pod's
+    (anti-)affinity masks."""
+    m = np.zeros(len(table.pods), dtype=bool)
+    for term in terms:
+        m |= pod_matches_term_props_mask(defining_pod, term, table)
+    return m
+
+
 class InterPodAffinityChecker:
     """MatchInterPodAffinity over a full snapshot {node name -> NodeInfo}.
 

@@ -102,6 +102,28 @@ def pods_violating_pdbs(pods: list[Pod],
     return violating
 
 
+def pods_violating_pdbs_mask(table, pdbs: list[PodDisruptionBudget]) -> "np.ndarray":
+    """[P] bool twin of pods_violating_pdbs over a columnar pod table
+    (ops.node_state.PodTable, duck-typed like the predicates matchers): one
+    selector mask per PDB instead of a Python loop per (pod, pdb) pair.
+    Must stay bit-identical to a row-by-row scalar evaluation — the victim
+    table's reprieve ordering sorts on these flags, so a divergence here is
+    a preemption-decision divergence (pinned by the PDB mask parity
+    fuzzes)."""
+    import numpy as np
+    from kubernetes_tpu.oracle.predicates import selector_match_mask
+    viol = np.zeros(len(table.pods), dtype=bool)
+    for pdb in pdbs:
+        if pdb.selector is None or pdb.disruptions_allowed > 0:
+            continue
+        nsid = table.ns_vocab.get(pdb.namespace)
+        if nsid is None:
+            continue
+        viol |= (table.ns_id == nsid) & selector_match_mask(pdb.selector,
+                                                            table)
+    return viol
+
+
 def select_victims_on_node(pod: Pod, node_info: NodeInfo,
                            fits_fn: Callable[[Pod, NodeInfo], bool],
                            pdbs: list[PodDisruptionBudget],

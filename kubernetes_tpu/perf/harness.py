@@ -200,6 +200,115 @@ def _drain(sched: Scheduler, cfg: PerfConfig) -> None:
         pass
 
 
+def run_preempt_cell(n_nodes: int, n_victims: int,
+                     n_preemptors: int = 128) -> dict:
+    """Preemption pressure-wave cell (BASELINE configs[3]): `n_preemptors`
+    failed pods run as ONE schedule-else-preempt launch on the device
+    (kernels.pressure_batch) against `n_victims` lower-priority pods spread
+    over `n_nodes`, vs the serial oracle doing the same work per pod (the
+    reference fans selectVictimsOnNode over 16 goroutines PER pod,
+    generic_scheduler.go:996). The device side runs with a WARM persistent
+    victim table (TPUScheduler.prewarm_preempt) — the steady-state
+    condition, since production scans ride a table maintained incrementally
+    across cycles — and reports the residual per-wave encode vs device-scan
+    phase split. Decisions are asserted identical before timing is
+    reported; returns {scans_per_s, vs_oracle, device_seconds,
+    oracle_seconds, encode_seconds, scan_seconds, preemptors}."""
+    import time as _t
+    from kubernetes_tpu.api.types import Pod, Node, Container
+    from kubernetes_tpu.cache.node_info import NodeInfo
+    from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+    from kubernetes_tpu.oracle import predicates as preds
+    from kubernetes_tpu.oracle.generic_scheduler import (FitError,
+                                                         GenericScheduler)
+    from kubernetes_tpu.oracle.preemption import Preemptor
+    GI = 1024 ** 3
+    per_node = max(1, n_victims // n_nodes)
+    cpu_each = 4000 // per_node
+    infos = {}
+    names = []
+    uid = 0
+    for i in range(n_nodes):
+        node = Node(name=f"node-{i}",
+                    allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110})
+        ni = NodeInfo(node)
+        for _ in range(per_node):
+            uid += 1
+            p = Pod(name=f"victim-{uid}", priority=1, node_name=node.name,
+                    containers=(Container.make(
+                        name="c", requests={"cpu": cpu_each}),))
+            ni.add_pod(p)
+        infos[node.name] = ni
+        names.append(node.name)
+    preemptors = [Pod(name=f"hi-{k}", priority=10, containers=(
+        Container.make(name="c", requests={"cpu": cpu_each}),))
+        for k in range(n_preemptors)]
+
+    def device_wave(tpu):
+        out = tpu.preempt_pressure_burst(preemptors, infos, names, [])
+        assert out is not None
+        return out
+
+    device_wave(TPUScheduler(percentage_of_nodes_to_score=100))  # compile
+    tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+    tpu.prewarm_preempt(infos, names, [])   # steady-state victim table
+    t0 = _t.perf_counter()
+    got = device_wave(tpu)
+    dev = _t.perf_counter() - t0
+
+    def oracle_wave():
+        # the serial referee: schedule-else-preempt with nominated ghosts,
+        # successes folded — normalized to the same outcome tuples the
+        # device wave returns (a fit-able nodes/pods ratio must compare,
+        # not crash)
+        nominated: dict = {}
+        nom_fn = lambda n: list(nominated.get(n, []))
+        g = GenericScheduler(percentage_of_nodes_to_score=100,
+                             nominated_pods_fn=nom_fn)
+        world = dict(infos)
+        out = []
+        for pod in preemptors:
+            funcs = preds.default_predicate_set(world)
+            try:
+                r = g.schedule(pod, world, names, predicate_funcs=funcs)
+            except FitError as err:
+                res = Preemptor().preempt(pod, world, names, err,
+                                          nominated_pods_fn=nom_fn)
+                if res.node is None:
+                    out.append(("failed", not res.nominated_to_clear))
+                    continue
+                ghost = pod.clone()
+                ghost.node_name = res.node.name
+                nominated.setdefault(res.node.name, []).append(ghost)
+                out.append(("nominated", res.node.name,
+                            sorted(v.name for v in res.victims)))
+                continue
+            assumed = pod.clone()
+            assumed.node_name = r.suggested_host
+            ni = world[r.suggested_host].clone()
+            ni.add_pod(assumed)
+            world = {**world, r.suggested_host: ni}
+            out.append(("bound", r.suggested_host))
+        return out
+
+    t0 = _t.perf_counter()
+    want = oracle_wave()
+    ora = _t.perf_counter() - t0
+    norm = [("nominated", o[1], sorted(v.name for v in o[2]))
+            if o[0] == "nominated" else o for o in got]
+    assert norm == want, f"device/oracle preempt divergence: {norm} != {want}"
+    phases = tpu.last_preempt_phases or {}
+    return {
+        "scans_per_s": round(n_preemptors / dev, 2),
+        "vs_oracle": round(ora / dev, 2),
+        "device_seconds": round(dev, 4),
+        "oracle_seconds": round(ora, 4),
+        "encode_seconds": round(phases.get("encode", 0.0), 4),
+        "scan_seconds": round(phases.get("scan", 0.0), 4),
+        "preemptors": n_preemptors,
+    }
+
+
 # the benchmark matrices (scheduler_bench_test.go:40-118)
 BENCHMARK_MATRIX = {
     "plain": [(100, 0), (100, 1000), (1000, 0), (1000, 1000), (5000, 1000)],
@@ -208,6 +317,10 @@ BENCHMARK_MATRIX = {
     "node-affinity": [(500, 250), (500, 5000), (1000, 1000), (5000, 1000)],
     # gang (PodGroup) cells: (nodes, gang_size) — run via run_gang_cell
     "gang": [(1000, 8), (1000, 64), (5000, 512)],
+    # preemption pressure cells: (nodes, victims, preemptors-per-wave) —
+    # run via run_preempt_cell (warm victim table, one launch per wave;
+    # 128 = one full PRESSURE_B_CAP chunk, the throughput configuration)
+    "preempt": [(1000, 10000, 16), (1000, 10000, 128)],
 }
 
 

@@ -421,3 +421,108 @@ class TestAPIServerMetricsE2E:
                 deadline -= 1
             assert ACTIVE_WATCHES.labels(NODES).value >= 1
             w.stop()
+
+
+class TestVictimGateReasonLabels:
+    """The old single victims-not-inert fallback counter is split per gate
+    reason (round 9): every reason the victim-table eligibility check can
+    refuse for gets its own label, in BOTH preempt (tpu_oracle_fallback_total
+    {reason=preempt-victims-*}) and preempt_pressure_burst
+    (tpu_pressure_gate_rejections_total{gate=victims-*})."""
+
+    EXPECTED = {"affinity-terms", "ports", "scalar", "term-match", "overflow"}
+
+    def _snapshot(self, victim):
+        from kubernetes_tpu.api.types import Node
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        node = Node(name="n0", allocatable={"cpu": 1000,
+                                            "memory": 8 * 1024 ** 3,
+                                            "pods": 200})
+        ni = NodeInfo(node)
+        victim.node_name = "n0"
+        ni.add_pod(victim)
+        return {"n0": ni}
+
+    def _preempt(self, incoming, infos):
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        from kubernetes_tpu.oracle.generic_scheduler import FitError
+        err = FitError(incoming, 1, {"n0": ["InsufficientResource:cpu"]})
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        return tpu.preempt(incoming, infos, ["n0"], err, [])
+
+    def test_label_set_and_per_reason_fires(self):
+        from kubernetes_tpu.api.types import (
+            Pod, Container, ContainerPort, Affinity, PodAntiAffinity,
+            PodAffinityTerm, LabelSelector, LABEL_HOSTNAME)
+        from kubernetes_tpu.core.tpu_scheduler import (
+            ORACLE_FALLBACKS, PRESSURE_GATES, TPUScheduler,
+            VICTIM_GATE_REASONS)
+        assert set(VICTIM_GATE_REASONS) == self.EXPECTED
+
+        def mk(name, cpu=1000, priority=0, **kw):
+            return Pod(name=name, priority=priority, containers=(
+                Container.make(name="c", requests={"cpu": cpu},
+                               **kw.pop("cmake", {})),), **kw)
+
+        anti = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=(PodAffinityTerm(
+                label_selector=LabelSelector(match_labels=(("a", "b"),)),
+                topology_key=LABEL_HOSTNAME),)))
+
+        def fired(child):
+            before = child.value
+            return lambda: child.value - before
+
+        # affinity-terms: the potential victim carries required terms
+        v = mk("v", priority=0)
+        v.affinity = anti
+        d = fired(ORACLE_FALLBACKS.labels("preempt-victims-affinity-terms"))
+        assert self._preempt(mk("hi", priority=9), self._snapshot(v)) is None
+        assert d() == 1
+        # ports: incoming pod wants a host port a victim also declares
+        ports = (ContainerPort(host_port=8080, container_port=8080),)
+        vp = Pod(name="v", priority=0, containers=(
+            Container.make(name="c", requests={"cpu": 1000}, ports=ports),))
+        hip = Pod(name="hi", priority=9, containers=(
+            Container.make(name="c", requests={"cpu": 1000}, ports=ports),))
+        d = fired(ORACLE_FALLBACKS.labels("preempt-victims-ports"))
+        assert self._preempt(hip, self._snapshot(vp)) is None
+        assert d() == 1
+        # scalar: the victim requests an extended resource
+        vs = Pod(name="v", priority=0, containers=(
+            Container.make(name="c", requests={"cpu": 1000,
+                                               "example.com/gpu": 1}),))
+        d = fired(ORACLE_FALLBACKS.labels("preempt-victims-scalar"))
+        assert self._preempt(mk("hi", priority=9), self._snapshot(vs)) is None
+        assert d() == 1
+        # term-match: a victim matches the incoming pod's required term
+        vt = mk("v", priority=0, labels={"a": "b"})
+        hit = mk("hi", priority=9)
+        hit.affinity = anti
+        d = fired(ORACLE_FALLBACKS.labels("preempt-victims-term-match"))
+        assert self._preempt(hit, self._snapshot(vt)) is None
+        assert d() == 1
+        # overflow: more pods on a candidate node than the slot cap
+        from kubernetes_tpu.api.types import Node
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        from kubernetes_tpu.ops.kernels import PREEMPT_P
+        node = Node(name="n0", allocatable={"cpu": 300000,
+                                            "memory": 8 * 1024 ** 3,
+                                            "pods": 500})
+        ni = NodeInfo(node)
+        for i in range(PREEMPT_P + 1):
+            p = mk(f"v{i}", cpu=1, priority=0)
+            p.node_name = "n0"
+            ni.add_pod(p)
+        d = fired(ORACLE_FALLBACKS.labels("preempt-victims-overflow"))
+        assert self._preempt(mk("hi", cpu=300000, priority=9),
+                             {"n0": ni}) is None
+        assert d() == 1
+        # the pressure path increments its own per-reason gate family
+        v2 = mk("v", priority=0)
+        v2.affinity = anti
+        d = fired(PRESSURE_GATES.labels("victims-affinity-terms"))
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        assert tpu.preempt_pressure_burst(
+            [mk("hi", priority=9)], self._snapshot(v2), ["n0"], []) is None
+        assert d() == 1

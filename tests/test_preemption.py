@@ -14,7 +14,8 @@ from kubernetes_tpu.oracle.generic_scheduler import GenericScheduler, FitError
 from kubernetes_tpu.oracle.preemption import (
     Victims, Preemptor, select_victims_on_node, pick_one_node_for_preemption,
     nodes_where_preemption_might_help, pod_eligible_to_preempt_others,
-    pod_fits_on_node_with_nominated,
+    pod_fits_on_node_with_nominated, pods_violating_pdbs,
+    pods_violating_pdbs_mask, importance_key,
 )
 
 GI = 1024 ** 3
@@ -705,3 +706,244 @@ class TestPressureBatchParity:
             pres.sort(key=lambda p: -p.priority)
             self._compare_batch(pres, infos, [n.name for n in nodes], pdbs,
                                 msg=f"trial={trial}")
+
+
+# ---------------------------------------------------------------------------
+# PDB mask twin + persistent victim table (round 9)
+# ---------------------------------------------------------------------------
+def _pod_table(infos, names):
+    from kubernetes_tpu.ops.node_state import NodeStateEncoder
+    enc = NodeStateEncoder()
+    b = enc.encode(infos, names)
+    return enc.pod_table(infos, b), b, enc
+
+
+def _pdb(name, ns="default", allowed=0, sel=None):
+    return PodDisruptionBudget(name=name, namespace=ns,
+                               disruptions_allowed=allowed, selector=sel)
+
+
+class TestPDBMaskParity:
+    """pods_violating_pdbs_mask — the vectorized sort-key input of the
+    persistent victim table — pinned row-by-row against the scalar
+    pods_violating_pdbs it twins. A divergence here IS a preemption
+    decision divergence (the reprieve order sorts on these flags)."""
+
+    def _assert_rows(self, infos, names, pdbs):
+        t, _b, _enc = _pod_table(infos, names)
+        got = pods_violating_pdbs_mask(t, pdbs)
+        want_set = {id(p) for p in pods_violating_pdbs(t.pods, pdbs)}
+        want = [id(p) in want_set for p in t.pods]
+        assert got.tolist() == want, (got.tolist(), want)
+
+    def test_empty_selector_matches_everything(self):
+        # an empty LabelSelector matches every pod in the namespace
+        nodes = [mknode("n0")]
+        infos = snapshot(nodes, {"n0": [mkpod("a", labels={"app": "db"}),
+                                        mkpod("b")]})
+        self._assert_rows(infos, ["n0"], [_pdb("p", sel=LabelSelector())])
+
+    def test_zero_disruptions_allowed_required(self):
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        nodes = [mknode("n0")]
+        infos = snapshot(nodes, {"n0": [mkpod("a", labels={"app": "db"})]})
+        # allowance left -> nobody violates; exhausted -> the match violates
+        self._assert_rows(infos, ["n0"], [_pdb("p", allowed=1, sel=sel)])
+        self._assert_rows(infos, ["n0"], [_pdb("p", allowed=0, sel=sel)])
+        self._assert_rows(infos, ["n0"], [_pdb("p", allowed=-1, sel=sel)])
+
+    def test_pod_matched_by_two_pdbs(self):
+        # one exhausted + one with allowance: violating either way the
+        # scalar loop breaks — the OR must agree
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        nodes = [mknode("n0")]
+        infos = snapshot(nodes, {"n0": [mkpod("a", labels={"app": "db"})]})
+        self._assert_rows(infos, ["n0"], [_pdb("x", allowed=0, sel=sel),
+                                          _pdb("y", allowed=1, sel=sel)])
+        self._assert_rows(infos, ["n0"], [_pdb("x", allowed=1, sel=sel),
+                                          _pdb("y", allowed=0, sel=sel)])
+        self._assert_rows(infos, ["n0"], [_pdb("x", allowed=0, sel=sel),
+                                          _pdb("y", allowed=0, sel=sel)])
+
+    def test_already_violating_victim_and_ns_mismatch(self):
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        other = LabelSelector(match_labels=(("app", "web"),))
+        nodes = [mknode("n0")]
+        viol = mkpod("v", labels={"app": "db"})
+        infos = snapshot(nodes, {"n0": [viol, mkpod("w", labels={"app": "web"})]})
+        # the already-violating pod stays violating when later PDBs also
+        # match it; namespace-mismatched PDBs contribute nothing
+        self._assert_rows(infos, ["n0"], [
+            _pdb("x", allowed=0, sel=sel),
+            _pdb("y", allowed=0, sel=LabelSelector()),
+            _pdb("z", ns="kube-system", allowed=0, sel=other)])
+
+    def test_selector_none_never_matches(self):
+        nodes = [mknode("n0")]
+        infos = snapshot(nodes, {"n0": [mkpod("a", labels={"app": "db"})]})
+        self._assert_rows(infos, ["n0"], [_pdb("p", allowed=0, sel=None)])
+
+    def test_fuzz_row_by_row(self):
+        import random
+        from kubernetes_tpu.api.types import (
+            Requirement, IN, NOT_IN, EXISTS, DOES_NOT_EXIST)
+        rng = random.Random(20260804)
+        KEYS = ["app", "tier", "size"]
+        VALS = ["web", "db", "7", ""]
+        NSS = ["default", "kube-system", "team-a"]
+        for trial in range(30):
+            nodes = [mknode(f"n{i}") for i in range(rng.randint(1, 5))]
+            by_node = {}
+            uid = 0
+            for n in nodes:
+                pods = []
+                for _ in range(rng.randint(0, 6)):
+                    uid += 1
+                    labels = {k: rng.choice(VALS)
+                              for k in rng.sample(KEYS, rng.randint(0, 3))}
+                    p = mkpod(f"p{uid}", labels=labels)
+                    p.namespace = rng.choice(NSS)
+                    pods.append(p)
+                by_node[n.name] = pods
+            infos = snapshot(nodes, by_node)
+            pdbs = []
+            for b in range(rng.randint(0, 4)):
+                kind = rng.random()
+                if kind < 0.2:
+                    sel = None
+                elif kind < 0.4:
+                    sel = LabelSelector()
+                elif kind < 0.7:
+                    sel = LabelSelector(match_labels=tuple(
+                        (k, rng.choice(VALS))
+                        for k in rng.sample(KEYS, rng.randint(1, 2))))
+                else:
+                    sel = LabelSelector(match_expressions=(Requirement(
+                        key=rng.choice(KEYS),
+                        op=rng.choice([IN, NOT_IN, EXISTS, DOES_NOT_EXIST]),
+                        values=tuple(rng.sample(VALS, rng.randint(1, 2)))),))
+                pdbs.append(_pdb(f"b{b}", ns=rng.choice(NSS),
+                                 allowed=rng.randint(-1, 1), sel=sel))
+            self._assert_rows(infos, [n.name for n in nodes], pdbs)
+
+
+class TestVictimTableCache:
+    """The persistent victim table: reprieve-order parity with the
+    per-node Python sort, generation-keyed invalidation (bind/assume/
+    delete), PDB-set invalidation, and rotation-permute alignment."""
+
+    def _expected_order(self, ni, pdbs):
+        pots = list(ni.pods)
+        violating = {p.uid for p in pods_violating_pdbs(pots, pdbs)}
+        pots.sort(key=lambda p: (0 if p.uid in violating else 1,
+                                 importance_key(p)))
+        return [p.name for p in pots]
+
+    def _vt(self, enc, infos, names, pdbs):
+        b = enc.encode(infos, names)
+        return enc.victim_table(infos, b, pdbs), b
+
+    def test_reprieve_order_matches_python_sort(self):
+        import random
+        from kubernetes_tpu.ops.node_state import NodeStateEncoder
+        rng = random.Random(42)
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        pdbs = [_pdb("b", allowed=0, sel=sel)]
+        nodes = [mknode(f"n{i}") for i in range(4)]
+        by_node = {}
+        uid = 0
+        for n in nodes:
+            pods = []
+            for _ in range(rng.randint(0, 7)):
+                uid += 1
+                pods.append(mkpod(
+                    f"p{uid}", priority=rng.randint(0, 5),
+                    labels={"app": rng.choice(["db", "web"])},
+                    start=rng.choice([None, float(rng.randint(1, 50))])))
+            by_node[n.name] = pods
+        infos = snapshot(nodes, by_node)
+        names = [n.name for n in nodes]
+        enc = NodeStateEncoder()
+        vt, b = self._vt(enc, infos, names, pdbs)
+        for name in names:
+            assert [p.name for p in vt.slots[name]] == \
+                self._expected_order(infos[name], pdbs), name
+            i = b.index[name]
+            row = [vt.prio[i, j] for j in range(int(vt.count[i]))]
+            assert all(vt.valid[i, : int(vt.count[i])])
+            assert not vt.valid[i, int(vt.count[i]):].any()
+            assert row == [p.priority for p in vt.slots[name]]
+
+    def test_generation_dirty_row_invalidation(self):
+        from kubernetes_tpu.ops.node_state import NodeStateEncoder
+        nodes = [mknode("n0"), mknode("n1")]
+        infos = snapshot(nodes, {"n0": [mkpod("a", priority=1)],
+                                 "n1": [mkpod("b", priority=2)]})
+        enc = NodeStateEncoder()
+        vt, b = self._vt(enc, infos, ["n0", "n1"], [])
+        vt.dirty_rows = []          # device mirror consumed the full upload
+        # steady state: no re-sort, no dirty rows
+        vt2, _ = self._vt(enc, infos, ["n0", "n1"], [])
+        assert vt2 is vt and vt2.dirty_rows == []
+        # an assumed/bound pod bumps the generation -> exactly that row
+        # re-sorts and lands in dirty_rows
+        newpod = mkpod("c", priority=0, start=3.0)
+        newpod.node_name = "n1"
+        infos["n1"].add_pod(newpod)
+        vt3, b3 = self._vt(enc, infos, ["n0", "n1"], [])
+        assert vt3.dirty_rows == [b3.index["n1"]]
+        assert [p.name for p in vt3.slots["n1"]] == \
+            self._expected_order(infos["n1"], [])
+        # delete invalidates the same way
+        vt3.dirty_rows = []
+        infos["n1"].remove_pod(newpod)
+        vt4, b4 = self._vt(enc, infos, ["n0", "n1"], [])
+        assert vt4.dirty_rows == [b4.index["n1"]]
+        assert [p.name for p in vt4.slots["n1"]] == ["b"]
+
+    def test_pdb_set_change_resorts_all(self):
+        from kubernetes_tpu.ops.node_state import NodeStateEncoder
+        sel = LabelSelector(match_labels=(("app", "db"),))
+        nodes = [mknode("n0")]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("hi", priority=5, labels={"app": "db"}),
+                   mkpod("lo", priority=0)]})
+        enc = NodeStateEncoder()
+        vt, _ = self._vt(enc, infos, ["n0"], [])
+        assert [p.name for p in vt.slots["n0"]] == ["hi", "lo"]
+        # exhausted PDB matching "hi": violating sorts FIRST now
+        vt2, _ = self._vt(enc, infos, ["n0"], [_pdb("b", allowed=0, sel=sel)])
+        assert [p.name for p in vt2.slots["n0"]] == ["hi", "lo"]
+        assert vt2.viol[0, 0] and not vt2.viol[0, 1]
+        # violating flag reorders when the non-violating pod is MORE
+        # important
+        infos2 = snapshot([mknode("m0")], {
+            "m0": [mkpod("big", priority=9),
+                   mkpod("db", priority=0, labels={"app": "db"})]})
+        enc2 = NodeStateEncoder()
+        vt3, _ = self._vt(enc2, infos2, ["m0"], [])
+        assert [p.name for p in vt3.slots["m0"]] == ["big", "db"]
+        vt4, _ = self._vt(enc2, infos2, ["m0"],
+                          [_pdb("b", allowed=0, sel=sel)])
+        assert [p.name for p in vt4.slots["m0"]] == ["db", "big"]
+
+    def test_rotation_permute_keeps_rows_aligned(self):
+        from kubernetes_tpu.ops.node_state import NodeStateEncoder
+        nodes = [mknode(f"n{i}") for i in range(3)]
+        infos = snapshot(nodes, {
+            "n0": [mkpod("a", priority=1)],
+            "n1": [mkpod("b", priority=2), mkpod("c", priority=0)],
+            "n2": []})
+        enc = NodeStateEncoder()
+        vt, b = self._vt(enc, infos, ["n0", "n1", "n2"], [])
+        vt.dirty_rows = []
+        # rotated enumeration of the same node set: the encode permutes the
+        # mirror AND the victim rows; dirty_rows=None forces a full device
+        # re-upload (row positions moved)
+        vt2, b2 = self._vt(enc, infos, ["n1", "n2", "n0"], [])
+        assert vt2.dirty_rows is None or vt2.dirty_rows == []
+        i1 = b2.index["n1"]
+        assert int(vt2.count[i1]) == 2
+        assert [p.name for p in vt2.slots["n1"]] == ["b", "c"]
+        assert vt2.prio[i1, 0] == 2 and vt2.prio[i1, 1] == 0
+        assert int(vt2.count[b2.index["n2"]]) == 0
