@@ -57,7 +57,16 @@ PIPELINE_OVERLAP = obs.counter(
     "in flight on the device (the pipelined-wave overlap win).")
 BURST_WAVES = obs.counter(
     "tpu_burst_waves_total",
-    "Pipelined burst waves dispatched, by path.", ("path",))
+    "Burst commit waves, by path — since round 10 a wave is a commit "
+    "window consumed out of the single fetched decision block, not a "
+    "separate device launch (tpu_device_fetches_total pins that).",
+    ("path",))
+BURST_SEGMENTS = obs.counter(
+    "tpu_burst_scan_segments_total",
+    "Segments scheduled through the fused segmented burst scan, by kind: "
+    "'run' (singleton sub-ranges) and 'gang' (all-or-nothing PodGroup "
+    "sub-ranges whose checkpoint/rewind happens inside the device carry).",
+    ("kind",))
 ORACLE_FALLBACKS = obs.counter(
     "tpu_oracle_fallback_total",
     "Decisions routed off the device path (host twin / serial rerun), "
@@ -808,17 +817,16 @@ class TPUScheduler:
             inv[l, perms[l]] = np.arange(n_pad, dtype=np.int32)
         return perms, inv, seq
 
-    # -- pipelined burst waves ----------------------------------------------
-    # Two-stage pipeline (the GPipe-style overlap of PAPERS.md applied to
-    # the scheduler; cf. the reference's async bind goroutine,
-    # scheduler.go:433): a burst is split into waves of `wave_size` pods,
-    # and wave k+1's kernel launch is dispatched — async on the tunnel;
-    # only the fetch blocks — BEFORE wave k's decisions are fetched and
-    # committed, so the host commit of wave k runs while the device
-    # executes wave k+1. The carried state (folded rows, lastNodeIndex,
-    # spread counts) chains device-side between launches, and the NodeTree
-    # rotation seq is sliced per wave from one burst-wide walk, so
-    # enumeration order stays serial-exact across wave boundaries.
+    # -- fused bursts, wave-windowed commit ----------------------------------
+    # Round 10 moved the wave chain INTO the kernel: a burst is ONE
+    # dispatch and ONE packed fetch (the round-7 pipeline paid one ~100ms
+    # tunneled round trip per wave — the dominant ceiling PROFILE.md
+    # names), and `wave_size` now sizes the COMMIT windows the host
+    # consumes out of the single fetched block (bounded store/event
+    # batches, same failure granularity as the pipelined rounds). Bursts
+    # above B_CAP chunk at the kernel cap; chunk k+1's device execution
+    # still overlaps chunk k's fetch+commit (the old pipeline, one level
+    # up).
     wave_size = 4096
     # the shell passes a per-wave commit callback when the algorithm
     # advertises this (Scheduler._burst_segment)
@@ -864,17 +872,20 @@ class TPUScheduler:
         returned placements to its cache (as the scheduler shell does via
         assume + note_burst_assumed) before the next cycle.
 
-        `commit(lo, hosts) -> bool` (optional) is the pipelined-wave sink:
-        it is called once per wave with consecutive windows of DECIDED
-        hosts (never None) while the next wave executes on the device; the
-        caller must commit them immediately. Returning False signals a
-        commit failure — the algorithm discards the in-flight wave's
-        decisions and its device folds (the host mirror is authoritative
-        again) and returns the committed prefix with a None tail, exactly
-        like the mid-burst-failure rewind contract. Decisions passed to
-        `commit` are never re-returned as the caller's responsibility
-        twice: the returned list still contains them, but the caller knows
-        how far its own callback committed."""
+        `commit(lo, hosts) -> bool` (optional) is the wave-window sink:
+        since round 10 the whole burst is ONE dispatch and ONE packed
+        fetch, and `commit` is called with consecutive `wave_size` windows
+        of DECIDED hosts (never None) consumed out of that single fetched
+        block (bursts above B_CAP chunk, and a later chunk's device time
+        still overlaps the earlier chunk's commit). Returning False
+        signals a commit failure — the algorithm stops consuming the
+        block, discards the undelivered decisions and the device folds
+        (the host mirror is authoritative again), rewinds the walk
+        counters to the delivered prefix, and returns that prefix with a
+        None tail, exactly like the mid-burst-failure rewind contract.
+        Decisions passed to `commit` are never re-returned as the
+        caller's responsibility twice: the returned list still contains
+        them, but the caller knows how far its own callback committed."""
         if not all_node_names or not pods:
             return [None] * len(pods)
         import time as _time
@@ -917,7 +928,7 @@ class TPUScheduler:
             rotation = self._burst_rotation(b, len(pods))
             _t = _obs("encode", _t0)
             sel = self._uniform_waves(pods, b, cls, extra_ok, ban, rotation,
-                                      n, commit, _obs, _t)
+                                      n, commit, _obs, _t, bucket)
             return [b.names[s] for s in sel] \
                 + [None] * (len(pods) - len(sel))
         from kubernetes_tpu.api.types import (
@@ -1058,35 +1069,41 @@ class TPUScheduler:
 
     def _uniform_waves(self, pods: list[Pod], b: NodeBatch, cls, extra_ok,
                        ban: bool, rotation, n: int, commit, _obs,
-                       _t: float) -> list[int]:
-        """Pipelined wave driver for the uniform kernel: dispatch wave k+1
-        (chained off wave k's device-resident folds + lastNodeIndex), then
-        fetch + commit wave k while k+1 executes. Returns the decided
-        selection prefix (device axis indices, all >= 0); the caller pads
-        the undecided tail with None.
+                       _t: float, bucket: int) -> list[int]:
+        """Single-launch driver for the uniform kernel: the ENTIRE burst
+        (up to B_CAP; larger bursts chunk, with chunk k's fetch+commit
+        overlapping chunk k+1's device execution) is ONE dispatch and ONE
+        packed [cap+1] fetch, which the commit then consumes wave-by-wave
+        (`wave_size` windows — the same bounded store/event batches the
+        pipelined rounds used). Returns the decided selection prefix
+        (device axis indices, all >= 0); the caller pads the undecided
+        tail with None.
 
-        Rewind contract: a failed (F==0) wave freezes device state — every
-        later identical pod fails too, so the in-flight wave folds nothing
-        and is discarded unfetched. A commit failure (callback returned
-        False) additionally drops the resident matrix: the host mirror,
-        which reflects exactly the committed decisions minus forgotten
-        pods, re-uploads on next use."""
-        # one fixed power-of-two cap serves every wave: the kernel's output
-        # buffer (and so the per-wave fetch payload) is cap+1 int32s, and
-        # the static shape means one compile per wave_size, not per burst
-        W = _pad_pow2(max(1, min(int(self.wave_size), K.B_CAP)), 4)
+        Rewind contract, re-derived from the single fetched block: the
+        uniform kernel's failures are a frozen-state suffix (F==0
+        persists for identical pods), so the decided prefix is exactly
+        the block's leading non-negative run. A commit failure (callback
+        returned False) stops consumption — the rest of the block is
+        discarded along with the resident folds, and the returned prefix
+        ends at the last window handed to the callback."""
+        # the launch cap IS the caller's burst bucket (clamped to B_CAP):
+        # the warmup burst rides the same bucket, so the one compile per
+        # (bucket, class-flags) signature happens outside any timed loop
+        cap = _pad_pow2(max(1, min(bucket, K.B_CAP)), 16)
+        W = max(1, min(int(self.wave_size), cap))
         n_pods = len(pods)
-        waves = [(lo, min(W, n_pods - lo)) for lo in range(0, n_pods, W)]
-        lni_dev = self.last_node_index   # device scalar after wave 0
+        chunks = [(lo, min(cap, n_pods - lo))
+                  for lo in range(0, n_pods, cap)]
+        lni_dev = self.last_node_index   # device scalar after chunk 0
         sel: list[int] = []
         inflight: list[tuple] = []
 
-        def dispatch(widx: int) -> None:
+        def dispatch(ci: int) -> None:
             nonlocal lni_dev, _t
-            lo, chunk = waves[widx]
+            lo, chunk = chunks[ci]
             rot = rotation
             if rotation is not None:
-                win = np.empty(W + K.K_BATCH, dtype=np.int32)
+                win = np.empty(cap + K.K_BATCH, dtype=np.int32)
                 piece = rotation[1][lo: lo + len(win)]
                 win[: len(piece)] = piece
                 win[len(piece):] = piece[-1] if len(piece) else 0
@@ -1095,43 +1112,48 @@ class TPUScheduler:
             rows, packed, lni_out = K.schedule_batch_uniform(
                 self._dev_nodes, dict(cls), chunk, lni_dev, n,
                 self.check_resources, weights=self.weights, rotation=rot,
-                extra_ok=extra_ok, ban=ban, mesh=self.mesh, cap=W)
+                extra_ok=extra_ok, ban=ban, mesh=self.mesh, cap=cap)
             lni_dev = lni_out
             self._dev_nodes = {**self._dev_nodes, **rows}
             DEVICE_DISPATCH.labels("burst_uniform").inc()
-            BURST_WAVES.labels("uniform").inc()
             _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
-            inflight.append((widx, lo, chunk, self._submit_fetch(packed),
+            inflight.append((ci, lo, chunk, self._submit_fetch(packed),
                              t_d))
 
         dispatch(0)
         aborted = False
         while inflight:
-            if len(inflight) == 1 and inflight[0][0] + 1 < len(waves):
-                dispatch(inflight[0][0] + 1)   # keep one wave in flight
-            widx, lo, chunk, fut, t_d = inflight.pop(0)
-            h = fut.result()   # ONE fetch per wave: selections + lni delta
+            if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
+                dispatch(inflight[0][0] + 1)   # keep one chunk in flight
+            ci, lo, chunk, fut, t_d = inflight.pop(0)
+            h = fut.result()   # ONE fetch per launch: selections + lni
             t_done = obs_trace.now()
             DEVICE_FETCHES.labels("burst_uniform").inc()
             DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
             obs_trace.add_span("burst.wave.device", t_d, t_done,
-                               cat="device", args={"wave": widx})
+                               cat="device", args={"chunk": ci})
             _t = _obs("fetch", _t)
-            self.last_node_index += int(h[W])
-            wave_sel = h[:chunk].tolist()
-            bad = next((i for i, s in enumerate(wave_sel) if s < 0), chunk)
-            sel.extend(wave_sel[:bad])
-            if commit is not None and bad:
-                t_c0 = obs_trace.now()
-                ok = commit(lo, [b.names[s] for s in wave_sel[:bad]])
-                t_c1 = obs_trace.now()
-                obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
-                                   cat="host", args={"wave": widx})
-                if inflight:
-                    PIPELINE_OVERLAP.inc(t_c1 - t_c0)
-                _t = t_c1
-                if not ok:
-                    aborted = True
+            self.last_node_index += int(h[cap])
+            chunk_sel = h[:chunk].tolist()
+            bad = next((i for i, s in enumerate(chunk_sel) if s < 0), chunk)
+            # commit consumes the single fetched block wave-by-wave
+            for wlo in range(0, bad, W):
+                hi = min(wlo + W, bad)
+                BURST_WAVES.labels("uniform").inc()
+                sel.extend(chunk_sel[wlo:hi])
+                if commit is not None:
+                    t_c0 = obs_trace.now()
+                    ok = commit(lo + wlo,
+                                [b.names[s] for s in chunk_sel[wlo:hi]])
+                    t_c1 = obs_trace.now()
+                    obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
+                                       cat="host", args={"chunk": ci})
+                    if inflight:
+                        PIPELINE_OVERLAP.inc(t_c1 - t_c0)
+                    _t = t_c1
+                    if not ok:
+                        aborted = True
+                        break
             if bad < chunk or aborted:
                 for item in inflight:
                     item[3].cancel()
@@ -1145,142 +1167,306 @@ class TPUScheduler:
                     spread0, rotation, rotation_pos, num_to_find: int,
                     n: int, z_pad: int, bucket: int, commit, _obs,
                     _t: float) -> list[Optional[str]]:
-        """Pipelined wave driver for the generic lax.scan burst: the mutable
-        node state, spread counts, and last_index/lastNodeIndex chain
-        device-side between launches (kernels.schedule_batch carry_in), the
-        rotation oid walk is sliced per wave from the burst-wide sequence,
-        and wave k's fetch + commit overlap wave k+1's execution.
+        """Single-launch driver for the generic lax.scan burst: the whole
+        burst runs as ONE scan launch (scan length = the caller's bucket,
+        so the warmup burst compiles the same program) and the host
+        fetches ONE packed [3B] block — selections plus the per-pod walk
+        counters. Commit then consumes the block wave-by-wave.
 
-        Unlike the uniform kernel, the scan keeps deciding after a failed
-        pod, so on a mid-wave failure the post-failure folds — and the
-        whole in-flight wave — are invalid: the device matrix is dropped
-        and host counters advance only over the committed prefix (the
-        fetched evaluated/found vectors), exactly the single-launch rewind
-        contract."""
-        # one FIXED scan length serves every wave of a workload: the wave
-        # bucket is the smaller of wave_size and the caller's burst bucket,
-        # so the warmup burst and every wave (including the padded last
-        # one) hit one compiled program — a per-wave _pad_pow2(chunk) here
-        # once put a fresh XLA compile inside the timed loop
-        W = _pad_pow2(max(1, min(int(self.wave_size), bucket)), 4)
+        Rewind contract, re-derived from slices of the single block: the
+        scan keeps deciding after a failed pod, so everything from the
+        first failure on is undecided and the committed-prefix counters
+        are read straight out of the block (li_after/lni_delta at the
+        last decided pod) — the failure path's second fetch is gone. A
+        commit failure stops consumption at that window; the counters
+        rewind to the last window handed to the callback and the resident
+        folds drop either way (the host mirror is authoritative again)."""
+        B = bucket
         n_pods = len(pods)
-        waves = [(lo, min(W, n_pods - lo)) for lo in range(0, n_pods, W)]
-        carry_spread = spread0 is not None
-        seq = None
+        W = max(1, min(int(self.wave_size), B))
+        wave = list(per_pod)
+        if len(wave) < B:
+            pad = dict(wave[-1])
+            pad["skip"] = self._true
+            wave.extend([pad] * (B - len(wave)))
+        stacked = self._stack_pods(wave)
+        rot = rotp = None
         if rotation is not None:
             perms, inv_perms, seq = rotation
+            rot = (perms, inv_perms, np.asarray(seq[:B], dtype=np.int32))
         elif rotation_pos is not None:
-            pos_arr, seq = rotation_pos
-        carry = None              # (mut_state, spread) after the last wave
-        li_dev, lni_dev = self.last_index, self.last_node_index
-        li_host, lni_host = self.last_index, self.last_node_index
-        sel: list[int] = []
-        inflight: list[tuple] = []
-
-        def dispatch(widx: int) -> None:
-            nonlocal carry, li_dev, lni_dev, _t
-            lo, chunk = waves[widx]
-            wave = list(per_pod[lo: lo + chunk])
-            if len(wave) < W:
-                pad = dict(wave[-1])
-                pad["skip"] = self._true
-                wave.extend([pad] * (W - len(wave)))
-            stacked = self._stack_pods(wave)
-            rot = rotp = None
-            if seq is not None:
-                # cycle t's order id, t counted from the burst's first pod:
-                # slicing the one walk keeps rotation serial-exact across
-                # wave boundaries (pad rows skip, so the fill is inert)
-                wseq = np.empty(W, dtype=np.int32)
-                piece = seq[lo: lo + W]
-                wseq[: len(piece)] = piece
-                wseq[len(piece):] = piece[-1] if len(piece) else 0
-                if rotation is not None:
-                    rot = (perms, inv_perms, wseq)
-                else:
-                    rotp = (pos_arr, wseq)
-            t_d = obs_trace.now()
-            state, li_out, lni_out, spread, outs = K.schedule_batch(
-                self._dev_nodes, stacked, li_dev, lni_dev, num_to_find, n,
-                z_pad, weights=self.weights, rotation=rot,
-                spread0=(spread0 if carry is None and carry_spread
-                         else None),
-                rotation_pos=rotp, carry_in=carry)
-            carry = (state, spread if carry_spread else None)
-            li_dev, lni_dev = li_out, lni_out
-            DEVICE_DISPATCH.labels("burst_scan").inc()
-            BURST_WAVES.labels("scan").inc()
-            _t = _obs("kernel", _t)
-            # the common-path fetch ships selections + the two counters;
-            # the per-cycle evaluated/found vectors are only needed to
-            # rewind a FAILED wave, so they stay device-resident (outs)
-            # and cost a second fetch only on that rare path
-            fut = self._submit_fetch({
-                "selected": outs["selected"], "li": li_out, "lni": lni_out})
-            inflight.append((widx, lo, chunk, fut, t_d, outs))
-
-        dispatch(0)
-        failed = aborted = False
-        while inflight:
-            if len(inflight) == 1 and inflight[0][0] + 1 < len(waves):
-                dispatch(inflight[0][0] + 1)
-            widx, lo, chunk, fut, t_d, outs = inflight.pop(0)
-            h = fut.result()
-            t_done = obs_trace.now()
-            DEVICE_FETCHES.labels("burst_scan").inc()
-            DEVICE_FETCHED_BYTES.labels("burst_scan").inc(_fetched_nbytes(h))
-            obs_trace.add_span("burst.wave.device", t_d, t_done,
-                               cat="device", args={"wave": widx})
-            _t = _obs("fetch", _t)
-            wave_sel = np.asarray(h["selected"])[:chunk]
-            neg = wave_sel < 0
-            bad = int(np.argmax(neg)) if neg.any() else chunk
-            if bad < chunk:
-                # rewind the committed-prefix counters from the per-cycle
-                # vectors (the wave-final scalars include the discarded
-                # post-failure cycles); failure path only, so the extra
-                # round trip never taxes the steady state
-                ev, fo = jax.device_get((outs["evaluated"], outs["found"]))
-                DEVICE_FETCHES.labels("burst_scan").inc()
-                DEVICE_FETCHED_BYTES.labels("burst_scan").inc(
-                    _fetched_nbytes((ev, fo)))
-                ev, fo = np.asarray(ev)[:bad], np.asarray(fo)[:bad]
-                li_host = int((li_host + ev.sum()) % max(n, 1))
-                lni_host += int((fo > 1).sum())
-                failed = True
-            else:
-                li_host, lni_host = int(h["li"]), int(h["lni"])
-            sel.extend(wave_sel[:bad].tolist())
-            if commit is not None and bad:
+            rotp = (rotation_pos[0],
+                    np.asarray(rotation_pos[1][:B], dtype=np.int32))
+        t_d = obs_trace.now()
+        state, _li_out, _lni_out, _spread, outs = K.schedule_batch(
+            self._dev_nodes, stacked, self.last_index, self.last_node_index,
+            num_to_find, n, z_pad, weights=self.weights, rotation=rot,
+            spread0=spread0, rotation_pos=rotp)
+        DEVICE_DISPATCH.labels("burst_scan").inc()
+        _t = _obs("kernel", _t)
+        h = np.asarray(self._submit_fetch(outs["packed"]).result())
+        t_done = obs_trace.now()
+        DEVICE_FETCHES.labels("burst_scan").inc()
+        DEVICE_FETCHED_BYTES.labels("burst_scan").inc(h.nbytes)
+        obs_trace.add_span("burst.wave.device", t_d, t_done, cat="device")
+        _t = _obs("fetch", _t)
+        sel_arr = h[:n_pods]
+        li_after = h[B:2 * B]
+        lni_delta = h[2 * B:3 * B]
+        lni0 = self.last_node_index
+        neg = sel_arr < 0
+        bad = int(np.argmax(neg)) if neg.any() else n_pods
+        committed = bad
+        aborted = False
+        if commit is not None:
+            committed = 0
+            for wlo in range(0, bad, W):
+                hi = min(wlo + W, bad)
+                BURST_WAVES.labels("scan").inc()
                 t_c0 = obs_trace.now()
-                ok = commit(lo, [b.names[s] for s in wave_sel[:bad]])
+                ok = commit(wlo,
+                            [b.names[s] for s in sel_arr[wlo:hi].tolist()])
                 t_c1 = obs_trace.now()
                 obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
-                                   cat="host", args={"wave": widx})
-                if inflight:
-                    PIPELINE_OVERLAP.inc(t_c1 - t_c0)
+                                   cat="host")
                 _t = t_c1
+                committed = hi
                 if not ok:
                     aborted = True
-            if failed or aborted:
-                for item in inflight:
-                    item[3].cancel()
-                inflight.clear()
-                break
-        self.last_index = li_host
-        self.last_node_index = lni_host
-        if failed or aborted:
-            # post-failure scan folds (and the in-flight wave) never became
-            # decisions: drop the device matrix — the host mirror reflects
-            # exactly the committed prefix after note_burst_assumed
+                    break
+        # walk counters at the consumed boundary, straight from the block
+        if committed > 0:
+            self.last_index = int(li_after[committed - 1])
+            self.last_node_index = lni0 + int(lni_delta[committed - 1])
+        if bad < n_pods or aborted:
+            # post-failure scan folds (or folds for decisions a failed
+            # commit discarded) never became decisions: drop the device
+            # matrix — the host mirror reflects exactly the committed
+            # prefix after note_burst_assumed
             self.discard_burst_folds()
         else:
             # persist the folds: the device-resident matrix is
             # authoritative for rows the scan mutated (the host mirror
             # catches up via note_burst_assumed; external changes still
             # arrive via dirty rows)
-            self._dev_nodes = {**self._dev_nodes, **carry[0]}
-        return [b.names[s] for s in sel] + [None] * (n_pods - len(sel))
+            self._dev_nodes = {**self._dev_nodes, **state}
+        return [b.names[s] for s in sel_arr[:committed].tolist()] \
+            + [None] * (n_pods - committed)
+
+    # -- fused segmented burst: one launch per drain window -------------------
+    # The shell advertises gang segments to this entry so a whole drain
+    # window — singleton runs AND PodGroups — rides ONE dispatch and ONE
+    # packed fetch (kernels.schedule_batch_segments): gang boundaries are
+    # scan segment boundaries, and the round-8 gang_checkpoint/gang_rewind
+    # contract runs inside the device carry instead of as one launch per
+    # gang trial.
+    supports_fused_segments = True
+
+    def schedule_burst_fused(self, segments, node_infos: dict[str, NodeInfo],
+                             all_node_names: list[str],
+                             bucket: Optional[int] = None):
+        """Schedule a segmented drain window in ONE launch + ONE packed
+        fetch. `segments` = [(pods, is_gang), ...] in queue order.
+
+        Gang segments are all-or-nothing ON DEVICE: a member that finds no
+        node rewinds the carry (mutable rows, li, lni, rotation cursor) to
+        the segment checkpoint in-scan, the rest of the segment is
+        skipped, and the window continues against the rewound state —
+        exactly the serial trial→reject→park→continue sequence, with zero
+        extra round trips and no discarded in-flight device work.
+
+        Returns None when the window isn't expressible on this path (the
+        caller falls back to the per-segment machinery), else
+        {"segments": [...], "consumed": n_enumerations} with per-segment
+        records:
+          {"status": "decided",  "hosts": [...], "li", "lni", "t"}
+          {"status": "rejected", "placed": k,    "li", "lni", "t"}  (gang)
+          {"status": "failed",   "hosts": [decided prefix], "li","lni","t"}
+          {"status": "undecided"}   (at/after a singleton failure)
+        The li/lni/t triple is the carry at that segment's END boundary —
+        the caller's abort target (fused_rewind) when a later commit comes
+        up short. On return, last_index/lastNodeIndex are already set to
+        the end of the decided prefix (a singleton failure's prefix is
+        re-derived from per-pod slices of the single fetched block), and
+        the resident folds persist unless that failure polluted them."""
+        from kubernetes_tpu.api.types import (has_pod_affinity_terms,
+                                              get_container_ports)
+        n_total = sum(len(p) for p, _g in segments)
+        if not all_node_names or n_total == 0:
+            return None
+        if self.mesh is not None:
+            # the sharded scan models neither segments nor rotation
+            ORACLE_FALLBACKS.labels("fused-mesh-mode").inc()
+            return None
+        if self.nominated is not None and self.nominated.has_any():
+            ORACLE_FALLBACKS.labels("fused-nominated-ghosts").inc()
+            return None
+        flat = [p for seg_pods, _g in segments for p in seg_pods]
+        if any(has_pod_affinity_terms(p) or get_container_ports(p)
+               or p.volumes for p in flat):
+            # per-node masks that depend on in-burst placements (and volume
+            # reservations) have no segment-rewind story on device
+            ORACLE_FALLBACKS.labels("fused-pod-features").inc()
+            return None
+        import time as _time
+        _t0 = _time.perf_counter()
+
+        def _obs(phase: str, t_start: float) -> float:
+            now = _time.perf_counter()
+            if self.metrics is not None:
+                self.metrics.observe_phase(phase, now - t_start)
+            name, cat = _PHASE_SPANS[phase]
+            obs_trace.add_span(name, t_start, now, cat=cat)
+            return now
+
+        b = self.encoder.encode(node_infos, all_node_names)
+        nodes = self._node_arrays(b)
+        enc = PodEncoder(node_infos, b, self.services_fn(),
+                         self.replicasets_fn(),
+                         hard_pod_affinity_weight=self.hard_pod_affinity_weight,
+                         enabled=self.enabled_predicates,
+                         volume_listers=self.volume_listers,
+                         volume_binder=self.volume_binder,
+                         state_encoder=self.encoder)
+        feat_by_sig: dict = {}
+        per_pod = []
+        for p in flat:
+            sig = self._class_signature(p)
+            f = feat_by_sig.get(sig)
+            if f is None:
+                f = feat_by_sig[sig] = enc.encode(p)
+            if f.spread_counts is not None:
+                # selector-spread counts carry through rewinds only with a
+                # checkpointed spread vector the shell's plain-class gate
+                # already excludes; refuse rather than drift
+                ORACLE_FALLBACKS.labels("fused-spread-selectors").inc()
+                return None
+            per_pod.append(self._pod_arrays(f, b.n_pad, upd_fields=True,
+                                            pod=p))
+        n = b.n_real
+        num_to_find = num_feasible_nodes_to_find(
+            n, self.percentage_of_nodes_to_score)
+        B = _pad_pow2(max(bucket or 16, n_total), 16)
+        rotation = rotation_pos = None
+        if self._tree_rotates():
+            # one burst-wide walk, indexed by enumerations CONSUMED inside
+            # the kernel (the carried t) — a rejected gang rewinds the
+            # cursor, so the walk must NOT be pre-sliced by pod position
+            rot = self._generic_rotation(b, B)
+            if num_to_find >= n:
+                rotation_pos = (rot[1], rot[2])
+            else:
+                rotation = rot
+        seg_start = np.zeros(B, dtype=bool)
+        gang = np.zeros(B, dtype=bool)
+        idx = 0
+        for seg_pods, is_gang in segments:
+            seg_start[idx] = True
+            if is_gang:
+                gang[idx: idx + len(seg_pods)] = True
+            BURST_SEGMENTS.labels("gang" if is_gang else "run").inc()
+            idx += len(seg_pods)
+        if idx < B:
+            seg_start[idx] = True   # padding: its own inert segment
+            pad = dict(per_pod[-1])
+            pad["skip"] = self._true
+            per_pod.extend([pad] * (B - idx))
+        stacked = self._stack_pods(per_pod)
+        z_pad = _pad_pow2(len(b.zone_names), 4)
+        _t = _obs("encode", _t0)
+        t_d = obs_trace.now()
+        state, _li, _lni, _spread, packed = K.schedule_batch_segments(
+            nodes, stacked, seg_start, gang, n_total, self.last_index,
+            self.last_node_index, num_to_find, n, z_pad,
+            weights=self.weights, rotation=rotation,
+            rotation_pos=rotation_pos)
+        DEVICE_DISPATCH.labels("burst_fused").inc()
+        _t = _obs("kernel", _t)
+        h = np.asarray(self._submit_fetch(packed).result())
+        t_done = obs_trace.now()
+        DEVICE_FETCHES.labels("burst_fused").inc()
+        DEVICE_FETCHED_BYTES.labels("burst_fused").inc(h.nbytes)
+        obs_trace.add_span("burst.wave.device", t_d, t_done, cat="device")
+        _obs("fetch", _t)
+        sel = h[:B]
+        li_after = h[B:2 * B]
+        lni_delta = h[2 * B:3 * B]
+        t_after = h[3 * B:4 * B]
+        li0, lni0 = self.last_index, self.last_node_index
+
+        def boundary(j: int) -> tuple[int, int, int]:
+            if j < 0:
+                return li0, lni0, 0
+            return (int(li_after[j]), lni0 + int(lni_delta[j]),
+                    int(t_after[j]))
+
+        results = []
+        fail_at = None   # first SINGLETON failure: everything after is
+        idx = 0          # undecided (its serial rerun may preempt)
+        for seg_pods, is_gang in segments:
+            L = len(seg_pods)
+            if fail_at is not None:
+                results.append({"status": "undecided"})
+                idx += L
+                continue
+            ss = sel[idx: idx + L]
+            end_li, end_lni, end_t = boundary(idx + L - 1)
+            def seqs(k: int) -> dict:
+                # per-member walk counters (window-grain rewind targets for
+                # a short commit inside a singleton run)
+                return {"li_seq": li_after[idx: idx + k],
+                        "lni_seq": lni0 + lni_delta[idx: idx + k],
+                        "t_seq": t_after[idx: idx + k]}
+
+            if is_gang:
+                if (ss < 0).any():
+                    # the kernel already rewound the carry; the boundary is
+                    # the (restored) pre-gang state
+                    results.append({"status": "rejected",
+                                    "placed": int((ss >= 0).sum()),
+                                    "li": end_li, "lni": end_lni,
+                                    "t": end_t})
+                else:
+                    results.append({"status": "decided",
+                                    "hosts": [b.names[s]
+                                              for s in ss.tolist()],
+                                    "li": end_li, "lni": end_lni,
+                                    "t": end_t, **seqs(L)})
+            elif (ss < 0).any():
+                k = int(np.argmax(ss < 0))
+                fail_at = idx + k
+                end_li, end_lni, end_t = boundary(idx + k - 1)
+                results.append({"status": "failed",
+                                "hosts": [b.names[s]
+                                          for s in ss[:k].tolist()],
+                                "li": end_li, "lni": end_lni, "t": end_t,
+                                **seqs(k)})
+            else:
+                results.append({"status": "decided",
+                                "hosts": [b.names[s] for s in ss.tolist()],
+                                "li": end_li, "lni": end_lni, "t": end_t,
+                                **seqs(L)})
+            idx += L
+        if fail_at is not None:
+            li_f, lni_f, consumed = boundary(fail_at - 1)
+            # post-failure folds never became decisions: drop the matrix
+            self.discard_burst_folds()
+        else:
+            li_f, lni_f, consumed = boundary(n_total - 1)
+            self._dev_nodes = {**self._dev_nodes, **state}
+        self.last_index, self.last_node_index = li_f, lni_f
+        return {"segments": results, "consumed": consumed}
+
+    def fused_rewind(self, li: int, lni: int) -> None:
+        """Abort handler for a fused window: a SHORT segment commit (pods
+        vanished between decision and commit) makes the shell stop
+        consuming the block — the walk counters rewind to the segment
+        boundary it got from schedule_burst_fused and the resident folds
+        drop (decisions past the boundary are discarded; the host mirror
+        is authoritative again)."""
+        self.last_index = int(li)
+        self.last_node_index = int(lni)
+        self.discard_burst_folds()
 
     # -- device preemption ---------------------------------------------------
     def preempt(self, pod: Pod, node_infos: dict[str, NodeInfo],

@@ -534,6 +534,8 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
             "found": out["found"],
             "evaluated": out["evaluated"],
             "max_score": out["max_score"],
+            "li_after": out["next_last_index"].astype(jnp.int32),
+            "lni_after": out["next_last_node_index"],
         })
 
     if carry_spread:
@@ -541,6 +543,15 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
     xs = (pods, oid_seq) if (rotate or rotate_pos) else pods
     init = (mut0, last_index, last_node_index, spread0)
     (state, li, lni, spread), outs = jax.lax.scan(step, init, xs)
+    # ONE packed fetch block [3B] i32: selections, then the walk counters
+    # AFTER each pod (li absolute — it is < n; lni as a delta from the
+    # launch's start so it fits i32) — a mid-burst failure's prefix rewind
+    # reads the counters straight out of the single fetched block instead
+    # of paying a second round trip for the evaluated/found vectors
+    outs["packed"] = jnp.concatenate([
+        outs["selected"].astype(jnp.int32),
+        outs["li_after"],
+        (outs["lni_after"] - last_node_index).astype(jnp.int32)])
     return state, li, lni, spread, outs
 
 
@@ -565,7 +576,10 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     mut_state is the prior return's `state` dict (the _MUTABLE rows),
     spread its carried count vector. `last_index`/`last_node_index` may
     likewise be the prior launch's device scalars. Returns
-    (state, li, lni, spread, outs)."""
+    (state, li, lni, spread, outs); outs["packed"] is the ONE-fetch block
+    [3B] i32 — selected | li-after-each-pod | lni-delta-after-each-pod —
+    so a caller fetches a single array per launch and re-derives any
+    failure-prefix rewind from slices of it."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -594,6 +608,172 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
         _i64(num_to_find), _i64(n_real), perms, inv_perms, oid_seq, s0,
         z_pad, weights_tuple, rotation is not None, carry_spread,
         rotate_pos=rotation_pos is not None)
+
+
+# ---------------------------------------------------------------------------
+# Segmented burst: the whole wave chain — singleton runs AND gang segments —
+# in ONE launch, with gang boundaries as scan segment boundaries
+# ---------------------------------------------------------------------------
+# The round-8 gang contract moved the atomicity boundary from the wave to the
+# group, but the trial still ran as its own launch (one dispatch+fetch per
+# gang — ruinous over a tunneled chip at hundreds of small gangs per drain).
+# This kernel fuses a whole drain window: the carry holds BOTH the live state
+# (mutable rows, li, lni, spread, t) and a CHECKPOINT of it taken at each
+# segment start; a gang member that finds no node rewinds the live carry to
+# the checkpoint in-scan (gang_checkpoint/gang_rewind semantics, now inside
+# the scan), the rest of its segment is skipped, and the next segment
+# proceeds against the rewound state — exactly the serial shell's
+# trial→reject→park→continue sequence, with zero extra round trips.
+#
+# `t` counts NodeTree enumerations actually consumed: each non-skipped cycle
+# advances it, a gang rewind restores it, and the per-cycle rotation order is
+# looked up as oid_seq[t] (not the scan position) — so a rejected gang leaves
+# the rotation walk exactly where it found it, matching the serial world's
+# tree.checkpoint()/restore(). The host pre-slices the walk long enough for
+# the all-segments-succeed case; consumed entries never exceed that.
+#
+# A failed SINGLETON (non-gang) pod does not rewind anything: the host-side
+# burst contract still discards everything from the first singleton failure
+# (its serial rerun may preempt), and the packed block carries the per-pod
+# walk counters so the prefix rewind costs no second fetch.
+
+
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rot_mode",
+                                   "carry_spread"))
+def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
+                            last_index, last_node_index, num_to_find, n_real,
+                            perms, inv_perms, oid_seq, spread0, z_pad,
+                            weights_tuple, rot_mode, carry_spread):
+    """rot_mode: 0 = stable axis order, 1 = perm/inv-perm gathers,
+    2 = gather-free positions (full-scan regime).
+
+    The pod count is a DYNAMIC operand of a single lax.while_loop (the
+    uniform kernel's trick): the [B, ...] operands are padded to the
+    caller's bucket for one compile per bucket, but the loop runs exactly
+    `n_pods` iterations — a 1.5k-pod gang window inside a 16k bucket pays
+    for 1.5k cycles, not 16k padded scan steps."""
+    weights = dict(weights_tuple)
+    i32 = jnp.int32
+    static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
+    B = seg_start.shape[0]
+
+    def pick(pred, new, old):
+        return jax.tree_util.tree_map(
+            lambda a, b: jnp.where(pred, a, b), new, old)
+
+    def body(carry):
+        cur, chk, t, chk_t, failed, i, out = carry
+        pod = {k: jax.lax.dynamic_index_in_dim(v, i, keepdims=False)
+               for k, v in pods.items()}
+        sflag = seg_start[i]
+        gflag = gang[i]
+        # segment boundary: re-checkpoint the whole live carry (device
+        # arrays are immutable, so this pins the pre-segment rows the same
+        # way gang_carry_checkpoint does host-side — zero-copy)
+        chk = pick(sflag, cur, chk)
+        chk_t = jnp.where(sflag, t, chk_t)
+        failed = jnp.where(sflag, False, failed)
+        state, li, lni, spread = cur
+        # a member behind its segment's first failure consumes nothing:
+        # the serial trial's post-failure decisions are discarded anyway
+        eskip = pod["skip"] | (gflag & failed)
+        pod = {**pod, "skip": eskip}
+        perm = inv_perm = pos = None
+        if rot_mode == 2:
+            pos = perms[oid_seq[t]]
+        elif rot_mode == 1:
+            oid = oid_seq[t]
+            perm, inv_perm = perms[oid], inv_perms[oid]
+        if carry_spread:
+            pod = {**pod, "spread_counts": spread}
+        full = {**static, **state}
+        out_c = _cycle_core(full, pod, li, lni, num_to_find, n_real,
+                            weights, z_pad, perm=perm, inv_perm=inv_perm,
+                            pos=pos)
+        sel = out_c["selected"]
+        hit = out_c["found"] > 0
+        new_state = _fold_state(state, pod, sel, hit)
+        new_spread = spread
+        if carry_spread:
+            new_spread = spread.at[jnp.maximum(sel, 0)].add(
+                jnp.where(hit & ~eskip, 1, 0))
+        new_cur = (new_state, out_c["next_last_index"],
+                   out_c["next_last_node_index"], new_spread)
+        new_t = t + jnp.where(eskip, 0, jnp.int32(1))
+        # gang member found no node: rewind the live carry to the segment
+        # checkpoint — the in-scan gang_rewind
+        fail_now = gflag & ~hit & ~eskip
+        cur2 = pick(fail_now, chk, new_cur)
+        t2 = jnp.where(fail_now, chk_t, new_t)
+        failed = failed | fail_now
+        _s2, li2, lni2, _sp2 = cur2
+        col = jnp.stack([
+            jnp.where(hit & ~eskip, sel, jnp.int64(-1)).astype(i32),
+            li2.astype(i32),
+            (lni2 - last_node_index).astype(i32),
+            t2])
+        return (cur2, chk, t2, chk_t, failed, i + 1, out.at[:, i].set(col))
+
+    init_cur = (mut0, last_index, last_node_index, spread0)
+    out0 = jnp.full((4, B), -1, i32)
+    init = (init_cur, init_cur, jnp.int32(0), jnp.int32(0),
+            jnp.zeros((), bool), jnp.int32(0), out0)
+    Bn = jnp.asarray(n_pods, i32)
+    (cur, _chk, _t, _ct, _f, _i, out) = jax.lax.while_loop(
+        lambda c: c[5] < Bn, body, init)
+    state, li, lni, spread = cur
+    # ONE packed fetch block [4B] i32: selections (−1 = miss / rewound gang
+    # member / padding), then the post-pod walk counters and the consumed-
+    # enumeration count — every boundary the host commit needs (decided
+    # prefixes, rejected-gang detection, rewind targets, NodeTree advance)
+    # is a slice of this single array
+    return state, li, lni, spread, out.reshape(4 * B)
+
+
+def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
+                            last_index, last_node_index, num_to_find,
+                            n_real, z_pad, weights=None, rotation=None,
+                            rotation_pos=None, spread0=None):
+    """Schedule a segmented drain window — singleton runs and all-or-nothing
+    gang segments — in ONE launch with ONE packed fetch (see block comment).
+
+    `pods` is a dict of [B, ...] stacked arrays padded to the caller's
+    bucket (one compile per bucket); `n_pods` is the DYNAMIC real count —
+    the while_loop runs exactly that many cycles, so bucket padding costs
+    nothing at run time. `seg_start[B]` marks each segment's first pod;
+    `gang[B]` marks members of all-or-nothing segments.
+    `rotation`/`rotation_pos` follow schedule_batch's contract except the
+    per-cycle order id sequence is indexed by enumerations CONSUMED (gang
+    rewinds restore the cursor), so it must be the plain burst-wide walk,
+    unsliced. Returns (state, li, lni, spread, packed[4B] i32) with
+    packed = selected | li_after | lni_delta | t_after (entries past
+    n_pods are -1 filler)."""
+    weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    z = jnp.zeros((1, 1), jnp.int32)
+    if rotation_pos is not None:
+        assert rotation is None
+        rot_mode = 2
+        perms = jnp.asarray(rotation_pos[0], jnp.int32)
+        inv_perms = z
+        oid_seq = jnp.asarray(rotation_pos[1], jnp.int32)
+    elif rotation is not None:
+        rot_mode = 1
+        perms, inv_perms, oid_seq = (jnp.asarray(a, jnp.int32)
+                                     for a in rotation)
+    else:
+        rot_mode = 0
+        perms = inv_perms = z
+        oid_seq = jnp.zeros(1, jnp.int32)
+    mut0 = {k: nodes[k] for k in _MUTABLE}
+    carry_spread = spread0 is not None
+    s0 = jnp.asarray(spread0, jnp.int64) if carry_spread \
+        else jnp.zeros((), jnp.int64)
+    return _schedule_batch_seg_jit(
+        nodes, mut0, pods, jnp.asarray(seg_start, bool),
+        jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
+        _i64(last_node_index), _i64(num_to_find), _i64(n_real), perms,
+        inv_perms, oid_seq, s0, z_pad, weights_tuple, rot_mode,
+        carry_spread)
 
 
 # ---------------------------------------------------------------------------

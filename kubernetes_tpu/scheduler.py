@@ -748,18 +748,80 @@ class Scheduler:
                 items[idx][1].append((pod, cycle))
         for gk, idx in gang_at.items():
             items[idx][1].extend(self.queue.pop_group(gk))
+        # fused planning (round 10): consecutive plain singleton runs and
+        # eligible plain gangs collapse into ONE device launch + ONE packed
+        # fetch (algorithm.schedule_burst_fused — gang boundaries become
+        # scan segment boundaries). Anything the fused path can't express
+        # (plugins, volumes, affinity/port/spread classes, incomplete or
+        # missing groups, active nominations) keeps the per-segment
+        # machinery, which knows how to park/degrade/serialize.
+        fuse_ok = (getattr(self.algorithm, "supports_fused_segments", False)
+                   and not self.framework.reserve
+                   and not self.framework.permit
+                   and not self.framework.prebind)
+        services = self._services_fn()
+        replicasets = self._replicasets_fn()
+
+        def plain_burstable(pod: Pod) -> bool:
+            return (self._pod_is_burstable(pod)
+                    and self._burst_class(pod, services, replicasets)
+                    == "plain")
+
         bound = 0
-        run: list = []
+        window: list = []   # fused entries in queue order:
+        wrun: list = []     # ("run", pairs) | ("gang", gk, group, members)
+        srun: list = []     # non-fusable singleton accumulator
+
+        def close_wrun() -> None:
+            if wrun:
+                window.append(("run", list(wrun)))
+                wrun.clear()
+
+        def flush_window() -> None:
+            nonlocal bound
+            close_wrun()
+            if not window:
+                return
+            if any(e[0] == "gang" for e in window):
+                bound += self._fused_window(window, max_pods)
+            else:
+                # no gang segment in the window: the ordinary burst path is
+                # already one launch + one packed fetch per segment
+                pairs = [pr for e in window for pr in e[1]]
+                bound += self._schedule_singletons_burst(pairs, max_pods)
+            window.clear()
+
+        def flush_srun() -> None:
+            nonlocal bound
+            if srun:
+                bound += self._schedule_singletons_burst(list(srun),
+                                                         max_pods)
+                srun.clear()
+
         for it in items:
             if isinstance(it, list):
-                if run:
-                    bound += self._schedule_singletons_burst(run, max_pods)
-                    run = []
-                bound += self._gang_segment(it[0], it[1], bucket=max_pods)
+                gk, members = it
+                flush_srun()
+                group = None
+                if fuse_ok and not self.queue.nominated.has_any() \
+                        and all(plain_burstable(p) for p, _c in members):
+                    group = self._fusable_gang(gk, members)
+                if group is not None:
+                    close_wrun()
+                    window.append(("gang", gk, group, members))
+                else:
+                    flush_window()
+                    bound += self._gang_segment(gk, members,
+                                                bucket=max_pods)
+            elif fuse_ok and not self.queue.nominated.has_any() \
+                    and plain_burstable(it[0]):
+                flush_srun()
+                wrun.append(it)
             else:
-                run.append(it)
-        if run:
-            bound += self._schedule_singletons_burst(run, max_pods)
+                flush_window()
+                srun.append(it)
+        flush_srun()
+        flush_window()
         return bound, len(drained)
 
     def _schedule_singletons_burst(self, pairs: list, bucket: int) -> int:
@@ -891,7 +953,8 @@ class Scheduler:
                     if discard is not None:
                         discard()
                 tree.restore(tree_chk)
-                self._reject_gang(group, pods, hosts)
+                self._reject_gang(group, pods,
+                                  sum(1 for h in hosts if h is not None))
                 return 0
             else:
                 # kernels refused this gang's feature mix: undo the consumed
@@ -904,7 +967,7 @@ class Scheduler:
                 self._snapshot = self.cache.update_snapshot(self._snapshot)
             hosts = trial.run(pods, self._schedule, refresh)
             if hosts is None:
-                self._reject_gang(group, pods, None)
+                self._reject_gang(group, pods, 0)
                 return 0
             committed = self._commit_burst(pods, hosts, cycles,
                                            assume=False)
@@ -933,10 +996,10 @@ class Scheduler:
         except NotFoundError:
             pass
 
-    def _reject_gang(self, group, pods: list, hosts) -> None:
+    def _reject_gang(self, group, pods: list, placed: int) -> None:
         """Book a rejected gang attempt: every member is unschedulable (the
-        trial rewound, so none is bound) and the group parks as a unit."""
-        placed = sum(1 for h in (hosts or []) if h is not None)
+        trial rewound, so none is bound) and the group parks as a unit.
+        `placed` is how many members found nodes before the rewind."""
         GANG_ATTEMPTS.labels("rejected").inc()
         self.metrics.observe("unschedulable", count=len(pods))
         self._park_gang(
@@ -969,18 +1032,192 @@ class Scheduler:
             except NotFoundError:
                 pass
 
+    # -- fused drain windows (round 10) ---------------------------------------
+    # test seam: when set, singleton runs inside a fused window are split
+    # into scan segments of at most this many pods. Non-gang segment
+    # boundaries are semantically inert (only gang segments rewind), so
+    # this forces the kernel's checkpoint machinery across many small
+    # segments without changing any decision — the segment-boundary fuzz
+    # variants set it to 3/4.
+    fused_run_split: Optional[int] = None
+
+    def _fusable_gang(self, group_key: str, members: list):
+        """A gang may ride a fused window only when the pre-trial host
+        checks all pass: the PodGroup object exists, enough members are
+        gathered (counting already-bound ones), and no member needs volume
+        reservations. Everything else (missing group, incomplete,
+        degraded) keeps the per-segment _gang_segment path, which knows
+        how to park/degrade. Returns the PodGroup or None."""
+        try:
+            group = self.store.get(PODGROUPS, group_key)
+        except NotFoundError:
+            return None
+        if group is None:
+            return None
+        pods = [p for p, _c in members]
+        if any(p.volumes for p in pods):
+            return None
+        min_member = max(group.min_member, 1)
+        from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP
+        already_bound = sum(
+            1 for p in self.informers.informer(PODS).list()
+            if p.node_name and p.namespace == group.namespace
+            and p.labels.get(LABEL_POD_GROUP) == group.name)
+        if len(pods) + already_bound < min_member:
+            return None
+        return group
+
+    def _fused_window(self, entries: list, bucket: int) -> int:
+        """One launch + one packed fetch for a drain window that contains
+        gang segments (algorithm.schedule_burst_fused): gang boundaries
+        become device scan segment boundaries, rejected gangs rewind in
+        the device carry and park host-side, and decided segments commit
+        wave-by-wave out of the single fetched block. Falls back to the
+        per-segment machinery when the algorithm refuses the window.
+        Returns pods bound."""
+        now = self.clock.now()
+        if self.fused_run_split:
+            split: list = []
+            for e in entries:
+                if e[0] != "run" or len(e[1]) <= self.fused_run_split:
+                    split.append(e)
+                    continue
+                for lo in range(0, len(e[1]), self.fused_run_split):
+                    split.append(("run",
+                                  e[1][lo: lo + self.fused_run_split]))
+            entries = split
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        tree = self.cache.node_tree
+        tree_chk = tree.checkpoint()
+        names = tree.list_names()
+        self._last_names = names
+        segments = []
+        for e in entries:
+            if e[0] == "gang":
+                _kind, gk, group, members = e
+                self._gang_first_seen.setdefault(gk, now)
+                self._set_group_phase(gk, PHASE_PRESCHEDULING, now)
+                segments.append(([p for p, _c in members], True))
+            else:
+                segments.append(([p for p, _c in e[1]], False))
+        res = self.algorithm.schedule_burst_fused(
+            segments, self._snapshot.node_infos, names, bucket=bucket)
+        if res is None:
+            # window refused: undo the consumed enumeration and run every
+            # entry through the per-segment paths
+            tree.restore(tree_chk)
+            return self._run_entries_unfused(entries, bucket)
+        bound = 0
+        consumed = res["consumed"]
+        aborted = False
+        leftovers: list = []
+        W = max(1, int(getattr(self.algorithm, "wave_size", 4096)))
+        for e, seg in zip(entries, res["segments"]):
+            status = seg["status"]
+            if aborted or status == "undecided":
+                leftovers.append(e)
+                continue
+            if e[0] == "gang":
+                _kind, gk, group, members = e
+                pods = [p for p, _c in members]
+                cycles = [c for _p, c in members]
+                if status == "rejected":
+                    # the device carry already rewound; book the rejection
+                    # exactly like a trial rewind (park under the group
+                    # backoff, every member unschedulable)
+                    self._reject_gang(group, pods, seg["placed"])
+                    continue
+                # decided gang: ONE atomic commit for the whole group (a
+                # wave window never splits a gang, so a crash between
+                # windows cannot leave a partial gang bound)
+                committed = self._commit_burst(pods, seg["hosts"], cycles)
+                bound += committed
+                if committed < len(pods):
+                    # members vanished between decision and commit: the
+                    # survivors are bound, the rest were forgotten and
+                    # re-queued — decisions past this segment assumed the
+                    # missing folds, so stop consuming the block
+                    GANG_ATTEMPTS.labels("error").inc()
+                    self.algorithm.fused_rewind(seg["li"], seg["lni"])
+                    consumed = seg["t"]
+                    aborted = True
+                else:
+                    GANG_ATTEMPTS.labels("scheduled").inc()
+                    created = group.creation_timestamp \
+                        or self._gang_first_seen.get(gk, now)
+                    GANG_WAIT.observe(max(0.0, self.clock.now() - created))
+                self._gang_first_seen.pop(gk, None)
+                self.queue.clear_group(gk)
+            else:
+                pairs = e[1]
+                pods = [p for p, _c in pairs]
+                cycles = [c for _p, c in pairs]
+                hosts = seg["hosts"]   # decided prefix (all, unless failed)
+                short_at = None
+                for wlo in range(0, len(hosts), W):
+                    hi = min(wlo + W, len(hosts))
+                    n_b = self._commit_burst(pods[wlo:hi], hosts[wlo:hi],
+                                             cycles[wlo:hi])
+                    bound += n_b
+                    if n_b < hi - wlo:
+                        short_at = hi
+                        break
+                if short_at is not None:
+                    # short commit mid-run: rewind the walk counters to the
+                    # end of the short window (its decisions were consumed,
+                    # vanished pods re-queued) and discard the rest
+                    self.algorithm.fused_rewind(
+                        int(seg["li_seq"][short_at - 1]),
+                        int(seg["lni_seq"][short_at - 1]))
+                    consumed = int(seg["t_seq"][short_at - 1])
+                    aborted = True
+                    if short_at < len(pairs):
+                        leftovers.append(("run", pairs[short_at:]))
+                elif status == "failed" and len(hosts) < len(pairs):
+                    # the run's tail (failing pod onward) reruns through
+                    # the per-segment paths — its serial rerun may preempt
+                    leftovers.append(("run", pairs[len(hosts):]))
+        # serial semantics consume one NodeTree enumeration per decided
+        # cycle; the kernel's consumed-count (rejected gangs rewound it) is
+        # authoritative. Nothing decided -> the window's enumeration was
+        # never used: restore it so the next cycle replays identically.
+        if consumed > 0:
+            tree.advance_enumerations(consumed - 1)
+        else:
+            tree.restore(tree_chk)
+        if leftovers:
+            bound += self._run_entries_unfused(leftovers, bucket)
+        return bound
+
+    def _run_entries_unfused(self, entries: list, bucket: int) -> int:
+        """Process fused-window entries through the per-segment machinery
+        (refused windows, and leftovers behind a failure/abort)."""
+        bound = 0
+        run: list = []
+        for e in entries:
+            if e[0] == "run":
+                run.extend(e[1])
+                continue
+            if run:
+                bound += self._schedule_singletons_burst(run, bucket)
+                run = []
+            bound += self._gang_segment(e[1], e[3], bucket=bucket)
+        if run:
+            bound += self._schedule_singletons_burst(run, bucket)
+        return bound
+
     def _burst_segment(self, pods: list[Pod], cycles: list[int],
                        bucket: int) -> int:
         """Schedule one burst segment; returns pods bound."""
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         names = self.cache.node_tree.list_names()
         self._last_names = names
-        # pipelined-wave sink (tpu_scheduler.schedule_burst `commit`): the
-        # algorithm calls back with consecutive windows of DECIDED hosts
-        # while the next wave executes on the device — the host commit of
-        # wave k overlaps wave k+1's device time. A short commit (pods that
-        # vanished between decision and commit) returns False, which makes
-        # the algorithm discard the in-flight wave's decisions and rewind.
+        # wave-window sink (tpu_scheduler.schedule_burst `commit`): the
+        # algorithm fetches the whole burst's decisions as ONE packed
+        # block and calls back with consecutive `wave_size` windows of
+        # DECIDED hosts. A short commit (pods that vanished between
+        # decision and commit) returns False, which makes the algorithm
+        # stop consuming the block, rewind, and discard the rest.
         progress = {"committed": 0, "bound": 0, "failed": False}
 
         def commit_wave(lo: int, hosts: list) -> bool:

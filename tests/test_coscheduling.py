@@ -351,9 +351,12 @@ class TestGangRewindParity:
         assert run(True) == run(False)
 
     def test_device_rewind_restores_pinned_matrix(self):
-        """The zero-copy rewind: when nothing re-uploaded between
-        checkpoint and rewind, gang_rewind restores the pinned pre-gang
-        matrix instead of discarding it (no fresh upload next cycle)."""
+        """The zero-copy HOST rewind (the non-fused gang path — mesh mode
+        and refused windows still ride it): when nothing re-uploaded
+        between checkpoint and rewind, gang_rewind restores the pinned
+        pre-gang matrix instead of discarding it (no fresh upload next
+        cycle). The fused path's in-carry rewind is pinned separately
+        (TestDeviceFetchContract / TestGangRewindParity no-trace)."""
         from kubernetes_tpu.core.tpu_scheduler import GANG_REWIND_FOLDS
         store = Store(watch_log_size=65536)
         for i in range(3):
@@ -361,6 +364,9 @@ class TestGangRewindParity:
         store.create(PODGROUPS, PodGroup(name="g", min_member=4))
         sched = Scheduler(store, use_tpu=True,
                           percentage_of_nodes_to_score=100)
+        # force the per-gang trial path: this test pins the host-side
+        # checkpoint/rewind machinery, not the fused in-scan rewind
+        sched.algorithm.supports_fused_segments = False
         sched.sync()
         # a successful warmup resides the matrix on device
         store.create(PODS, singleton("warm"))
@@ -378,6 +384,60 @@ class TestGangRewindParity:
         # the pre-gang matrix was restored in place, not dropped
         assert alg._dev_nodes is not None
         assert all(alg._dev_nodes[k] is dev_before[k] for k in dev_before)
+
+
+class TestFusedWindowCrashInjection:
+    """Round-10 fused windows: the store write dies between the single
+    packed fetch and the FIRST wave commit — the decided-but-uncommitted
+    block is discarded, the fused rewind restores the walk counters, no
+    partial gang is ever visible, and the retry lands everything whole."""
+
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    def test_crash_between_fetch_and_first_commit(self, wave_size):
+        clock = FakeClock(100.0)
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, mknode(f"n{i}"))
+        store.create(PODGROUPS, PodGroup(name="g", min_member=3))
+        sched = Scheduler(store, use_tpu=True, clock=clock,
+                          percentage_of_nodes_to_score=100)
+        if wave_size:
+            sched.algorithm.wave_size = wave_size
+            sched.fused_run_split = wave_size
+        sched.sync()
+        for j in range(4):
+            store.create(PODS, singleton(f"s{j}", cpu=200))
+        for r in range(3):
+            store.create(PODS, member(f"m{r}", "g", cpu=200))
+        sched.pump()
+        from kubernetes_tpu.core.tpu_scheduler import DEVICE_FETCHES
+        f0 = DEVICE_FETCHES.labels("burst_fused").value
+        real_bind_pods = store.bind_pods
+        calls = {"n": 0}
+
+        def crashing_bind_pods(bindings):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # fires inside the first commit window, AFTER the single
+                # fetch already shipped the whole decision block
+                raise RuntimeError("store write failed mid-commit")
+            return real_bind_pods(bindings)
+
+        store.bind_pods = crashing_bind_pods
+        for _round in range(80):
+            sched.pump()
+            drain_burst(sched)
+            sched.pump()
+            assert_no_partial_gang(store)
+            if all(p.node_name for p in store.list(PODS)[0]):
+                break
+            clock.step(61.0)
+            sched.queue.flush()
+        assert calls["n"] >= 2
+        assert all(p.node_name for p in store.list(PODS)[0])
+        # the window that crashed had already fetched; the retry paid its
+        # own single fetch — never one per wave
+        assert DEVICE_FETCHES.labels("burst_fused").value - f0 >= 1
 
 
 class TestGangCrashInjection:
@@ -591,6 +651,10 @@ class TestGangBurstParity:
                               percentage_of_nodes_to_score=100)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
+                # also force small SCAN SEGMENTS inside fused windows, so
+                # the kernel's checkpoint machinery crosses many segment
+                # boundaries (non-gang boundaries are semantically inert)
+                sched.fused_run_split = wave_size
             sched.sync()
             make_workload(s)
             idle = 0

@@ -163,25 +163,26 @@ class TestPipelinedWaves:
 
     def test_multi_wave_burst_binds_everything(self):
         from kubernetes_tpu.core.tpu_scheduler import (BURST_WAVES,
-                                                       DEVICE_FETCHES,
-                                                       PIPELINE_OVERLAP)
+                                                       DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
         store, sched = self._mk()
         for j in range(22):
             store.create(PODS, mkpod(f"p{j}"))
         sched.pump()
         waves0 = BURST_WAVES.labels("uniform").value
+        disp0 = DEVICE_DISPATCH.labels("burst_uniform").value
         fetch0 = DEVICE_FETCHES.labels("burst_uniform").value
-        over0 = PIPELINE_OVERLAP.value
         n = sched.schedule_burst(max_pods=22)
         sched.pump()
         assert n == 22
         assert all(store.get(PODS, f"default/p{j}").node_name
                    for j in range(22))
-        # 22 pods at wave_size=4 -> 6 waves, ONE fetch per wave, and the
-        # commits of waves 0..4 ran while a later wave was in flight
+        # fused burst contract (round 10): 22 pods at wave_size=4 -> ONE
+        # dispatch, ONE packed fetch, and the commit consumes the fetched
+        # block in 6 wave windows
         assert BURST_WAVES.labels("uniform").value - waves0 == 6
-        assert DEVICE_FETCHES.labels("burst_uniform").value - fetch0 == 6
-        assert PIPELINE_OVERLAP.value > over0
+        assert DEVICE_DISPATCH.labels("burst_uniform").value - disp0 == 1
+        assert DEVICE_FETCHES.labels("burst_uniform").value - fetch0 == 1
 
     def test_wave_decisions_match_single_launch(self):
         def run(wave_size):

@@ -838,7 +838,7 @@ class TestSpreadBurstParity:
     including the zone blend and uneven-zone rotation."""
 
     # wave_size=4 drives the generic scan's carried spread counts and
-    # rotation walk across wave boundaries (device-chained carry_in)
+    # rotation walk across commit-window boundaries of the single block
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("n_nodes,zones,n_pods", [
         (7, 3, 20),     # uneven zones -> rotated orders in-burst
@@ -1221,3 +1221,114 @@ class TestDeviceFetchContract:
         assert res is not None and res.node is not None
         assert DEVICE_DISPATCH.labels("preempt_scan").value - d0 == 1
         assert DEVICE_FETCHES.labels("preempt_scan").value - f0 == 1
+
+    # -- round 10: EXACTLY one dispatch + one packed fetch per fused burst ----
+    def _uniform_world(self, n_nodes=5):
+        infos = {}
+        names = []
+        for i in range(n_nodes):
+            node = Node(name=f"n{i}",
+                        allocatable={"cpu": 4000, "memory": 32 * GI,
+                                     "pods": 110})
+            infos[node.name] = NodeInfo(node)
+            names.append(node.name)
+        return infos, names
+
+    def test_uniform_burst_one_fetch_across_waves(self):
+        """22 identical pods at wave_size=4: six commit waves all consume
+        ONE fetched block from ONE dispatch — a per-wave fetch sneaking
+        back in fails here before it lands as a 100ms-per-wave cliff."""
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        infos, names = self._uniform_world()
+        pods = [Pod(name=f"p{k}", labels={"app": "x"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100}),))
+                for k in range(22)]
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        tpu.wave_size = 4
+        d0 = DEVICE_DISPATCH.labels("burst_uniform").value
+        f0 = DEVICE_FETCHES.labels("burst_uniform").value
+        committed = []
+        hosts = tpu.schedule_burst(pods, infos, names,
+                                   commit=lambda lo, hs:
+                                   committed.append((lo, len(hs))) or True)
+        assert hosts is not None and all(h is not None for h in hosts)
+        assert len(committed) == 6    # wave-by-wave out of the one block
+        assert DEVICE_DISPATCH.labels("burst_uniform").value - d0 == 1
+        assert DEVICE_FETCHES.labels("burst_uniform").value - f0 == 1
+
+    def test_scan_burst_one_fetch_even_on_failure(self):
+        """Heterogeneous pods ride the generic scan; a mid-burst failure's
+        prefix rewind reads the per-pod walk counters out of the SAME
+        packed block — the failure path's second fetch is gone."""
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        infos, names = self._uniform_world(3)
+        pods = []
+        for k in range(9):
+            cpu = 20000 if k == 4 else (100 if k % 2 else 300)
+            pods.append(Pod(name=f"p{k}", labels={"sz": str(cpu)},
+                            containers=(Container.make(
+                                name="c", requests={"cpu": cpu}),)))
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        d0 = DEVICE_DISPATCH.labels("burst_scan").value
+        f0 = DEVICE_FETCHES.labels("burst_scan").value
+        hosts = tpu.schedule_burst(pods, infos, names)
+        assert hosts is not None
+        assert all(h is not None for h in hosts[:4])
+        assert all(h is None for h in hosts[4:])   # undecided from failure
+        assert DEVICE_DISPATCH.labels("burst_scan").value - d0 == 1
+        assert DEVICE_FETCHES.labels("burst_scan").value - f0 == 1
+
+    def test_fused_gang_burst_one_fetch(self):
+        """A drain window containing gang segments — one decided, one
+        REJECTED (rewound in the device carry) — plus singletons before
+        and after is still exactly ONE dispatch and ONE packed fetch."""
+        from kubernetes_tpu.core.tpu_scheduler import (BURST_SEGMENTS,
+                                                       DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        from kubernetes_tpu.coscheduling.types import (LABEL_POD_GROUP,
+                                                       PodGroup)
+        from kubernetes_tpu.store.store import Store, PODS, NODES, PODGROUPS
+        from kubernetes_tpu.scheduler import Scheduler
+        store = Store(watch_log_size=65536)
+        for i in range(4):
+            store.create(NODES, Node(
+                name=f"n{i}",
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        store.create(PODS, Pod(name="s0", containers=(Container.make(
+            name="c", requests={"cpu": 100}),)))
+        store.create(PODGROUPS, PodGroup(name="ok", min_member=3))
+        for r in range(3):
+            store.create(PODS, Pod(
+                name=f"ok-{r}", labels={LABEL_POD_GROUP: "ok"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": 200}),)))
+        store.create(PODGROUPS, PodGroup(name="toobig", min_member=3))
+        for r in range(3):
+            store.create(PODS, Pod(
+                name=f"toobig-{r}", labels={LABEL_POD_GROUP: "toobig"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": 4500}),)))
+        store.create(PODS, Pod(name="s1", containers=(Container.make(
+            name="c", requests={"cpu": 100}),)))
+        sched.pump()
+        d0 = DEVICE_DISPATCH.labels("burst_fused").value
+        f0 = DEVICE_FETCHES.labels("burst_fused").value
+        g0 = BURST_SEGMENTS.labels("gang").value
+        r0 = BURST_SEGMENTS.labels("run").value
+        sched.schedule_burst(max_pods=64)
+        sched.pump()
+        assert DEVICE_DISPATCH.labels("burst_fused").value - d0 == 1
+        assert DEVICE_FETCHES.labels("burst_fused").value - f0 == 1
+        assert BURST_SEGMENTS.labels("gang").value - g0 == 2
+        assert BURST_SEGMENTS.labels("run").value - r0 >= 1
+        by_name = {p.name: p.node_name for p in store.list(PODS)[0]}
+        assert by_name["s0"] and by_name["s1"]
+        assert all(by_name[f"ok-{r}"] for r in range(3))
+        # the rejected gang rewound in-scan: nothing bound, group parked
+        assert not any(by_name[f"toobig-{r}"] for r in range(3))
