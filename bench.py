@@ -262,6 +262,46 @@ def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
     }
 
 
+def run_commit_bench(n_pods: int = 4096, waves: int = 8,
+                     watchers: int = 8) -> dict:
+    """`--mode commit`: the round-11 commit-core lane — the store-write +
+    watch-fan-out tail of a burst wave in isolation (ONE commit_wave +
+    ONE fanout_wave call per wave; perf.harness.run_commit_cell). Runs
+    the best-available core AND the pure-Python twin on the identical
+    wave sequence and asserts the observable streams bit-identical
+    (per-wave missing keys + resourceVersions, and the full first-watcher
+    event stream) before reporting — the same in-bench referee posture as
+    the gang lane's atomicity audit. One JSON line."""
+    from kubernetes_tpu.perf.harness import run_commit_cell
+    audit: list = []
+    r = run_commit_cell(n_pods, waves, watchers, audit=audit)
+    twin_audit: list = []
+    t = run_commit_cell(n_pods, waves, watchers, impl="twin",
+                        audit=twin_audit)
+    # referee: rv assignment, missing detection, and the watch sequence
+    # must be bit-identical between the native core and the twin (both
+    # runs replay the same op sequence from rv 0)
+    assert audit[:-1] == twin_audit[:-1], "commit core rv/missing drift"
+    assert audit[-1] == twin_audit[-1], "commit core watch-stream drift"
+    serial = r["serial_writes_per_s"]
+    return {
+        "metric": f"commit_core_{n_pods}p_{waves}w",
+        "value": r["writes_per_s"],
+        "unit": "writes/s",
+        "vs_baseline": round(r["writes_per_s"] / 100.0, 2),
+        "events_per_s": r["events_per_s"],
+        "events_delivered": r["events_delivered"],
+        "watchers": watchers,
+        "impl": r["impl"],
+        # the round-10 per-pod shape measured in the SAME run — the
+        # throttle-proof normalizer the floor test divides by
+        "serial_writes_per_s": serial,
+        "vs_serial": round(r["writes_per_s"] / serial, 2) if serial else None,
+        "twin_writes_per_s": t["writes_per_s"],
+        "twin_parity": "ok",
+    }
+
+
 # the non-plain lanes of the benchmark matrix at the reference's 1000-node /
 # 1000-existing cell (scheduler_bench_test.go:61-118) plus the spread lane
 MATRIX_LANES = ("plain", "anti-affinity", "affinity", "node-affinity",
@@ -379,7 +419,7 @@ def main():
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
-                             "gang"],
+                             "gang", "commit"],
                     default="burst")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
@@ -442,6 +482,12 @@ def main():
         result = retry_transient(
             lambda: run_gang_bench(n_nodes, pods_budget=n_pods))
         finish(result)
+        return
+    if args.mode == "commit":
+        # host-only lane (no device dispatch -> no transient tunnel risk):
+        # --pods is the per-wave width, the default one full scheduler wave
+        finish(run_commit_bench(
+            n_pods=args.pods if args.pods is not None else 4096))
         return
     if args.mode == "matrix":
         # just the matrix lanes + ratio-to-plain, one JSON line (transient

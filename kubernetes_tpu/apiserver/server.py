@@ -270,7 +270,14 @@ def make_handler(store: Store, admission: AdmissionChain,
                     return False
             try:
                 while True:
-                    ev = w.next(timeout=0.5)
+                    try:
+                        ev = w.next(timeout=0.5)
+                    except ExpiredError:
+                        # this consumer fell behind the fan-out ring and
+                        # was dropped-with-resync: end the stream — the
+                        # client reconnects from its last seen rv and gets
+                        # a replay, or a 410 -> re-list (reflector contract)
+                        break
                     if ev is None:
                         # blank-line keep-alive (an empty chunk would be the
                         # stream terminator); readers skip empty lines

@@ -605,6 +605,20 @@ class TPUScheduler:
                 pod.tolerations, pod.node_name, pod.containers,
                 pod.init_containers)
 
+    @staticmethod
+    def class_signatures(pods: list) -> list:
+        """Batched _class_signature — the burst encode prologue's per-pod
+        tuple build as ONE native call (commitcore.class_signatures) when
+        the extension is built, with this module's per-pod static method as
+        the twin (tuples are equal element-for-element by construction;
+        pinned by the commit-core parity tests)."""
+        from kubernetes_tpu import native
+        mod = native.load("commitcore")
+        if mod is not None:
+            return mod.class_signatures(pods)
+        sig = TPUScheduler._class_signature
+        return [sig(p) for p in pods]
+
     def _uniform_class(self, p0: Pod, f0, b: NodeBatch,
                        node_infos: dict[str, NodeInfo]) -> Optional[tuple]:
         """Eligibility + class extraction for a burst of pods spec-identical
@@ -911,13 +925,16 @@ class TPUScheduler:
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
         uniform = None
         feats: Optional[list] = None
+        # batched signature build (one native call — the drain/encode
+        # prologue's dominant per-pod tuple cost)
+        sigs = self.class_signatures(pods)
+        uniform_spec = all(s == sigs[0] for s in sigs)
         if num_to_find >= n and self.last_index == 0:
             # spec-identical pods produce identical encoder output against a
             # fixed snapshot, so the uniform path encodes ONE pod — per-pod
             # feature encoding (IPA topology counting in particular) is the
             # dominant host cost for affinity bursts
-            sig0 = self._class_signature(pods[0])
-            if all(self._class_signature(p) == sig0 for p in pods[1:]):
+            if uniform_spec:
                 uniform = self._uniform_class(pods[0], enc.encode(pods[0]),
                                               b, node_infos)
         if uniform is not None:
@@ -944,9 +961,6 @@ class TPUScheduler:
         # spec-identical pods produce identical encoder output against a
         # fixed snapshot: encode ONE pod and share (the O(N) python feature
         # loops — spread counting especially — dominate otherwise)
-        sig0 = self._class_signature(pods[0])
-        uniform_spec = all(self._class_signature(p) == sig0
-                           for p in pods[1:])
         if uniform_spec:
             feats = [enc.encode(pods[0])] * len(pods)
         else:
@@ -1330,8 +1344,7 @@ class TPUScheduler:
                          state_encoder=self.encoder)
         feat_by_sig: dict = {}
         per_pod = []
-        for p in flat:
-            sig = self._class_signature(p)
+        for p, sig in zip(flat, self.class_signatures(flat)):
             f = feat_by_sig.get(sig)
             if f is None:
                 f = feat_by_sig[sig] = enc.encode(p)

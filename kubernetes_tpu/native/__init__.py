@@ -23,9 +23,21 @@ _lock = threading.Lock()
 _cache: dict[str, object] = {}
 
 
+def _asan() -> bool:
+    """ASan build mode (KTPU_NATIVE_ASAN=1): compile the extensions with
+    AddressSanitizer so native bugs surface as aborts-with-reports in a
+    dedicated test run, not as silent heap corruption. The instrumented
+    artifact gets its own cache name (never clobbers the fast build) and
+    only imports when the ASan runtime is preloaded (tests/test_native.py
+    runs a subprocess with LD_PRELOAD=libasan); anywhere else the import
+    fails and consumers degrade to their twins as usual."""
+    return os.environ.get("KTPU_NATIVE_ASAN") == "1"
+
+
 def _so_path(name: str) -> str:
     tag = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    return os.path.join(_DIR, f"_{name}{tag}")
+    variant = "_asan" if _asan() else ""
+    return os.path.join(_DIR, f"_{name}{variant}{tag}")
 
 
 def _build(name: str, force: bool = False) -> str:
@@ -41,6 +53,8 @@ def _build(name: str, force: bool = False) -> str:
     include = sysconfig.get_paths()["include"]
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
            f"-I{include}", src, "-o", out]
+    if _asan():
+        cmd[1:1] = ["-fsanitize=address", "-fno-omit-frame-pointer", "-g"]
     subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     return out
 

@@ -1,0 +1,862 @@
+// commitcore — native commit core for the versioned store.
+//
+// The store's three hot host loops behind the fused device pipeline
+// (ROADMAP item 3: with a burst at ONE dispatch + ONE packed fetch, the
+// serial floor is the host commit tail) become one native call each per
+// wave:
+//   1. bind_batch / create_batch / commit_wave — the versioned batched
+//      store write: resourceVersion assignment, missing-key detection,
+//      AlreadyExists raises and watch-log records with semantics
+//      bit-identical to store/commit_core.PyCommitCore (the mandatory
+//      pure-Python twin and referee; tests/test_commit_core.py pins the
+//      two against each other op-for-op).
+//   2. flush — watch fan-out: watchers are CURSORS into the per-kind
+//      bounded log ring, so delivery is O(watchers) cursor publishes per
+//      wave, with slow consumers dropped-with-resync (ExpiredError on the
+//      next poll; 410-Gone semantics) instead of buffered unboundedly.
+//   3. poll — consumer copy-out, which blocks with the GIL RELEASED
+//      (std::condition_variable) and materializes Event objects on the
+//      consumer's own thread, so watch delivery overlaps the committing
+//      thread's next wave.
+//
+// Locking contract: the rv counter and the Python-object work (clone,
+// setattr, bucket dict writes) run under the CALLER's store lock with the
+// GIL held and never touch the core mutex; the log ring + watcher cursors
+// are guarded by a std::mutex that is ONLY ever acquired with the GIL
+// released (a thread may re-acquire the GIL while holding the mutex — for
+// refcounts, never allocations — but never waits for the mutex while
+// holding the GIL, so the pair cannot deadlock). Python work that can
+// allocate (and hence run GC finalizers that might re-enter this module)
+// happens strictly outside the mutex.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// interned event-type strings + attr names (module init)
+PyObject* S_ADDED;
+PyObject* S_MODIFIED;
+PyObject* S_DELETED;
+PyObject* S_clone;
+PyObject* S_key;
+PyObject* S_node_name;
+PyObject* S_resource_version;
+PyObject* S_namespace;
+PyObject* S_labels;
+PyObject* S_node_selector;
+PyObject* S_affinity;
+PyObject* S_tolerations;
+PyObject* S_containers;
+PyObject* S_init_containers;
+PyObject* EMPTY_TUPLE;
+PyObject* DEEPCOPY;   // copy.deepcopy (clone() fallback, as store._clone)
+
+struct Entry {
+    PyObject* etype;   // owned (interned constant, incref'd per entry)
+    PyObject* obj;     // owned
+    long long rv;
+};
+
+struct KindLog {
+    std::deque<Entry> entries;
+    long long start = 0;     // absolute seq of entries[0]
+    long long flushed = 0;   // absolute seq published to watchers
+    long long end() const { return start + (long long)entries.size(); }
+};
+
+struct Watcher {
+    std::string kind;
+    long long cursor;
+    bool resync = false;
+};
+
+struct CommitCore {
+    PyObject_HEAD
+    long long rv;
+    long long log_size;
+    long long ring_size;
+    long long next_wid;
+    PyObject* event_cls;     // owned
+    PyObject* expired_exc;   // owned
+    PyObject* already_exc;   // owned
+    std::unordered_map<std::string, KindLog>* logs;
+    std::unordered_map<long long, Watcher>* watchers;
+    std::unordered_map<std::string, std::vector<long long>>* by_kind;
+    std::mutex* mu;
+    std::condition_variable* cv;
+};
+
+KindLog& kind_log(CommitCore* self, const std::string& kind) {
+    return (*self->logs)[kind];
+}
+
+// Release the GIL for the lifetime of this object (constructor) and take
+// it back at destruction. Mutex sections run inside this scope; a
+// re-acquire for refcount-only work uses block().
+//
+// Shutdown hazard: a daemon thread that re-acquires the GIL while the
+// interpreter finalizes is pthread_exit()ed by CPython — a forced unwind
+// through this extension's C++ frames, which std::terminate()s the whole
+// process. When finalization is underway we PARK the thread instead (the
+// process is exiting; the thread must not touch Python again). Callers
+// ensure no mutex is held when parking (lock guards are declared after
+// the GilRelease, so they unwind first; poll() unlocks explicitly).
+struct GilRelease {
+    PyThreadState* ts;
+    GilRelease() : ts(PyEval_SaveThread()) {}
+    ~GilRelease() { if (ts) block(); }
+    bool finalizing() const { return _Py_IsFinalizing() != 0; }
+    void block() {
+        if (finalizing()) park();
+        PyEval_RestoreThread(ts);
+        ts = nullptr;
+    }
+    [[noreturn]] static void park() {
+        for (;;)
+            std::this_thread::sleep_for(std::chrono::hours(1));
+    }
+};
+
+// -- staged-append plumbing --------------------------------------------------
+// Writers build `staged` entries (owned refs) with the GIL held and no
+// mutex, then splice them into the log ring under the mutex (GIL
+// released); evicted entries are decref'd after the mutex is dropped.
+void splice(CommitCore* self, const std::string& kind,
+            std::vector<Entry>& staged, std::vector<Entry>& evicted) {
+    GilRelease gil;
+    std::lock_guard<std::mutex> lk(*self->mu);
+    KindLog& log = kind_log(self, kind);
+    for (Entry& e : staged) {
+        log.entries.push_back(e);
+        if ((long long)log.entries.size() > self->log_size) {
+            evicted.push_back(log.entries.front());
+            log.entries.pop_front();
+            log.start += 1;
+        }
+    }
+    staged.clear();
+}
+
+void drop_entries(std::vector<Entry>& evicted) {
+    for (Entry& e : evicted) {
+        Py_DECREF(e.etype);
+        Py_DECREF(e.obj);
+    }
+    evicted.clear();
+}
+
+// snapshot an object crossing the store boundary (store._clone semantics:
+// fast clone() when present, copy.deepcopy otherwise)
+PyObject* clone_obj(PyObject* obj) {
+    PyObject* m = PyObject_GetAttr(obj, S_clone);
+    if (m != nullptr) {
+        PyObject* out = PyObject_CallNoArgs(m);
+        Py_DECREF(m);
+        return out;
+    }
+    if (!PyErr_ExceptionMatches(PyExc_AttributeError)) return nullptr;
+    PyErr_Clear();
+    return PyObject_CallOneArg(DEEPCOPY, obj);
+}
+
+// assign the next rv to `stored` (sets .resource_version); returns rv or
+// -1 on error
+long long assign_rv(CommitCore* self, PyObject* stored) {
+    self->rv += 1;
+    PyObject* rvo = PyLong_FromLongLong(self->rv);
+    if (!rvo) return -1;
+    int rc = PyObject_SetAttr(stored, S_resource_version, rvo);
+    Py_DECREF(rvo);
+    return rc < 0 ? -1 : self->rv;
+}
+
+// -- bind / create bodies (GIL held, no mutex; append via staging) -----------
+// returns 0 on success, -1 with a Python error set. Appends MODIFIED
+// entries for every landed bind to `staged` and missing keys to `missing`.
+int bind_batch_body(CommitCore* self, PyObject* bucket, PyObject* bindings,
+                    PyObject* missing, std::vector<Entry>& staged) {
+    PyObject* seq = PySequence_Fast(bindings, "bindings must be a sequence");
+    if (!seq) return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* pair = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* key;
+        PyObject* node;
+        if (PyTuple_Check(pair) && PyTuple_GET_SIZE(pair) == 2) {
+            key = PyTuple_GET_ITEM(pair, 0);
+            node = PyTuple_GET_ITEM(pair, 1);
+        } else {
+            PyErr_SetString(PyExc_TypeError, "binding must be (key, node)");
+            Py_DECREF(seq);
+            return -1;
+        }
+        PyObject* current = PyDict_GetItemWithError(bucket, key);  // borrowed
+        if (current == nullptr) {
+            if (PyErr_Occurred()) { Py_DECREF(seq); return -1; }
+            if (PyList_Append(missing, key) < 0) { Py_DECREF(seq); return -1; }
+            continue;
+        }
+        PyObject* stored = clone_obj(current);
+        if (!stored) { Py_DECREF(seq); return -1; }
+        if (PyObject_SetAttr(stored, S_node_name, node) < 0) {
+            Py_DECREF(stored); Py_DECREF(seq); return -1;
+        }
+        long long rv = assign_rv(self, stored);
+        if (rv < 0) { Py_DECREF(stored); Py_DECREF(seq); return -1; }
+        if (PyDict_SetItem(bucket, key, stored) < 0) {
+            Py_DECREF(stored); Py_DECREF(seq); return -1;
+        }
+        Py_INCREF(S_MODIFIED);
+        staged.push_back(Entry{S_MODIFIED, stored, rv});  // stored ref moves
+    }
+    Py_DECREF(seq);
+    return 0;
+}
+
+// Appends ADDED entries to `staged` and stored objects to `out` (may be
+// null). On a duplicate key, raises AlreadyExists but leaves the entries
+// staged so far in `staged` (the twin logs them before raising too).
+int create_batch_body(CommitCore* self, PyObject* bucket, const char* kind,
+                      PyObject* objs, int move, PyObject* out,
+                      std::vector<Entry>& staged) {
+    PyObject* seq = PySequence_Fast(objs, "objs must be a sequence");
+    if (!seq) return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* obj = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* key = PyObject_GetAttr(obj, S_key);
+        if (!key) { Py_DECREF(seq); return -1; }
+        int dup = PyDict_Contains(bucket, key);
+        if (dup != 0) {
+            if (dup > 0)
+                PyErr_Format(self->already_exc, "%s/%U", kind, key);
+            Py_DECREF(key); Py_DECREF(seq);
+            return -1;
+        }
+        PyObject* stored;
+        if (move) {
+            Py_INCREF(obj);
+            stored = obj;
+        } else {
+            stored = clone_obj(obj);
+            if (!stored) { Py_DECREF(key); Py_DECREF(seq); return -1; }
+        }
+        long long rv = assign_rv(self, stored);
+        if (rv < 0) { Py_DECREF(stored); Py_DECREF(key); Py_DECREF(seq); return -1; }
+        if (PyDict_SetItem(bucket, key, stored) < 0) {
+            Py_DECREF(stored); Py_DECREF(key); Py_DECREF(seq); return -1;
+        }
+        Py_DECREF(key);
+        if (out != nullptr && PyList_Append(out, stored) < 0) {
+            Py_DECREF(stored); Py_DECREF(seq); return -1;
+        }
+        Py_INCREF(S_ADDED);
+        staged.push_back(Entry{S_ADDED, stored, rv});  // stored ref moves
+    }
+    Py_DECREF(seq);
+    return 0;
+}
+
+// -- methods ----------------------------------------------------------------
+PyObject* core_rv(CommitCore* self, PyObject*) {
+    return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* core_set_rv(CommitCore* self, PyObject* arg) {
+    long long v = PyLong_AsLongLong(arg);
+    if (v == -1 && PyErr_Occurred()) return nullptr;
+    self->rv = v;
+    Py_RETURN_NONE;
+}
+
+PyObject* core_next_rv(CommitCore* self, PyObject*) {
+    self->rv += 1;
+    return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* core_append(CommitCore* self, PyObject* args) {
+    PyObject* etype;
+    const char* kind;
+    PyObject* obj;
+    long long rv;
+    if (!PyArg_ParseTuple(args, "UsOL", &etype, &kind, &obj, &rv))
+        return nullptr;
+    std::vector<Entry> staged, evicted;
+    Py_INCREF(etype);
+    Py_INCREF(obj);
+    staged.push_back(Entry{etype, obj, rv});
+    splice(self, kind, staged, evicted);
+    drop_entries(evicted);
+    Py_RETURN_NONE;
+}
+
+PyObject* core_bind_batch(CommitCore* self, PyObject* args) {
+    PyObject* bucket;
+    const char* kind;
+    PyObject* bindings;
+    if (!PyArg_ParseTuple(args, "O!sO", &PyDict_Type, &bucket, &kind,
+                          &bindings))
+        return nullptr;
+    PyObject* missing = PyList_New(0);
+    if (!missing) return nullptr;
+    std::vector<Entry> staged, evicted;
+    if (bind_batch_body(self, bucket, bindings, missing, staged) < 0) {
+        // staged entries still enter the log (the twin appends per item
+        // before any raise); callers treat a raise as partially-applied
+        splice(self, kind, staged, evicted);
+        drop_entries(evicted);
+        Py_DECREF(missing);
+        return nullptr;
+    }
+    splice(self, kind, staged, evicted);
+    drop_entries(evicted);
+    return missing;
+}
+
+PyObject* core_create_batch(CommitCore* self, PyObject* args) {
+    PyObject* bucket;
+    const char* kind;
+    PyObject* objs;
+    int move;
+    if (!PyArg_ParseTuple(args, "O!sOp", &PyDict_Type, &bucket, &kind,
+                          &objs, &move))
+        return nullptr;
+    PyObject* out = PyList_New(0);
+    if (!out) return nullptr;
+    std::vector<Entry> staged, evicted;
+    int rc = create_batch_body(self, bucket, kind, objs, move, out, staged);
+    splice(self, kind, staged, evicted);
+    drop_entries(evicted);
+    if (rc < 0) { Py_DECREF(out); return nullptr; }
+    return out;
+}
+
+PyObject* core_commit_wave(CommitCore* self, PyObject* args) {
+    PyObject* pod_bucket;
+    const char* pod_kind;
+    PyObject* bindings;
+    PyObject* ev_bucket;
+    const char* ev_kind;
+    PyObject* recs;
+    if (!PyArg_ParseTuple(args, "O!sOO!sO", &PyDict_Type, &pod_bucket,
+                          &pod_kind, &bindings, &PyDict_Type, &ev_bucket,
+                          &ev_kind, &recs))
+        return nullptr;
+    PyObject* missing = PyList_New(0);
+    if (!missing) return nullptr;
+    std::vector<Entry> pod_staged, ev_staged, evicted;
+    if (bind_batch_body(self, pod_bucket, bindings, missing,
+                        pod_staged) < 0) {
+        splice(self, pod_kind, pod_staged, evicted);
+        drop_entries(evicted);
+        Py_DECREF(missing);
+        return nullptr;
+    }
+    int rc = 0;
+    Py_ssize_t n_recs = PySequence_Size(recs);
+    if (n_recs < 0) { PyErr_Clear(); n_recs = 0; }
+    if (n_recs > 0) {
+        PyObject* picked = recs;
+        PyObject* filtered = nullptr;
+        if (PyList_GET_SIZE(missing) > 0) {
+            // recs[i] rides bindings[i]: skip the records of vanished pods
+            filtered = PyList_New(0);
+            PyObject* bseq = filtered == nullptr ? nullptr
+                : PySequence_Fast(bindings, "bindings must be a sequence");
+            PyObject* rseq = bseq == nullptr ? nullptr
+                : PySequence_Fast(recs, "recs must be a sequence");
+            if (rseq == nullptr) {
+                Py_XDECREF(bseq); Py_XDECREF(filtered);
+                Py_DECREF(missing);
+                splice(self, pod_kind, pod_staged, evicted);
+                drop_entries(evicted);
+                return nullptr;
+            }
+            Py_ssize_t nb = PySequence_Fast_GET_SIZE(bseq);
+            Py_ssize_t nr = PySequence_Fast_GET_SIZE(rseq);
+            for (Py_ssize_t i = 0; i < nb && i < nr && rc == 0; ++i) {
+                PyObject* key =
+                    PyTuple_GET_ITEM(PySequence_Fast_GET_ITEM(bseq, i), 0);
+                int found = PySequence_Contains(missing, key);
+                if (found < 0) rc = -1;
+                else if (found == 0 &&
+                         PyList_Append(filtered,
+                                       PySequence_Fast_GET_ITEM(rseq, i)) < 0)
+                    rc = -1;
+            }
+            Py_DECREF(bseq);
+            Py_DECREF(rseq);
+            picked = filtered;
+        }
+        if (rc == 0)
+            rc = create_batch_body(self, ev_bucket, ev_kind, picked, 1,
+                                   nullptr, ev_staged);
+        Py_XDECREF(filtered);
+    }
+    splice(self, pod_kind, pod_staged, evicted);
+    splice(self, ev_kind, ev_staged, evicted);
+    drop_entries(evicted);
+    if (rc < 0) { Py_DECREF(missing); return nullptr; }
+    return missing;
+}
+
+PyObject* core_flush(CommitCore* self, PyObject*) {
+    long long dropped = 0;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        for (auto& kv : *self->logs) {
+            KindLog& log = kv.second;
+            if (log.flushed >= log.end()) continue;
+            log.flushed = log.end();
+            auto it = self->by_kind->find(kv.first);
+            if (it == self->by_kind->end()) continue;
+            for (long long wid : it->second) {
+                Watcher& w = self->watchers->at(wid);
+                if (w.resync) continue;
+                long long backlog = log.flushed - w.cursor;
+                if (w.cursor < log.start || backlog > self->ring_size) {
+                    dropped += backlog;
+                    w.cursor = log.flushed;
+                    w.resync = true;
+                }
+            }
+        }
+        self->cv->notify_all();
+    }
+    return PyLong_FromLongLong(dropped);
+}
+
+PyObject* core_attach(CommitCore* self, PyObject* args) {
+    const char* kind;
+    PyObject* since = Py_None;
+    if (!PyArg_ParseTuple(args, "s|O", &kind, &since)) return nullptr;
+    long long since_rv = 0;
+    bool has_since = since != Py_None;
+    if (has_since) {
+        since_rv = PyLong_AsLongLong(since);
+        if (since_rv == -1 && PyErr_Occurred()) return nullptr;
+    }
+    long long wid = -1;
+    bool expired = false;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        KindLog& log = kind_log(self, kind);
+        long long cursor;
+        if (!has_since) {
+            cursor = log.end();
+        } else if (!log.entries.empty() &&
+                   since_rv < log.entries.front().rv - 1) {
+            expired = true;
+            cursor = 0;
+        } else {
+            // first absolute index with rv > since_rv (rvs are increasing)
+            long long lo = 0, hi = (long long)log.entries.size();
+            while (lo < hi) {
+                long long mid = (lo + hi) / 2;
+                if (log.entries[(size_t)mid].rv > since_rv) hi = mid;
+                else lo = mid + 1;
+            }
+            cursor = log.start + lo;
+        }
+        if (!expired) {
+            wid = self->next_wid++;
+            (*self->watchers)[wid] = Watcher{kind, cursor};
+            (*self->by_kind)[kind].push_back(wid);
+        }
+    }
+    if (expired) {
+        PyErr_Format(self->expired_exc, "%s: rv %lld older than log window",
+                     kind, since_rv);
+        return nullptr;
+    }
+    return PyLong_FromLongLong(wid);
+}
+
+PyObject* core_detach(CommitCore* self, PyObject* arg) {
+    long long wid = PyLong_AsLongLong(arg);
+    if (wid == -1 && PyErr_Occurred()) return nullptr;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        auto it = self->watchers->find(wid);
+        if (it != self->watchers->end()) {
+            auto& lst = (*self->by_kind)[it->second.kind];
+            for (auto v = lst.begin(); v != lst.end(); ++v) {
+                if (*v == wid) { lst.erase(v); break; }
+            }
+            self->watchers->erase(it);
+        }
+        self->cv->notify_all();
+    }
+    Py_RETURN_NONE;
+}
+
+PyObject* core_poll(CommitCore* self, PyObject* args) {
+    long long wid;
+    PyObject* timeout_obj;
+    long long limit;
+    if (!PyArg_ParseTuple(args, "LOL", &wid, &timeout_obj, &limit))
+        return nullptr;
+    bool forever = timeout_obj == Py_None;
+    double timeout = 0.0;
+    if (!forever) {
+        timeout = PyFloat_AsDouble(timeout_obj);
+        if (timeout == -1.0 && PyErr_Occurred()) return nullptr;
+    }
+    std::vector<Entry> picked;
+    std::string kind;
+    bool expired = false, evicted_window = false;
+    {
+        GilRelease gil;
+        std::unique_lock<std::mutex> lk(*self->mu);
+        auto deadline = std::chrono::steady_clock::now() +
+            std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                std::chrono::duration<double>(timeout > 0 ? timeout : 0));
+        for (;;) {
+            auto it = self->watchers->find(wid);
+            if (it == self->watchers->end()) break;   // stopped -> []
+            Watcher& w = it->second;
+            kind = w.kind;
+            if (w.resync) { expired = true; break; }
+            KindLog& log = kind_log(self, kind);
+            if (w.cursor < log.start) {
+                // the ring evicted entries this watcher never consumed
+                w.resync = true;
+                expired = evicted_window = true;
+                break;
+            }
+            long long avail = log.flushed - w.cursor;
+            if (avail > 0) {
+                long long n = avail < limit ? avail : limit;
+                size_t lo = (size_t)(w.cursor - log.start);
+                // take raw pointers under the mutex (eviction can't run
+                // while we hold it), incref below before releasing it
+                for (long long i = 0; i < n; ++i)
+                    picked.push_back(log.entries[lo + (size_t)i]);
+                w.cursor += n;
+                break;
+            }
+            if (!forever && timeout <= 0) break;
+            if (forever) {
+                self->cv->wait(lk);
+            } else if (self->cv->wait_until(lk, deadline) ==
+                       std::cv_status::timeout) {
+                timeout = 0;   // one last non-blocking re-check
+            }
+        }
+        if (!picked.empty()) {
+            // refcount-only work with the GIL re-acquired while STILL
+            // holding the mutex (no allocations here — see lock contract);
+            // at interpreter shutdown, release the mutex before parking
+            if (gil.finalizing()) lk.unlock();
+            gil.block();
+            for (Entry& e : picked) {
+                Py_INCREF(e.etype);
+                Py_INCREF(e.obj);
+            }
+        }
+    }
+    if (expired) {
+        if (evicted_window)
+            PyErr_Format(self->expired_exc,
+                         "%s: rv window evicted before copy-out",
+                         kind.c_str());
+        else
+            PyErr_Format(self->expired_exc,
+                         "%s: watch dropped (resync required)", kind.c_str());
+        return nullptr;
+    }
+    PyObject* out = PyList_New((Py_ssize_t)picked.size());
+    PyObject* kind_str = nullptr;
+    if (out != nullptr && !picked.empty())
+        kind_str = PyUnicode_FromStringAndSize(kind.data(),
+                                               (Py_ssize_t)kind.size());
+    for (size_t i = 0; i < picked.size(); ++i) {
+        Entry& e = picked[i];
+        PyObject* ev = nullptr;
+        if (out != nullptr && (kind_str != nullptr || picked.empty())) {
+            PyObject* rvo = PyLong_FromLongLong(e.rv);
+            if (rvo != nullptr) {
+                ev = PyObject_CallFunctionObjArgs(
+                    self->event_cls, e.etype, kind_str, e.obj, rvo, nullptr);
+                Py_DECREF(rvo);
+            }
+        }
+        Py_DECREF(e.etype);
+        Py_DECREF(e.obj);
+        if (ev == nullptr) {
+            Py_CLEAR(out);
+            continue;   // keep releasing the remaining picked refs
+        }
+        PyList_SET_ITEM(out, (Py_ssize_t)i, ev);
+    }
+    Py_XDECREF(kind_str);
+    return out;
+}
+
+PyObject* core_backlog(CommitCore* self, PyObject* arg) {
+    long long wid = PyLong_AsLongLong(arg);
+    if (wid == -1 && PyErr_Occurred()) return nullptr;
+    long long n = 0;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        auto it = self->watchers->find(wid);
+        if (it != self->watchers->end()) {
+            KindLog& log = kind_log(self, it->second.kind);
+            long long cur = it->second.cursor;
+            if (cur < log.start) cur = log.start;
+            n = log.flushed - cur;
+            if (n < 0) n = 0;
+        }
+    }
+    return PyLong_FromLongLong(n);
+}
+
+PyObject* core_log_window(CommitCore* self, PyObject* arg) {
+    const char* kind = PyUnicode_AsUTF8(arg);
+    if (!kind) return nullptr;
+    long long first = 0, last = 0;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        KindLog& log = kind_log(self, kind);
+        if (!log.entries.empty()) {
+            first = log.entries.front().rv;
+            last = log.entries.back().rv;
+        }
+    }
+    return Py_BuildValue("(LL)", first, last);
+}
+
+// -- lifecycle --------------------------------------------------------------
+PyObject* core_new(PyTypeObject* type, PyObject* args, PyObject*) {
+    long long log_size, ring_size;
+    PyObject* event_cls;
+    PyObject* expired_exc;
+    PyObject* already_exc;
+    if (!PyArg_ParseTuple(args, "LLOOO", &log_size, &ring_size, &event_cls,
+                          &expired_exc, &already_exc))
+        return nullptr;
+    CommitCore* self = (CommitCore*)type->tp_alloc(type, 0);
+    if (!self) return nullptr;
+    self->rv = 0;
+    self->log_size = log_size;
+    self->ring_size = ring_size;
+    self->next_wid = 0;
+    Py_INCREF(event_cls);
+    self->event_cls = event_cls;
+    Py_INCREF(expired_exc);
+    self->expired_exc = expired_exc;
+    Py_INCREF(already_exc);
+    self->already_exc = already_exc;
+    self->logs = new std::unordered_map<std::string, KindLog>();
+    self->watchers = new std::unordered_map<long long, Watcher>();
+    self->by_kind =
+        new std::unordered_map<std::string, std::vector<long long>>();
+    self->mu = new std::mutex();
+    self->cv = new std::condition_variable();
+    return (PyObject*)self;
+}
+
+void core_dealloc(CommitCore* self) {
+    if (self->logs) {
+        // dealloc may run on the FINALIZING thread (shutdown GC), where
+        // GilRelease would park forever — lock with the GIL held instead.
+        // Safe here: dealloc implies refcount 0, so no poll() is active
+        // (an in-flight call holds a reference through its frame), and no
+        // mutex section can therefore be waiting on the GIL.
+        bool waiters;
+        {
+            std::lock_guard<std::mutex> lk(*self->mu);
+            waiters = !self->watchers->empty();
+            self->cv->notify_all();
+        }
+        for (auto& kv : *self->logs) {
+            for (Entry& e : kv.second.entries) {
+                Py_DECREF(e.etype);
+                Py_DECREF(e.obj);
+            }
+        }
+        delete self->logs;
+        delete self->by_kind;
+        if (!waiters) {
+            // a watcher that was never detached may still be blocked in
+            // poll (a daemon thread at teardown): destroying a mutex/cv
+            // with waiters is UB, so in that case the three small C++
+            // objects are deliberately leaked
+            delete self->watchers;
+            delete self->mu;
+            delete self->cv;
+        }
+    }
+    Py_XDECREF(self->event_cls);
+    Py_XDECREF(self->expired_exc);
+    Py_XDECREF(self->already_exc);
+    Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyMethodDef core_methods[] = {
+    {"rv", (PyCFunction)core_rv, METH_NOARGS, "current resourceVersion"},
+    {"set_rv", (PyCFunction)core_set_rv, METH_O, "set the rv counter"},
+    {"next_rv", (PyCFunction)core_next_rv, METH_NOARGS,
+     "increment and return the rv counter"},
+    {"append", (PyCFunction)core_append, METH_VARARGS,
+     "append(etype, kind, obj, rv) — one pending log entry"},
+    {"bind_batch", (PyCFunction)core_bind_batch, METH_VARARGS,
+     "bind_batch(bucket, kind, bindings) -> missing keys"},
+    {"create_batch", (PyCFunction)core_create_batch, METH_VARARGS,
+     "create_batch(bucket, kind, objs, move) -> stored objects"},
+    {"commit_wave", (PyCFunction)core_commit_wave, METH_VARARGS,
+     "commit_wave(pod_bucket, pod_kind, bindings, ev_bucket, ev_kind, "
+     "recs) -> missing keys"},
+    {"flush", (PyCFunction)core_flush, METH_NOARGS,
+     "publish pending entries to watchers -> events dropped"},
+    {"attach", (PyCFunction)core_attach, METH_VARARGS,
+     "attach(kind, since_rv=None) -> watcher id (raises on expired rv)"},
+    {"detach", (PyCFunction)core_detach, METH_O, "remove a watcher"},
+    {"poll", (PyCFunction)core_poll, METH_VARARGS,
+     "poll(wid, timeout, limit) -> list[Event] (GIL released while "
+     "blocked; raises ExpiredError when dropped)"},
+    {"backlog", (PyCFunction)core_backlog, METH_O,
+     "published-but-unconsumed events for a watcher"},
+    {"log_window", (PyCFunction)core_log_window, METH_O,
+     "(first rv retained, last rv) of a kind's log ring"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject CommitCoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    .tp_name = "_commitcore.CommitCore",
+    .tp_basicsize = sizeof(CommitCore),
+    .tp_dealloc = (destructor)core_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = PyDoc_STR("versioned store write log + watch fan-out core"),
+    .tp_methods = core_methods,
+    .tp_new = core_new,
+};
+
+// -- class_signatures: the activeQ drain/encode prologue's hot tuple ---------
+// twin: core/tpu_scheduler.TPUScheduler._class_signature — identical
+// tuples by construction (the parity tests compare them element-wise)
+PyObject* sorted_items(PyObject* d) {
+    if (PyDict_Check(d) && PyDict_GET_SIZE(d) == 0) {
+        Py_INCREF(EMPTY_TUPLE);
+        return EMPTY_TUPLE;
+    }
+    PyObject* items = PyMapping_Items(d);
+    if (!items) return nullptr;
+    if (PyList_Sort(items) < 0) { Py_DECREF(items); return nullptr; }
+    PyObject* out = PyList_AsTuple(items);
+    Py_DECREF(items);
+    return out;
+}
+
+PyObject* class_signatures(PyObject*, PyObject* arg) {
+    PyObject* seq = PySequence_Fast(arg, "pods must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    PyObject* out = PyList_New(n);
+    if (!out) { Py_DECREF(seq); return nullptr; }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* p = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* sig = PyTuple_New(8);
+        int ok = sig != nullptr;
+        if (ok) {
+            struct { PyObject* attr; int slot; int sort; } fields[] = {
+                {S_namespace, 0, 0}, {S_labels, 1, 1},
+                {S_node_selector, 2, 1}, {S_affinity, 3, 0},
+                {S_tolerations, 4, 0}, {S_node_name, 5, 0},
+                {S_containers, 6, 0}, {S_init_containers, 7, 0},
+            };
+            for (auto& f : fields) {
+                PyObject* v = PyObject_GetAttr(p, f.attr);
+                if (!v) { ok = 0; break; }
+                if (f.sort) {
+                    PyObject* t = sorted_items(v);
+                    Py_DECREF(v);
+                    if (!t) { ok = 0; break; }
+                    v = t;
+                }
+                PyTuple_SET_ITEM(sig, f.slot, v);
+            }
+        }
+        if (!ok) {
+            Py_XDECREF(sig);
+            Py_DECREF(out);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        PyList_SET_ITEM(out, i, sig);
+    }
+    Py_DECREF(seq);
+    return out;
+}
+
+PyMethodDef module_methods[] = {
+    {"class_signatures", (PyCFunction)class_signatures, METH_O,
+     "class_signatures(pods) -> [signature tuple per pod] — the batched "
+     "twin of TPUScheduler._class_signature"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef commitcore_module = {
+    PyModuleDef_HEAD_INIT, "_commitcore",
+    "native store commit core (batched write + watch fan-out)", -1,
+    module_methods,
+};
+
+int intern(PyObject** slot, const char* s) {
+    *slot = PyUnicode_InternFromString(s);
+    return *slot == nullptr ? -1 : 0;
+}
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__commitcore(void) {
+    if (intern(&S_ADDED, "ADDED") < 0 || intern(&S_MODIFIED, "MODIFIED") < 0
+        || intern(&S_DELETED, "DELETED") < 0 || intern(&S_clone, "clone") < 0
+        || intern(&S_key, "key") < 0 || intern(&S_node_name, "node_name") < 0
+        || intern(&S_resource_version, "resource_version") < 0
+        || intern(&S_namespace, "namespace") < 0
+        || intern(&S_labels, "labels") < 0
+        || intern(&S_node_selector, "node_selector") < 0
+        || intern(&S_affinity, "affinity") < 0
+        || intern(&S_tolerations, "tolerations") < 0
+        || intern(&S_containers, "containers") < 0
+        || intern(&S_init_containers, "init_containers") < 0)
+        return nullptr;
+    EMPTY_TUPLE = PyTuple_New(0);
+    if (!EMPTY_TUPLE) return nullptr;
+    PyObject* copy_mod = PyImport_ImportModule("copy");
+    if (!copy_mod) return nullptr;
+    DEEPCOPY = PyObject_GetAttrString(copy_mod, "deepcopy");
+    Py_DECREF(copy_mod);
+    if (!DEEPCOPY) return nullptr;
+    if (PyType_Ready(&CommitCoreType) < 0) return nullptr;
+    if (PyDict_SetItemString(CommitCoreType.tp_dict, "is_native",
+                             Py_True) < 0)
+        return nullptr;
+    PyObject* m = PyModule_Create(&commitcore_module);
+    if (!m) return nullptr;
+    Py_INCREF(&CommitCoreType);
+    if (PyModule_AddObject(m, "CommitCore", (PyObject*)&CommitCoreType) < 0) {
+        Py_DECREF(&CommitCoreType);
+        Py_DECREF(m);
+        return nullptr;
+    }
+    return m;
+}

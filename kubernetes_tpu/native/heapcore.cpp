@@ -17,6 +17,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -35,10 +36,16 @@ inline bool less(const Entry& x, const Entry& y) {
     return x.c < y.c;
 }
 
+// The sift/index work is pure C++ (payloads are opaque, refcounts only
+// change at the Python boundary), so the batched drain releases the GIL
+// around it; `mu` keeps the structure consistent for the GIL-holding
+// single-item calls that may interleave. No heapcore mutex section ever
+// (re)acquires the GIL, so taking `mu` with the GIL held cannot deadlock.
 struct HeapCore {
     PyObject_HEAD
     std::vector<Entry>* items;
     std::unordered_map<std::string, size_t>* index;
+    std::mutex* mu;
 };
 
 void set_pos(HeapCore* self, size_t i) {
@@ -105,6 +112,7 @@ PyObject* heap_add(HeapCore* self, PyObject* args) {
         return nullptr;
     std::string k(key, (size_t)klen);
     Py_INCREF(payload);
+    std::lock_guard<std::mutex> lk(*self->mu);
     auto it = self->index->find(k);
     if (it != self->index->end()) {
         Entry& e = (*self->items)[it->second];
@@ -125,6 +133,7 @@ PyObject* heap_get(HeapCore* self, PyObject* arg) {
     Py_ssize_t klen;
     const char* key = PyUnicode_AsUTF8AndSize(arg, &klen);
     if (!key) return nullptr;
+    std::lock_guard<std::mutex> lk(*self->mu);
     auto it = self->index->find(std::string(key, (size_t)klen));
     if (it == self->index->end()) Py_RETURN_NONE;
     PyObject* p = (*self->items)[it->second].payload;
@@ -136,17 +145,43 @@ PyObject* heap_delete(HeapCore* self, PyObject* arg) {
     Py_ssize_t klen;
     const char* key = PyUnicode_AsUTF8AndSize(arg, &klen);
     if (!key) return nullptr;
+    std::lock_guard<std::mutex> lk(*self->mu);
     auto it = self->index->find(std::string(key, (size_t)klen));
     if (it == self->index->end()) Py_RETURN_NONE;
     return remove_at(self, it->second);
 }
 
 PyObject* heap_pop(HeapCore* self, PyObject*) {
+    std::lock_guard<std::mutex> lk(*self->mu);
     if (self->items->empty()) Py_RETURN_NONE;
     return remove_at(self, 0);
 }
 
+PyObject* heap_pop_many(HeapCore* self, PyObject* arg) {
+    // batched drain: up to `limit` ascending pops as ONE call, the sifts
+    // running with the GIL RELEASED (the queue's pop_burst prologue)
+    Py_ssize_t limit = PyNumber_AsSsize_t(arg, PyExc_OverflowError);
+    if (limit == -1 && PyErr_Occurred()) return nullptr;
+    std::vector<PyObject*> popped;   // owned refs transferred from entries
+    Py_BEGIN_ALLOW_THREADS
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        while ((Py_ssize_t)popped.size() < limit && !self->items->empty())
+            popped.push_back(remove_at(self, 0));
+    }
+    Py_END_ALLOW_THREADS
+    PyObject* out = PyList_New((Py_ssize_t)popped.size());
+    if (!out) {
+        for (PyObject* p : popped) Py_DECREF(p);
+        return nullptr;
+    }
+    for (size_t i = 0; i < popped.size(); ++i)
+        PyList_SET_ITEM(out, (Py_ssize_t)i, popped[i]);
+    return out;
+}
+
 PyObject* heap_peek(HeapCore* self, PyObject*) {
+    std::lock_guard<std::mutex> lk(*self->mu);
     if (self->items->empty()) Py_RETURN_NONE;
     PyObject* p = (*self->items)[0].payload;
     Py_INCREF(p);
@@ -154,6 +189,7 @@ PyObject* heap_peek(HeapCore* self, PyObject*) {
 }
 
 PyObject* heap_list(HeapCore* self, PyObject*) {
+    std::lock_guard<std::mutex> lk(*self->mu);
     PyObject* out = PyList_New((Py_ssize_t)self->items->size());
     if (!out) return nullptr;
     for (size_t i = 0; i < self->items->size(); ++i) {
@@ -171,10 +207,12 @@ int heap_contains(HeapCore* self, PyObject* arg) {
         PyErr_Clear();
         return 0;
     }
+    std::lock_guard<std::mutex> lk(*self->mu);
     return self->index->count(std::string(key, (size_t)klen)) ? 1 : 0;
 }
 
 Py_ssize_t heap_len(HeapCore* self) {
+    std::lock_guard<std::mutex> lk(*self->mu);
     return (Py_ssize_t)self->items->size();
 }
 
@@ -183,6 +221,7 @@ PyObject* heap_new(PyTypeObject* type, PyObject*, PyObject*) {
     if (!self) return nullptr;
     self->items = new std::vector<Entry>();
     self->index = new std::unordered_map<std::string, size_t>();
+    self->mu = new std::mutex();
     return (PyObject*)self;
 }
 
@@ -191,6 +230,7 @@ void heap_dealloc(HeapCore* self) {
         for (Entry& e : *self->items) Py_XDECREF(e.payload);
         delete self->items;
         delete self->index;
+        delete self->mu;
     }
     Py_TYPE(self)->tp_free((PyObject*)self);
 }
@@ -202,6 +242,9 @@ PyMethodDef heap_methods[] = {
     {"delete", (PyCFunction)heap_delete, METH_O,
      "remove by key, returning the payload or None"},
     {"pop", (PyCFunction)heap_pop, METH_NOARGS, "remove + return the min"},
+    {"pop_many", (PyCFunction)heap_pop_many, METH_O,
+     "pop_many(limit) — up to limit ascending pops as one call (GIL "
+     "released during the sifts)"},
     {"peek", (PyCFunction)heap_peek, METH_NOARGS, "the min without removal"},
     {"list", (PyCFunction)heap_list, METH_NOARGS, "payloads, heap order"},
     {nullptr, nullptr, 0, nullptr},

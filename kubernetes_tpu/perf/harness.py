@@ -19,7 +19,7 @@ from kubernetes_tpu.api.types import LABEL_HOSTNAME, LABEL_ZONE_FAILURE_DOMAIN
 from kubernetes_tpu.models.hollow import (
     NodeStrategy, PodStrategy, make_pods, populate_store,
 )
-from kubernetes_tpu.store.store import Store, PODS
+from kubernetes_tpu.store.store import Store, EVENTS, PODS
 from kubernetes_tpu.scheduler import Scheduler
 
 MIN_QPS_THRESHOLD = 30      # scheduler_test.go:35 (fail)
@@ -321,6 +321,10 @@ BENCHMARK_MATRIX = {
     # run via run_preempt_cell (warm victim table, one launch per wave;
     # 128 = one full PRESSURE_B_CAP chunk, the throughput configuration)
     "preempt": [(1000, 10000, 16), (1000, 10000, 128)],
+    # commit-core cells: (pods-per-wave, waves, watchers) — run via
+    # run_commit_cell (the round-11 store-write + fan-out tail; the
+    # 4096-pod cell is one full default scheduler wave)
+    "commit": [(1024, 8, 8), (4096, 8, 8)],
 }
 
 
@@ -376,6 +380,99 @@ def run_gang_cell(nodes: int = 1000, gang_size: int = 64,
     throughput = scheduled / elapsed if elapsed > 0 else 0.0
     return PerfResult(scheduled, elapsed, throughput, throughput,
                       dict(sched.metrics.schedule_attempts))
+
+
+def run_commit_cell(n_pods: int = 4096, waves: int = 8,
+                    n_watchers: int = 8, impl: Optional[str] = None,
+                    audit: Optional[list] = None) -> dict:
+    """Commit-core cell (`bench.py --mode commit`): the store-write +
+    fan-out tail of a burst wave in isolation — `waves` waves of `n_pods`
+    binds each, every wave ONE `commit_wave` call (batched bind + the
+    Scheduled audit-record creates) and ONE `fanout_wave` call, with
+    `n_watchers` live pod watchers copying events out on their own
+    threads (the overlap the core's GIL-released poll buys).
+
+    Reports writes/s (binds + event creates landed; the watchers are
+    ATTACHED during the timed loop, so every fanout_wave pays its cursor
+    publishes) and events/s (events copied out through the watcher
+    fan-out, timed as its own phase — on a single-core box a concurrent
+    consumer just timeshares the GIL with the commit loop and turns both
+    numbers into scheduler noise; the threaded-overlap correctness is
+    pinned by tests/test_commit_core.py instead). `impl` pins the core
+    ("native"/"twin"); when `audit` is a list, every wave's (missing,
+    rv-after) and the full first-watcher event stream are appended so the
+    caller can referee native vs twin bit-for-bit."""
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.store.record import EventRecorder
+    store = Store(watch_log_size=max(1 << 17, 4 * n_pods * waves),
+                  commit_core=impl)
+    recorder = EventRecorder(store)
+    MI = 1024 ** 2
+    for j in range(n_pods):
+        store.create(PODS, Pod(
+            name=f"p{j}", labels={"app": "commit"},
+            containers=(Container.make(
+                name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
+    pods_by_key = {p.key: p for p in store.list(PODS)[0]}
+    keys = [f"default/p{j}" for j in range(n_pods)]
+    watches = [store.watch(PODS) for _ in range(n_watchers)]
+    writes = 0
+    t0 = time.perf_counter()
+    for wv in range(waves):
+        # the binding subresource is unconditional, so re-binding the same
+        # pods wave after wave exercises the steady-state commit path
+        bindings = [(k, f"n{wv}") for k in keys]
+        recs = recorder.make_pod_records([
+            (pods_by_key[k], "Normal", "Scheduled",
+             f"Successfully assigned {k} to n{wv}") for k in keys])
+        missing = store.commit_wave(bindings, recs)
+        store.fanout_wave()
+        writes += 2 * len(bindings) - len(missing)
+        if audit is not None:
+            audit.append((list(missing), store.resource_version()))
+    elapsed = time.perf_counter() - t0
+    # copy-out phase: drain every watcher (Event materialization happens
+    # here, on the consumer side — the cost fan-out moved OFF the commit
+    # thread above)
+    delivered = 0
+    audit_stream: list = []
+    t1 = time.perf_counter()
+    for i, w in enumerate(watches):
+        evs = w.drain()
+        delivered += len(evs)
+        if audit is not None and i == 0:
+            audit_stream = [(e.type, e.resource_version, e.obj.key,
+                             e.obj.node_name) for e in evs]
+    t_drain = time.perf_counter() - t1
+    # reference: the per-pod verb shape (serial bind_pod + its record
+    # construction + per-record create, watchers still attached — the
+    # same work per write the wave loop timed) measured IN THE SAME RUN,
+    # so the floor check can normalize against whatever CPU
+    # quota/throttle this box is under right now (absolute writes/s here
+    # swing 3-4x run to run with cgroup credits)
+    ref_n = min(n_pods, 1024)
+    t2 = time.perf_counter()
+    for k in keys[:ref_n]:
+        store.bind_pod(k, "ref")
+        rec = recorder.make_pod_records([
+            (pods_by_key[k], "Normal", "Scheduled",
+             f"Successfully assigned {k} to ref")])[0]
+        store.create(EVENTS, rec, move=True)
+    t_ref = time.perf_counter() - t2
+    for w in watches:
+        w.stop()
+    if audit is not None:
+        audit.append(audit_stream)
+    return {
+        "writes_per_s": round(writes / elapsed, 1) if elapsed else 0.0,
+        "events_per_s": round(delivered / t_drain, 1) if t_drain else 0.0,
+        "serial_writes_per_s": round(2 * ref_n / t_ref, 1) if t_ref else 0.0,
+        "writes": writes,
+        "events_delivered": delivered,
+        "waves": waves,
+        "watchers": n_watchers,
+        "impl": store.core_impl,
+    }
 
 
 def run_benchmark_cell(workload: str, nodes: int, existing: int,

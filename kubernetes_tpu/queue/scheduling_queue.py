@@ -223,19 +223,17 @@ class PriorityQueue:
                 self._cond.wait(wait)
 
     def pop_burst(self, limit: int) -> list[tuple[Pod, int]]:
-        """Drain up to `limit` ready pods under one lock acquisition —
-        (pod, scheduling_cycle) pairs, cycle numbering identical to `limit`
-        successive pop() calls. Non-blocking; the burst shell's drain loop."""
+        """Drain up to `limit` ready pods under one lock acquisition and
+        ONE heap-core call (pop_many: the sifts run with the GIL released
+        on the native core) — (pod, scheduling_cycle) pairs, cycle
+        numbering identical to `limit` successive pop() calls.
+        Non-blocking; the burst shell's drain prologue."""
         with self._cond:
             self._flush_locked()
-            out: list[tuple[Pod, int]] = []
-            while len(out) < limit:
-                q = self._active.pop()
-                if q is None:
-                    break
-                self._scheduling_cycle += 1
-                out.append((q.pod, self._scheduling_cycle))
-            return out
+            base = self._scheduling_cycle
+            qs = self._active.pop_many(limit)
+            self._scheduling_cycle += len(qs)
+            return [(q.pod, base + i + 1) for i, q in enumerate(qs)]
 
     # -- gang (coscheduling) ops --------------------------------------------
     def pop_group(self, group_key: str,

@@ -1356,14 +1356,32 @@ class Scheduler:
                     gen = self.cache.node_generation(host)
                     if gen is not None:
                         note(assumed, host, gen)
+        # the wave's whole store-write tail — batched binds PLUS the
+        # Scheduled audit records for the binds that land — is ONE
+        # commit-core call (native/commitcore.cpp or its Python twin);
+        # watch fan-out is deliberately deferred to the ONE fanout_wave
+        # call below so consumers copy events out while this thread
+        # finishes the cache/metric tail (the call-count contract is
+        # pinned by TestCommitWaveContract)
+        bindings = [(a.key, h) for a, h in zip(assumed_list, hosts)]
+        commit_wave = getattr(self.store, "commit_wave", None)
+        emit_batch = commit_wave is None
         try:
-            missing = set(self.store.bind_pods(
-                [(a.key, h) for a, h in zip(assumed_list, hosts)]))
+            if commit_wave is not None:
+                recs = self.recorder.make_pod_records([
+                    (a, NORMAL, "Scheduled",
+                     f"Successfully assigned {a.key} to {h}")
+                    for a, h in zip(assumed_list, hosts)])
+                missing = set(commit_wave(bindings, recs))
+            else:
+                missing = set(self.store.bind_pods(bindings))
         except Exception:
             # a mid-batch store failure may have partially committed:
             # resolve each pod by what actually landed — bound pods finish,
             # the rest forget + re-queue, exactly like the serial _bind's
-            # per-pod failure handling
+            # per-pod failure handling (their audit records re-emit below;
+            # fire-and-forget records tolerate the crash-path duplicate)
+            emit_batch = True
             missing = set()
             for assumed, host in zip(assumed_list, hosts):
                 try:
@@ -1376,6 +1394,10 @@ class Scheduler:
                     continue
                 if landed.node_name != host:
                     missing.add(assumed.key)
+        finally:
+            fanout = getattr(self.store, "fanout_wave", None)
+            if fanout is not None:
+                fanout()
         bound = []
         for assumed, pod, host, cycle in zip(assumed_list, pods, hosts,
                                              cycles):
@@ -1397,10 +1419,12 @@ class Scheduler:
         self.metrics.binding_duration.observe_many(dt / k, k)
         self.metrics.observe_phase("binding", dt / k, count=k)
         self.metrics.observe("scheduled", count=k)
-        # audit records land in one store write (scheduler.go:433 per pod)
-        self.recorder.pod_events_batch([
-            (a, NORMAL, "Scheduled",
-             f"Successfully assigned {a.key} to {h}") for a, h in bound])
+        if emit_batch:
+            # stores without the wave verb (and the crash-resolution path)
+            # land audit records in one batched write (scheduler.go:433)
+            self.recorder.pod_events_batch([
+                (a, NORMAL, "Scheduled",
+                 f"Successfully assigned {a.key} to {h}") for a, h in bound])
         return k
 
     def _assume_for_burst(self, pod: Pod, host: str) -> Pod:

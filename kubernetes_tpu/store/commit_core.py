@@ -1,0 +1,321 @@
+"""Commit core: the store's versioned write log + watch fan-out engine.
+
+This module is the REFEREE for `kubernetes_tpu/native/commitcore.cpp` — the
+C++ CPython extension that turns the store's three hot host loops (batched
+bind, batched create+event write, watch fan-out) into one native call each
+per burst wave. Both implementations expose the same object protocol and
+must produce BIT-IDENTICAL observable state: resourceVersion assignment
+order, missing-key detection, AlreadyExists raises, per-watcher event
+sequences, and overflow/resync decisions (tests/test_commit_core.py pins
+them against each other op-for-op).
+
+Design (shared by twin and native):
+
+- The core owns the store's rv counter and the per-kind event LOG — a
+  bounded ring of (etype, obj, rv) entries with an absolute sequence
+  number. Objects in the log are the store's write snapshots (the same
+  aliasing contract as before: read-only by convention).
+- A watcher is a CURSOR into its kind's log, not a private queue: fan-out
+  is O(watchers) per wave (advance the published cursor + wake sleepers),
+  not O(watchers x events), and the consumer thread materializes its own
+  `Event` objects at copy-out — moving that per-event cost OFF the commit
+  thread (the native core also releases the GIL while a consumer blocks,
+  so watch delivery overlaps the next wave's commit).
+- Slow consumers are BOUNDED: a watcher whose backlog exceeds `ring_size`
+  (or whose cursor falls out of the log ring) is dropped-with-resync —
+  its pending events are discarded and the next poll raises ExpiredError,
+  exactly the reference's 410-Gone watch-cache semantics. The store
+  counts these on `watch_dropped_total{reason}`.
+- Writes APPEND pending entries without delivering; `flush()` publishes
+  them to watchers in log order. Serial store verbs flush before
+  returning; `Store.commit_wave` defers so the wave's fan-out is one
+  separate call (`Store.fanout_wave`) that can overlap the commit tail.
+
+The rv counter and log appends are guarded by the Store's lock (every
+writer holds it); the cursor/notify state has its own condition so
+copy-out never touches the store lock.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import threading
+from bisect import bisect_right
+from typing import Any, Optional
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+def _clone(obj: Any) -> Any:
+    """The store's write-snapshot rule: a fast clone() when the type has
+    one, deepcopy otherwise (identical to store._clone; the native core
+    implements the same attribute probe)."""
+    c = getattr(obj, "clone", None)
+    return c() if c is not None else copy.deepcopy(obj)
+
+
+class _KindLog:
+    __slots__ = ("entries", "rvs", "start", "flushed")
+
+    def __init__(self):
+        self.entries: list = []   # (etype, obj, rv) from abs seq `start`
+        self.rvs: list[int] = []  # parallel rv vector (attach binary search)
+        self.start = 0            # absolute seq of entries[0]
+        self.flushed = 0          # absolute seq events are published up to
+
+    @property
+    def end(self) -> int:
+        return self.start + len(self.entries)
+
+
+class _Watcher:
+    __slots__ = ("kind", "cursor", "resync", "stopped")
+
+    def __init__(self, kind: str, cursor: int):
+        self.kind = kind
+        self.cursor = cursor      # absolute seq of the next entry to read
+        self.resync = False
+        self.stopped = False
+
+
+class PyCommitCore:
+    """Pure-Python twin of native/commitcore.cpp (identical semantics)."""
+
+    is_native = False
+
+    def __init__(self, log_size: int, ring_size: int,
+                 event_cls, expired_exc, already_exists_exc):
+        self._log_size = int(log_size)
+        self._ring_size = int(ring_size)
+        self._event_cls = event_cls
+        self._expired = expired_exc
+        self._already = already_exists_exc
+        self._rv = 0
+        self._logs: dict[str, _KindLog] = {}
+        self._watchers: dict[int, _Watcher] = {}
+        self._by_kind: dict[str, list[int]] = {}
+        self._next_wid = 0
+        self._cond = threading.Condition(threading.Lock())
+
+    # -- rv ------------------------------------------------------------------
+    def rv(self) -> int:
+        return self._rv
+
+    def set_rv(self, v: int) -> None:
+        self._rv = int(v)
+
+    def next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    # -- log append (pending; caller holds the store lock) -------------------
+    def _kind_log(self, kind: str) -> _KindLog:
+        log = self._logs.get(kind)
+        if log is None:
+            log = self._logs[kind] = _KindLog()
+        return log
+
+    def _append(self, log: _KindLog, etype: str, obj: Any, rv: int) -> None:
+        log.entries.append((etype, obj, rv))
+        log.rvs.append(rv)
+        if len(log.entries) > self._log_size:
+            n = len(log.entries) - self._log_size
+            del log.entries[:n]
+            del log.rvs[:n]
+            log.start += n
+            # a cursor the eviction passed is detected at flush/poll time
+            # (cursor < log.start -> drop-with-resync)
+
+    def append(self, etype: str, kind: str, obj: Any, rv: int) -> None:
+        """One pending log entry (the serial update/delete verbs)."""
+        self._append(self._kind_log(kind), etype, obj, rv)
+
+    # -- batched write verbs (pending; caller holds the store lock) ----------
+    def bind_batch(self, bucket: dict, kind: str,
+                   bindings: list[tuple[str, str]]) -> list[str]:
+        """The store's batched bind body (_bind_locked semantics per
+        binding): clone, set node_name, assign the next rv, replace the
+        bucket entry, log MODIFIED. Returns the keys that were missing."""
+        log = self._kind_log(kind)
+        missing = []
+        for pod_key, node_name in bindings:
+            current = bucket.get(pod_key)
+            if current is None:
+                missing.append(pod_key)
+                continue
+            stored = current.clone()
+            stored.node_name = node_name
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[pod_key] = stored
+            self._append(log, MODIFIED, stored, self._rv)
+        return missing
+
+    def create_batch(self, bucket: dict, kind: str, objs: list,
+                     move: bool) -> list:
+        """The store's batched create body (_create_locked semantics per
+        object): raise AlreadyExists on a duplicate key, snapshot unless
+        `move`, assign the next rv, log ADDED. Returns the stored objects."""
+        log = self._kind_log(kind)
+        out = []
+        for obj in objs:
+            key = obj.key
+            if key in bucket:
+                raise self._already(f"{kind}/{key}")
+            stored = obj if move else _clone(obj)
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[key] = stored
+            self._append(log, ADDED, stored, self._rv)
+            out.append(stored)
+        return out
+
+    def commit_wave(self, pod_bucket: dict, pod_kind: str,
+                    bindings: list[tuple[str, str]],
+                    ev_bucket: dict, ev_kind: str, recs: list) -> list[str]:
+        """One burst wave's whole store-write tail in one call: the batched
+        bind plus the audit-record creates for the bindings that landed
+        (recs[i] rides bindings[i]; a vanished pod's record is skipped,
+        like the serial path that never reaches its Scheduled event).
+        Event creates are move=True (recorder ownership transfer)."""
+        missing = self.bind_batch(pod_bucket, pod_kind, bindings)
+        if recs:
+            if missing:
+                miss = set(missing)
+                recs = [r for (k, _n), r in zip(bindings, recs)
+                        if k not in miss]
+            self.create_batch(ev_bucket, ev_kind, recs, True)
+        return missing
+
+    # -- fan-out -------------------------------------------------------------
+    def flush(self) -> int:
+        """Publish every pending entry to its kind's watchers (log order)
+        and wake blocked polls. A watcher whose backlog would exceed the
+        ring bound — or whose cursor the log ring already evicted — is
+        dropped-with-resync. Returns the number of events dropped."""
+        dropped = 0
+        with self._cond:
+            for kind, log in self._logs.items():
+                if log.flushed >= log.end:
+                    continue
+                log.flushed = log.end
+                for wid in self._by_kind.get(kind, ()):
+                    w = self._watchers[wid]
+                    if w.resync or w.stopped:
+                        continue
+                    backlog = log.flushed - w.cursor
+                    if w.cursor < log.start or backlog > self._ring_size:
+                        dropped += backlog
+                        w.cursor = log.flushed
+                        w.resync = True
+            self._cond.notify_all()
+        return dropped
+
+    # -- watch ---------------------------------------------------------------
+    def attach(self, kind: str, since_rv: Optional[int]) -> int:
+        """New watcher cursor. since_rv=None -> only events published after
+        this point; else replay from the log, raising ExpiredError when the
+        resume point predates the log window (410 Gone)."""
+        log = self._kind_log(kind)
+        with self._cond:
+            if since_rv is None:
+                cursor = log.end
+            elif log.rvs and since_rv < log.rvs[0] - 1:
+                raise self._expired(
+                    f"{kind}: rv {since_rv} older than log window")
+            else:
+                cursor = log.start + bisect_right(log.rvs, since_rv)
+            wid = self._next_wid
+            self._next_wid += 1
+            self._watchers[wid] = _Watcher(kind, cursor)
+            self._by_kind.setdefault(kind, []).append(wid)
+            return wid
+
+    def detach(self, wid: int) -> None:
+        with self._cond:
+            w = self._watchers.pop(wid, None)
+            if w is not None:
+                w.stopped = True
+                lst = self._by_kind.get(w.kind, [])
+                if wid in lst:
+                    lst.remove(wid)
+            self._cond.notify_all()
+
+    def poll(self, wid: int, timeout: Optional[float],
+             limit: int) -> list:
+        """Copy out up to `limit` published events past the watcher's
+        cursor, blocking up to `timeout` seconds (None = forever) for the
+        first one. Returns [] on timeout or after stop; raises ExpiredError
+        when the watcher was dropped (slow consumer / log window)."""
+        deadline = None
+        if timeout and timeout > 0:
+            import time as _time
+            deadline = _time.monotonic() + timeout
+        with self._cond:
+            while True:
+                w = self._watchers.get(wid)
+                if w is None:
+                    return []
+                if w.resync:
+                    raise self._expired(
+                        f"{w.kind}: watch dropped (resync required)")
+                log = self._logs[w.kind]
+                if w.cursor < log.start:
+                    # the ring evicted entries this watcher never consumed
+                    w.resync = True
+                    raise self._expired(
+                        f"{w.kind}: rv window evicted before copy-out")
+                if w.cursor < log.flushed:
+                    break
+                if timeout == 0:
+                    return []
+                wait = None
+                if deadline is not None:
+                    import time as _time
+                    wait = deadline - _time.monotonic()
+                    if wait <= 0:
+                        return []
+                self._cond.wait(wait)   # None = wait forever
+            lo = w.cursor - log.start
+            n = min(limit, log.flushed - w.cursor)
+            picked = log.entries[lo: lo + n]
+            w.cursor += n
+        ev = self._event_cls
+        return [ev(t, w.kind, o, rv) for t, o, rv in picked]
+
+    # -- introspection (tests / bench) ---------------------------------------
+    def backlog(self, wid: int) -> int:
+        with self._cond:
+            w = self._watchers.get(wid)
+            if w is None:
+                return 0
+            log = self._logs[w.kind]
+            return max(0, log.flushed - max(w.cursor, log.start))
+
+    def log_window(self, kind: str) -> tuple[int, int]:
+        """(first rv retained, last rv) of a kind's log ring."""
+        log = self._kind_log(kind)
+        if not log.rvs:
+            return (0, 0)
+        return (log.rvs[0], log.rvs[-1])
+
+
+def make_commit_core(log_size: int, ring_size: int, event_cls,
+                     expired_exc, already_exists_exc, force: Optional[str] = None):
+    """Native CommitCore when it builds, PyCommitCore otherwise. `force`
+    (or KTPU_COMMITCORE=twin|native) pins the implementation — the parity
+    tests and the bench's in-run twin referee use it."""
+    choice = force or os.environ.get("KTPU_COMMITCORE", "auto")
+    if choice != "twin":
+        from kubernetes_tpu import native
+        mod = native.load("commitcore")
+        if mod is not None:
+            return mod.CommitCore(log_size, ring_size, event_cls,
+                                  expired_exc, already_exists_exc)
+        if choice == "native":
+            raise RuntimeError("KTPU_COMMITCORE=native but the commitcore "
+                               "extension failed to build/load")
+    return PyCommitCore(log_size, ring_size, event_cls,
+                        expired_exc, already_exists_exc)

@@ -77,12 +77,13 @@ class EventRecorder:
             while len(self._known) > self._max_entries:
                 self._known.popitem(last=False)
 
-    def pod_events_batch(self, items) -> None:
-        """Burst-commit form: `items` is [(pod, etype, reason, message)].
-        Messages in a burst are unique per pod (they carry the pod's key),
-        so the correlation cache can never aggregate them — the batch
-        skips it and lands every record in ONE store write (create_many),
-        one lock instead of one per pod."""
+    def make_pod_records(self, items) -> list:
+        """Construct (without writing) one EventRecord per
+        (pod, etype, reason, message) item. Burst messages are unique per
+        pod (they carry the pod's key), so the correlation cache can never
+        aggregate them and is skipped. The burst commit passes these
+        straight into `store.commit_wave` so a wave's binds AND audit
+        records land in ONE core call."""
         recs = []
         new = EventRecord.__new__
         for pod, etype, reason, message in items:
@@ -98,6 +99,12 @@ class EventRecorder:
                 type=etype, reason=reason, message=message,
                 count=1, component=self.component, resource_version=0)
             recs.append(rec)
+        return recs
+
+    def pod_events_batch(self, items) -> None:
+        """Burst-commit form: every record lands in ONE store write
+        (create_many), one lock instead of one per pod."""
+        recs = self.make_pod_records(items)
         if not recs:
             return
         drop = (APIStatusError, AlreadyExistsError, ConflictError, OSError)

@@ -301,6 +301,33 @@ class RemoteStore:
                 missing.append(pod_key)
         return missing
 
+    def commit_wave(self, bindings: list[tuple[str, str]],
+                    events: Optional[list] = None) -> list[str]:
+        """Wave contract of Store.commit_wave over the wire: binds via the
+        binding subresource (404 -> missing, mapped exactly like
+        bind_pods), then the audit records of the binds that landed via
+        per-record POSTs — each isolated and fire-and-forget like the
+        recorder's remote path (a rejected or undeliverable event write
+        never fails the commit)."""
+        missing = self.bind_pods(bindings)
+        if events:
+            from kubernetes_tpu.store.store import EVENTS
+            gone = set(missing)
+            drop = (APIStatusError, AlreadyExistsError, ConflictError,
+                    OSError)
+            for (pod_key, _n), rec in zip(bindings, events):
+                if pod_key in gone:
+                    continue
+                try:
+                    self.create(EVENTS, rec, move=True)
+                except drop:
+                    continue
+        return missing
+
+    def fanout_wave(self) -> None:
+        """Watch fan-out happens server-side (the embedded store's commit
+        core); the wire client has nothing to deliver."""
+
     def guaranteed_update(self, kind: str, key: str,
                           mutate: Callable[[Any], Any],
                           allow_skip: bool = False) -> Any:

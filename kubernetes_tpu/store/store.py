@@ -20,10 +20,11 @@ from __future__ import annotations
 
 import copy
 import os
-import queue as _queue
 import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
+
+from kubernetes_tpu import obs
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -59,6 +60,21 @@ PODGROUPS = "podgroups"  # co-scheduling gangs (coscheduling.types.PodGroup)
 
 DEFAULT_WATCH_LOG = 8192  # events retained per kind for resumable watches
 
+# watch fan-out robustness counters (reference: the watch cache terminates
+# streams that outrun it; apiserver_terminated_watchers_total analog)
+WATCH_DROPPED = obs.counter(
+    "watch_dropped_total",
+    "Watch events dropped instead of buffered unboundedly, by reason: "
+    "slow-consumer (per-watcher backlog exceeded the ring bound at "
+    "fan-out) or log-window (the shared event log evicted entries the "
+    "watcher never copied out). The watcher's next poll raises "
+    "ExpiredError and the consumer re-lists (410 Gone).", ("reason",))
+COMMIT_WAVES = obs.counter(
+    "store_commit_waves_total",
+    "Batched bind+event commit waves written through the commit core, by "
+    "implementation (native C++ extension vs pure-Python twin).",
+    ("impl",))
+
 
 class ConflictError(Exception):
     """resourceVersion precondition failed (optimistic-concurrency loss)."""
@@ -86,46 +102,47 @@ class Event:
 
 
 class Watch:
-    """One watch stream: a bounded queue of Events plus a stop handle."""
+    """One watch stream: a bounded cursor into the commit core's event log
+    plus a stop handle. Copy-out happens on the CONSUMER's thread (the
+    core materializes Event objects at poll, off the committing thread),
+    and a consumer that falls behind the ring bound is dropped-with-resync:
+    next()/try_next()/drain() raise ExpiredError and the caller re-lists,
+    exactly like the reference reflector on 410 Gone."""
 
-    def __init__(self, store: "Store", kind: str):
+    def __init__(self, store: "Store", kind: str, wid: int):
         self._store = store
         self.kind = kind
-        self._q: _queue.Queue[Optional[Event]] = _queue.Queue()
+        self._wid = wid
         self._stopped = False
 
-    def _deliver(self, event: Event) -> None:
-        if not self._stopped:
-            self._q.put(event)
+    def _poll(self, timeout: Optional[float], limit: int) -> list[Event]:
+        try:
+            return self._store._core.poll(self._wid, timeout, limit)
+        except ExpiredError as e:
+            # fan-out-time drops were already counted (slow-consumer, by
+            # event) in flush; an eviction the poll itself detects is the
+            # log-window case (contract message shared with the native core)
+            if "evicted" in str(e):
+                WATCH_DROPPED.labels("log-window").inc()
+            raise
 
     def next(self, timeout: Optional[float] = None) -> Optional[Event]:
-        """Next event, or None on timeout / stream close."""
-        try:
-            return self._q.get(timeout=timeout)
-        except _queue.Empty:
-            return None
+        """Next event, or None on timeout / stream close. Raises
+        ExpiredError when this watcher was dropped (slow consumer)."""
+        evs = self._poll(timeout, 1)
+        return evs[0] if evs else None
 
     def try_next(self) -> Optional[Event]:
-        """Non-blocking next event, or None when the queue is empty."""
-        try:
-            return self._q.get_nowait()
-        except _queue.Empty:
-            return None
+        """Non-blocking next event, or None when nothing is pending."""
+        evs = self._poll(0, 1)
+        return evs[0] if evs else None
 
     def drain(self) -> list[Event]:
-        out = []
-        while True:
-            try:
-                ev = self._q.get_nowait()
-            except _queue.Empty:
-                return out
-            if ev is not None:
-                out.append(ev)
+        return self._poll(0, 1 << 30)
 
     def stop(self) -> None:
         self._stopped = True
-        self._store._remove_watch(self)
-        self._q.put(None)  # wake any blocked next()
+        self._store._core.detach(self._wid)  # wakes any blocked next()
 
 
 def nominated_node_mutator(node_name: str) -> Callable[[Any], Any]:
@@ -169,16 +186,33 @@ def _clone(obj: Any) -> Any:
 
 
 class Store:
-    """Threadsafe versioned KV with per-kind watch fan-out."""
+    """Threadsafe versioned KV with per-kind watch fan-out.
+
+    The versioned write log and watch delivery live in the COMMIT CORE
+    (native/commitcore.cpp when it builds, store/commit_core.PyCommitCore
+    otherwise — bit-identical semantics either way): every write verb is
+    one core call assigning resourceVersions and appending watch-log
+    entries, and the burst path's `commit_wave`/`fanout_wave` pair lands a
+    whole wave's binds + audit events as ONE core call each.
+
+    `watch_queue_size` bounds each watcher's backlog (defaults to the log
+    size — the shared ring is the buffer); a consumer that falls further
+    behind is dropped-with-resync instead of buffering unboundedly."""
 
     def __init__(self, watch_log_size: int = DEFAULT_WATCH_LOG,
-                 debug_integrity: Optional[bool] = None):
+                 debug_integrity: Optional[bool] = None,
+                 watch_queue_size: Optional[int] = None,
+                 commit_core: Optional[str] = None):
+        from kubernetes_tpu.store.commit_core import make_commit_core
         self._lock = threading.RLock()
-        self._rv = 0
         self._objs: dict[str, dict[str, Any]] = {}
-        self._watchers: dict[str, list[Watch]] = {}
-        # per-kind ring of recent events for watch resume
-        self._log: dict[str, list[Event]] = {}
+        self._core = make_commit_core(
+            watch_log_size,
+            watch_queue_size if watch_queue_size is not None
+            else watch_log_size,
+            Event, ExpiredError, AlreadyExistsError, force=commit_core)
+        self.core_impl = "native" if getattr(self._core, "is_native", False) \
+            else "twin"
         self._log_size = watch_log_size
         # alias tripwire: watch events and create/update return values alias
         # the write snapshot, read-only BY CONVENTION. In debug mode every
@@ -233,38 +267,37 @@ class Store:
         """Objects plus the store resourceVersion the list is consistent at."""
         with self._lock:
             objs = [_clone(o) for o in self._objs.get(kind, {}).values()]
-            return objs, self._rv
+            return objs, self._core.rv()
 
     def resource_version(self) -> int:
         with self._lock:
-            return self._rv
+            return self._core.rv()
 
     # -- writes -------------------------------------------------------------
-    def _create_locked(self, kind: str, obj: Any, move: bool) -> Any:
-        """Single-entry create body; caller holds the lock. One snapshot
-        serves the bucket, the event log, and the return value: the store
-        NEVER mutates a stored object in place (every write replaces the
-        bucket entry), and consumers receive store objects read-only —
-        anything that mutates must clone() first, which every caller
-        (cache, queue, scheduler) already does."""
-        bucket = self._objs.setdefault(kind, {})
-        key = _key_of(obj)
-        if key in bucket:
-            raise AlreadyExistsError(f"{kind}/{key}")
-        stored = obj if move else _clone(obj)
-        self._rv += 1
-        stored.resource_version = self._rv
-        bucket[key] = stored
-        self._record_entry(kind, key, stored)
-        self._emit(Event(ADDED, kind, stored, self._rv))
-        return stored
+    # Every verb's per-object body lives in the commit core (shared by the
+    # serial verbs and the burst wave): one snapshot serves the bucket, the
+    # event log, and the return value — the store NEVER mutates a stored
+    # object in place, and consumers receive store objects read-only;
+    # anything that mutates must clone() first, which every caller (cache,
+    # queue, scheduler) already does.
+    def _flush(self) -> None:
+        """Publish pending log entries to watchers, booking drops."""
+        dropped = self._core.flush()
+        if dropped:
+            WATCH_DROPPED.labels("slow-consumer").inc(dropped)
 
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
         """`move=True` transfers ownership: the caller promises never to
         touch `obj` again, skipping the write snapshot (the event recorder's
         fire-and-forget records use this)."""
         with self._lock:
-            return self._create_locked(kind, obj, move)
+            try:
+                stored = self._core.create_batch(
+                    self._objs.setdefault(kind, {}), kind, [obj], move)[0]
+            finally:
+                self._flush()
+            self._record_entry(kind, _key_of(stored), stored)
+            return stored
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
         with self._lock:
@@ -278,11 +311,12 @@ class Store:
                     f"{kind}/{key}: rv {current.resource_version} != expected {expect_rv}")
             self._check_entry(kind, key, current)
             stored = _clone(obj)
-            self._rv += 1
-            stored.resource_version = self._rv
+            rv = self._core.next_rv()
+            stored.resource_version = rv
             bucket[key] = stored
             self._record_entry(kind, key, stored)
-            self._emit(Event(MODIFIED, kind, stored, self._rv))  # see create()
+            self._core.append(MODIFIED, kind, stored, rv)  # see create()
+            self._flush()
             return stored
 
     def guaranteed_update(self, kind: str, key: str,
@@ -311,8 +345,9 @@ class Store:
             self._check_entry(kind, key, obj)
             if self._integrity is not None:
                 self._integrity.pop((kind, key), None)
-            self._rv += 1
-            self._emit(Event(DELETED, kind, _clone(obj), self._rv))
+            rv = self._core.next_rv()
+            self._core.append(DELETED, kind, _clone(obj), rv)
+            self._flush()
             return obj
 
     # -- pod conveniences (the scheduler's write surface) --------------------
@@ -322,51 +357,97 @@ class Store:
         Single-lock fast path of guaranteed_update(set nodeName): the
         binding subresource replaces one spec field unconditionally (the
         reference's Bind POST carries no resourceVersion precondition), so
-        no CAS retry loop — one clone, one lock, one event."""
+        no CAS retry loop — one clone, one lock, one event. The per-binding
+        body is the commit core's bind_batch (identical to the burst wave)."""
         with self._lock:
             bucket = self._objs.setdefault(PODS, {})
-            if not self._bind_locked(bucket, pod_key, node_name):
+            if self._bind_batch_locked(bucket, [(pod_key, node_name)]):
+                self._flush()
                 raise NotFoundError(f"{PODS}/{pod_key}")
+            self._flush()
             return bucket[pod_key]
 
-    def _bind_locked(self, bucket, pod_key: str, node_name: str) -> bool:
-        """Single-binding body shared by bind_pod/bind_pods; caller holds
-        the lock. Returns False when the pod is gone."""
-        current = bucket.get(pod_key)
-        if current is None:
-            return False
-        self._check_entry(PODS, pod_key, current)
-        stored = _clone(current)
-        stored.node_name = node_name
-        self._rv += 1
-        stored.resource_version = self._rv
-        bucket[pod_key] = stored
-        self._record_entry(PODS, pod_key, stored)
-        self._emit(Event(MODIFIED, PODS, stored, self._rv))
-        return True
+    def _bind_batch_locked(self, bucket,
+                           bindings: list[tuple[str, str]]) -> list[str]:
+        """Batched binding body shared by bind_pod/bind_pods/commit_wave;
+        caller holds the lock and flushes. Returns the missing keys. The
+        integrity tripwire brackets the core call (debug mode only)."""
+        if self._integrity is not None:
+            for pod_key, _n in bindings:
+                current = bucket.get(pod_key)
+                if current is not None:
+                    self._check_entry(PODS, pod_key, current)
+        missing = self._core.bind_batch(bucket, PODS, bindings)
+        if self._integrity is not None:
+            gone = set(missing)
+            for pod_key, _n in bindings:
+                if pod_key not in gone:
+                    self._record_entry(PODS, pod_key, bucket[pod_key])
+        return missing
 
     def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
         """Batch form of bind_pod for the burst prefix commit: ONE lock
-        acquisition for the whole burst instead of one per pod (the
-        per-binding semantics are _bind_locked's, identical to bind_pod).
-        Returns the keys that were missing (deleted between decision and
-        commit); the caller handles those like failed binds."""
-        missing = []
+        acquisition and ONE core call for the whole burst instead of one
+        per pod (per-binding semantics identical to bind_pod). Returns the
+        keys that were missing (deleted between decision and commit); the
+        caller handles those like failed binds."""
         with self._lock:
             bucket = self._objs.setdefault(PODS, {})
-            for pod_key, node_name in bindings:
-                if not self._bind_locked(bucket, pod_key, node_name):
-                    missing.append(pod_key)
+            missing = self._bind_batch_locked(bucket, bindings)
+        self._flush()
         return missing
 
     def create_many(self, kind: str, objs: list, move: bool = False) -> None:
-        """Batch create under one lock (event records from a burst commit);
-        per-object semantics are _create_locked's, identical to create().
+        """Batch create under one lock and one core call (event records
+        from a burst commit); per-object semantics identical to create().
         Raises on the first duplicate — callers pass fresh uniquely-named
         objects."""
         with self._lock:
-            for obj in objs:
-                self._create_locked(kind, obj, move)
+            try:
+                stored = self._core.create_batch(
+                    self._objs.setdefault(kind, {}), kind, objs, move)
+            finally:
+                self._flush()
+            if self._integrity is not None:
+                for o in stored:
+                    self._record_entry(kind, _key_of(o), o)
+
+    def commit_wave(self, bindings: list[tuple[str, str]],
+                    events: Optional[list] = None) -> list[str]:
+        """One burst wave's whole store-write tail as ONE core call: the
+        batched bind (bind_pods semantics) plus the audit-record creates
+        for the bindings that landed (`events[i]` rides `bindings[i]`;
+        records are created move=True, like the recorder's batch path).
+        Fan-out is deliberately NOT triggered here — the scheduler calls
+        `fanout_wave()` as its one separate per-wave delivery call, which
+        may overlap the remaining host commit work."""
+        with self._lock:
+            pods = self._objs.setdefault(PODS, {})
+            evs = self._objs.setdefault(EVENTS, {})
+            if self._integrity is not None:
+                for pod_key, _n in bindings:
+                    current = pods.get(pod_key)
+                    if current is not None:
+                        self._check_entry(PODS, pod_key, current)
+            missing = self._core.commit_wave(pods, PODS, bindings,
+                                             evs, EVENTS, events or [])
+            COMMIT_WAVES.labels(self.core_impl).inc()
+            if self._integrity is not None:
+                gone = set(missing)
+                for pod_key, _n in bindings:
+                    if pod_key not in gone:
+                        self._record_entry(PODS, pod_key, pods[pod_key])
+                for rec in events or []:
+                    stored = evs.get(rec.key)
+                    if stored is not None:
+                        self._record_entry(EVENTS, rec.key, stored)
+        return missing
+
+    def fanout_wave(self) -> None:
+        """Deliver a committed wave's pending watch events: ONE core call
+        advancing every watcher's published cursor (O(watchers), not
+        O(watchers x events) — consumers copy out on their own threads)."""
+        self._flush()
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,
@@ -403,35 +484,11 @@ class Store:
 
         Raises ExpiredError when since_rv has fallen out of the event log —
         callers re-list, exactly like the reference's Reflector on 410 Gone.
+        (The core can't prove no gap when the oldest retained event may not
+        be the first after since_rv.)
         """
         with self._lock:
-            w = Watch(self, kind)
-            if since_rv is not None:
-                log = self._log.get(kind, [])
-                if log and since_rv < log[0].resource_version - 1:
-                    # Can't prove no gap: the oldest retained event may not
-                    # be the first after since_rv.
-                    raise ExpiredError(
-                        f"{kind}: rv {since_rv} older than log window")
-                for ev in log:
-                    if ev.resource_version > since_rv:
-                        w._deliver(ev)
-            self._watchers.setdefault(kind, []).append(w)
-            return w
-
-    def _remove_watch(self, w: Watch) -> None:
-        with self._lock:
-            lst = self._watchers.get(w.kind, [])
-            if w in lst:
-                lst.remove(w)
-
-    def _emit(self, event: Event) -> None:
-        log = self._log.setdefault(event.kind, [])
-        log.append(event)
-        if len(log) > self._log_size:
-            del log[: len(log) - self._log_size]
-        for w in self._watchers.get(event.kind, []):
-            w._deliver(event)
+            return Watch(self, kind, self._core.attach(kind, since_rv))
 
     # -- bulk load (benchmark harness) --------------------------------------
     def load(self, kind: str, objs: Iterable[Any]) -> None:

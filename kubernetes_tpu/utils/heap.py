@@ -74,6 +74,14 @@ class KeyedHeap:
             return None
         return self.delete(self._key_fn(self._items[0]))
 
+    def pop_many(self, limit: int) -> list[Any]:
+        """Up to `limit` ascending pops as one call (the native core's
+        batched-drain twin)."""
+        out = []
+        while len(out) < limit and self._items:
+            out.append(self.pop())
+        return out
+
     # -- internals ----------------------------------------------------------
     def _swap(self, i: int, j: int) -> None:
         items = self._items
@@ -151,3 +159,18 @@ class NumericKeyedHeap:
 
     def pop(self) -> Optional[Any]:
         return self._core.pop()
+
+    def pop_many(self, limit: int) -> list[Any]:
+        """Batched drain: ONE native call pops up to `limit` items with
+        the GIL released during the sifts (the activeQ burst prologue). A
+        stale pre-pop_many .so degrades to per-item pops."""
+        pm = getattr(self._core, "pop_many", None)
+        if pm is not None:
+            return pm(limit)
+        out = []
+        while len(out) < limit:
+            item = self._core.pop()
+            if item is None:
+                return out
+            out.append(item)
+        return out

@@ -412,18 +412,18 @@ class TestFusedWindowCrashInjection:
         sched.pump()
         from kubernetes_tpu.core.tpu_scheduler import DEVICE_FETCHES
         f0 = DEVICE_FETCHES.labels("burst_fused").value
-        real_bind_pods = store.bind_pods
+        real_commit_wave = store.commit_wave
         calls = {"n": 0}
 
-        def crashing_bind_pods(bindings):
+        def crashing_commit_wave(bindings, events=None):
             calls["n"] += 1
             if calls["n"] == 1:
                 # fires inside the first commit window, AFTER the single
                 # fetch already shipped the whole decision block
                 raise RuntimeError("store write failed mid-commit")
-            return real_bind_pods(bindings)
+            return real_commit_wave(bindings, events)
 
-        store.bind_pods = crashing_bind_pods
+        store.commit_wave = crashing_commit_wave
         for _round in range(80):
             sched.pump()
             drain_burst(sched)
@@ -447,7 +447,7 @@ class TestGangCrashInjection:
 
     @pytest.mark.parametrize("use_tpu", [True, False])
     def test_commit_write_crash_never_partial(self, use_tpu):
-        """store.bind_pods dies (transport crash) AFTER the trial decided:
+        """store.commit_wave dies (transport crash) AFTER the trial decided:
         the gang's assumes are rolled back per the commit failure path and
         the store never shows a partial gang; the retry lands it whole."""
         clock = FakeClock(100.0)
@@ -461,16 +461,16 @@ class TestGangCrashInjection:
         for j in range(4):
             store.create(PODS, member(f"m{j}", "g"))
         sched.pump()
-        real_bind_pods = store.bind_pods
+        real_commit_wave = store.commit_wave
         calls = {"n": 0}
 
-        def crashing_bind_pods(bindings):
+        def crashing_commit_wave(bindings, events=None):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("store write failed mid-commit")
-            return real_bind_pods(bindings)
+            return real_commit_wave(bindings, events)
 
-        store.bind_pods = crashing_bind_pods
+        store.commit_wave = crashing_commit_wave
         for _round in range(80):
             sched.pump()
             drain_burst(sched)
