@@ -106,6 +106,15 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
         fam_total = lambda fam: sum(c.value for c in fam._children.values())
         disp0 = fam_total(DEVICE_DISPATCH)
         fetch0 = fam_total(DEVICE_FETCHES)
+    # pod-lifecycle ledger: reset AFTER warmup so the startup percentiles
+    # and phase split cover exactly the measured pods (warmup pods carry
+    # jit-compile time in their dispatch phase). NOTE: the measured pods
+    # were just enqueued by the pump above — re-stamp their arrival so the
+    # queue phase starts at the timed window, not at creation.
+    from kubernetes_tpu.obs.ledger import LEDGER
+    LEDGER.reset()
+    for p in sched.queue.pending_pods()["active"]:
+        LEDGER.stamp_enqueue(p.key)
     bound = 0
     t0 = time.perf_counter()
     if mode == "serial" or mode == "oracle":
@@ -140,6 +149,13 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
         # burst reports 1/1 here; per-wave fetches would show as ~3x)
         result["device_dispatches"] = int(fam_total(DEVICE_DISPATCH) - disp0)
         result["device_fetches"] = int(fam_total(DEVICE_FETCHES) - fetch0)
+    # pod-startup SLO percentiles + per-phase latency decomposition from
+    # the lifecycle ledger (the soak scoreboard fields, ROADMAP item 5)
+    led = LEDGER.snapshot()
+    result["startup_p50"] = led["startup_p50"]
+    result["startup_p99"] = led["startup_p99"]
+    result["phase_split"] = led["phase_split"]
+    result["pods_completed"] = led["pods_completed"]
     if compare and mode != "oracle":
         # measured same-node-count oracle ratio next to the fixed 100 pods/s
         # CI floor (the oracle's per-pod cost is flat in pod count; sample a
@@ -448,9 +464,18 @@ def main():
                          "(load in Perfetto / chrome://tracing); host-encode "
                          "vs device dispatch+readback separate by span "
                          "category")
+    ap.add_argument("--metrics-out", metavar="PATH", default=None,
+                    help="dump the end-of-run metrics-registry snapshot "
+                         "(Prometheus text exposition) beside the JSON "
+                         "line — the soak scoreboard artifact")
     args = ap.parse_args()
 
     def finish(result: dict) -> None:
+        if args.metrics_out:
+            from kubernetes_tpu import obs
+            with open(args.metrics_out, "w") as f:
+                f.write(obs.render_global())
+            result["metrics_out"] = args.metrics_out
         if args.trace:
             from kubernetes_tpu.obs import trace as obs_trace
             from kubernetes_tpu.core.tpu_scheduler import PIPELINE_OVERLAP

@@ -212,8 +212,30 @@ def make_handler(store: Store, admission: AdmissionChain,
                 return
             if path == "/debug/traces":
                 # Chrome trace-event JSON of the span ring buffer —
-                # loadable in Perfetto / chrome://tracing
-                self._send(200, obs_trace.to_chrome())
+                # loadable in Perfetto / chrome://tracing. `?limit=N`
+                # keeps the newest N spans, `?cat=host|device` filters by
+                # category (the full 64k-span ring is a multi-MB response)
+                limit = q.get("limit", [None])[0]
+                if limit is not None:
+                    try:
+                        limit = int(limit)
+                        if limit < 0:
+                            raise ValueError(limit)
+                    except ValueError:
+                        self._error(400, "BadRequest",
+                                    f"invalid limit {limit!r}")
+                        return
+                cat = q.get("cat", [None])[0]
+                self._send(200, obs_trace.to_chrome(limit=limit, cat=cat))
+                return
+            if path == "/debug/sched":
+                # deep scheduler introspection: every registered debug
+                # section (queue depths, parked gangs, device mirror,
+                # victim table, ledger) plus THIS server's store (rv,
+                # object counts, per-watcher cursor lag)
+                snap = obs.debug_snapshot()
+                snap["store"] = store.debug_state()
+                self._send(200, snap)
                 return
             if path == "/version":
                 self._send(200, {"gitVersion": "v0.3.0-kubernetes-tpu"})

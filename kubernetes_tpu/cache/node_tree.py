@@ -22,6 +22,9 @@ class NodeTree:
         self._exhausted: set[str] = set()
         self.num_nodes = 0
         self._rotation_cache: Optional[list[int]] = None  # keyed by membership
+        # membership epoch: bumps on add/remove — burst records pin it so a
+        # replayed burst can prove the tree it captured is the tree it ran
+        self.epoch = 0
 
     def add_node(self, node: Node) -> None:
         zone = get_zone_key(node)
@@ -36,6 +39,7 @@ class NodeTree:
         names.append(node.name)
         self.num_nodes += 1
         self._rotation_cache = None
+        self.epoch += 1
 
     def remove_node(self, node: Node) -> None:
         zone = get_zone_key(node)
@@ -45,6 +49,7 @@ class NodeTree:
         names.remove(node.name)
         self.num_nodes -= 1
         self._rotation_cache = None
+        self.epoch += 1
         if not names:
             del self._tree[zone]
             self._zones.remove(zone)

@@ -82,9 +82,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
-        if self.path == "/healthz":
+        from urllib.parse import parse_qs, urlparse
+        u = urlparse(self.path)
+        if u.path == "/healthz":
             self._send(200, "ok")
-        elif self.path == "/metrics":
+        elif u.path == "/metrics":
             # scheduler families + the process-global registry (device
             # pipeline, informers, workqueues) in one scrape — name sets
             # are disjoint, so the concatenation stays lintable
@@ -92,11 +94,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, render_metrics(self.scheduler)
                        + obs.render_global(),
                        "text/plain; version=0.0.4")
-        elif self.path == "/debug/traces":
+        elif u.path == "/debug/traces":
+            # same query knobs as the apiserver route: ?limit= newest N,
+            # ?cat= host|device
             from kubernetes_tpu.obs import trace as obs_trace
-            self._send(200, json.dumps(obs_trace.to_chrome()),
+            q = parse_qs(u.query)
+            limit = q.get("limit", [None])[0]
+            if limit is not None:
+                try:
+                    limit = int(limit)
+                    if limit < 0:
+                        raise ValueError(limit)
+                except ValueError:
+                    self._send(400, f"invalid limit {limit!r}")
+                    return
+            cat = q.get("cat", [None])[0]
+            self._send(200, json.dumps(obs_trace.to_chrome(limit=limit,
+                                                           cat=cat)),
                        "application/json")
-        elif self.path == "/configz":
+        elif u.path == "/debug/sched":
+            from kubernetes_tpu import obs
+            snap = obs.debug_snapshot()
+            # this command OWNS a scheduler: serve its sections directly
+            # (no dependence on registration order / instance races)
+            snap["scheduler"] = self.scheduler.debug_state()
+            self._send(200, json.dumps(snap), "application/json")
+        elif u.path == "/configz":
             self._send(200, json.dumps(self.scheduler_config.to_dict()),
                        "application/json")
         else:

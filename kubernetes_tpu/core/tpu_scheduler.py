@@ -33,6 +33,8 @@ from kubernetes_tpu.ops.node_state import (
 from kubernetes_tpu.ops import kernels as K
 from kubernetes_tpu import obs
 from kubernetes_tpu.obs import trace as obs_trace
+from kubernetes_tpu.obs import flight as obs_flight
+from kubernetes_tpu.obs import ledger as obs_ledger
 
 import jax
 import jax.numpy as jnp
@@ -89,6 +91,12 @@ GANG_REWIND_FOLDS = obs.counter(
 _PHASE_SPANS = {"encode": ("burst.encode", "host"),
                 "kernel": ("burst.dispatch", "device"),
                 "fetch": ("burst.fetch", "device")}
+# phase -> pod-lifecycle ledger stamp slot: the same boundary that closes
+# a burst phase span stamps every in-flight pod of the burst (one clock
+# read + O(pods) dict writes; committed pods already left the ledger)
+_PHASE_SLOTS = {"encode": obs_ledger.ENCODE,
+                "kernel": obs_ledger.DISPATCH,
+                "fetch": obs_ledger.FETCH}
 
 # every reason the victim-table eligibility gate can refuse a preemption
 # for (the old single "victims-not-inert" label, split per class so
@@ -904,6 +912,7 @@ class TPUScheduler:
             return [None] * len(pods)
         import time as _time
         _t0 = _time.perf_counter()
+        _keys = [p.key for p in pods]
 
         def _obs(phase: str, t_start: float) -> float:
             now = _time.perf_counter()
@@ -911,6 +920,7 @@ class TPUScheduler:
                 self.metrics.observe_phase(phase, now - t_start)
             name, cat = _PHASE_SPANS[phase]
             obs_trace.add_span(name, t_start, now, cat=cat)
+            obs_ledger.LEDGER.stamp_many(_keys, _PHASE_SLOTS[phase], t=now)
             return now
         b = self.encoder.encode(node_infos, all_node_names)
         nodes = self._node_arrays(b)
@@ -943,9 +953,13 @@ class TPUScheduler:
             # resolution with exact prefix validation (kernels.py K_BATCH)
             cls, extra_ok, ban = uniform
             rotation = self._burst_rotation(b, len(pods))
+            # flight recorder: capture BEFORE any wave commit can mutate
+            # the cache's NodeInfos (deep capture clones the world here)
+            fl = obs_flight.RECORDER.begin("uniform", self, [(pods, False)],
+                                           all_node_names, node_infos)
             _t = _obs("encode", _t0)
             sel = self._uniform_waves(pods, b, cls, extra_ok, ban, rotation,
-                                      n, commit, _obs, _t, bucket)
+                                      n, commit, _obs, _t, bucket, fl=fl)
             return [b.names[s] for s in sel] \
                 + [None] * (len(pods) - len(sel))
         from kubernetes_tpu.api.types import (
@@ -1076,14 +1090,16 @@ class TPUScheduler:
             self.last_node_index = int(lni)
             return [b.names[s] if s >= 0 else None
                     for s in selected.tolist()]
+        fl = obs_flight.RECORDER.begin("scan", self, [(pods, False)],
+                                       all_node_names, node_infos)
         _t = _obs("encode", _t0)
         return self._scan_waves(pods, b, per_pod, spread0, rotation,
                                 rotation_pos, num_to_find, n, z_pad, bucket,
-                                commit, _obs, _t)
+                                commit, _obs, _t, fl=fl)
 
     def _uniform_waves(self, pods: list[Pod], b: NodeBatch, cls, extra_ok,
                        ban: bool, rotation, n: int, commit, _obs,
-                       _t: float, bucket: int) -> list[int]:
+                       _t: float, bucket: int, fl=None) -> list[int]:
         """Single-launch driver for the uniform kernel: the ENTIRE burst
         (up to B_CAP; larger bursts chunk, with chunk k's fetch+commit
         overlapping chunk k+1's device execution) is ONE dispatch and ONE
@@ -1136,6 +1152,7 @@ class TPUScheduler:
 
         dispatch(0)
         aborted = False
+        failed = False
         while inflight:
             if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
                 dispatch(inflight[0][0] + 1)   # keep one chunk in flight
@@ -1146,6 +1163,7 @@ class TPUScheduler:
             DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
             obs_trace.add_span("burst.wave.device", t_d, t_done,
                                cat="device", args={"chunk": ci})
+            obs_flight.RECORDER.note_block(fl, h)
             _t = _obs("fetch", _t)
             self.last_node_index += int(h[cap])
             chunk_sel = h[:chunk].tolist()
@@ -1174,13 +1192,22 @@ class TPUScheduler:
                 inflight.clear()
                 if aborted:
                     self.discard_burst_folds()
+                if bad < chunk:
+                    failed = True
                 break
+        obs_flight.RECORDER.note_outcome(fl, {
+            # device-decided hosts up to the last commit/abort boundary;
+            # `failed` marks that the NEXT pod found no node on device
+            "hosts": [b.names[s] for s in sel],
+            "failed": failed,
+            "aborted": aborted,
+        })
         return sel
 
     def _scan_waves(self, pods: list[Pod], b: NodeBatch, per_pod: list,
                     spread0, rotation, rotation_pos, num_to_find: int,
                     n: int, z_pad: int, bucket: int, commit, _obs,
-                    _t: float) -> list[Optional[str]]:
+                    _t: float, fl=None) -> list[Optional[str]]:
         """Single-launch driver for the generic lax.scan burst: the whole
         burst runs as ONE scan launch (scan length = the caller's bucket,
         so the warmup burst compiles the same program) and the host
@@ -1223,6 +1250,7 @@ class TPUScheduler:
         DEVICE_FETCHES.labels("burst_scan").inc()
         DEVICE_FETCHED_BYTES.labels("burst_scan").inc(h.nbytes)
         obs_trace.add_span("burst.wave.device", t_d, t_done, cat="device")
+        obs_flight.RECORDER.note_block(fl, h)
         _t = _obs("fetch", _t)
         sel_arr = h[:n_pods]
         li_after = h[B:2 * B]
@@ -1264,6 +1292,13 @@ class TPUScheduler:
             # catches up via note_burst_assumed; external changes still
             # arrive via dirty rows)
             self._dev_nodes = {**self._dev_nodes, **state}
+        obs_flight.RECORDER.note_outcome(fl, {
+            # the full device-decided prefix (commit aborts shorten the
+            # RETURNED prefix but not what the device decided)
+            "hosts": [b.names[s] for s in sel_arr[:bad].tolist()],
+            "failed": bad < n_pods,
+            "aborted": aborted,
+        })
         return [b.names[s] for s in sel_arr[:committed].tolist()] \
             + [None] * (n_pods - committed)
 
@@ -1324,6 +1359,7 @@ class TPUScheduler:
             return None
         import time as _time
         _t0 = _time.perf_counter()
+        _keys = [p.key for p in flat]
 
         def _obs(phase: str, t_start: float) -> float:
             now = _time.perf_counter()
@@ -1331,6 +1367,7 @@ class TPUScheduler:
                 self.metrics.observe_phase(phase, now - t_start)
             name, cat = _PHASE_SPANS[phase]
             obs_trace.add_span(name, t_start, now, cat=cat)
+            obs_ledger.LEDGER.stamp_many(_keys, _PHASE_SLOTS[phase], t=now)
             return now
 
         b = self.encoder.encode(node_infos, all_node_names)
@@ -1386,6 +1423,10 @@ class TPUScheduler:
             per_pod.extend([pad] * (B - idx))
         stacked = self._stack_pods(per_pod)
         z_pad = _pad_pow2(len(b.zone_names), 4)
+        # flight recorder: the fused window is THE canonical record — gang
+        # boundaries, rewinds and rotation state all ride one launch
+        fl = obs_flight.RECORDER.begin("fused", self, segments,
+                                       all_node_names, node_infos)
         _t = _obs("encode", _t0)
         t_d = obs_trace.now()
         state, _li, _lni, _spread, packed = K.schedule_batch_segments(
@@ -1400,6 +1441,7 @@ class TPUScheduler:
         DEVICE_FETCHES.labels("burst_fused").inc()
         DEVICE_FETCHED_BYTES.labels("burst_fused").inc(h.nbytes)
         obs_trace.add_span("burst.wave.device", t_d, t_done, cat="device")
+        obs_flight.RECORDER.note_block(fl, h)
         _obs("fetch", _t)
         sel = h[:B]
         li_after = h[B:2 * B]
@@ -1468,6 +1510,11 @@ class TPUScheduler:
             li_f, lni_f, consumed = boundary(n_total - 1)
             self._dev_nodes = {**self._dev_nodes, **state}
         self.last_index, self.last_node_index = li_f, lni_f
+        obs_flight.RECORDER.note_outcome(fl, {
+            "segments": [{k: r[k] for k in ("status", "hosts", "placed")
+                          if k in r} for r in results],
+            "consumed": consumed,
+        })
         return {"segments": results, "consumed": consumed}
 
     def fused_rewind(self, li: int, lni: int) -> None:
@@ -1795,6 +1842,10 @@ class TPUScheduler:
                 k: jnp.zeros(b.n_pad, jnp.int64)
                 for k in ("cpu", "mem", "eph", "cnt")}
         li, lni = self.last_index, self.last_node_index
+        # flight recorder: pressure waves are dump-only records (no oracle
+        # replay harness) — the digest still pins inputs + outcomes
+        fl = obs_flight.RECORDER.begin("pressure", self, [(pods, False)],
+                                       all_node_names, node_infos)
         # encode vs device-scan phase boundary: everything above is host
         # encode + delta upload; everything below is dispatch + the one
         # fetch that pays the round trip (bench --mode preempt reports it)
@@ -1854,6 +1905,10 @@ class TPUScheduler:
         self._dev_nodes = {**self._dev_nodes, **mut0}
         self.last_index = int(li)
         self.last_node_index = int(lni)
+        obs_flight.RECORDER.note_outcome(fl, {"outcomes": [
+            oc if oc[0] != "nominated"
+            else ("nominated", oc[1], sorted(v.name for v in oc[2]))
+            for oc in outcomes]})
         return outcomes
 
     # -- gang (PodGroup) checkpoint/rewind -----------------------------------
@@ -1898,6 +1953,40 @@ class TPUScheduler:
         if self._dev_nodes is not None:
             DISCARDED_FOLDS.inc()
         self._dev_nodes = None
+
+    def debug_state(self) -> dict:
+        """The /debug/sched device section: mirror shape + epochs, walk
+        counters, victim-table generations/dirty rows, serial-path
+        latencies — everything a stuck-scheduler triage reads first."""
+        dev = self._dev_nodes
+        mirror = None
+        if dev is not None:
+            any_field = dev.get("valid")
+            mirror = {"fields": len(dev),
+                      "n_pad": (None if any_field is None
+                                else int(any_field.shape[-1]))}
+        vt = getattr(self.encoder, "_vt", None)
+        vic = None
+        if vt is not None:
+            vic = {"P": int(vt.P), "rows": int(vt.valid.shape[0]),
+                   "generations": len(getattr(self.encoder, "_vt_gens", {})),
+                   "dirty_rows": (None if vt.dirty_rows is None
+                                  else len(vt.dirty_rows)),
+                   "resident": self._dev_vic is not None}
+        return {
+            "mirror": mirror,
+            "dev_epoch": self._dev_epoch,
+            "last_index": self.last_index,
+            "last_node_index": self.last_node_index,
+            "victim_table": vic,
+            "mesh": self.mesh is not None,
+            "serial_path": self.serial_path,
+            "serial_lat_ms": {
+                "host_twin": (None if self._lat_ora is None
+                              else round(self._lat_ora * 1e3, 3)),
+                "device": (None if self._lat_dev is None
+                           else round(self._lat_dev * 1e3, 3))},
+        }
 
     def note_burst_assumed(self, pod: Pod, host: str, generation: int) -> None:
         """Post-burst bookkeeping for one placed pod: fold the same delta

@@ -66,7 +66,15 @@ struct Entry {
     PyObject* etype;   // owned (interned constant, incref'd per entry)
     PyObject* obj;     // owned
     long long rv;
+    double ts = 0.0;   // monotonic commit stamp (watch fan-out lag)
 };
+
+// monotonic seconds (only ever DIFFERENCED against itself: the fan-out
+// sink receives lags, not absolute times, so the epoch never matters)
+double mono_now() {
+    return std::chrono::duration<double>(
+        std::chrono::steady_clock::now().time_since_epoch()).count();
+}
 
 struct KindLog {
     std::deque<Entry> entries;
@@ -95,6 +103,7 @@ struct CommitCore {
     std::unordered_map<std::string, std::vector<long long>>* by_kind;
     std::mutex* mu;
     std::condition_variable* cv;
+    PyObject* fanout_sink;   // owned, may be null (observability hook)
 };
 
 KindLog& kind_log(CommitCore* self, const std::string& kind) {
@@ -134,10 +143,12 @@ struct GilRelease {
 // released); evicted entries are decref'd after the mutex is dropped.
 void splice(CommitCore* self, const std::string& kind,
             std::vector<Entry>& staged, std::vector<Entry>& evicted) {
+    double now = mono_now();   // one commit stamp for the whole batch
     GilRelease gil;
     std::lock_guard<std::mutex> lk(*self->mu);
     KindLog& log = kind_log(self, kind);
     for (Entry& e : staged) {
+        e.ts = now;
         log.entries.push_back(e);
         if ((long long)log.entries.size() > self->log_size) {
             evicted.push_back(log.entries.front());
@@ -603,8 +614,46 @@ PyObject* core_poll(CommitCore* self, PyObject* args) {
         }
         PyList_SET_ITEM(out, (Py_ssize_t)i, ev);
     }
+    // fan-out sink: commit->copy-out lag per event, observed here on the
+    // CONSUMER's thread (mirror of PyCommitCore.poll's hook). A sink
+    // failure is unraisable, never a delivery failure.
+    if (out != nullptr && self->fanout_sink != nullptr && !picked.empty()) {
+        double now = mono_now();
+        PyObject* lags = PyList_New((Py_ssize_t)picked.size());
+        if (lags != nullptr) {
+            bool ok = true;
+            for (size_t i = 0; i < picked.size() && ok; ++i) {
+                PyObject* lag = PyFloat_FromDouble(now - picked[i].ts);
+                if (lag == nullptr) ok = false;
+                else PyList_SET_ITEM(lags, (Py_ssize_t)i, lag);
+            }
+            if (ok) {
+                PyObject* r = PyObject_CallFunctionObjArgs(
+                    self->fanout_sink, kind_str, out, lags, nullptr);
+                if (r == nullptr) PyErr_WriteUnraisable(self->fanout_sink);
+                else Py_DECREF(r);
+            } else {
+                PyErr_WriteUnraisable(self->fanout_sink);
+            }
+            Py_DECREF(lags);
+        } else {
+            PyErr_Clear();
+        }
+    }
     Py_XDECREF(kind_str);
     return out;
+}
+
+PyObject* core_set_fanout_sink(CommitCore* self, PyObject* arg) {
+    PyObject* old = self->fanout_sink;
+    if (arg == Py_None) {
+        self->fanout_sink = nullptr;
+    } else {
+        Py_INCREF(arg);
+        self->fanout_sink = arg;
+    }
+    Py_XDECREF(old);
+    Py_RETURN_NONE;
 }
 
 PyObject* core_backlog(CommitCore* self, PyObject* arg) {
@@ -669,6 +718,7 @@ PyObject* core_new(PyTypeObject* type, PyObject* args, PyObject*) {
         new std::unordered_map<std::string, std::vector<long long>>();
     self->mu = new std::mutex();
     self->cv = new std::condition_variable();
+    self->fanout_sink = nullptr;
     return (PyObject*)self;
 }
 
@@ -706,6 +756,7 @@ void core_dealloc(CommitCore* self) {
     Py_XDECREF(self->event_cls);
     Py_XDECREF(self->expired_exc);
     Py_XDECREF(self->already_exc);
+    Py_XDECREF(self->fanout_sink);
     Py_TYPE(self)->tp_free((PyObject*)self);
 }
 
@@ -733,6 +784,9 @@ PyMethodDef core_methods[] = {
      "blocked; raises ExpiredError when dropped)"},
     {"backlog", (PyCFunction)core_backlog, METH_O,
      "published-but-unconsumed events for a watcher"},
+    {"set_fanout_sink", (PyCFunction)core_set_fanout_sink, METH_O,
+     "set_fanout_sink(callable|None) — observability hook called at poll "
+     "copy-out with (kind, events, lags)"},
     {"log_window", (PyCFunction)core_log_window, METH_O,
      "(first rv retained, last rv) of a kind's log ring"},
     {nullptr, nullptr, 0, nullptr},

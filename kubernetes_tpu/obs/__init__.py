@@ -14,7 +14,8 @@ from __future__ import annotations
 
 from kubernetes_tpu.obs.registry import (   # noqa: F401
     Counter, Gauge, Histogram, MetricFamily, Registry,
-    DEFAULT_BUCKETS, escape_help, escape_label_value, format_value,
+    DEFAULT_BUCKETS, MICRO_BUCKETS, LATENCY_BUCKETS,
+    escape_help, escape_label_value, format_value,
 )
 from kubernetes_tpu.obs import trace        # noqa: F401
 
@@ -37,3 +38,47 @@ def histogram(name, help, labelnames=(), buckets=DEFAULT_BUCKETS):
 def render_global() -> str:
     """One scrape of the global registry (every registered component)."""
     return REGISTRY.render()
+
+
+# -- debug introspection registry (the /debug/sched surface) -----------------
+# Components register named snapshot callables; `GET /debug/sched` (the
+# apiserver and the scheduler command both serve it) collects every
+# section into one JSON document. Sections use weakref-style callables
+# that return None once their component is gone; a raising section reports
+# its error instead of killing the whole endpoint.
+_DEBUG_SOURCES: dict = {}
+
+
+def register_debug(name: str, fn) -> None:
+    """Register (or replace — latest wins) a named debug section."""
+    _DEBUG_SOURCES[name] = fn
+
+
+def unregister_debug(name: str) -> None:
+    _DEBUG_SOURCES.pop(name, None)
+
+
+def debug_snapshot() -> dict:
+    out = {}
+    for name, fn in list(_DEBUG_SOURCES.items()):
+        try:
+            snap = fn()
+        except Exception as e:     # a broken section must not 500 the rest
+            out[name] = {"error": repr(e)}
+            continue
+        if snap is not None:
+            out[name] = snap
+    return out
+
+
+# the trace ring's overflow counter registers lazily from trace.py (it
+# cannot import this package at its own import time); declare it eagerly
+# here so the family is always present in the exposition
+counter("obs_trace_dropped_total",
+        "Spans dropped from the trace ring buffer on overflow (the "
+        "ring keeps the newest spans; resize with "
+        "obs.trace.set_capacity).")
+
+# imported LAST: both modules register families against REGISTRY above
+from kubernetes_tpu.obs import ledger       # noqa: F401,E402
+from kubernetes_tpu.obs import flight       # noqa: F401,E402

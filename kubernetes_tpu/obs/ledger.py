@@ -1,0 +1,257 @@
+"""Pod-lifecycle ledger — per-pod phase-stamped latency decomposition.
+
+The paper's claim is throughput *with identical decisions*, and the soak
+scoreboard (ROADMAP item 5) scores pod-startup SLO percentiles — but until
+this round no pod could answer "where did my 5 seconds go?". The ledger is
+a low-overhead per-pod phase stamper: monotonic (`time.perf_counter`)
+timestamps at each lifecycle boundary,
+
+    enqueue -> pop -> encode -> dispatch -> fetch -> commit -> copyout
+
+stamped by the queue (enqueue/pop), the TPU burst drivers
+(encode/dispatch/fetch — one shared stamp per launch, so a 10k-pod burst
+pays O(1) clock reads plus O(pods) dict writes, never a per-pod syscall),
+the store's commit verbs (commit — the `commit_wave` landing), and the
+commit core's watch copy-out sink (copyout — stamped from inside BOTH
+`native/commitcore.cpp` and the `PyCommitCore` twin via the fan-out sink).
+
+Phase durations are differences of consecutive stamps, so they telescope:
+the six phases sum EXACTLY to copyout - enqueue (the contract test pins
+per-pod sums against measured burst wall time). Folds are batched: one
+vectorized `observe_batch` per phase per committed wave, not 6 histogram
+walks per pod.
+
+Exposed families:
+- pod_e2e_duration_seconds{phase} — the decomposition histograms
+  (LATENCY_BUCKETS: the µs..100s ladder; queue waits and µs commits share
+  one family without crushing either end);
+- pod_startup_seconds_p50 / _p99 — callback gauges over a bounded
+  reservoir of enqueue->commit latencies (the density.go-style SLO view);
+- pod_startup_slo_ok — 1 when p99 <= slo_seconds (default 5s, density.go:56).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from kubernetes_tpu import obs
+from kubernetes_tpu.obs.registry import LATENCY_BUCKETS
+
+# stamp slots (indices into a pod's record)
+ENQUEUE, POP, ENCODE, DISPATCH, FETCH, COMMIT, COPYOUT = range(7)
+
+#: phase names, in stamp order; PHASES[i] = stamps[i+1] - stamps[i]
+PHASES = ("queue", "encode", "dispatch", "fetch", "commit", "fanout")
+
+POD_E2E = obs.histogram(
+    "pod_e2e_duration_seconds",
+    "Per-pod lifecycle phase durations: queue (enqueue->pop), encode "
+    "(pop->features encoded), dispatch (encode->device program "
+    "dispatched), fetch (dispatch->packed block fetched), commit "
+    "(fetch->commit_wave landed in the store), fanout (commit->first "
+    "watch copy-out, stamped by the commit core).",
+    ("phase",), buckets=LATENCY_BUCKETS)
+
+LEDGER_EVICTED = obs.counter(
+    "pod_ledger_evicted_total",
+    "Pod ledger records evicted before completing (bound on in-flight "
+    "records; an eviction means a pod sat pending longer than the "
+    "ledger's capacity window).")
+
+#: density.go:56 — the pod-startup latency SLO the gauges score against
+STARTUP_SLO_SECONDS = 5.0
+
+
+class PodLifecycleLedger:
+    """Process-global per-pod phase stamper (see module docstring)."""
+
+    def __init__(self, capacity: int = 1 << 17,
+                 reservoir: int = 1 << 16):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._recs: dict[str, list] = {}      # key -> [t0..t5] (pre-commit)
+        self._awaiting: dict[str, float] = {}  # key -> commit ts (fan-out)
+        self._e2e: deque = deque(maxlen=reservoir)   # enqueue->commit
+        self._phase_sum = {p: 0.0 for p in PHASES}
+        self._completed = 0
+        self._trace: Optional[dict] = None    # key -> stamps (test mode)
+
+    # -- configuration -------------------------------------------------------
+    def set_trace(self, on: bool) -> None:
+        """Keep completed records' raw stamps (contract-test mode)."""
+        with self._lock:
+            self._trace = {} if on else None
+
+    def reset(self) -> None:
+        """Drop every record and accumulated stat (bench run isolation)."""
+        with self._lock:
+            self._recs.clear()
+            self._awaiting.clear()
+            self._e2e.clear()
+            self._phase_sum = {p: 0.0 for p in PHASES}
+            self._completed = 0
+            if self._trace is not None:
+                self._trace = {}
+
+    # -- stamping ------------------------------------------------------------
+    def stamp_enqueue(self, key: str, t: Optional[float] = None) -> None:
+        """First enqueue wins: a re-queued (backoff) pod keeps its original
+        arrival, so queue time honestly includes backoff waits."""
+        with self._lock:
+            if key in self._recs:
+                return
+            if len(self._recs) >= self._capacity:
+                # bound in-flight records: evict the oldest insertion
+                self._recs.pop(next(iter(self._recs)))
+                LEDGER_EVICTED.inc()
+            rec = [None] * 7
+            rec[ENQUEUE] = t if t is not None else time.perf_counter()
+            self._recs[key] = rec
+
+    def stamp(self, key: str, slot: int, t: Optional[float] = None) -> None:
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is not None:
+                rec[slot] = t if t is not None else time.perf_counter()
+
+    def stamp_many(self, keys, slot: int,
+                   t: Optional[float] = None) -> None:
+        """One shared timestamp for a whole wave/burst boundary — O(1)
+        clock reads, O(pods) dict writes."""
+        tt = t if t is not None else time.perf_counter()
+        with self._lock:
+            recs = self._recs
+            for k in keys:
+                rec = recs.get(k)
+                if rec is not None:
+                    rec[slot] = tt
+
+    def stamp_serial(self, key: str, t: Optional[float] = None) -> None:
+        """Serial-cycle boundary: the host twin has no separate device
+        dispatch/fetch, so encode/dispatch/fetch land on one stamp and the
+        telescoping identity holds on every path."""
+        tt = t if t is not None else time.perf_counter()
+        with self._lock:
+            rec = self._recs.get(key)
+            if rec is not None:
+                rec[ENCODE] = rec[DISPATCH] = rec[FETCH] = tt
+
+    # -- completion ----------------------------------------------------------
+    def commit_many(self, keys, t: Optional[float] = None) -> None:
+        """A wave of bindings landed (`Store.commit_wave` / bind verbs):
+        fold each pod's pre-commit phases into the histograms in one
+        vectorized batch per phase, record the enqueue->commit latency in
+        the startup reservoir, and park the commit stamp for the fan-out
+        phase (completed by the commit core's copy-out sink)."""
+        tt = t if t is not None else time.perf_counter()
+        folds: list[list] = []
+        with self._lock:
+            recs = self._recs
+            for k in keys:
+                rec = recs.pop(k, None)
+                if rec is None:
+                    continue
+                rec[COMMIT] = tt
+                # missing intermediate stamps (a path that skipped a
+                # boundary) inherit the previous stamp: the phase reads 0
+                # and the telescoping identity survives
+                for i in range(1, COMMIT + 1):
+                    if rec[i] is None:
+                        rec[i] = rec[i - 1]
+                folds.append(rec)
+                self._awaiting[k] = tt
+                if len(self._awaiting) > self._capacity:
+                    self._awaiting.pop(next(iter(self._awaiting)))
+                if self._trace is not None:
+                    self._trace[k] = rec
+            if not folds:
+                return
+            for rec in folds:
+                self._e2e.append(rec[COMMIT] - rec[ENQUEUE])
+            self._completed += len(folds)
+        # histogram folds outside the ledger lock (families self-lock)
+        for slot, phase in ((POP, "queue"), (ENCODE, "encode"),
+                            (DISPATCH, "dispatch"), (FETCH, "fetch"),
+                            (COMMIT, "commit")):
+            vals = [max(0.0, r[slot] - r[slot - 1]) for r in folds]
+            POD_E2E.labels(phase).observe_batch(vals)
+            self._phase_sum[PHASES[slot - 1]] += sum(vals)
+
+    def has_awaiting(self) -> bool:
+        return bool(self._awaiting)
+
+    def copyout(self, key: str, t: Optional[float] = None) -> None:
+        """First watch copy-out of the pod's bind event (stamped via the
+        commit core's fan-out sink — both native and twin)."""
+        with self._lock:
+            committed = self._awaiting.pop(key, None)
+            if committed is None:
+                return
+            tt = t if t is not None else time.perf_counter()
+            d = max(0.0, tt - committed)
+            self._phase_sum["fanout"] += d
+            if self._trace is not None and key in self._trace:
+                self._trace[key][COPYOUT] = tt
+        POD_E2E.labels("fanout").observe(d)
+
+    # -- readout -------------------------------------------------------------
+    def trace_record(self, key: str) -> Optional[list]:
+        with self._lock:
+            return None if self._trace is None else self._trace.get(key)
+
+    def percentile(self, q: float) -> float:
+        """Startup (enqueue->commit) latency percentile over the bounded
+        reservoir; 0.0 with no data."""
+        with self._lock:
+            vals = sorted(self._e2e)
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def slo_ok(self, slo: float = STARTUP_SLO_SECONDS) -> float:
+        p99 = self.percentile(0.99)
+        return 1.0 if p99 <= slo else 0.0
+
+    def snapshot(self) -> dict:
+        """Bench/harness readout: startup percentiles + the per-phase
+        split over everything folded since the last reset(). phase_split
+        values are POD-SECONDS (the sum over pods of that phase's
+        duration) — burst-shared boundaries mean each pod's phase spans
+        the launch's wall time, so the split reads as relative weight,
+        not as wall seconds."""
+        with self._lock:
+            split = dict(self._phase_sum)
+            n = self._completed
+        return {
+            "startup_p50": round(self.percentile(0.50), 6),
+            "startup_p99": round(self.percentile(0.99), 6),
+            "startup_slo_ok": bool(self.slo_ok()),
+            "phase_split": {p: round(v, 6) for p, v in split.items()},
+            "pods_completed": n,
+        }
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            return {"in_flight": len(self._recs),
+                    "awaiting_fanout": len(self._awaiting),
+                    "completed": self._completed}
+
+
+#: the process-global ledger every layer stamps into
+LEDGER = PodLifecycleLedger()
+
+# first-class SLO gauges: read the ledger at collect time (GaugeFunc)
+_P50 = obs.gauge("pod_startup_seconds_p50",
+                 "Median pod startup (enqueue->commit) latency over the "
+                 "ledger reservoir.")
+_P50.set_function(lambda: LEDGER.percentile(0.50))
+_P99 = obs.gauge("pod_startup_seconds_p99",
+                 "p99 pod startup (enqueue->commit) latency over the "
+                 "ledger reservoir.")
+_P99.set_function(lambda: LEDGER.percentile(0.99))
+_SLO = obs.gauge("pod_startup_slo_ok",
+                 "1 when the p99 pod-startup latency meets the 5s SLO "
+                 "(density.go:56); vacuously 1 with no data.")
+_SLO.set_function(lambda: LEDGER.slo_ok())

@@ -21,6 +21,14 @@ from typing import Callable, Iterable, Optional, Sequence
 
 # reference buckets: ExponentialBuckets(0.001, 2, 15) (metrics.go:93)
 DEFAULT_BUCKETS = tuple(0.001 * 2 ** i for i in range(15))
+# µs-scale preset for the native commit/fan-out phases: the default
+# ms-scale ladder starts at 1ms, which crushes a 5-30µs commit-core call
+# or a sub-ms watch fan-out lag into the first bucket — these start at 1µs
+# and reach ~4s (ExponentialBuckets(1e-6, 4, 12) shape)
+MICRO_BUCKETS = tuple(1e-6 * 4 ** i for i in range(12))
+# wide pod-lifecycle preset: one family spans µs-scale phases (commit,
+# fan-out copy-out) AND seconds-scale phases (queue wait) — 1µs..134s
+LATENCY_BUCKETS = tuple(1e-6 * 4 ** i for i in range(14))
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -129,6 +137,27 @@ class _HistogramChild:
             for i, b in enumerate(self.bounds):
                 if value <= b:
                     self.buckets[i] += count
+
+    def observe_batch(self, values) -> None:
+        """Observe a whole batch of DISTINCT values in one vectorized pass —
+        the watch fan-out copy-out and the per-wave ledger folds observe
+        thousands of values per call; a Python observe() loop there would
+        put an O(events) bucket walk back on the consumer threads."""
+        import numpy as _np
+        arr = _np.asarray(values, dtype=_np.float64)
+        if arr.size == 0:
+            return
+        bounds = _np.asarray(self.bounds, dtype=_np.float64)
+        # first bucket each value lands in; counts cumulate left-to-right
+        # (bucket[i] counts v <= bounds[i], the Prometheus cumulative shape)
+        idx = _np.searchsorted(bounds, arr, side="left")
+        hist = _np.bincount(idx, minlength=len(bounds) + 1)
+        cum = _np.cumsum(hist[:len(bounds)])
+        with self._lock:
+            self.count += int(arr.size)
+            self.sum += float(arr.sum())
+            for i in range(len(self.bounds)):
+                self.buckets[i] += int(cum[i])
 
 
 _CHILD_TYPES = {"counter": _CounterChild, "gauge": _GaugeChild}
@@ -245,6 +274,9 @@ class Histogram(MetricFamily):
     def observe_many(self, value: float, count: int) -> None:
         self._default().observe_many(value, count)
 
+    def observe_batch(self, values) -> None:
+        self._default().observe_batch(values)
+
     def sample_lines(self) -> list[str]:
         out = []
         for values in sorted(self._children):
@@ -286,6 +318,17 @@ class Registry:
                     raise ValueError(
                         f"metric {name!r} re-registered with a different "
                         f"type or label set")
+                want = kw.get("buckets")
+                if want is not None and tuple(want) != DEFAULT_BUCKETS \
+                        and existing.buckets != tuple(sorted(want)):
+                    # per-family bucket overrides are part of the family's
+                    # shape: silently returning the old ladder is how a
+                    # µs-scale family ends up crushed into one ms bucket.
+                    # (Passing the default ladder means "no opinion", so a
+                    # declare-without-buckets reuse keeps working.)
+                    raise ValueError(
+                        f"metric {name!r} re-registered with different "
+                        f"buckets")
                 return existing
             fam = cls(name, help, labelnames, **kw)
             self._families[name] = fam

@@ -54,6 +54,22 @@ def now() -> float:
     return time.perf_counter()
 
 
+def _note_dropped(n: int = 1) -> None:
+    """Book spans the ring overflowed away (the deque drops them silently;
+    this is the observable tripwire). Lazy import: obs/__init__ imports
+    this module, so the counter can only be fetched after init — drops are
+    rare, and the registry's get-or-create makes the repeat lookup cheap."""
+    try:
+        from kubernetes_tpu import obs
+        obs.counter(
+            "obs_trace_dropped_total",
+            "Spans dropped from the trace ring buffer on overflow (the "
+            "ring keeps the newest spans; resize with "
+            "obs.trace.set_capacity).").inc(n)
+    except Exception:
+        pass   # never let observability bookkeeping break a hot path
+
+
 def add_span(name: str, t0: float, t1: float, cat: str = "host",
              args: Optional[dict] = None) -> None:
     """Record one complete span from explicit perf_counter timestamps —
@@ -68,7 +84,10 @@ def add_span(name: str, t0: float, t1: float, cat: str = "host",
         if parent:
             a.setdefault("parent", parent)
         ev["args"] = a
-    _buf.append(ev)
+    buf = _buf
+    if buf.maxlen is not None and len(buf) >= buf.maxlen:
+        _note_dropped()
+    buf.append(ev)
 
 
 @contextmanager
@@ -86,15 +105,25 @@ def span(name: str, cat: str = "host", **args):
                  args=args or None)
 
 
-def events() -> list[dict]:
-    """Snapshot of the recorded spans, oldest first."""
-    return list(_buf)
+def events(limit: Optional[int] = None,
+           cat: Optional[str] = None) -> list[dict]:
+    """Snapshot of the recorded spans, oldest first. `cat` filters by span
+    category (e.g. "device" vs "host"); `limit` keeps only the NEWEST N
+    spans after filtering — the /debug/traces query knobs."""
+    evs = list(_buf)
+    if cat is not None:
+        evs = [e for e in evs if e.get("cat") == cat]
+    if limit is not None and limit >= 0:
+        evs = evs[-limit:] if limit else []
+    return evs
 
 
-def to_chrome() -> dict:
+def to_chrome(limit: Optional[int] = None,
+              cat: Optional[str] = None) -> dict:
     """Chrome trace-event JSON object — Perfetto and chrome://tracing both
     load it directly."""
-    return {"traceEvents": events(), "displayTimeUnit": "ms"}
+    return {"traceEvents": events(limit=limit, cat=cat),
+            "displayTimeUnit": "ms"}
 
 
 def export(path: str) -> int:

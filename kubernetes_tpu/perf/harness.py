@@ -494,6 +494,8 @@ def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
     from kubernetes_tpu.cmd.cluster import Cluster
     from kubernetes_tpu.api.types import Pod, Container
     from kubernetes_tpu.models.hollow import MI
+    from kubernetes_tpu.obs.ledger import LEDGER
+    LEDGER.reset()   # scope the decomposition to this density run
     with Cluster(n_nodes=n_nodes, api_port=-1, use_tpu=use_tpu,
                  kubelet_interval=0.02) as cluster:
         created: dict[str, float] = {}
@@ -519,6 +521,7 @@ def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
         elapsed = _t.perf_counter() - t0
     lats = sorted(started[k] - created[k] for k in started)
     pct = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))] if lats else None
+    led = LEDGER.snapshot()
     return {
         "saturated": ok,
         "throughput": round(n_pods / elapsed, 1) if elapsed else 0.0,
@@ -526,4 +529,10 @@ def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
         "startup_p99": round(pct(0.99), 3) if lats else None,
         "startup_slo_5s": bool(lats) and pct(0.99) <= 5.0,
         "throughput_slo_8pps": (n_pods / elapsed) >= 8.0 if elapsed else False,
+        # the ledger's view of the same run: scheduling (enqueue->commit)
+        # percentiles + the full per-phase decomposition — "where did my
+        # 5 seconds go" for the density SLO
+        "sched_startup_p50": led["startup_p50"],
+        "sched_startup_p99": led["startup_p99"],
+        "sched_phase_split": led["phase_split"],
     }

@@ -20,6 +20,7 @@ from typing import Optional
 
 from kubernetes_tpu.api.types import Pod, has_pod_affinity_terms
 from kubernetes_tpu.coscheduling.types import pod_group_key
+from kubernetes_tpu.obs import ledger as obs_ledger
 from kubernetes_tpu.utils.clock import Clock, RealClock
 from kubernetes_tpu.utils.heap import KeyedHeap, NumericKeyedHeap
 
@@ -169,6 +170,9 @@ class PriorityQueue:
             self._unschedulable.pop(pod.key, None)
             self._backoffq.delete(pod.key)
             self.nominated.add(pod)
+            # lifecycle ledger: monotonic arrival stamp (first-enqueue
+            # wins, so backoff re-entries keep their true queue wait)
+            obs_ledger.LEDGER.stamp_enqueue(pod.key)
             self._cond.notify()
 
     def add_if_not_present(self, pod: Pod) -> None:
@@ -194,6 +198,7 @@ class PriorityQueue:
             else:
                 self._unschedulable[pod.key] = q
             self.nominated.add(pod)
+            obs_ledger.LEDGER.stamp_enqueue(pod.key)  # first-enqueue wins
 
     def pop(self, timeout: Optional[float] = None) -> Optional[Pod]:
         """Blocks until a pod is ready (reference: :389). Flushes backoff /
@@ -211,6 +216,7 @@ class PriorityQueue:
                 q = self._active.pop()
                 if q is not None:
                     self._scheduling_cycle += 1
+                    obs_ledger.LEDGER.stamp(q.pod.key, obs_ledger.POP)
                     return q.pod
                 if self._closed:
                     return None
@@ -233,6 +239,9 @@ class PriorityQueue:
             base = self._scheduling_cycle
             qs = self._active.pop_many(limit)
             self._scheduling_cycle += len(qs)
+            if qs:
+                obs_ledger.LEDGER.stamp_many(
+                    [q.pod.key for q in qs], obs_ledger.POP)
             return [(q.pod, base + i + 1) for i, q in enumerate(qs)]
 
     # -- gang (coscheduling) ops --------------------------------------------
@@ -253,6 +262,9 @@ class PriorityQueue:
                 self._active.delete(q.pod.key)
                 self._scheduling_cycle += 1
                 out.append((q.pod, self._scheduling_cycle))
+            if out:
+                obs_ledger.LEDGER.stamp_many(
+                    [p.key for p, _c in out], obs_ledger.POP)
             return out
 
     def park_group(self, group_key: str, pods: list[Pod]) -> float:
@@ -436,6 +448,36 @@ class PriorityQueue:
     def num_pending(self) -> int:
         with self._lock:
             return len(self._active) + len(self._backoffq) + len(self._unschedulable)
+
+    def parked_gangs(self) -> dict[str, dict]:
+        """Gangs currently under a group backoff window, with deadlines —
+        the /debug/sched view of why a PodGroup isn't being attempted."""
+        with self._lock:
+            now = self.clock.now()
+            out = {}
+            for gk in self._gang_backoff._attempts:
+                expiry = self._gang_backoff.backoff_expiry(gk)
+                out[gk] = {
+                    "attempts": self._gang_backoff._attempts[gk],
+                    "backoff_expiry": round(expiry, 3),
+                    "remaining_seconds": round(max(0.0, expiry - now), 3),
+                }
+            return out
+
+    def debug_state(self) -> dict:
+        """One /debug/sched section: queue depths, cycle counter, parked
+        gangs with deadlines, nominated-pod count."""
+        with self._lock:
+            state = {
+                "active_depth": len(self._active),
+                "backoff_depth": len(self._backoffq),
+                "unschedulable_depth": len(self._unschedulable),
+                "scheduling_cycle": self._scheduling_cycle,
+                "move_request_cycle": self._move_request_cycle,
+                "nominated_nodes": len(self.nominated._by_node),
+            }
+        state["parked_gangs"] = self.parked_gangs()
+        return state
 
     def clear_backoff(self, pod: Pod) -> None:
         with self._cond:

@@ -268,6 +268,38 @@ class Scheduler:
             snapshot_fn=lambda: self._snapshot.node_infos,
             store=store, enabled=plugins_enabled)
         self._add_all_event_handlers()
+        self._register_debug()
+
+    def _register_debug(self) -> None:
+        """Publish this scheduler's /debug/sched sections (queue depths,
+        parked gangs, device mirror, ledger) into the obs debug registry.
+        Weakref-held: a dropped scheduler's section silently disappears
+        instead of pinning the whole object graph (latest instance wins,
+        matching the one-scheduler-per-process deployment shape)."""
+        import weakref
+        ref = weakref.ref(self)
+
+        def snap():
+            s = ref()
+            if s is None:
+                return None
+            return s.debug_state()
+        obs.register_debug("scheduler", snap)
+
+    def debug_state(self) -> dict:
+        from kubernetes_tpu.obs.ledger import LEDGER
+        out = {
+            "name": self.name,
+            "queue": self.queue.debug_state(),
+            "ledger": LEDGER.debug_state(),
+        }
+        algo_dbg = getattr(self.algorithm, "debug_state", None)
+        if algo_dbg is not None:
+            out["device"] = algo_dbg()
+        store_dbg = getattr(self.store, "debug_state", None)
+        if store_dbg is not None:
+            out["store"] = store_dbg()
+        return out
 
     # -- event handlers (reference: eventhandlers.go:319) --------------------
     def _responsible_for(self, pod: Pod) -> bool:
@@ -433,6 +465,11 @@ class Scheduler:
                 self.metrics.observe_phase("algorithm",
                                            self.clock.now() - t_alg)
                 cycle_trace.step("scheduling algorithm")
+                # ledger: the serial cycle has no separate device
+                # dispatch/fetch boundary — one stamp keeps the per-pod
+                # phase decomposition telescoping on every path
+                from kubernetes_tpu.obs.ledger import LEDGER
+                LEDGER.stamp_serial(pod.key)
         except FitError as err:
             self.metrics.observe("unschedulable")
             if not self.disable_preemption:
@@ -1381,6 +1418,8 @@ class Scheduler:
             # the rest forget + re-queue, exactly like the serial _bind's
             # per-pod failure handling (their audit records re-emit below;
             # fire-and-forget records tolerate the crash-path duplicate)
+            from kubernetes_tpu.obs import flight as obs_flight
+            obs_flight.RECORDER.note_crash("commit-wave-crash")
             emit_batch = True
             missing = set()
             for assumed, host in zip(assumed_list, hosts):

@@ -60,7 +60,10 @@ class _KindLog:
     __slots__ = ("entries", "rvs", "start", "flushed")
 
     def __init__(self):
-        self.entries: list = []   # (etype, obj, rv) from abs seq `start`
+        # (etype, obj, rv, ts) from abs seq `start`; ts is the monotonic
+        # commit stamp feeding the watch_fanout_lag_seconds histogram
+        # (commit -> copy-out) through the fan-out sink
+        self.entries: list = []
         self.rvs: list[int] = []  # parallel rv vector (attach binary search)
         self.start = 0            # absolute seq of entries[0]
         self.flushed = 0          # absolute seq events are published up to
@@ -98,6 +101,15 @@ class PyCommitCore:
         self._by_kind: dict[str, list[int]] = {}
         self._next_wid = 0
         self._cond = threading.Condition(threading.Lock())
+        self._fanout_sink = None
+
+    def set_fanout_sink(self, sink) -> None:
+        """Observability hook (identical on the native core): called at
+        poll copy-out with (kind, events, lags) — `lags[i]` is the seconds
+        between events[i]'s commit stamp and this copy-out. The store wires
+        it to the watch_fanout_lag_seconds histogram and the pod-lifecycle
+        ledger's copy-out stamp. Never part of parity-observable state."""
+        self._fanout_sink = sink
 
     # -- rv ------------------------------------------------------------------
     def rv(self) -> int:
@@ -117,8 +129,11 @@ class PyCommitCore:
             log = self._logs[kind] = _KindLog()
         return log
 
-    def _append(self, log: _KindLog, etype: str, obj: Any, rv: int) -> None:
-        log.entries.append((etype, obj, rv))
+    def _append(self, log: _KindLog, etype: str, obj: Any, rv: int,
+                ts: Optional[float] = None) -> None:
+        import time as _time
+        log.entries.append((etype, obj, rv,
+                            ts if ts is not None else _time.perf_counter()))
         log.rvs.append(rv)
         if len(log.entries) > self._log_size:
             n = len(log.entries) - self._log_size
@@ -138,7 +153,9 @@ class PyCommitCore:
         """The store's batched bind body (_bind_locked semantics per
         binding): clone, set node_name, assign the next rv, replace the
         bucket entry, log MODIFIED. Returns the keys that were missing."""
+        import time as _time
         log = self._kind_log(kind)
+        ts = _time.perf_counter()   # one commit stamp for the whole batch
         missing = []
         for pod_key, node_name in bindings:
             current = bucket.get(pod_key)
@@ -150,7 +167,7 @@ class PyCommitCore:
             self._rv += 1
             stored.resource_version = self._rv
             bucket[pod_key] = stored
-            self._append(log, MODIFIED, stored, self._rv)
+            self._append(log, MODIFIED, stored, self._rv, ts)
         return missing
 
     def create_batch(self, bucket: dict, kind: str, objs: list,
@@ -158,7 +175,9 @@ class PyCommitCore:
         """The store's batched create body (_create_locked semantics per
         object): raise AlreadyExists on a duplicate key, snapshot unless
         `move`, assign the next rv, log ADDED. Returns the stored objects."""
+        import time as _time
         log = self._kind_log(kind)
+        ts = _time.perf_counter()   # one commit stamp for the whole batch
         out = []
         for obj in objs:
             key = obj.key
@@ -168,7 +187,7 @@ class PyCommitCore:
             self._rv += 1
             stored.resource_version = self._rv
             bucket[key] = stored
-            self._append(log, ADDED, stored, self._rv)
+            self._append(log, ADDED, stored, self._rv, ts)
             out.append(stored)
         return out
 
@@ -283,7 +302,19 @@ class PyCommitCore:
             picked = log.entries[lo: lo + n]
             w.cursor += n
         ev = self._event_cls
-        return [ev(t, w.kind, o, rv) for t, o, rv in picked]
+        events = [ev(t, w.kind, o, rv) for t, o, rv, _ts in picked]
+        sink = self._fanout_sink
+        if sink is not None and events:
+            # copy-out stamp: commit->copy-out lag per event, observed on
+            # the CONSUMER's thread (the identical hook exists in
+            # commitcore.cpp's poll)
+            import time as _time
+            now = _time.perf_counter()
+            try:
+                sink(w.kind, events, [now - e[3] for e in picked])
+            except Exception:
+                pass   # observability must never break delivery
+        return events
 
     # -- introspection (tests / bench) ---------------------------------------
     def backlog(self, wid: int) -> int:

@@ -74,6 +74,21 @@ COMMIT_WAVES = obs.counter(
     "Batched bind+event commit waves written through the commit core, by "
     "implementation (native C++ extension vs pure-Python twin).",
     ("impl",))
+# µs-scale families (obs.MICRO_BUCKETS): the native commit core lands a
+# wave in tens of µs and fan-out lag is sub-ms on an idle box — the
+# default ms ladder would crush both into one bucket (the round-12
+# per-family bucket-override satellite)
+COMMIT_WAVE_SECONDS = obs.histogram(
+    "store_commit_wave_seconds",
+    "Wall seconds of one commit_wave core call (batched bind + audit "
+    "record creates), by implementation.",
+    ("impl",), buckets=obs.MICRO_BUCKETS)
+WATCH_FANOUT_LAG = obs.histogram(
+    "watch_fanout_lag_seconds",
+    "Seconds from an event's commit (core log append) to its copy-out by "
+    "a watcher — stamped inside BOTH commit cores (native commitcore.cpp "
+    "and the PyCommitCore twin) via the fan-out sink.",
+    ("impl",), buckets=obs.MICRO_BUCKETS)
 
 
 class ConflictError(Exception):
@@ -142,6 +157,7 @@ class Watch:
 
     def stop(self) -> None:
         self._stopped = True
+        self._store._watch_ids.pop(self._wid, None)
         self._store._core.detach(self._wid)  # wakes any blocked next()
 
 
@@ -214,6 +230,15 @@ class Store:
         self.core_impl = "native" if getattr(self._core, "is_native", False) \
             else "twin"
         self._log_size = watch_log_size
+        # live watcher ids (wid -> kind) for the /debug/sched cursor-lag
+        # view; pruned on Watch.stop()
+        self._watch_ids: dict[int, str] = {}
+        # fan-out sink: the commit core calls this at poll copy-out (both
+        # impls) with (kind, events, lags) — feeds the fan-out-lag
+        # histogram and the pod ledger's copy-out stamp. hasattr-gated so a
+        # stale prebuilt .so without the hook degrades to no lag samples.
+        if hasattr(self._core, "set_fanout_sink"):
+            self._core.set_fanout_sink(self._make_fanout_sink())
         # alias tripwire: watch events and create/update return values alias
         # the write snapshot, read-only BY CONVENTION. In debug mode every
         # write records a fingerprint of the stored object; the next write
@@ -224,6 +249,50 @@ class Store:
         if debug_integrity is None:
             debug_integrity = bool(os.environ.get("KTPU_STORE_INTEGRITY"))
         self._integrity: Optional[dict] = {} if debug_integrity else None
+
+    # -- observability -------------------------------------------------------
+    def _make_fanout_sink(self):
+        """Build the copy-out sink. Deliberately closes over nothing of
+        `self` (the core holds the sink; a closure over the store would
+        make a reference cycle through the core)."""
+        from kubernetes_tpu.obs.ledger import LEDGER
+        lag_child = WATCH_FANOUT_LAG.labels(self.core_impl)
+
+        def sink(kind, events, lags):
+            # one vectorized fold per poll batch — a per-event observe()
+            # loop here would put O(events) Python back on the consumer
+            # threads the GIL-released poll just freed
+            lag_child.observe_batch(lags)
+            if kind == PODS and LEDGER.has_awaiting():
+                import time as _time
+                now = _time.perf_counter()
+                for ev in events:
+                    if ev.type == MODIFIED and ev.obj.node_name:
+                        LEDGER.copyout(ev.obj.key, now)
+        return sink
+
+    def watcher_lags(self) -> list[dict]:
+        """Per-watcher published-but-unconsumed cursor backlog (the
+        /debug/sched fan-out health view)."""
+        out = []
+        with self._lock:
+            ids = list(self._watch_ids.items())
+        for wid, kind in ids:
+            try:
+                out.append({"wid": wid, "kind": kind,
+                            "backlog": int(self._core.backlog(wid))})
+            except Exception:
+                continue
+        return out
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            n_objs = {k: len(v) for k, v in self._objs.items()}
+            rv = self._core.rv()
+        return {"resource_version": rv,
+                "commit_core": self.core_impl,
+                "objects": n_objs,
+                "watchers": self.watcher_lags()}
 
     # -- alias tripwire ------------------------------------------------------
     @staticmethod
@@ -365,6 +434,8 @@ class Store:
                 self._flush()
                 raise NotFoundError(f"{PODS}/{pod_key}")
             self._flush()
+            from kubernetes_tpu.obs.ledger import LEDGER
+            LEDGER.commit_many((pod_key,))
             return bucket[pod_key]
 
     def _bind_batch_locked(self, bucket,
@@ -395,6 +466,9 @@ class Store:
             bucket = self._objs.setdefault(PODS, {})
             missing = self._bind_batch_locked(bucket, bindings)
         self._flush()
+        from kubernetes_tpu.obs.ledger import LEDGER
+        gone = set(missing)
+        LEDGER.commit_many([k for k, _n in bindings if k not in gone])
         return missing
 
     def create_many(self, kind: str, objs: list, move: bool = False) -> None:
@@ -421,6 +495,7 @@ class Store:
         Fan-out is deliberately NOT triggered here — the scheduler calls
         `fanout_wave()` as its one separate per-wave delivery call, which
         may overlap the remaining host commit work."""
+        import time as _time
         with self._lock:
             pods = self._objs.setdefault(PODS, {})
             evs = self._objs.setdefault(EVENTS, {})
@@ -429,9 +504,13 @@ class Store:
                     current = pods.get(pod_key)
                     if current is not None:
                         self._check_entry(PODS, pod_key, current)
+            t_core = _time.perf_counter()
             missing = self._core.commit_wave(pods, PODS, bindings,
                                              evs, EVENTS, events or [])
+            t_landed = _time.perf_counter()
             COMMIT_WAVES.labels(self.core_impl).inc()
+            COMMIT_WAVE_SECONDS.labels(self.core_impl).observe(
+                t_landed - t_core)
             if self._integrity is not None:
                 gone = set(missing)
                 for pod_key, _n in bindings:
@@ -441,6 +520,11 @@ class Store:
                     stored = evs.get(rec.key)
                     if stored is not None:
                         self._record_entry(EVENTS, rec.key, stored)
+        # ledger: the commit_wave landing IS the per-pod commit stamp
+        from kubernetes_tpu.obs.ledger import LEDGER
+        gone = set(missing)
+        LEDGER.commit_many([k for k, _n in bindings if k not in gone],
+                           t=t_landed)
         return missing
 
     def fanout_wave(self) -> None:
@@ -488,7 +572,9 @@ class Store:
         be the first after since_rv.)
         """
         with self._lock:
-            return Watch(self, kind, self._core.attach(kind, since_rv))
+            wid = self._core.attach(kind, since_rv)
+            self._watch_ids[wid] = kind
+            return Watch(self, kind, wid)
 
     # -- bulk load (benchmark harness) --------------------------------------
     def load(self, kind: str, objs: Iterable[Any]) -> None:

@@ -14,10 +14,32 @@ seeds.
 """
 import random
 import sys
+from contextlib import contextmanager
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+@contextmanager
+def _flight_recorder():
+    """The round-12 flight-recorder harness the pytest fixture provides:
+    replay-mode capture, restored to digest after the trial. Each green
+    trial ALSO replays every recorded burst through the oracle referee
+    (finish_with_flight inside the fuzz bodies)."""
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    try:
+        yield flight.RECORDER
+    finally:
+        flight.RECORDER.configure(mode="digest")
+        flight.RECORDER.clear()
+
+
+def _with_flight(fn, s, w):
+    with _flight_recorder() as rec:
+        fn(s, w, rec)
 
 
 def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
@@ -28,9 +50,10 @@ def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
     rng = random.Random(base_seed)
     classes = [
         ("mixed", TestMixedWorkloadShellFuzz(),
-         lambda t, s, w: t.test_bindings_identical(s, w)),
+         lambda t, s, w: _with_flight(t.test_bindings_identical, s, w)),
         ("pressure", TestPreemptionPressureShellFuzz(),
-         lambda t, s, w: t.test_preemptive_convergence_identical(s, w)),
+         lambda t, s, w: _with_flight(
+             t.test_preemptive_convergence_identical, s, w)),
         ("spread", TestSpreadBurstParity(),
          lambda t, s, w: t.test_burst_matches_oracle_with_existing_pods(
              s, w)),

@@ -133,6 +133,44 @@ def test_commit_mode_floor():
 
 
 @pytest.mark.slow
+def test_headline_ledger_fields_and_metrics_out(tmp_path):
+    """Round-12: the headline JSON line gains the soak-scoreboard fields
+    (startup_p50/startup_p99/phase_split from the pod-lifecycle ledger)
+    and `--metrics-out` dumps the end-of-run registry snapshot beside it.
+    Floors are shape checks, not variance tripwires: percentiles ordered
+    and positive, every phase present, the device phases (fetch+commit)
+    actually attributed, and the metrics artifact lints clean with the
+    new families inside."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    metrics_path = tmp_path / "metrics.prom"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--nodes", "300", "--pods", "2000",
+         "--repeat", "1", "--no-matrix", "--no-mesh",
+         "--metrics-out", str(metrics_path)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["pods_completed"] == 2000, out
+    assert 0 < out["startup_p50"] <= out["startup_p99"], out
+    split = out["phase_split"]
+    assert set(split) == {"queue", "encode", "dispatch", "fetch",
+                          "commit", "fanout"}, split
+    # the burst path pays real time in fetch (the packed readback) and
+    # commit (store write tail) — a zeroed phase means a dead stamp
+    assert split["fetch"] > 0 and split["commit"] > 0, split
+    # ledger stamping must not add device traffic (the 1/1 contract)
+    assert out["device_fetches"] <= out["device_dispatches"], out
+    # the metrics artifact: full exposition, lint-clean, ledger inside
+    from kubernetes_tpu.obs.lint import lint_exposition
+    text = metrics_path.read_text()
+    assert lint_exposition(text) == []
+    assert "pod_e2e_duration_seconds_bucket" in text
+    assert "pod_startup_seconds_p99" in text
+    assert out["metrics_out"] == str(metrics_path)
+
+
+@pytest.mark.slow
 def test_gang_mode_floor():
     """`bench.py --mode gang` (the gang lane's standalone entry): one JSON
     line, the atomicity audit passed (all_or_nothing — the bench itself

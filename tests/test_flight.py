@@ -1,0 +1,151 @@
+"""Burst flight recorder tests (ISSUE 8 tentpole, part 2).
+
+The recorder keeps the last N single-launch bursts (inputs digest + packed
+fetch block + commit outcome); `dump()` is a JSON artifact and `replay()`
+re-derives a recorded burst's decisions through the pure-Python oracle and
+compares bit-for-bit — including gang segments (in-scan rewinds) and
+failed singletons. A tampered record must FAIL replay: the referee is only
+worth anything if it can actually see a divergence."""
+import json
+
+import pytest
+
+from kubernetes_tpu.api.types import Pod, Node, Container
+from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+from kubernetes_tpu.obs import flight
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import Store, NODES, PODS, PODGROUPS
+
+GI = 1024 ** 3
+
+
+def mknode(i, cpu=4000, zone=None):
+    return Node(name=f"n{i}",
+                labels={"kubernetes.io/hostname": f"n{i}",
+                        "failure-domain.beta.kubernetes.io/zone":
+                        zone or f"z{i % 2}"},
+                allocatable={"cpu": cpu, "memory": 32 * GI, "pods": 110})
+
+
+def mkpod(name, cpu=100, **kw):
+    return Pod(name=name,
+               containers=(Container.make(name="c",
+                                          requests={"cpu": cpu}),), **kw)
+
+
+@pytest.fixture
+def replay_recorder():
+    rec = flight.RECORDER
+    rec.configure(mode="replay", capacity=32)
+    rec.clear()
+    yield rec
+    rec.configure(mode="digest")
+    rec.clear()
+
+
+def run_cluster(recorder, n_nodes=5, gang=None, singles=8, fat=False,
+                node_cpu=4000):
+    store = Store()
+    for i in range(n_nodes):
+        store.create(NODES, mknode(i, cpu=node_cpu))
+    sched = Scheduler(store, use_tpu=True,
+                      percentage_of_nodes_to_score=100)
+    sched.sync()
+    if gang:
+        name, members, need = gang
+        store.create(PODGROUPS, PodGroup(name=name, min_member=need))
+        for r in range(members):
+            store.create(PODS, mkpod(f"{name}-{r}", cpu=900,
+                                     labels={LABEL_POD_GROUP: name}))
+    for j in range(singles):
+        store.create(PODS, mkpod(f"s{j}", labels={"app": "x"}))
+    if fat:
+        store.create(PODS, mkpod("fat", cpu=10 * node_cpu,
+                                 labels={"app": "x"}))
+    sched.pump()
+    while sched.schedule_burst(max_pods=64):
+        pass
+    sched.pump()
+    return store, sched
+
+
+class TestRecording:
+    def test_digest_mode_records_inputs_and_outcome(self):
+        rec = flight.RECORDER
+        rec.configure(mode="digest", capacity=8)
+        rec.clear()
+        run_cluster(rec)
+        records = rec.records()
+        assert records, "no burst recorded"
+        r = records[0]
+        assert r.kind in ("uniform", "scan", "fused")
+        assert len(r.pods) == 8
+        assert r.blocks, "packed fetch block not attached"
+        assert r.outcome is not None
+        assert r.capture is None          # digest mode: no deep clones
+        rec.clear()
+
+    def test_dump_is_json_artifact(self, replay_recorder, tmp_path):
+        run_cluster(replay_recorder)
+        path = tmp_path / "flight.json"
+        out = flight.dump(str(path))
+        assert out == str(path)
+        doc = json.loads(path.read_text())
+        (r0,) = doc["flight_records"][:1]
+        for key in ("kind", "segments", "last_index", "last_node_index",
+                    "dev_epoch", "node_tree_epoch", "victim_table",
+                    "blocks", "outcome", "replayable"):
+            assert key in r0, key
+        assert r0["replayable"] is True
+        assert r0["segments"][0]["pods"][0].startswith("default/")
+
+    def test_ring_is_bounded(self, replay_recorder):
+        replay_recorder.configure(capacity=2)
+        run_cluster(replay_recorder, singles=4)
+        run_cluster(replay_recorder, singles=4)
+        run_cluster(replay_recorder, singles=4)
+        assert len(replay_recorder.records()) <= 2
+
+
+class TestReplay:
+    def test_decided_burst_replays_bit_identical(self, replay_recorder):
+        run_cluster(replay_recorder)
+        errs = replay_recorder.replay_all()
+        assert errs == [], errs
+
+    def test_failed_singleton_and_gang_replay(self, replay_recorder):
+        # a gang that must REJECT (4 members of 900cpu on 3 nodes) and a
+        # fat singleton that fails -> rejected + failed records replay
+        run_cluster(replay_recorder, n_nodes=3, node_cpu=1000,
+                    gang=("g", 4, 4), singles=2, fat=True)
+        kinds = {(r.kind, seg[1]) for r in replay_recorder.records()
+                 for seg in r.segments}
+        assert any(g for _k, g in kinds), "no gang segment recorded"
+        errs = replay_recorder.replay_all()
+        assert errs == [], errs
+
+    def test_tampered_record_fails_replay(self, replay_recorder):
+        run_cluster(replay_recorder, singles=4)
+        rec = next(r for r in replay_recorder.records()
+                   if r.capture is not None)
+        # flip one decided host: the oracle referee must see it
+        if rec.kind == "fused":
+            hosts = rec.outcome["segments"][0]["hosts"]
+        else:
+            hosts = rec.outcome["hosts"]
+        assert hosts
+        hosts[0] = "n-bogus"
+        errs = replay_recorder.replay(rec)
+        assert errs, "tampered record replayed clean"
+
+    def test_replay_requires_capture(self):
+        rec = flight.FlightRecorder()
+        r = flight.BurstRecord("scan", [([], False)], [], 0, 0, None,
+                               None, 0, None, None)
+        with pytest.raises(ValueError):
+            rec.replay(r)
+
+    def test_crash_note_annotates_last_record(self, replay_recorder):
+        run_cluster(replay_recorder, singles=4)
+        replay_recorder.note_crash("commit-wave-crash")
+        assert "commit-wave-crash" in replay_recorder.records()[-1].notes

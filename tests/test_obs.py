@@ -423,6 +423,156 @@ class TestAPIServerMetricsE2E:
             w.stop()
 
 
+class TestTraceDropCounter:
+    """Satellite: the span ring used to drop spans silently on overflow —
+    obs_trace_dropped_total books every span the deque pushes off."""
+
+    def test_overflow_increments_counter(self):
+        fam = obs.counter("obs_trace_dropped_total", "x")
+        obs.trace.set_capacity(4)
+        try:
+            obs.trace.clear()
+            before = fam.value
+            for i in range(10):
+                obs.trace.add_span(f"d{i}", 0.0, 0.001)
+            assert fam.value == before + 6
+            assert len(obs.trace.events()) == 4
+        finally:
+            obs.trace.set_capacity(obs.trace.DEFAULT_CAPACITY)
+
+    def test_no_drops_under_capacity(self):
+        fam = obs.counter("obs_trace_dropped_total", "x")
+        obs.trace.clear()
+        before = fam.value
+        obs.trace.add_span("fits", 0.0, 0.001)
+        assert fam.value == before
+
+
+class TestBucketOverrides:
+    """Satellite: per-family histogram bucket overrides — µs-scale
+    families must not silently inherit (or be silently overridden back
+    to) the ms-scale default ladder."""
+
+    def test_override_renders_and_lints(self):
+        r = Registry()
+        h = r.histogram("t_micro_seconds", "µs-scale.",
+                        buckets=obs.MICRO_BUCKETS)
+        h.observe(5e-6)
+        text = r.render()
+        assert lint_exposition(text) == []
+        assert 'le="1e-06"' in text
+        # the 5µs sample does NOT land in the first (1µs) bucket — the
+        # whole point of the override vs the 1ms default floor
+        assert 't_micro_seconds_bucket{le="1e-06"} 0' in text
+
+    def test_conflicting_override_raises_same_default_reuses(self):
+        r = Registry()
+        h = r.histogram("t_shape_seconds", "x", buckets=obs.MICRO_BUCKETS)
+        # declare-without-buckets reuse keeps working (default = silence)
+        assert r.histogram("t_shape_seconds", "x") is h
+        assert r.histogram("t_shape_seconds", "x",
+                           buckets=obs.MICRO_BUCKETS) is h
+        with pytest.raises(ValueError):
+            r.histogram("t_shape_seconds", "x", buckets=(0.5, 1.0))
+
+    def test_observe_batch_matches_serial_observes(self):
+        r = Registry()
+        a = r.histogram("t_batch_a_seconds", "x", buckets=obs.MICRO_BUCKETS)
+        b = r.histogram("t_batch_b_seconds", "x", buckets=obs.MICRO_BUCKETS)
+        vals = [0.0, 1e-6, 3e-6, 2e-4, 0.5, 100.0]
+        a.observe_batch(vals)
+        for v in vals:
+            b.observe(v)
+        assert a.labels().buckets == b.labels().buckets
+        assert a.labels().count == b.labels().count
+        assert a.labels().sum == pytest.approx(b.labels().sum)
+
+
+class TestDebugEndpoints:
+    """Satellites + tentpole part 3: /debug/traces grows ?limit= and
+    ?cat= filters, and GET /debug/sched serves the deep-introspection
+    snapshot — on the apiserver AND the scheduler command's server."""
+
+    def _seed_spans(self):
+        obs.trace.clear()
+        obs.trace.add_span("h1", 0.0, 0.001, cat="host")
+        obs.trace.add_span("d1", 0.0, 0.002, cat="device")
+        obs.trace.add_span("h2", 0.0, 0.003, cat="host")
+
+    def test_apiserver_traces_filters(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        self._seed_spans()
+        with APIServer(Store()) as srv:
+            full = json.loads(urllib.request.urlopen(
+                srv.url + "/debug/traces").read())
+            assert {"h1", "d1", "h2"} <= {e["name"]
+                                          for e in full["traceEvents"]}
+            lim = json.loads(urllib.request.urlopen(
+                srv.url + "/debug/traces?limit=1").read())
+            assert [e["name"] for e in lim["traceEvents"]] == ["h2"]
+            cat = json.loads(urllib.request.urlopen(
+                srv.url + "/debug/traces?cat=device").read())
+            assert [e["name"] for e in cat["traceEvents"]] == ["d1"]
+            both = json.loads(urllib.request.urlopen(
+                srv.url + "/debug/traces?cat=host&limit=1").read())
+            assert [e["name"] for e in both["traceEvents"]] == ["h2"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(srv.url + "/debug/traces?limit=x")
+            assert ei.value.code == 400
+
+    def test_apiserver_debug_sched_snapshot(self):
+        from kubernetes_tpu.apiserver.server import APIServer
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        store.create(PODS, mkpod("p1"))
+        sched.pump()
+        with APIServer(store) as srv:
+            snap = json.loads(urllib.request.urlopen(
+                srv.url + "/debug/sched").read())
+        # scheduler section (registered via the obs debug registry)
+        q = snap["scheduler"]["queue"]
+        assert {"active_depth", "backoff_depth", "unschedulable_depth",
+                "scheduling_cycle", "parked_gangs"} <= set(q)
+        assert q["active_depth"] == 1
+        dev = snap["scheduler"]["device"]
+        assert {"mirror", "dev_epoch", "last_index",
+                "victim_table"} <= set(dev)
+        assert "ledger" in snap["scheduler"]
+        # the server's own store section: rv + per-watcher cursor lag
+        assert snap["store"]["resource_version"] >= 2
+        assert isinstance(snap["store"]["watchers"], list)
+        assert snap["store"]["commit_core"] in ("native", "twin")
+
+    def test_scheduler_command_serves_debug_endpoints(self):
+        from kubernetes_tpu.apis.config import SchedulerConfiguration
+        from kubernetes_tpu.cmd.scheduler import serve_http
+        store = Store()
+        store.create(NODES, mknode("n0"))
+        sched = Scheduler(store, percentage_of_nodes_to_score=100,
+                          clock=FakeClock())
+        sched.sync()
+        self._seed_spans()
+        server = serve_http(sched, SchedulerConfiguration(), 0)
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            lim = json.loads(urllib.request.urlopen(
+                base + "/debug/traces?limit=1&cat=host").read())
+            assert [e["name"] for e in lim["traceEvents"]] == ["h2"]
+            snap = json.loads(urllib.request.urlopen(
+                base + "/debug/sched").read())
+            assert snap["scheduler"]["queue"]["active_depth"] == 0
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + "/debug/traces?limit=-2")
+            assert ei.value.code == 400
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
 class TestVictimGateReasonLabels:
     """The old single victims-not-inert fallback counter is split per gate
     reason (round 9): every reason the victim-table eligibility check can

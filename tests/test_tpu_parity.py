@@ -574,6 +574,39 @@ class TestBanElimBurstParity:
         assert len(placed) == 7
 
 
+@pytest.fixture
+def flight_replay():
+    """Round-12 fuzz harness: record every TPU burst in replay mode so a
+    parity failure dumps an attachable artifact and a green run ALSO
+    proves each recorded burst re-derives bit-identically through the
+    oracle referee (obs.flight.replay)."""
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    yield flight.RECORDER
+    flight.RECORDER.configure(mode="digest")
+    flight.RECORDER.clear()
+
+
+def finish_with_flight(recorder, tag: str, ok: bool, msg: str) -> None:
+    """Close a fuzz run: on parity failure dump the flight ring (the
+    attachable repro artifact) and fail with its path; on success replay
+    every recorded burst through the oracle and require bit-identity."""
+    import os
+    import tempfile
+    path = os.path.join(tempfile.gettempdir(), f"flight-{tag}.json")
+    if not ok:
+        recorder.dump(path)
+        raise AssertionError(
+            f"{msg}\n[flight recorder dumped "
+            f"{len(recorder.records())} bursts to {path}]")
+    errs = recorder.replay_all()
+    if errs:
+        recorder.dump(path)
+        raise AssertionError(
+            f"flight replay divergence (dumped to {path}): {errs[:4]}")
+
+
 class TestMixedWorkloadShellFuzz:
     """Differential soak at the SHELL level: randomized clusters and mixed
     pod classes (plain, node-selector, tolerations, hostname anti-affinity,
@@ -588,7 +621,7 @@ class TestMixedWorkloadShellFuzz:
     # must stay bit-identical with and without the pipeline
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
-    def test_bindings_identical(self, seed, wave_size):
+    def test_bindings_identical(self, seed, wave_size, flight_replay):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -677,7 +710,9 @@ class TestMixedWorkloadShellFuzz:
         diff = {k: (bindings[0].get(k), bindings[1].get(k))
                 for k in bindings[0]
                 if bindings[0].get(k) != bindings[1].get(k)}
-        assert not diff, f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}"
+        finish_with_flight(
+            flight_replay, f"mixed-{seed}-{wave_size}", not diff,
+            f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}")
 
 
 class TestPreemptionPressureShellFuzz:
@@ -692,7 +727,8 @@ class TestPreemptionPressureShellFuzz:
     # crosses the new seam too
     @pytest.mark.parametrize("wave_size", [None, 3])
     @pytest.mark.parametrize("seed", [3, 5, 17, 7, 29])
-    def test_preemptive_convergence_identical(self, seed, wave_size):
+    def test_preemptive_convergence_identical(self, seed, wave_size,
+                                              flight_replay):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -748,7 +784,9 @@ class TestPreemptionPressureShellFuzz:
                 clock.step(2.0)   # deterministic backoff expiry
             outs.append(sorted((p.key, p.node_name, p.nominated_node_name)
                                for p in s.list(PODS)[0]))
-        assert outs[0] == outs[1]
+        finish_with_flight(flight_replay, f"pressure-{seed}-{wave_size}",
+                           outs[0] == outs[1],
+                           f"seed={seed}: {outs[0]} != {outs[1]}")
 
     # mid-burst churn: a bound pod is DELETED and a fresh pod created
     # between pressure scans — the round-9 persistent victim table must
@@ -757,7 +795,8 @@ class TestPreemptionPressureShellFuzz:
     # from scratch, so any staleness shows up as a binding divergence
     @pytest.mark.parametrize("wave_size", [None, 3])
     @pytest.mark.parametrize("seed", [11, 23, 41])
-    def test_mid_burst_churn_identical(self, seed, wave_size):
+    def test_mid_burst_churn_identical(self, seed, wave_size,
+                                       flight_replay):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -829,7 +868,9 @@ class TestPreemptionPressureShellFuzz:
                 clock.step(2.0)   # deterministic backoff expiry
             outs.append(sorted((p.key, p.node_name, p.nominated_node_name)
                                for p in s.list(PODS)[0]))
-        assert outs[0] == outs[1]
+        finish_with_flight(flight_replay, f"churn-{seed}-{wave_size}",
+                           outs[0] == outs[1],
+                           f"seed={seed}: {outs[0]} != {outs[1]}")
 
 
 class TestSpreadBurstParity:
