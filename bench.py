@@ -31,6 +31,7 @@ import argparse
 import json
 import sys
 import time
+from typing import Optional
 
 BASELINE_NOTE = ("vs_baseline = throughput / 100 pods/s, the reference "
                  "harness CI warn floor (scheduler_test.go:35-38), not a "
@@ -77,7 +78,9 @@ def measure_oracle(n_nodes: int, n_pods: int) -> float:
 
 
 def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
-              compare: bool = True, mesh=None) -> dict:
+              compare: bool = True, mesh=None,
+              chaos_rates: Optional[dict] = None,
+              chaos_seed: int = 42, chaos_limit: int = 5) -> dict:
     from kubernetes_tpu.store.store import Store
     from kubernetes_tpu.scheduler import Scheduler
 
@@ -115,6 +118,26 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
     LEDGER.reset()
     for p in sched.queue.pending_pods()["active"]:
         LEDGER.stamp_enqueue(p.key)
+    # chaos lane: install the deterministic injection plan AFTER warmup
+    # (compiles ride the happy path) so the timed loop measures
+    # degraded-mode throughput with faults firing at every enabled seam.
+    # The fused pipeline is so batched that a whole burst is a handful of
+    # seam draws — shrink the commit windows so the store/fan-out seams
+    # actually see traffic during the measured run.
+    plan = None
+    if chaos_rates:
+        from kubernetes_tpu import chaos as chaos_mod
+        if getattr(sched.algorithm, "wave_size", 0):
+            sched.algorithm.wave_size = min(sched.algorithm.wave_size, 256)
+        breaker = getattr(sched.algorithm, "breaker", None)
+        if breaker is not None:
+            # a refused gate here is a whole BURST rerun on the serial
+            # twin (seconds, not microseconds) — probe after 2 refusals,
+            # not 16, or an early trip pins the entire bench run to
+            # host-only mode and the lane measures the twin, not the mix
+            breaker.probe_after = 2
+        plan = chaos_mod.plan(seed=chaos_seed, rates=chaos_rates,
+                              limit=chaos_limit)
     bound = 0
     t0 = time.perf_counter()
     if mode == "serial" or mode == "oracle":
@@ -126,7 +149,18 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
             if n == 0:
                 break
             bound += n
+            if plan is not None:
+                # per-round pump: the watch-path seams (watch.drop,
+                # deferred fan-out delivery) draw inside the measured
+                # window, and the informers absorb injected drops with
+                # the re-list + backoff machinery under test
+                sched.pump()
     elapsed = time.perf_counter() - t0
+    injections = None
+    if plan is not None:
+        from kubernetes_tpu import chaos as chaos_mod
+        injections = plan.counts()
+        chaos_mod.disable()   # confirm/audit below runs injection-free
     # one parent span over the timed loop — the per-launch encode /
     # dispatch / fetch spans the TPU pipeline records nest under it in the
     # trace viewer (bench.py --trace)
@@ -137,12 +171,38 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
 
     throughput = bound / elapsed if elapsed > 0 else 0.0
     tag = "_mesh" if mesh is not None else ""
+    if chaos_rates:
+        tag += "_chaos"
     result = {
         "metric": f"sched_throughput_{n_nodes}n_{n_pods}p_{mode}{tag}",
         "value": round(throughput, 1),
         "unit": "pods/s",
         "vs_baseline": round(throughput / 100.0, 2),
     }
+    if injections is not None:
+        # the chaos lane's scoreboard: which faults fired (deterministic
+        # per seed) and what the degradation machinery did with them
+        result["chaos"] = {
+            "seed": chaos_seed,
+            "rates": {k: v for k, v in chaos_rates.items()},
+            "limit_per_seam": chaos_limit,
+            "injections": injections,
+            "injections_total": sum(injections.values()),
+            "breaker": sched.algorithm.breaker.debug_state()
+            if getattr(sched.algorithm, "breaker", None) is not None
+            else None,
+            "store_impl": store.core_impl,
+        }
+        # degraded-mode correctness audit (the gang lane's posture): every
+        # measured pod landed exactly once despite the injected faults
+        from kubernetes_tpu.store.store import PODS as _PODS
+        measured = sum(
+            1 for p in store.list(_PODS)[0]
+            if p.node_name and int(p.name.rsplit("-", 1)[1]) < n_pods)
+        assert bound == n_pods, \
+            f"chaos lane lost pods: bound {bound} of {n_pods}"
+        assert measured == n_pods, \
+            f"chaos lane store audit: {measured} != {n_pods} bound in store"
     if mode != "oracle":
         # the round-10 tunnel economy, driver-captured: a fused burst is
         # exactly ONE dispatch and ONE packed fetch (the headline 10k-pod
@@ -435,7 +495,7 @@ def main():
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
-                             "gang", "commit"],
+                             "gang", "commit", "chaos"],
                     default="burst")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
@@ -448,6 +508,18 @@ def main():
     # the wave exactly like the scheduling lanes' 10k-pod bursts — at 16
     # the tunnel RTT alone caps the lane at ~160 scans/s
     ap.add_argument("--preemptors", type=int, default=128)
+    # `--mode chaos`: the fault plane's bench lane — the headline burst
+    # workload with deterministic injection at every non-opt-in seam. The
+    # JSON line carries injection counts per seam, breaker state, and the
+    # degraded throughput next to the measured serial-oracle floor.
+    ap.add_argument("--chaos-seed", type=int, default=42)
+    ap.add_argument("--chaos-rate", type=float, default=0.1,
+                    help="per-call injection probability applied to every "
+                         "chaos seam (clock/crash/remote are opt-in only)")
+    ap.add_argument("--chaos-limit", type=int, default=5,
+                    help="cap injections per seam (0 = unlimited); bounds "
+                         "the degraded-serial reruns so the lane's runtime "
+                         "stays a bench, not a soak")
     # the tunneled chip's dispatch latency varies +-15% run to run; report
     # the median of N timed runs (compiles are cached after the first)
     ap.add_argument("--repeat", type=int, default=3)
@@ -496,8 +568,9 @@ def main():
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     n_nodes = args.nodes if args.nodes is not None \
-        else (1000 if args.mode == "preempt" else 15000)
-    n_pods = args.pods if args.pods is not None else 10000
+        else (1000 if args.mode in ("preempt", "chaos") else 15000)
+    n_pods = args.pods if args.pods is not None \
+        else (5000 if args.mode == "chaos" else 10000)
     if args.mode == "preempt":
         result = retry_transient(
             lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors))
@@ -518,6 +591,23 @@ def main():
         # just the matrix lanes + ratio-to-plain, one JSON line (transient
         # isolation happens per lane inside run_matrix)
         finish(run_matrix_only(repeat=args.matrix_repeat))
+        return
+    if args.mode == "chaos":
+        from kubernetes_tpu import chaos as chaos_mod
+        # every seam the embedded burst pipeline exercises; the clock and
+        # crash seams need a wrapped clock / test harness and remote.http
+        # has no call site against the in-process store. Smaller bursts
+        # than the headline: a device-faulted burst degrades to the serial
+        # oracle path, so the refusal unit must stay bench-sized.
+        rates = {s: args.chaos_rate for s in chaos_mod.SEAMS
+                 if s not in ("clock.jump", "sched.crash", "remote.http")}
+        chaos_burst = args.burst if args.burst != 10000 else 512
+        result = retry_transient(lambda: run_bench(
+            n_nodes, n_pods, "burst", chaos_burst, compare=True,
+            chaos_rates=rates, chaos_seed=args.chaos_seed,
+            chaos_limit=args.chaos_limit))
+        result["baseline_note"] = BASELINE_NOTE
+        finish(result)
         return
     mesh = _make_mesh() if args.mesh else None
     # each timed repeat individually survives a dropped tunnel response

@@ -204,6 +204,14 @@ class SchedulerCache:
         with self._lock:
             return pod.uid in self._assumed
 
+    def assumed_pods(self) -> list[Pod]:
+        """Snapshot of every currently assumed pod (crash-restart
+        recovery reconciles these against the store: landed bindings are
+        finished/adopted, the rest forgotten and re-queued)."""
+        with self._lock:
+            return [self._pod_states[uid].pod for uid in self._assumed
+                    if uid in self._pod_states]
+
     def get_pod(self, pod: Pod) -> Optional[Pod]:
         with self._lock:
             state = self._pod_states.get(pod.uid)

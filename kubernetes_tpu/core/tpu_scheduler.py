@@ -31,10 +31,16 @@ from kubernetes_tpu.ops.node_state import (
     IPA_EXISTING_ANTI, IPA_OWN_AFFINITY, IPA_OWN_ANTI,
 )
 from kubernetes_tpu.ops import kernels as K
-from kubernetes_tpu import obs
+from kubernetes_tpu import chaos, obs
+from kubernetes_tpu.core.breaker import DeviceCircuitBreaker
 from kubernetes_tpu.obs import trace as obs_trace
 from kubernetes_tpu.obs import flight as obs_flight
 from kubernetes_tpu.obs import ledger as obs_ledger
+
+# exception classes the circuit breaker absorbs at the device seams: the
+# chaos plane's injected DeviceFault plus jax's real runtime error (what a
+# dropped tunnel dispatch/readback actually raises)
+_DEVICE_FAULTS = chaos.device_fault_types()
 
 import jax
 import jax.numpy as jnp
@@ -237,6 +243,15 @@ class TPUScheduler:
         # every pressure launch can share one set instead of re-creating
         # four jnp.zeros per wave)
         self._ghost_zeros: dict[int, dict] = {}
+        # device circuit breaker: a failed launch/fetch degrades that
+        # burst/cycle to the serial oracle path (decisions identical);
+        # repeated faults trip to host-only mode, re-promoted by a
+        # half-open probe (core/breaker.py)
+        self.breaker = DeviceCircuitBreaker()
+        # walk counters at the last wave window handed to the commit
+        # callback — the scheduler shell's crash-restart checkpoint source
+        # (None = no exact per-window counters on this path)
+        self.commit_marker: Optional[dict] = None
 
     def _shared_zero_scalar(self, n: int) -> np.ndarray:
         arr = self._zero_scalars.get(n)
@@ -476,6 +491,14 @@ class TPUScheduler:
             return ora >= dev                # re-probe the losing path
         return ora < dev
 
+    def _device_fault(self, exc: BaseException) -> str:
+        """Book one absorbed device fault with the circuit breaker; returns
+        the seam name (injected faults carry theirs, real tunnel errors
+        book as device.runtime)."""
+        seam = getattr(exc, "seam", "device.runtime")
+        self.breaker.record_fault(seam)
+        return seam
+
     def schedule(self, pod: Pod, node_infos: dict[str, NodeInfo],
                  all_node_names: list[str]) -> ScheduleResult:
         if not all_node_names:
@@ -484,6 +507,9 @@ class TPUScheduler:
         if self.nominated is not None and self.nominated.has_any():
             use_twin = True     # two-pass ghost-pod fitting lives on the twin
             reason = "nominated-ghosts"
+        elif not self.breaker.allow_device():
+            use_twin = True     # circuit open: host-only until a probe wins
+            reason = "circuit-open"
         elif self.serial_path == "adaptive":
             use_twin = self._serial_pick_host_twin()
             reason = "adaptive-twin-faster"
@@ -497,7 +523,16 @@ class TPUScheduler:
         try:
             if use_twin:
                 return self._schedule_host_twin(pod, node_infos, all_node_names)
-            return self._schedule_device(pod, node_infos, all_node_names)
+            try:
+                return self._schedule_device(pod, node_infos, all_node_names)
+            except _DEVICE_FAULTS as e:
+                # a failed launch/fetch degrades THIS cycle to the host
+                # twin — the decision is identical; only latency differs
+                self._device_fault(e)
+                ORACLE_FALLBACKS.labels("device-fault").inc()
+                use_twin = True
+                return self._schedule_host_twin(pod, node_infos,
+                                                all_node_names)
         finally:
             dt = _time.perf_counter() - t0
             if use_twin:
@@ -522,6 +557,7 @@ class TPUScheduler:
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
+        chaos.check("device.dispatch")
         if self.mesh is not None:
             # node axis split over the chips; collectives ride ICI and the
             # select epilogue replicates (parallel/sharding.py)
@@ -552,7 +588,9 @@ class TPUScheduler:
                          fail_first=out["fail_first"],
                          general_bits=out["general_bits"])
         t_fetch = obs_trace.now()
+        chaos.check("device.fetch")
         h = jax.device_get(fetch)
+        self.breaker.record_success()
         DEVICE_DISPATCH.labels("cycle").inc()
         DEVICE_FETCHES.labels("cycle").inc()
         DEVICE_FETCHED_BYTES.labels("cycle").inc(_fetched_nbytes(h))
@@ -910,6 +948,13 @@ class TPUScheduler:
         them, but the caller knows how far its own callback committed."""
         if not all_node_names or not pods:
             return [None] * len(pods)
+        self.commit_marker = None
+        if not self.breaker.allow_device():
+            # circuit open (host-only mode): refuse the whole burst BEFORE
+            # any dispatch — the shell runs the pods serially, where
+            # schedule() picks the host twin under the same open circuit
+            ORACLE_FALLBACKS.labels("circuit-open").inc()
+            return None
         import time as _time
         _t0 = _time.perf_counter()
         _keys = [p.key for p in pods]
@@ -960,6 +1005,10 @@ class TPUScheduler:
             _t = _obs("encode", _t0)
             sel = self._uniform_waves(pods, b, cls, extra_ok, ban, rotation,
                                       n, commit, _obs, _t, bucket, fl=fl)
+            if sel is None:
+                # device fault during a commit-less trial: whole-burst
+                # refusal (nothing committed, counters rewound)
+                return None
             return [b.names[s] for s in sel] \
                 + [None] * (len(pods) - len(sel))
         from kubernetes_tpu.api.types import (
@@ -1051,14 +1100,27 @@ class TPUScheduler:
             if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
                 self._sharded_batch = (z_pad, S.sharded_batch_fn(
                     self.mesh, z_pad=z_pad, weights=self.weights))
-            pods_sharded = S.shard_pod_batch(self.mesh, stacked)
-            state, li, lni, outs = self._sharded_batch[1](
-                nodes, pods_sharded, K._i64(self.last_index),
-                K._i64(self.last_node_index), K._i64(num_to_find), K._i64(n))
-            DEVICE_DISPATCH.labels("burst_scan").inc()
-            _t = _obs("kernel", _t)
-            selected = np.asarray(outs["selected"])[: len(pods)]
-            li, lni = int(li), int(lni)
+            try:
+                chaos.check("device.dispatch")
+                pods_sharded = S.shard_pod_batch(self.mesh, stacked)
+                state, li, lni, outs = self._sharded_batch[1](
+                    nodes, pods_sharded, K._i64(self.last_index),
+                    K._i64(self.last_node_index), K._i64(num_to_find),
+                    K._i64(n))
+                DEVICE_DISPATCH.labels("burst_scan").inc()
+                _t = _obs("kernel", _t)
+                chaos.check("device.fetch")
+                selected = np.asarray(outs["selected"])[: len(pods)]
+                li, lni = int(li), int(lni)
+            except _DEVICE_FAULTS as e:
+                # nothing committed / no counters mutated yet: refuse the
+                # burst (the shell's serial rerun re-derives identical
+                # decisions against the untouched host mirror)
+                self._device_fault(e)
+                self.discard_burst_folds()
+                ORACLE_FALLBACKS.labels("device-fault").inc()
+                return None
+            self.breaker.record_success()
             DEVICE_FETCHES.labels("burst_scan").inc()
             DEVICE_FETCHED_BYTES.labels("burst_scan").inc(selected.nbytes + 16)
             _obs("fetch", _t)
@@ -1099,7 +1161,8 @@ class TPUScheduler:
 
     def _uniform_waves(self, pods: list[Pod], b: NodeBatch, cls, extra_ok,
                        ban: bool, rotation, n: int, commit, _obs,
-                       _t: float, bucket: int, fl=None) -> list[int]:
+                       _t: float, bucket: int,
+                       fl=None) -> Optional[list]:
         """Single-launch driver for the uniform kernel: the ENTIRE burst
         (up to B_CAP; larger bursts chunk, with chunk k's fetch+commit
         overlapping chunk k+1's device execution) is ONE dispatch and ONE
@@ -1125,6 +1188,7 @@ class TPUScheduler:
         chunks = [(lo, min(cap, n_pods - lo))
                   for lo in range(0, n_pods, cap)]
         lni_dev = self.last_node_index   # device scalar after chunk 0
+        li_entry, lni_entry = self.last_index, self.last_node_index
         sel: list[int] = []
         inflight: list[tuple] = []
 
@@ -1139,6 +1203,7 @@ class TPUScheduler:
                 win[len(piece):] = piece[-1] if len(piece) else 0
                 rot = (rotation[0], win)
             t_d = obs_trace.now()
+            chaos.check("device.dispatch")
             rows, packed, lni_out = K.schedule_batch_uniform(
                 self._dev_nodes, dict(cls), chunk, lni_dev, n,
                 self.check_resources, weights=self.weights, rotation=rot,
@@ -1150,51 +1215,96 @@ class TPUScheduler:
             inflight.append((ci, lo, chunk, self._submit_fetch(packed),
                              t_d))
 
-        dispatch(0)
         aborted = False
         failed = False
-        while inflight:
-            if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
-                dispatch(inflight[0][0] + 1)   # keep one chunk in flight
-            ci, lo, chunk, fut, t_d = inflight.pop(0)
-            h = fut.result()   # ONE fetch per launch: selections + lni
-            t_done = obs_trace.now()
-            DEVICE_FETCHES.labels("burst_uniform").inc()
-            DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
-            obs_trace.add_span("burst.wave.device", t_d, t_done,
-                               cat="device", args={"chunk": ci})
-            obs_flight.RECORDER.note_block(fl, h)
-            _t = _obs("fetch", _t)
-            self.last_node_index += int(h[cap])
-            chunk_sel = h[:chunk].tolist()
-            bad = next((i for i, s in enumerate(chunk_sel) if s < 0), chunk)
-            # commit consumes the single fetched block wave-by-wave
-            for wlo in range(0, bad, W):
-                hi = min(wlo + W, bad)
-                BURST_WAVES.labels("uniform").inc()
-                sel.extend(chunk_sel[wlo:hi])
-                if commit is not None:
-                    t_c0 = obs_trace.now()
-                    ok = commit(lo + wlo,
-                                [b.names[s] for s in chunk_sel[wlo:hi]])
-                    t_c1 = obs_trace.now()
-                    obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
-                                       cat="host", args={"chunk": ci})
-                    if inflight:
-                        PIPELINE_OVERLAP.inc(t_c1 - t_c0)
-                    _t = t_c1
-                    if not ok:
-                        aborted = True
-                        break
-            if bad < chunk or aborted:
-                for item in inflight:
-                    item[3].cancel()
-                inflight.clear()
-                if aborted:
-                    self.discard_burst_folds()
-                if bad < chunk:
-                    failed = True
-                break
+        faulted = False
+        try:
+            dispatch(0)
+            while inflight:
+                if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
+                    dispatch(inflight[0][0] + 1)  # keep one chunk in flight
+                ci, lo, chunk, fut, t_d = inflight.pop(0)
+                chaos.check("device.fetch")
+                h = fut.result()  # ONE fetch per launch: selections + lni
+                t_done = obs_trace.now()
+                DEVICE_FETCHES.labels("burst_uniform").inc()
+                DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
+                obs_trace.add_span("burst.wave.device", t_d, t_done,
+                                   cat="device", args={"chunk": ci})
+                obs_flight.RECORDER.note_block(fl, h)
+                _t = _obs("fetch", _t)
+                lni_chunk_start = self.last_node_index
+                self.last_node_index += int(h[cap])
+                chunk_sel = h[:chunk].tolist()
+                bad = next((i for i, s in enumerate(chunk_sel) if s < 0),
+                           chunk)
+                # commit consumes the single fetched block wave-by-wave
+                for wlo in range(0, bad, W):
+                    hi = min(wlo + W, bad)
+                    BURST_WAVES.labels("uniform").inc()
+                    sel.extend(chunk_sel[wlo:hi])
+                    if commit is not None:
+                        # crash-restart checkpoint marker (the shell's
+                        # recovery context source): exact walk counters at
+                        # this window's two boundaries where the block
+                        # carries them. The uniform kernel never advances
+                        # last_index, and the packed block only holds the
+                        # CHUNK's lni advance — so mid-chunk window
+                        # boundaries have no exact lni (None; recovery
+                        # degrades to reconcile-only there).
+                        self.commit_marker = {
+                            "li0": li_entry,
+                            "lni0": (lni_chunk_start if wlo == 0 else None),
+                            "li1": li_entry,
+                            "lni1": (self.last_node_index if hi == chunk
+                                     else None),
+                            "committed0": lo + wlo, "committed1": lo + hi,
+                        }
+                        t_c0 = obs_trace.now()
+                        ok = commit(lo + wlo,
+                                    [b.names[s] for s in chunk_sel[wlo:hi]])
+                        t_c1 = obs_trace.now()
+                        obs_trace.add_span("burst.wave.commit", t_c0, t_c1,
+                                           cat="host", args={"chunk": ci})
+                        if inflight:
+                            PIPELINE_OVERLAP.inc(t_c1 - t_c0)
+                        _t = t_c1
+                        if not ok:
+                            aborted = True
+                            break
+                if bad < chunk or aborted:
+                    for item in inflight:
+                        item[3].cancel()
+                    inflight.clear()
+                    if aborted:
+                        self.discard_burst_folds()
+                    if bad < chunk:
+                        failed = True
+                    break
+        except _DEVICE_FAULTS as e:
+            # a failed launch/fetch: everything already committed stands
+            # (its counters landed with its chunk); the faulted chunk
+            # decided nothing, so the remainder of the burst degrades to
+            # the serial oracle path via the undecided-tail contract
+            self._device_fault(e)
+            ORACLE_FALLBACKS.labels("device-fault").inc()
+            for item in inflight:
+                item[3].cancel()
+            inflight.clear()
+            self.discard_burst_folds()
+            faulted = True
+            if commit is None:
+                # pure trial (gang): nothing was committed — rewind the
+                # walk counters consumed by already-fetched chunks and
+                # refuse outright, so the caller reruns the WHOLE trial
+                # through the serial referee instead of misreading the
+                # undecided tail as a rejected gang
+                self.last_index, self.last_node_index = li_entry, lni_entry
+                obs_flight.RECORDER.note_outcome(fl, {
+                    "hosts": [], "failed": False, "aborted": True})
+                return None
+        if not (failed or aborted or faulted):
+            self.breaker.record_success()
         obs_flight.RECORDER.note_outcome(fl, {
             # device-decided hosts up to the last commit/abort boundary;
             # `failed` marks that the NEXT pod found no node on device
@@ -1239,13 +1349,29 @@ class TPUScheduler:
             rotp = (rotation_pos[0],
                     np.asarray(rotation_pos[1][:B], dtype=np.int32))
         t_d = obs_trace.now()
-        state, _li_out, _lni_out, _spread, outs = K.schedule_batch(
-            self._dev_nodes, stacked, self.last_index, self.last_node_index,
-            num_to_find, n, z_pad, weights=self.weights, rotation=rot,
-            spread0=spread0, rotation_pos=rotp)
-        DEVICE_DISPATCH.labels("burst_scan").inc()
-        _t = _obs("kernel", _t)
-        h = np.asarray(self._submit_fetch(outs["packed"]).result())
+        try:
+            chaos.check("device.dispatch")
+            state, _li_out, _lni_out, _spread, outs = K.schedule_batch(
+                self._dev_nodes, stacked, self.last_index,
+                self.last_node_index, num_to_find, n, z_pad,
+                weights=self.weights, rotation=rot,
+                spread0=spread0, rotation_pos=rotp)
+            DEVICE_DISPATCH.labels("burst_scan").inc()
+            _t = _obs("kernel", _t)
+            chaos.check("device.fetch")
+            h = np.asarray(self._submit_fetch(outs["packed"]).result())
+        except _DEVICE_FAULTS as e:
+            # the single dispatch+fetch happens BEFORE any commit or
+            # counter update: refuse the whole burst — the shell reruns
+            # the pods serially (host twin under an open circuit) against
+            # the untouched host mirror, decisions identical
+            self._device_fault(e)
+            self.discard_burst_folds()
+            ORACLE_FALLBACKS.labels("device-fault").inc()
+            obs_flight.RECORDER.note_outcome(fl, {
+                "hosts": [], "failed": False, "aborted": True})
+            return None
+        self.breaker.record_success()
         t_done = obs_trace.now()
         DEVICE_FETCHES.labels("burst_scan").inc()
         DEVICE_FETCHED_BYTES.labels("burst_scan").inc(h.nbytes)
@@ -1260,11 +1386,25 @@ class TPUScheduler:
         bad = int(np.argmax(neg)) if neg.any() else n_pods
         committed = bad
         aborted = False
+        li_entry = self.last_index
         if commit is not None:
             committed = 0
             for wlo in range(0, bad, W):
                 hi = min(wlo + W, bad)
                 BURST_WAVES.labels("scan").inc()
+                # crash-restart checkpoint marker: the packed block carries
+                # per-pod walk counters, so BOTH boundaries of every window
+                # are exact on this path (recovery picks the side matching
+                # what the store says actually landed)
+                self.commit_marker = {
+                    "li0": (li_entry if wlo == 0
+                            else int(li_after[wlo - 1])),
+                    "lni0": (lni0 if wlo == 0
+                             else lni0 + int(lni_delta[wlo - 1])),
+                    "li1": int(li_after[hi - 1]),
+                    "lni1": lni0 + int(lni_delta[hi - 1]),
+                    "committed0": wlo, "committed1": hi,
+                }
                 t_c0 = obs_trace.now()
                 ok = commit(wlo,
                             [b.names[s] for s in sel_arr[wlo:hi].tolist()])
@@ -1342,6 +1482,13 @@ class TPUScheduler:
                                               get_container_ports)
         n_total = sum(len(p) for p, _g in segments)
         if not all_node_names or n_total == 0:
+            return None
+        self.commit_marker = None
+        if not self.breaker.allow_device():
+            # circuit open (host-only mode): refuse the window before any
+            # dispatch — the shell's per-segment fallback runs the serial
+            # loop, where schedule() picks the host twin
+            ORACLE_FALLBACKS.labels("circuit-open").inc()
             return None
         if self.mesh is not None:
             # the sharded scan models neither segments nor rotation
@@ -1429,14 +1576,30 @@ class TPUScheduler:
                                        all_node_names, node_infos)
         _t = _obs("encode", _t0)
         t_d = obs_trace.now()
-        state, _li, _lni, _spread, packed = K.schedule_batch_segments(
-            nodes, stacked, seg_start, gang, n_total, self.last_index,
-            self.last_node_index, num_to_find, n, z_pad,
-            weights=self.weights, rotation=rotation,
-            rotation_pos=rotation_pos)
-        DEVICE_DISPATCH.labels("burst_fused").inc()
-        _t = _obs("kernel", _t)
-        h = np.asarray(self._submit_fetch(packed).result())
+        try:
+            chaos.check("device.dispatch")
+            state, _li, _lni, _spread, packed = K.schedule_batch_segments(
+                nodes, stacked, seg_start, gang, n_total, self.last_index,
+                self.last_node_index, num_to_find, n, z_pad,
+                weights=self.weights, rotation=rotation,
+                rotation_pos=rotation_pos)
+            DEVICE_DISPATCH.labels("burst_fused").inc()
+            _t = _obs("kernel", _t)
+            chaos.check("device.fetch")
+            h = np.asarray(self._submit_fetch(packed).result())
+        except _DEVICE_FAULTS as e:
+            # the single dispatch+fetch happens BEFORE any counter update
+            # or commit: refuse the window — the shell reruns every entry
+            # through the per-segment machinery against the untouched host
+            # mirror (which cascades to the serial loop under an open
+            # circuit), decisions identical
+            self._device_fault(e)
+            self.discard_burst_folds()
+            ORACLE_FALLBACKS.labels("device-fault").inc()
+            obs_flight.RECORDER.note_outcome(fl, {
+                "segments": [], "consumed": 0, "aborted": True})
+            return None
+        self.breaker.record_success()
         t_done = obs_trace.now()
         DEVICE_FETCHES.labels("burst_fused").inc()
         DEVICE_FETCHED_BYTES.labels("burst_fused").inc(h.nbytes)
@@ -1555,6 +1718,10 @@ class TPUScheduler:
             get_container_ports, get_resource_request)
         if not all_node_names:
             return None
+        if not self.breaker.allow_device():
+            # circuit open: the oracle Preemptor runs this scan instead
+            ORACLE_FALLBACKS.labels("circuit-open").inc()
+            return None
         if self.nominated is not None and self.nominated.has_any():
             ORACLE_FALLBACKS.labels("preempt-nominated-ghosts").inc()
             return None
@@ -1622,9 +1789,20 @@ class TPUScheduler:
                   "req_mem": np.int64(req.memory),
                   "req_eph": np.int64(req.ephemeral_storage)}
         t_scan = obs_trace.now()
-        out = np.asarray(K.preemption_scan(
-            nodes, vic, pod_in, feas, order_rank, b.n_real,
-            self.check_resources, f.has_request, pod.priority))
+        try:
+            chaos.check("device.dispatch")
+            chaos.check("device.fetch")
+            out = np.asarray(K.preemption_scan(
+                nodes, vic, pod_in, feas, order_rank, b.n_real,
+                self.check_resources, f.has_request, pod.priority))
+        except _DEVICE_FAULTS as e:
+            # the scan reads resident state and mutates nothing: refuse —
+            # the caller falls back to the oracle Preemptor, whose
+            # decisions are identical by the parity contract
+            self._device_fault(e)
+            ORACLE_FALLBACKS.labels("device-fault").inc()
+            return None
+        self.breaker.record_success()
         DEVICE_DISPATCH.labels("preempt_scan").inc()
         DEVICE_FETCHES.labels("preempt_scan").inc()
         DEVICE_FETCHED_BYTES.labels("preempt_scan").inc(out.nbytes)
@@ -1772,6 +1950,11 @@ class TPUScheduler:
             return None
         import time as _time
         _t0 = _time.perf_counter()
+        if not self.breaker.allow_device():
+            # circuit open: the serial loop (host twin + oracle Preemptor)
+            # runs the tail instead — decisions identical
+            PRESSURE_GATES.labels("circuit-open").inc()
+            return None
         if self.mesh is not None:
             PRESSURE_GATES.labels("mesh-mode").inc()
             return None
@@ -1852,22 +2035,36 @@ class TPUScheduler:
         _t_enc = _time.perf_counter()
         obs_trace.add_span("pressure.encode", _t0, _t_enc, cat="host")
         outs_chunks = []
-        for lo in range(0, len(per_pod), self.PRESSURE_B_CAP):
-            chunk = per_pod[lo: lo + self.PRESSURE_B_CAP]
-            bucket = _pad_pow2(len(chunk), 8)
-            if len(chunk) < bucket:
-                pad = dict(chunk[-1])
-                pad["skip"] = self._true
-                chunk = chunk + [pad] * (bucket - len(chunk))
-            stacked = self._stack_pods(chunk)
-            mut0, ghost0, li, lni, outs = K.pressure_batch(
-                nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find, n,
-                z_pad, weights=self.weights)
-            DEVICE_DISPATCH.labels("pressure_batch").inc()
-            outs_chunks.append(outs)
-        # ONE fetch for every chunk's outputs + the final counters
-        t_fetch = obs_trace.now()
-        h_chunks, li, lni = jax.device_get((outs_chunks, li, lni))
+        try:
+            for lo in range(0, len(per_pod), self.PRESSURE_B_CAP):
+                chaos.check("device.dispatch")
+                chunk = per_pod[lo: lo + self.PRESSURE_B_CAP]
+                bucket = _pad_pow2(len(chunk), 8)
+                if len(chunk) < bucket:
+                    pad = dict(chunk[-1])
+                    pad["skip"] = self._true
+                    chunk = chunk + [pad] * (bucket - len(chunk))
+                stacked = self._stack_pods(chunk)
+                mut0, ghost0, li, lni, outs = K.pressure_batch(
+                    nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find,
+                    n, z_pad, weights=self.weights)
+                DEVICE_DISPATCH.labels("pressure_batch").inc()
+                outs_chunks.append(outs)
+            # ONE fetch for every chunk's outputs + the final counters
+            t_fetch = obs_trace.now()
+            chaos.check("device.fetch")
+            h_chunks, li, lni = jax.device_get((outs_chunks, li, lni))
+        except _DEVICE_FAULTS as e:
+            # everything so far is device-local (the resident matrix,
+            # counters, and host mirror are untouched until after the
+            # fetch): refuse the wave — the shell's serial loop re-derives
+            # identical schedule/preempt decisions through the oracle
+            self._device_fault(e)
+            PRESSURE_GATES.labels("device-fault").inc()
+            obs_flight.RECORDER.note_outcome(fl, {"outcomes": [],
+                                                  "aborted": True})
+            return None
+        self.breaker.record_success()
         # ONE synchronization for the whole wave regardless of chunk count —
         # the tunnel contract the preemption-lane test pins
         DEVICE_FETCHES.labels("pressure_batch").inc()
@@ -1954,6 +2151,24 @@ class TPUScheduler:
             DISCARDED_FOLDS.inc()
         self._dev_nodes = None
 
+    def recover_device(self, li: Optional[int] = None,
+                       lni: Optional[int] = None) -> None:
+        """Crash-restart device reset (Scheduler.recover): drop every
+        device-resident structure — the node matrix (in-flight folds for
+        decisions that never committed must not survive the crash) and the
+        victim table — and rewind the walk counters to the recovered
+        commit boundary. The next encode re-uploads from the host mirror,
+        which the cache reconcile has already made authoritative; the
+        victim table rebuilds from its generation cache."""
+        self.discard_burst_folds()
+        self._dev_vic = None
+        self._dev_vic_key = None
+        if li is not None:
+            self.last_index = int(li)
+        if lni is not None:
+            self.last_node_index = int(lni)
+        self.commit_marker = None
+
     def debug_state(self) -> dict:
         """The /debug/sched device section: mirror shape + epochs, walk
         counters, victim-table generations/dirty rows, serial-path
@@ -1976,6 +2191,7 @@ class TPUScheduler:
         return {
             "mirror": mirror,
             "dev_epoch": self._dev_epoch,
+            "breaker": self.breaker.debug_state(),
             "last_index": self.last_index,
             "last_node_index": self.last_node_index,
             "victim_table": vic,

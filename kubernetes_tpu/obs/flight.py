@@ -27,7 +27,14 @@ import threading
 from collections import deque
 from typing import Optional
 
-import numpy as np
+try:
+    import numpy as np
+except ImportError:          # pragma: no cover — minimal interpreters
+    # `python -S` consumers (the native ASan stress subprocess) import the
+    # store, which transitively imports this module; they never RECORD
+    # bursts, so the recorder degrades to inert instead of killing the
+    # import chain
+    np = None
 
 
 class BurstRecord:

@@ -103,6 +103,11 @@ class NominatedPodMap:
     def pods_for_node(self, node_name: str) -> list[Pod]:
         return list(self._by_node.get(node_name, []))
 
+    def all_pods(self) -> list[Pod]:
+        """Every nominated pod (crash-restart recovery prunes entries the
+        store no longer backs, then re-adds from the relist)."""
+        return [p for lst in self._by_node.values() for p in lst]
+
     def has_any(self) -> bool:
         return bool(self._by_node)
 

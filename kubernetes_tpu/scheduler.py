@@ -11,11 +11,14 @@ reference's bind goroutine (scheduler.go:523).
 from __future__ import annotations
 
 import copy
+import itertools
 import threading
+import time
+import urllib.error
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from kubernetes_tpu import obs
+from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.api.types import (
     Pod, Node, PodCondition, POD_SCHEDULED, CONDITION_FALSE,
     REASON_UNSCHEDULABLE, REASON_SCHEDULER_ERROR,
@@ -59,6 +62,26 @@ GANG_WAIT = obs.histogram(
     "Seconds from PodGroup creation (or first scheduler sighting) to the "
     "gang's committed placement.",
     buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600))
+COMMIT_RETRIES = obs.counter(
+    "store_commit_retries_total",
+    "commit_wave store-write retries by the scheduler's idempotent retry "
+    "loop, by outcome: retried (another attempt followed), recovered (a "
+    "retry landed — or deduped against a wave that had already landed "
+    "under the same token), exhausted (all attempts failed; the per-pod "
+    "crash-resolution path took over).", ("outcome",))
+
+#: exception classes the commit retry loop treats as transient: the chaos
+#: plane's injected store fault, transport-level failures (the remote
+#: store), and server-side 5xx (classified by the remote client)
+def _retryable_store_error(exc: BaseException) -> bool:
+    if isinstance(exc, chaos.SchedulerCrash):
+        return False                 # a crash stand-in is never "transient"
+    if isinstance(exc, chaos.InjectedFault):
+        return True
+    if isinstance(exc, (urllib.error.URLError, OSError, TimeoutError)):
+        return True
+    code = getattr(exc, "code", None)
+    return code in (500, 502, 503, 504)
 
 
 class Histogram:
@@ -178,6 +201,14 @@ class Scheduler:
         self._snapshot = Snapshot()
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
+        # idempotent commit retry: one fresh token per wave (REUSED across
+        # that wave's retries) keys the store's dedupe map
+        self._wave_seq = itertools.count(1)
+        # crash-restart recovery context: while a burst's windows commit,
+        # this tracks the exact walk-counter/rotation boundary of the
+        # committed prefix plus the window in flight — recover() reads it
+        # to resume with decisions matching an oracle that never crashed
+        self._crash_ctx: Optional[dict] = None
         services = self.informers.informer(SERVICES)
         replicasets = self.informers.informer(REPLICASETS)
         self._services_fn = services.list
@@ -442,10 +473,18 @@ class Scheduler:
         # cycle land in the obs ring buffer regardless (bounded, cheap).
         cycle_trace = Trace(f"scheduling cycle {pod.key}",
                             threshold=self.slow_cycle_threshold)
+        crashed = False
         try:
             return self._process_one_traced(pod, cycle, names, start,
                                             cycle_trace)
+        except chaos.SchedulerCrash:
+            crashed = True   # freeze the recovery context for recover()
+            raise
         finally:
+            if not crashed:
+                # a completed (or ordinarily failed) cycle leaves no
+                # window in flight — stale contexts must not survive it
+                self._crash_ctx = None
             if cycle_trace.log_if_long():
                 cycle_trace.emit_spans()
 
@@ -455,6 +494,12 @@ class Scheduler:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         cycle_trace.step("snapshot updated")
         if names is None:
+            # serial-cycle crash bracket: checkpoint the rotation BEFORE
+            # this cycle's enumeration so a crash between decision and a
+            # landed bind recovers to the pre-decision boundary (the
+            # re-queued pod then re-derives the identical decision)
+            tree_chk = self.cache.node_tree.checkpoint()
+            self._ctx_open(tree_chk)
             names = self.cache.node_tree.list_names()
         self._last_names = names
         try:
@@ -484,6 +529,16 @@ class Scheduler:
             self.metrics.observe("error")
             self._record_failure(pod, cycle, REASON_SCHEDULER_ERROR, str(err))
             raise
+        if self._crash_ctx is not None:
+            # window bracket for this cycle's bind: before = pre-decision
+            # boundary, after = the advanced counters + one enumeration
+            c = self._crash_ctx
+            self._ctx_window(
+                {"li0": c["li"], "lni0": c["lni"], "committed0": 0,
+                 "li1": getattr(self.algorithm, "last_index", 0),
+                 "lni1": getattr(self.algorithm, "last_node_index", 0),
+                 "committed1": 1},
+                [pod.key], [result.suggested_host])
         assumed = pod.clone()
         assumed.node_name = result.suggested_host
         ctx = PluginContext()
@@ -589,6 +644,9 @@ class Scheduler:
                     ctx.read("volume-reservations"))
             except KeyError:
                 pass
+            # crash seams bracketing the serial bind write (the same
+            # process-death stand-in the wave commit carries)
+            chaos.check("sched.crash")
             if self._extender_binder is not None \
                     and self._extender_binder.is_interested(assumed):
                 # extender-managed binding (factory.go GetBinder: a binder
@@ -596,6 +654,7 @@ class Scheduler:
                 self._extender_binder.bind(assumed, host)
             else:
                 self.store.bind_pod(assumed.key, host)
+            chaos.check("sched.crash")
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
             self.metrics.binding_duration.observe(self.clock.now() - t_bind)
@@ -606,6 +665,8 @@ class Scheduler:
                 assumed, NORMAL, "Scheduled",
                 f"Successfully assigned {assumed.key} to {host}")
             return True
+        except chaos.SchedulerCrash:
+            raise   # process-death stand-in: recovery, not re-queue
         except Exception as err:
             fail(False, str(err))
             return False
@@ -967,12 +1028,28 @@ class Scheduler:
                 getattr(self.algorithm, "last_index", 0),
                 getattr(self.algorithm, "last_node_index", 0))
             tree_chk = tree.checkpoint()
+            self._ctx_open(tree_chk)
             names = tree.list_names()
             self._last_names = names
             hosts = self.algorithm.schedule_burst(
                 pods, self._snapshot.node_infos, names, bucket=bucket)
             if hosts is not None and all(h is not None for h in hosts):
+                # crash bracket: the gang commits as ONE atomic window —
+                # before = the pre-gang checkpoint, after = the post-trial
+                # counters (a crash mid-commit recovers to whichever side
+                # the store proves, never to a partial gang)
+                ctx = self._crash_ctx
+                self._ctx_window(
+                    {"li0": ctx["li"], "lni0": ctx["lni"],
+                     "committed0": 0,
+                     "li1": getattr(self.algorithm, "last_index", 0),
+                     "lni1": getattr(self.algorithm,
+                                     "last_node_index", 0),
+                     "committed1": len(pods)},
+                    [p.key for p in pods], hosts)
                 committed = self._commit_burst(pods, hosts, cycles)
+                self._ctx_window_done()
+                self._crash_ctx = None
                 tree.advance_enumerations(len(pods) - 1)
             elif hosts is not None:
                 # a member found no node: the gang is REJECTED — discard the
@@ -990,6 +1067,7 @@ class Scheduler:
                     if discard is not None:
                         discard()
                 tree.restore(tree_chk)
+                self._crash_ctx = None
                 self._reject_gang(group, pods,
                                   sum(1 for h in hosts if h is not None))
                 return 0
@@ -997,7 +1075,11 @@ class Scheduler:
                 # kernels refused this gang's feature mix: undo the consumed
                 # enumeration and run the serial referee trial instead
                 tree.restore(tree_chk)
+                self._crash_ctx = None
         if hosts is None:
+            # serial referee trial: per-member cycles with no packed-block
+            # counters — crash recovery over this path is reconcile-only
+            self._crash_ctx = None
             trial = GangTrial(self.cache, self.algorithm)
 
             def refresh():
@@ -1126,6 +1208,7 @@ class Scheduler:
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         tree = self.cache.node_tree
         tree_chk = tree.checkpoint()
+        self._ctx_open(tree_chk)
         names = tree.list_names()
         self._last_names = names
         segments = []
@@ -1143,12 +1226,25 @@ class Scheduler:
             # window refused: undo the consumed enumeration and run every
             # entry through the per-segment paths
             tree.restore(tree_chk)
+            self._crash_ctx = None
             return self._run_entries_unfused(entries, bucket)
         bound = 0
         consumed = res["consumed"]
         aborted = False
         leftovers: list = []
         W = max(1, int(getattr(self.algorithm, "wave_size", 4096)))
+        ctx = self._crash_ctx
+
+        def seg_boundary(li1, lni1, t1) -> dict:
+            """Window bracket from the committed-prefix boundary (ctx) to
+            a segment/seq boundary — both sides exact on the fused path."""
+            return {"li0": ctx["li"], "lni0": ctx["lni"],
+                    "committed0": ctx["t"], "li1": int(li1),
+                    "lni1": int(lni1), "committed1": int(t1)}
+
+        def fold_boundary(li1, lni1, t1) -> None:
+            ctx["li"], ctx["lni"], ctx["t"] = int(li1), int(lni1), int(t1)
+
         for e, seg in zip(entries, res["segments"]):
             status = seg["status"]
             if aborted or status == "undecided":
@@ -1161,13 +1257,19 @@ class Scheduler:
                 if status == "rejected":
                     # the device carry already rewound; book the rejection
                     # exactly like a trial rewind (park under the group
-                    # backoff, every member unschedulable)
+                    # backoff, every member unschedulable). The rewound
+                    # boundary (= pre-gang) is the new committed prefix.
                     self._reject_gang(group, pods, seg["placed"])
+                    fold_boundary(seg["li"], seg["lni"], seg["t"])
                     continue
                 # decided gang: ONE atomic commit for the whole group (a
                 # wave window never splits a gang, so a crash between
                 # windows cannot leave a partial gang bound)
+                self._ctx_window(
+                    seg_boundary(seg["li"], seg["lni"], seg["t"]),
+                    [p.key for p in pods], seg["hosts"])
                 committed = self._commit_burst(pods, seg["hosts"], cycles)
+                self._ctx_window_done()
                 bound += committed
                 if committed < len(pods):
                     # members vanished between decision and commit: the
@@ -1193,8 +1295,14 @@ class Scheduler:
                 short_at = None
                 for wlo in range(0, len(hosts), W):
                     hi = min(wlo + W, len(hosts))
+                    self._ctx_window(
+                        seg_boundary(seg["li_seq"][hi - 1],
+                                     seg["lni_seq"][hi - 1],
+                                     seg["t_seq"][hi - 1]),
+                        [p.key for p in pods[wlo:hi]], hosts[wlo:hi])
                     n_b = self._commit_burst(pods[wlo:hi], hosts[wlo:hi],
                                              cycles[wlo:hi])
+                    self._ctx_window_done()
                     bound += n_b
                     if n_b < hi - wlo:
                         short_at = hi
@@ -1222,6 +1330,7 @@ class Scheduler:
             tree.advance_enumerations(consumed - 1)
         else:
             tree.restore(tree_chk)
+        self._crash_ctx = None   # window fully reconciled; nothing in flight
         if leftovers:
             bound += self._run_entries_unfused(leftovers, bucket)
         return bound
@@ -1247,8 +1356,10 @@ class Scheduler:
                        bucket: int) -> int:
         """Schedule one burst segment; returns pods bound."""
         self._snapshot = self.cache.update_snapshot(self._snapshot)
+        tree_chk = self.cache.node_tree.checkpoint()
         names = self.cache.node_tree.list_names()
         self._last_names = names
+        self._ctx_open(tree_chk)
         # wave-window sink (tpu_scheduler.schedule_burst `commit`): the
         # algorithm fetches the whole burst's decisions as ONE packed
         # block and calls back with consecutive `wave_size` windows of
@@ -1259,8 +1370,14 @@ class Scheduler:
 
         def commit_wave(lo: int, hosts: list) -> bool:
             k = len(hosts)
+            # crash-restart window bracket: the algorithm's commit_marker
+            # carries the exact walk counters at both window boundaries
+            # (None fields where the packed block can't supply them)
+            m = getattr(self.algorithm, "commit_marker", None)
+            self._ctx_window(m, [p.key for p in pods[lo:lo + k]], hosts)
             n_bound = self._commit_burst(pods[lo:lo + k], hosts,
                                          cycles[lo:lo + k])
+            self._ctx_window_done()
             progress["committed"] = lo + k
             progress["bound"] += n_bound
             if n_bound < k:
@@ -1307,6 +1424,9 @@ class Scheduler:
         # enumeration — fast-forward the rest of the committed prefix
         if kf > 0:
             self.cache.node_tree.advance_enumerations(kf - 1)
+        # committed prefix fully reconciled: recovery past this point is
+        # per-cycle (serial tail) or reconcile-only (pressure tail)
+        self._crash_ctx = None
         if kf < len(pods):
             if progress["failed"]:
                 # wave-commit failure: the algorithm discarded the in-flight
@@ -1404,14 +1524,28 @@ class Scheduler:
         commit_wave = getattr(self.store, "commit_wave", None)
         emit_batch = commit_wave is None
         try:
+            # crash seam, pre-write side: the wave has been assumed in the
+            # cache but NOTHING reached the store — recovery must re-queue
+            # every pod of this window
+            chaos.check("sched.crash")
             if commit_wave is not None:
                 recs = self.recorder.make_pod_records([
                     (a, NORMAL, "Scheduled",
                      f"Successfully assigned {a.key} to {h}")
                     for a, h in zip(assumed_list, hosts)])
-                missing = set(commit_wave(bindings, recs))
+                missing = set(self._commit_wave_retrying(
+                    commit_wave, bindings, recs))
             else:
                 missing = set(self.store.bind_pods(bindings))
+            # crash seam, post-write side: the wave LANDED but the cache
+            # finish / metrics / fan-out tail never ran — recovery must
+            # adopt every landed binding
+            chaos.check("sched.crash")
+        except chaos.SchedulerCrash:
+            # the process-death stand-in must NOT be absorbed by the
+            # graceful per-pod resolution below: it propagates to the test
+            # harness, which then drives Scheduler.recover()
+            raise
         except Exception:
             # a mid-batch store failure may have partially committed:
             # resolve each pod by what actually landed — bound pods finish,
@@ -1465,6 +1599,45 @@ class Scheduler:
                 (a, NORMAL, "Scheduled",
                  f"Successfully assigned {a.key} to {h}") for a, h in bound])
         return k
+
+    def _commit_wave_retrying(self, commit_wave, bindings: list,
+                              recs: list) -> list:
+        """Idempotent commit_wave: bounded exponential backoff with jitter
+        on transient store failures, under ONE dedupe token for the wave.
+        A pre-land failure (nothing written) simply re-runs the wave; an
+        AMBIGUOUS failure (the wave landed, the response was lost) is
+        answered by the store's token map on retry — the wave can neither
+        double-land nor double-emit its events. Exhausted retries fall
+        back to the caller's per-pod crash resolution, which is also safe
+        (it reads back what actually landed)."""
+        import inspect
+        try:
+            # probed per wave, not cached: tests (and alternate stores)
+            # swap commit_wave at runtime
+            takes_token = "token" in inspect.signature(
+                commit_wave).parameters
+        except (TypeError, ValueError):
+            takes_token = False
+        kwargs = {}
+        if takes_token:
+            kwargs["token"] = f"{self.name}:w{next(self._wave_seq)}"
+        delay = 0.005
+        attempts = 4
+        for attempt in range(attempts):
+            try:
+                out = commit_wave(bindings, recs, **kwargs)
+                if attempt:
+                    COMMIT_RETRIES.labels("recovered").inc()
+                return out
+            except Exception as e:   # noqa: BLE001 — filtered below
+                if attempt + 1 >= attempts \
+                        or not _retryable_store_error(e):
+                    if attempt:
+                        COMMIT_RETRIES.labels("exhausted").inc()
+                    raise
+                COMMIT_RETRIES.labels("retried").inc()
+                time.sleep(delay * (0.5 + (attempt % 2) / 2))
+                delay *= 2
 
     def _assume_for_burst(self, pod: Pod, host: str) -> Pod:
         assumed = pod.clone()
@@ -1554,6 +1727,178 @@ class Scheduler:
         # (identity rotation is a batch gate); consume the remainder
         self.cache.node_tree.advance_enumerations(len(pods) - 1)
         return n_bound
+
+    # -- crash-restart warm recovery ------------------------------------------
+    # The recovery context brackets every committed burst window with the
+    # exact walk-counter / NodeTree boundary on each side. A crash
+    # (chaos.SchedulerCrash — the process-death stand-in — escaping the
+    # commit path) freezes it; recover() reads the store to learn which
+    # side of the in-flight window actually landed and rewinds/advances
+    # the decision state to exactly where an oracle that never crashed
+    # would be, then reconciles cache/queue/nominations from a relist.
+    def _ctx_open(self, tree_chk) -> None:
+        """Open a burst recovery context at the segment's pre-enumeration
+        boundary (tree checkpoint taken BEFORE list_names)."""
+        self._crash_ctx = {
+            "tree_chk": tree_chk,
+            "li": getattr(self.algorithm, "last_index", 0),
+            "lni": getattr(self.algorithm, "last_node_index", 0),
+            "t": 0, "exact": True, "window": None,
+        }
+
+    def _ctx_window(self, marker: Optional[dict], keys: list,
+                    hosts: list) -> None:
+        """Bracket one commit window: `marker` is the algorithm's
+        commit_marker (exact boundary counters where the packed block
+        carries them; None fields degrade recovery to reconcile-only)."""
+        ctx = self._crash_ctx
+        if ctx is None:
+            return
+        m = marker or {}
+        ctx["window"] = {
+            "keys": list(keys), "hosts": list(hosts),
+            "li0": m.get("li0"), "lni0": m.get("lni0"),
+            "li1": m.get("li1"), "lni1": m.get("lni1"),
+            "t0": m.get("committed0"), "t1": m.get("committed1"),
+        }
+
+    def _ctx_window_done(self) -> None:
+        """Fold a successfully committed window into the context's
+        committed-prefix boundary."""
+        ctx = self._crash_ctx
+        if ctx is None or ctx["window"] is None:
+            return
+        w = ctx.pop("window")
+        ctx["window"] = None
+        if w["li1"] is None or w["lni1"] is None or w["t1"] is None:
+            ctx["exact"] = False
+        else:
+            ctx["li"], ctx["lni"], ctx["t"] = w["li1"], w["lni1"], w["t1"]
+
+    def recover(self) -> dict:
+        """Crash-restart warm recovery (the reference's restart story —
+        factory.go:643 re-queue, re-list on restart — compressed into one
+        in-process path, plus the device state a restarted TPU scheduler
+        must rebuild):
+
+        1. decide the commit boundary: when a burst window was in flight,
+           read the store to learn whether it landed (commit_wave is
+           atomic per window: all its binds or none), and set the walk
+           counters / NodeTree rotation to that side's exact boundary —
+           the state an oracle that never crashed would hold;
+        2. re-list every informer (authoritative store view; handlers
+           reconcile caches/queue with DeltaFIFO Replace semantics);
+        3. reconcile the scheduler cache: assumed-but-unbound pods are
+           forgotten and RE-QUEUED (their assume died with the crash),
+           assumed pods whose binding landed are ADOPTED (finish), bound
+           pods the cache never saw are adopted via the relist;
+        4. rebuild the nomination map from the store's
+           nominatedNodeName fields;
+        5. drop every device-resident structure (folds for uncommitted
+           decisions, the victim table) — the next encode re-uploads from
+           the now-authoritative host mirror.
+
+        Returns a report dict (requeued/adopted keys, whether the walk
+        counters were recovered exactly)."""
+        self.wait_for_binds()
+        report = {"requeued": [], "adopted": [], "exact": True,
+                  "window_landed": None}
+        # -- 1. commit boundary from the frozen context ----------------------
+        ctx, self._crash_ctx = self._crash_ctx, None
+        li = lni = t = None
+        if ctx is not None:
+            li, lni, t = ctx["li"], ctx["lni"], ctx["t"]
+            exact = ctx["exact"]
+            w = ctx.get("window")
+            if w is not None:
+                landed = False
+                for key, host in zip(w["keys"], w["hosts"]):
+                    try:
+                        cur = self.store.get(PODS, key)
+                    except NotFoundError:
+                        continue
+                    if cur.node_name == host:
+                        landed = True
+                        break
+                report["window_landed"] = landed
+                side = ("li1", "lni1", "t1") if landed \
+                    else ("li0", "lni0", "t0")
+                vals = [w[k] for k in side]
+                if any(v is None for v in vals):
+                    exact = False
+                else:
+                    li, lni, t = vals
+            report["exact"] = exact
+            if exact:
+                tree = self.cache.node_tree
+                tree.restore(ctx["tree_chk"])
+                if t and t > 0:
+                    # the committed prefix consumed t enumerations: one
+                    # via list_names + (t-1) fast-forwards, mirroring the
+                    # shell's own advance pattern
+                    tree.list_names()
+                    tree.advance_enumerations(t - 1)
+            else:
+                li = lni = None   # keep current counters; reconcile only
+        # -- 2. authoritative relist -----------------------------------------
+        for inf in list(self.informers._informers.values()):
+            if inf.has_synced:
+                inf._relist()
+            else:
+                inf.sync()
+        # -- 3. cache reconcile ----------------------------------------------
+        store_pods = {p.key: p for p in self.store.list(PODS)[0]}
+        for assumed in self.cache.assumed_pods():
+            cur = store_pods.get(assumed.key)
+            if cur is not None and cur.node_name == assumed.node_name:
+                # bound-but-unobserved: the write landed, the finish never
+                # ran (or the informer skipped the self-inflicted update)
+                self.cache.finish_binding(assumed)
+                report["adopted"].append(assumed.key)
+                continue
+            # assumed-but-unbound (or bound elsewhere / deleted): the
+            # assume died with the crash — forget it; the queue rebuild
+            # below re-enters the live store object
+            self.cache.forget_pod(assumed)
+            if cur is not None and not cur.node_name \
+                    and not cur.deleted and self._responsible_for(cur):
+                report["requeued"].append(assumed.key)
+        # -- 3b. activeQ rebuild from the relist ------------------------------
+        # A restarted scheduler's queue is EMPTY: every pending pod
+        # re-enters in creation order (the store lists in insertion
+        # order), exactly the arrival order the never-crashed world's
+        # informer fed its queue — so the post-restart pop order matches
+        # the oracle's. This deliberately resets in-process backoff and
+        # parked-gang state (it died with the process, as on a real
+        # restart); pods mid-pop at the crash (the drained-but-undecided
+        # burst tail) re-enter here too.
+        pending = [cur for cur in store_pods.values()
+                   if not cur.node_name and not cur.deleted
+                   and self._responsible_for(cur)]
+        for cur in pending:
+            self.queue.delete(cur)
+        for cur in pending:
+            self.queue.add(cur)
+        # -- 4. nominations ----------------------------------------------------
+        for p in self.queue.nominated.all_pods():
+            cur = store_pods.get(p.key)
+            if cur is None or cur.node_name or not cur.nominated_node_name:
+                self.queue.nominated.delete(p)
+        for cur in store_pods.values():
+            if not cur.node_name and cur.nominated_node_name:
+                self.queue.nominated.add(cur)
+        # -- 5. device state ---------------------------------------------------
+        rec_dev = getattr(self.algorithm, "recover_device", None)
+        if rec_dev is not None:
+            rec_dev(li=li, lni=lni)
+        else:
+            if li is not None and hasattr(self.algorithm, "last_index"):
+                self.algorithm.last_index = li
+            if lni is not None \
+                    and hasattr(self.algorithm, "last_node_index"):
+                self.algorithm.last_node_index = lni
+        self._snapshot = self.cache.update_snapshot(self._snapshot)
+        return report
 
     def run(self, stop_after: Optional[Callable[[], bool]] = None) -> None:
         """wait.Until(scheduleOne, 0) analog; call from a thread."""

@@ -252,6 +252,23 @@ class PyCommitCore:
             self._by_kind.setdefault(kind, []).append(wid)
             return wid
 
+    def adopt_watcher(self, wid: int, kind: str,
+                      resync: bool = True) -> None:
+        """Take over a watcher id from a DEMOTED core (store fault plane):
+        the Watch object keeps its wid, but its cursor state died with the
+        old core, so the adopted watcher starts at the log head marked
+        `resync` — the next poll raises ExpiredError and the consumer
+        re-lists (the standard drop-with-resync contract). Twin-only: the
+        native core is never the demotion TARGET."""
+        log = self._kind_log(kind)
+        with self._cond:
+            w = _Watcher(kind, log.end)
+            w.resync = bool(resync)
+            self._watchers[wid] = w
+            self._by_kind.setdefault(kind, []).append(wid)
+            self._next_wid = max(self._next_wid, wid + 1)
+            self._cond.notify_all()
+
     def detach(self, wid: int) -> None:
         with self._cond:
             w = self._watchers.pop(wid, None)

@@ -14,6 +14,7 @@ Two pump modes:
 """
 from __future__ import annotations
 
+import random
 import threading
 from typing import Any, Callable, Optional
 
@@ -31,6 +32,13 @@ WATCH_EXPIRATIONS = obs.counter(
     "informer_watch_expirations_total",
     "Watches that outran the server's event log (410 Gone), by kind.",
     ("kind",))
+RELIST_BACKOFF = obs.histogram(
+    "informer_relist_backoff_seconds",
+    "Backoff slept before a re-list during a consecutive-ExpiredError "
+    "streak, by kind. The first expiry of a streak re-lists immediately "
+    "(zero observation); a sustained expired window climbs the jittered "
+    "exponential ladder instead of hot-looping list+watch.", ("kind",),
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 
 Handler = Callable[[Any], None]
 UpdateHandler = Callable[[Any, Any], None]
@@ -92,6 +100,16 @@ class SharedInformer:
         # recorded by _safe_relist before it stops the informer, so the
         # operator sees WHY the informer died instead of a silent stall
         self.last_error: Optional[Exception] = None
+        # consecutive-ExpiredError streak driving the re-list backoff:
+        # the first expiry re-lists immediately, a sustained expired
+        # window (log smaller than churn) backs off exponentially with
+        # jitter instead of spinning list+watch back-to-back. `_sleep` is
+        # injectable so tests count/observe delays deterministically.
+        self._expired_streak = 0
+        self._backoff_rng = random.Random(f"relist:{kind}")
+        self._sleep: Callable[[float], Any] = self._stop.wait
+        self.relist_backoff_base = 0.05
+        self.relist_backoff_cap = 1.0
 
     # -- registration -------------------------------------------------------
     def add_event_handler(self,
@@ -114,6 +132,21 @@ class SharedInformer:
     def has_synced(self) -> bool:
         return self._synced
 
+    # -- relist backoff guard ------------------------------------------------
+    def _note_expired(self) -> None:
+        """One step of the consecutive-ExpiredError streak: sleep the
+        streak's jittered exponential delay (0 on the first expiry) and
+        record it. Stopping the informer interrupts the sleep."""
+        streak = self._expired_streak
+        self._expired_streak = streak + 1
+        if streak == 0:
+            return
+        delay = min(self.relist_backoff_cap,
+                    self.relist_backoff_base * (2 ** (streak - 1)))
+        delay *= 0.5 + self._backoff_rng.random() / 2
+        RELIST_BACKOFF.labels(self.kind).observe(delay)
+        self._sleep(delay)
+
     # -- list+watch ---------------------------------------------------------
     def sync(self) -> None:
         """Initial list + open watch at the list's resourceVersion."""
@@ -134,6 +167,10 @@ class SharedInformer:
             try:
                 self._watch = self.store.watch(self.kind, since_rv=rv)
             except ExpiredError:
+                # the log window moved past rv between list and watch
+                # open: a sustained window would otherwise re-list
+                # back-to-back — climb the backoff ladder instead
+                self._note_expired()
                 continue
             break
         new = {o.key: o for o in objs}
@@ -162,8 +199,10 @@ class SharedInformer:
                       else self._watch.try_next())
             except ExpiredError:
                 # the watch outran the server's event log: re-list
-                # (reflector 410 contract)
+                # (reflector 410 contract); consecutive expirations with
+                # no event applied in between back off
                 WATCH_EXPIRATIONS.labels(self.kind).inc()
+                self._note_expired()
                 self._relist()
                 continue
             if ev is None:
@@ -173,6 +212,8 @@ class SharedInformer:
         return n
 
     def _apply(self, ev: Event) -> None:
+        # a delivered event ends any consecutive-ExpiredError streak
+        self._expired_streak = 0
         old = None
         with self._lock:
             if ev.type in (ADDED, MODIFIED):
@@ -207,6 +248,7 @@ class SharedInformer:
                 ev = self._watch.next(timeout=0.05)
             except ExpiredError:
                 WATCH_EXPIRATIONS.labels(self.kind).inc()
+                self._note_expired()
                 self._safe_relist()
                 continue
             if ev is not None:
@@ -228,6 +270,7 @@ class SharedInformer:
                 self._relist()
                 return
             except ExpiredError:
+                self._note_expired()
                 continue
             except Exception as e:
                 code = getattr(e, "code", None)

@@ -24,13 +24,14 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Any, Callable, Optional
 
-from kubernetes_tpu import obs
+from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.store.store import (
     Event, PODS, AlreadyExistsError, ConflictError, ExpiredError,
@@ -51,6 +52,11 @@ TRANSIENT_RETRIES = obs.counter(
     "remote_transient_retries_total",
     "Transient transport failures retried during watch re-open, by kind.",
     ("kind",))
+REQUEST_RETRIES = obs.counter(
+    "remote_request_retries_total",
+    "Unary requests retried after a transient transport failure or 5xx, "
+    "by verb class (read / cas / bind / status). Write classes that are "
+    "not idempotent (create / delete) never auto-retry.", ("verb",))
 
 
 class APIStatusError(Exception):
@@ -225,17 +231,51 @@ def _status_body(e: urllib.error.HTTPError) -> dict:
 
 class RemoteStore:
     """The Store read/write surface over HTTP. Watch streams reconnect;
-    unary calls fail fast with mapped errors."""
+    unary calls retry transient transport failures with bounded
+    exponential backoff + jitter PER VERB CLASS (reads and CAS-guarded
+    writes are retry-safe; creates/deletes are not idempotent and fail
+    fast), then fail with mapped errors."""
+
+    #: verb class -> (total attempts, base backoff seconds). The bases are
+    #: deliberately small: the client's job is to ride out a connection
+    #: reset or an apiserver restart blip, not to poll an outage — callers
+    #: with real deadlines own the long waits.
+    RETRY_POLICY = {
+        "read": (4, 0.02),     # GET/LIST: always idempotent
+        "cas": (3, 0.02),      # rv-preconditioned PUT: a replay that landed
+                               # surfaces as 409 to the CAS loop above it
+        "bind": (4, 0.02),     # binding POST: read-your-write dedupe below
+        "status": (3, 0.02),   # status subresource PUT (idempotent mutator)
+        "write": (1, 0.0),     # create/delete: NOT idempotent — no retry
+    }
 
     def __init__(self, base_url: str, timeout: float = 30.0,
                  token: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token   # bearer identity (tokenfile authn analog)
+        # deterministic jitter stream + injectable sleep (tests stub it)
+        self._rng = random.Random(0xC0FFEE)
+        self._sleep = time.sleep
 
     # -- transport -----------------------------------------------------------
-    def _request(self, method: str, path: str,
-                 body: Optional[dict] = None) -> Any:
+    @staticmethod
+    def _is_transient(exc: BaseException) -> bool:
+        """A failure worth retrying on an idempotent verb: transport-level
+        (connection reset/refused, timeout — incl. the chaos plane's
+        injected RemoteFault, a URLError subclass) or a server-side 5xx.
+        Mapped client errors (404/409/410/422...) are REAL answers."""
+        if isinstance(exc, APIStatusError):
+            return exc.code in (500, 502, 503, 504)
+        return isinstance(exc, (urllib.error.URLError, OSError,
+                                TimeoutError))
+
+    def _backoff(self, attempt: int, base: float) -> float:
+        return base * (2 ** attempt) * (0.5 + self._rng.random() / 2)
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict] = None) -> Any:
+        chaos.check("remote.http")
         data = json.dumps(body).encode() if body is not None else None
         headers = {"Content-Type": "application/json"}
         if self.token:
@@ -249,6 +289,18 @@ class RemoteStore:
             b = _status_body(e)
             _raise_for(e.code, b.get("reason", ""),
                        b.get("message", str(e)))
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 verb_class: str = "read") -> Any:
+        attempts, base = self.RETRY_POLICY.get(verb_class, (1, 0.0))
+        for attempt in range(attempts):
+            try:
+                return self._request_once(method, path, body)
+            except Exception as e:   # noqa: BLE001 — filtered below
+                if attempt + 1 >= attempts or not self._is_transient(e):
+                    raise
+                REQUEST_RETRIES.labels(verb_class).inc()
+                self._sleep(self._backoff(attempt, base))
 
     # -- reads ---------------------------------------------------------------
     def get(self, kind: str, key: str) -> Any:
@@ -267,9 +319,11 @@ class RemoteStore:
     # -- writes --------------------------------------------------------------
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
         # `move` is the embedded store's no-clone fast path; over the wire
-        # serialization copies regardless
+        # serialization copies regardless. POST is not idempotent (a retry
+        # whose first attempt landed would AlreadyExists) — no auto-retry.
         return serde.from_dict(kind, self._request(
-            "POST", f"/api/v1/{kind}", serde.to_dict(obj)))
+            "POST", f"/api/v1/{kind}", serde.to_dict(obj),
+            verb_class="write"))
 
     def update(self, kind: str, obj: Any,
                expect_rv: Optional[int] = None) -> Any:
@@ -278,16 +332,43 @@ class RemoteStore:
         # precondition; expect_rv overrides it (None = unconditional)
         d["resource_version"] = expect_rv if expect_rv is not None else 0
         return serde.from_dict(kind, self._request(
-            "PUT", f"/api/v1/{kind}/{obj.key}", d))
+            "PUT", f"/api/v1/{kind}/{obj.key}", d,
+            verb_class="cas" if expect_rv is not None else "write"))
 
     def delete(self, kind: str, key: str) -> Any:
         return serde.from_dict(kind, self._request(
-            "DELETE", f"/api/v1/{kind}/{key}"))
+            "DELETE", f"/api/v1/{kind}/{key}", verb_class="write"))
 
     def bind_pod(self, pod_key: str, node_name: str) -> Any:
-        # POST pods/{ns}/{name}/binding (factory.go:710)
-        return self._request("POST", f"/api/v1/{PODS}/{pod_key}/binding",
-                             {"node": node_name})
+        """POST pods/{ns}/{name}/binding (factory.go:710), idempotent
+        under retry: a transient failure after the POST went out is
+        AMBIGUOUS (the write may have landed, only the response was lost),
+        so before re-POSTing the client reads the pod back — a binding
+        that already landed is success, never re-POSTed, and therefore
+        never double-bumps the rv or double-emits the MODIFIED event."""
+        attempts, base = self.RETRY_POLICY["bind"]
+        path = f"/api/v1/{PODS}/{pod_key}/binding"
+        last: Optional[BaseException] = None
+        for attempt in range(attempts):
+            if attempt:
+                # ambiguity check FIRST: did the lost attempt land?
+                try:
+                    current = self.get(PODS, pod_key)
+                    if current.node_name == node_name:
+                        return current
+                except NotFoundError:
+                    raise
+                except Exception:   # noqa: BLE001 — probe is best-effort
+                    pass
+                REQUEST_RETRIES.labels("bind").inc()
+                self._sleep(self._backoff(attempt - 1, base))
+            try:
+                return self._request_once("POST", path, {"node": node_name})
+            except Exception as e:   # noqa: BLE001 — filtered below
+                if not self._is_transient(e):
+                    raise
+                last = e
+        raise last
 
     def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
         """Batch contract of Store.bind_pods over the wire: one POST per
@@ -302,19 +383,28 @@ class RemoteStore:
         return missing
 
     def commit_wave(self, bindings: list[tuple[str, str]],
-                    events: Optional[list] = None) -> list[str]:
+                    events: Optional[list] = None,
+                    token: Optional[str] = None) -> list[str]:
         """Wave contract of Store.commit_wave over the wire: binds via the
         binding subresource (404 -> missing, mapped exactly like
         bind_pods), then the audit records of the binds that landed via
         per-record POSTs — each isolated and fire-and-forget like the
         recorder's remote path (a rejected or undeliverable event write
-        never fails the commit)."""
+        never fails the commit).
+
+        Idempotency: the REST surface carries no wave token, so the
+        embedded store's token map is replaced by per-verb dedupe — every
+        bind retry read-checks before re-POSTing (bind_pod), and a retried
+        record create that already landed dies on 409 AlreadyExists and is
+        dropped (record keys are deterministic per event). `token` is
+        accepted for surface parity with the embedded store."""
+        del token   # per-verb dedupe makes the wave token redundant here
         missing = self.bind_pods(bindings)
         if events:
             from kubernetes_tpu.store.store import EVENTS
             gone = set(missing)
             drop = (APIStatusError, AlreadyExistsError, ConflictError,
-                    OSError)
+                    OSError, urllib.error.URLError)
             for (pod_key, _n), rec in zip(bindings, events):
                 if pod_key in gone:
                     continue
@@ -370,5 +460,5 @@ class RemoteStore:
         d = self._request(
             "PUT", f"/api/v1/{PODGROUPS}/{group_key}/status",
             {"phase": phase, "members": members, "scheduled": scheduled,
-             "last_transition_time": now})
+             "last_transition_time": now}, verb_class="status")
         return serde.from_dict(PODGROUPS, d)

@@ -21,10 +21,11 @@ from __future__ import annotations
 import copy
 import os
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
-from kubernetes_tpu import obs
+from kubernetes_tpu import chaos, obs
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -89,6 +90,16 @@ WATCH_FANOUT_LAG = obs.histogram(
     "a watcher — stamped inside BOTH commit cores (native commitcore.cpp "
     "and the PyCommitCore twin) via the fan-out sink.",
     ("impl",), buckets=obs.MICRO_BUCKETS)
+WAVE_DEDUP = obs.counter(
+    "store_commit_wave_dedup_total",
+    "commit_wave calls answered from the wave-token dedupe map: a retried "
+    "wave whose first attempt had landed before the ambiguous failure — "
+    "the retry returned the recorded result instead of double-landing "
+    "binds or double-emitting events.")
+
+#: retained dedupe tokens (one per wave; the retry window is one wave, so
+#: a small multiple of any realistic pipeline depth is plenty)
+WAVE_TOKEN_CAP = 1024
 
 
 class ConflictError(Exception):
@@ -131,6 +142,16 @@ class Watch:
         self._stopped = False
 
     def _poll(self, timeout: Optional[float], limit: int) -> list[Event]:
+        if self._store._fanout_deferred:
+            # a chaos-deferred wave fan-out: the consumer's poll is the
+            # seam's delivery point — events are delayed, never lost
+            self._store.deliver_deferred()
+        if chaos.take("watch.drop"):
+            # injected slow-consumer drop: identical consumer contract to
+            # the real overflow path (ExpiredError -> re-list)
+            WATCH_DROPPED.labels("injected").inc()
+            raise ExpiredError(
+                f"{self.kind}: chaos-injected watch drop (resync required)")
         try:
             return self._store._core.poll(self._wid, timeout, limit)
         except ExpiredError as e:
@@ -222,14 +243,22 @@ class Store:
         from kubernetes_tpu.store.commit_core import make_commit_core
         self._lock = threading.RLock()
         self._objs: dict[str, dict[str, Any]] = {}
+        self._queue_size = (watch_queue_size if watch_queue_size is not None
+                            else watch_log_size)
         self._core = make_commit_core(
-            watch_log_size,
-            watch_queue_size if watch_queue_size is not None
-            else watch_log_size,
+            watch_log_size, self._queue_size,
             Event, ExpiredError, AlreadyExistsError, force=commit_core)
         self.core_impl = "native" if getattr(self._core, "is_native", False) \
             else "twin"
         self._log_size = watch_log_size
+        # wave-token dedupe map (idempotent commit retry): token -> the
+        # missing-keys result of the wave that landed under it. A retried
+        # commit_wave after an ambiguous failure replays the RESULT, not
+        # the write.
+        self._wave_tokens: "OrderedDict[str, list]" = OrderedDict()
+        # chaos store.fanout seam: a deferred wave delivery is flushed by
+        # the next fan-out call or the next consumer poll (never lost)
+        self._fanout_deferred = False
         # live watcher ids (wid -> kind) for the /debug/sched cursor-lag
         # view; pruned on Watch.stop()
         self._watch_ids: dict[int, str] = {}
@@ -249,6 +278,42 @@ class Store:
         if debug_integrity is None:
             debug_integrity = bool(os.environ.get("KTPU_STORE_INTEGRITY"))
         self._integrity: Optional[dict] = {} if debug_integrity else None
+
+    # -- native-core demotion (graceful degradation) -------------------------
+    def _core_guard(self) -> None:
+        """Called (under the store lock) before every write verb's core
+        call: when the chaos plane fires the native.commitcore seam against
+        a native core, demote to the twin BEFORE the call — the verb then
+        lands on the twin, so no wave/write is ever dropped."""
+        if self.core_impl == "native" \
+                and chaos.take("native.commitcore"):
+            self._demote_core()
+
+    def _demote_core(self) -> None:
+        """Swap the commit core for the pure-Python twin mid-run.
+
+        The rv counter carries over (resourceVersion assignment continues
+        without a gap) and the OBJECT buckets are untouched — they live in
+        the store, not the core — so reads and subsequent writes are
+        seamless. The event log and watcher cursors are core-internal
+        state the faulted native core cannot be trusted to yield, so live
+        watchers are dropped-with-resync: each keeps its wid in the twin
+        but the next poll raises ExpiredError and the consumer re-lists,
+        exactly the slow-consumer contract informers already implement.
+        Caller holds the store lock."""
+        from kubernetes_tpu.store.commit_core import PyCommitCore
+        twin = PyCommitCore(self._log_size, self._queue_size,
+                            Event, ExpiredError, AlreadyExistsError)
+        twin.set_rv(self._core.rv())
+        for wid, kind in self._watch_ids.items():
+            twin.adopt_watcher(wid, kind, resync=True)
+        self._core = twin
+        self.core_impl = "twin"
+        if hasattr(twin, "set_fanout_sink"):
+            twin.set_fanout_sink(self._make_fanout_sink())
+        chaos.DEMOTIONS.labels("commitcore").inc()
+        if self._watch_ids:
+            WATCH_DROPPED.labels("core-demotion").inc(len(self._watch_ids))
 
     # -- observability -------------------------------------------------------
     def _make_fanout_sink(self):
@@ -360,6 +425,7 @@ class Store:
         touch `obj` again, skipping the write snapshot (the event recorder's
         fire-and-forget records use this)."""
         with self._lock:
+            self._core_guard()
             try:
                 stored = self._core.create_batch(
                     self._objs.setdefault(kind, {}), kind, [obj], move)[0]
@@ -379,6 +445,7 @@ class Store:
                 raise ConflictError(
                     f"{kind}/{key}: rv {current.resource_version} != expected {expect_rv}")
             self._check_entry(kind, key, current)
+            self._core_guard()
             stored = _clone(obj)
             rv = self._core.next_rv()
             stored.resource_version = rv
@@ -414,6 +481,7 @@ class Store:
             self._check_entry(kind, key, obj)
             if self._integrity is not None:
                 self._integrity.pop((kind, key), None)
+            self._core_guard()
             rv = self._core.next_rv()
             self._core.append(DELETED, kind, _clone(obj), rv)
             self._flush()
@@ -429,6 +497,7 @@ class Store:
         no CAS retry loop — one clone, one lock, one event. The per-binding
         body is the commit core's bind_batch (identical to the burst wave)."""
         with self._lock:
+            self._core_guard()
             bucket = self._objs.setdefault(PODS, {})
             if self._bind_batch_locked(bucket, [(pod_key, node_name)]):
                 self._flush()
@@ -463,6 +532,7 @@ class Store:
         keys that were missing (deleted between decision and commit); the
         caller handles those like failed binds."""
         with self._lock:
+            self._core_guard()
             bucket = self._objs.setdefault(PODS, {})
             missing = self._bind_batch_locked(bucket, bindings)
         self._flush()
@@ -477,6 +547,7 @@ class Store:
         Raises on the first duplicate — callers pass fresh uniquely-named
         objects."""
         with self._lock:
+            self._core_guard()
             try:
                 stored = self._core.create_batch(
                     self._objs.setdefault(kind, {}), kind, objs, move)
@@ -487,16 +558,33 @@ class Store:
                     self._record_entry(kind, _key_of(o), o)
 
     def commit_wave(self, bindings: list[tuple[str, str]],
-                    events: Optional[list] = None) -> list[str]:
+                    events: Optional[list] = None,
+                    token: Optional[str] = None) -> list[str]:
         """One burst wave's whole store-write tail as ONE core call: the
         batched bind (bind_pods semantics) plus the audit-record creates
         for the bindings that landed (`events[i]` rides `bindings[i]`;
         records are created move=True, like the recorder's batch path).
         Fan-out is deliberately NOT triggered here — the scheduler calls
         `fanout_wave()` as its one separate per-wave delivery call, which
-        may overlap the remaining host commit work."""
+        may overlap the remaining host commit work.
+
+        `token` is the caller's idempotency key (one fresh token per wave,
+        REUSED across retries of that wave): a wave that already landed
+        under the same token returns its recorded missing-keys result
+        without touching the core — a retried bind after an AMBIGUOUS
+        failure (the wave landed but the caller saw an exception) can
+        neither double-land nor double-emit its events."""
         import time as _time
         with self._lock:
+            if token is not None:
+                hit = self._wave_tokens.get(token)
+                if hit is not None:
+                    WAVE_DEDUP.inc()
+                    return list(hit)
+            # injected pre-land failure: nothing written yet — the caller
+            # retries the whole wave under the same token
+            chaos.check("store.commit_wave")
+            self._core_guard()
             pods = self._objs.setdefault(PODS, {})
             evs = self._objs.setdefault(EVENTS, {})
             if self._integrity is not None:
@@ -508,6 +596,13 @@ class Store:
             missing = self._core.commit_wave(pods, PODS, bindings,
                                              evs, EVENTS, events or [])
             t_landed = _time.perf_counter()
+            if token is not None:
+                self._wave_tokens[token] = list(missing)
+                while len(self._wave_tokens) > WAVE_TOKEN_CAP:
+                    self._wave_tokens.popitem(last=False)
+            # injected AMBIGUOUS failure: the wave LANDED (core write done,
+            # token recorded) but the caller's "response" is lost below
+            ambiguous = chaos.take("store.commit_wave.ambiguous")
             COMMIT_WAVES.labels(self.core_impl).inc()
             COMMIT_WAVE_SECONDS.labels(self.core_impl).observe(
                 t_landed - t_core)
@@ -525,13 +620,30 @@ class Store:
         gone = set(missing)
         LEDGER.commit_many([k for k, _n in bindings if k not in gone],
                            t=t_landed)
+        if ambiguous:
+            raise chaos.StoreFault(
+                "store.commit_wave.ambiguous",
+                "chaos: commit_wave response lost after the wave landed")
         return missing
 
     def fanout_wave(self) -> None:
         """Deliver a committed wave's pending watch events: ONE core call
         advancing every watcher's published cursor (O(watchers), not
-        O(watchers x events) — consumers copy out on their own threads)."""
+        O(watchers x events) — consumers copy out on their own threads).
+        A chaos-deferred delivery is flushed by the NEXT fan-out call or
+        the next consumer poll — delayed, never lost."""
+        if chaos.take("store.fanout"):
+            self._fanout_deferred = True
+            return
+        self._fanout_deferred = False
         self._flush()
+
+    def deliver_deferred(self) -> None:
+        """Flush a chaos-deferred wave fan-out (called from a consumer's
+        poll — the seam's guaranteed delivery point)."""
+        with self._lock:
+            self._fanout_deferred = False
+            self._flush()
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,

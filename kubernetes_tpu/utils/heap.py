@@ -112,9 +112,55 @@ class KeyedHeap:
             i = smallest
 
 
+class _PyHeapCore:
+    """Pure-Python stand-in exposing heapcore's exact call surface — the
+    DEMOTION TARGET when the native heap faults mid-run (the chaos plane's
+    native.heapcore seam). Entries are (key, triple, item); ordering is
+    the same ascending numeric triple, so a heap migrated item-by-item
+    pops in the identical order (queue triples embed a unique sequence
+    number — no ties to reorder)."""
+
+    def __init__(self):
+        self._h = KeyedHeap(lambda e: e[0], lambda x, y: x[1] < y[1])
+
+    def add(self, key, a, b, c, item) -> None:
+        self._h.add((key, (a, b, c), item))
+
+    def get(self, key):
+        e = self._h.get(key)
+        return e[2] if e is not None else None
+
+    def delete(self, key):
+        e = self._h.delete(key)
+        return e[2] if e is not None else None
+
+    def peek(self):
+        e = self._h.peek()
+        return e[2] if e is not None else None
+
+    def pop(self):
+        e = self._h.pop()
+        return e[2] if e is not None else None
+
+    def pop_many(self, limit: int) -> list:
+        return [e[2] for e in self._h.pop_many(limit)]
+
+    def list(self) -> list:
+        return [e[2] for e in self._h.list()]
+
+    def __len__(self) -> int:
+        return len(self._h)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._h
+
+
 class NumericKeyedHeap:
     """KeyedHeap specialization: ordering = ascending numeric triple.
-    Uses the native core when available; falls back to KeyedHeap."""
+    Uses the native core when available; falls back to KeyedHeap. A
+    native core that faults mid-run (chaos seam native.heapcore, or a
+    real extension fault) DEMOTES: the items migrate into _PyHeapCore and
+    every later call rides the twin — no queued pod is ever lost."""
 
     def __new__(cls, key_fn: Callable[[Any], str],
                 triple_fn: Callable[[Any], tuple]):
@@ -127,7 +173,30 @@ class NumericKeyedHeap:
         self._key_fn = key_fn
         self._triple = triple_fn
         self._core = core_mod.HeapCore()
+        self._native = True
         return self
+
+    # -- demotion ------------------------------------------------------------
+    def _guard(self) -> None:
+        """Entry-point hook: when the chaos plane fires the heapcore seam
+        against a live native core, demote BEFORE the call (injection
+        precedes the fault, so the core's state is intact to migrate) —
+        the operation that triggered it completes on the twin."""
+        if self._native:
+            from kubernetes_tpu import chaos
+            if chaos.take("native.heapcore"):
+                self._demote()
+
+    def _demote(self) -> None:
+        items = self._core.list()
+        twin = _PyHeapCore()
+        for item in items:
+            a, b, c = self._triple(item)
+            twin.add(self._key_fn(item), float(a), float(b), float(c), item)
+        self._core = twin
+        self._native = False
+        from kubernetes_tpu import chaos
+        chaos.DEMOTIONS.labels("heapcore").inc()
 
     def __len__(self) -> int:
         return len(self._core)
@@ -142,6 +211,7 @@ class NumericKeyedHeap:
         return self._core.list()
 
     def add(self, item: Any) -> None:
+        self._guard()
         a, b, c = self._triple(item)
         self._core.add(self._key_fn(item), float(a), float(b), float(c), item)
 
@@ -152,18 +222,21 @@ class NumericKeyedHeap:
             self.add(item)
 
     def delete(self, key: str) -> Optional[Any]:
+        self._guard()
         return self._core.delete(key)
 
     def peek(self) -> Optional[Any]:
         return self._core.peek()
 
     def pop(self) -> Optional[Any]:
+        self._guard()
         return self._core.pop()
 
     def pop_many(self, limit: int) -> list[Any]:
         """Batched drain: ONE native call pops up to `limit` items with
         the GIL released during the sifts (the activeQ burst prologue). A
         stale pre-pop_many .so degrades to per-item pops."""
+        self._guard()
         pm = getattr(self._core, "pop_many", None)
         if pm is not None:
             return pm(limit)

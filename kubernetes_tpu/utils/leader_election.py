@@ -52,12 +52,24 @@ class LeaderElectionConfig:
 class LeaderElector:
     def __init__(self, store: Store, config: LeaderElectionConfig,
                  clock: Optional[Clock] = None):
+        # the fencing invariant (leaderelection.go:128 validation): the
+        # holder must abdicate at renew_deadline, strictly BEFORE the
+        # lease_duration window in which another candidate may acquire —
+        # an equal or larger deadline would allow two leaders
+        if config.renew_deadline >= config.lease_duration:
+            raise ValueError(
+                f"renew_deadline ({config.renew_deadline}) must be < "
+                f"lease_duration ({config.lease_duration}): a leader must "
+                "stop before its lease can be re-acquired")
         self.store = store
         self.config = config
         self.clock = clock or RealClock()
         self._leading = False
         self._observed: Optional[Lease] = None
         self._observed_at = 0.0
+        # last SUCCESSFUL renew (fencing clock): step() tolerates store
+        # failures only until last_renew + renew_deadline
+        self._last_renew = 0.0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -109,14 +121,35 @@ class LeaderElector:
     # -- run loop ------------------------------------------------------------
     def step(self) -> bool:
         """One election step; returns current leadership. Suitable for
-        deterministic test pumping as well as the background loop."""
-        got = self.try_acquire_or_renew()
-        if got and not self._leading:
-            self._leading = True
-            if self.config.on_started_leading:
-                self.config.on_started_leading()
-        elif not got and self._leading:
-            # failed to renew within deadline -> step down
+        deterministic test pumping as well as the background loop.
+
+        Fencing (leaderelection.go renewLoop): a store failure during a
+        renew is TRANSIENT — the holder keeps leading and retrying — but
+        only until `renew_deadline` past the last successful renew; at the
+        deadline it fires on_stopped_leading and stops, strictly before
+        the lease (lease_duration > renew_deadline) becomes acquirable by
+        another candidate. A definitive loss (the CAS failed because the
+        record moved, or another holder is valid) steps down immediately."""
+        now = self.clock.now()
+        try:
+            got = self.try_acquire_or_renew()
+        except Exception:   # noqa: BLE001 — store unreachable: transient
+            got = None
+        if got:
+            self._last_renew = now
+            if not self._leading:
+                self._leading = True
+                if self.config.on_started_leading:
+                    self.config.on_started_leading()
+        elif self._leading:
+            if got is None \
+                    and now - self._last_renew < self.config.renew_deadline:
+                # transient renew failure inside the deadline: keep
+                # leading, the run loop retries (no split brain — the
+                # lease itself is still unexpired for everyone else)
+                return self._leading
+            # deadline blown, or the lock definitively moved: stop
+            # leading NOW, before the lease can be re-acquired
             self._leading = False
             if self.config.on_stopped_leading:
                 self.config.on_stopped_leading()

@@ -1,0 +1,99 @@
+"""Chaos-seed parity sweep: the 42-trial extra-seed run UNDER INJECTION.
+
+Not collected by pytest (no test_ prefix; the tier-1-speed smoke is
+test_chaos_plane.test_parity_smoke_one_trial_per_seam): run by hand after
+any change to the fault plane or a degradation path —
+
+    JAX_PLATFORMS=cpu python tests/sweep_chaos_seeds.py [trials] [base_seed]
+
+Each trial re-runs one long-range differential fuzz (mixed workload,
+preemption pressure, spread burst, gang burst) with a fresh seed, a
+wave-boundary variant, and the fault plane firing at EVERY round-13 seam
+in the TPU world (CHAOS_FUZZ_RATES: device dispatch/fetch, commit_wave +
+ambiguous, fan-out, native cores, watch drops — store.commit_wave capped
+below the commit retry budget, see set_world_chaos). The oracle world
+always runs clean: it IS the referee. Bindings must stay bit-identical —
+an injected fault may cost a trial throughput, never a decision — and
+green trials ALSO replay every recorded burst through the flight
+recorder's oracle referee. Any divergence prints the failing
+(class, seed, wave_size) plus the trial's injection counts so the exact
+fault schedule can be replayed.
+"""
+import random
+import sys
+from contextlib import contextmanager
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+@contextmanager
+def _flight_recorder():
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    try:
+        yield flight.RECORDER
+    finally:
+        flight.RECORDER.configure(mode="digest")
+        flight.RECORDER.clear()
+
+
+def _with_flight(fn, s, w):
+    with _flight_recorder() as rec:
+        fn(s, w, rec, chaos=True)
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    from kubernetes_tpu import chaos as chaos_mod
+    from tests.test_tpu_parity import (TestMixedWorkloadShellFuzz,
+                                       TestPreemptionPressureShellFuzz,
+                                       TestSpreadBurstParity)
+    from tests.test_coscheduling import TestGangBurstParity
+    rng = random.Random(base_seed)
+    classes = [
+        ("mixed", TestMixedWorkloadShellFuzz(),
+         lambda t, s, w: _with_flight(t.test_bindings_identical, s, w)),
+        ("pressure", TestPreemptionPressureShellFuzz(),
+         lambda t, s, w: _with_flight(
+             t.test_preemptive_convergence_identical, s, w)),
+        ("spread", TestSpreadBurstParity(),
+         lambda t, s, w: t.test_burst_matches_oracle_with_existing_pods(
+             s, w, chaos=True)),
+        ("gang", TestGangBurstParity(),
+         lambda t, s, w: t.test_gang_parity(s, w, chaos=True)),
+    ]
+    def injected() -> dict[str, int]:
+        # the plan object dies when the oracle world disables the plane;
+        # the registry's chaos_injections_total{seam} family is the
+        # durable record of what fired
+        return {seam: int(c.value) for (seam,), c in
+                chaos_mod.INJECTIONS._children.items()}
+
+    start = injected()
+    for trial in range(trials):
+        name, inst, fn = classes[trial % len(classes)]
+        seed = rng.randint(1, 10_000)
+        wave = rng.choice([None, 3, 4])
+        before = sum(injected().values())
+        try:
+            fn(inst, seed, wave)
+        except Exception:
+            print(f"FAIL class={name} seed={seed} wave_size={wave} "
+                  f"injected={injected()}")
+            raise
+        finally:
+            chaos_mod.disable()
+        print(f"ok {trial + 1}/{trials} {name} seed={seed} wave={wave} "
+              f"injected={sum(injected().values()) - before}")
+    total = {k: v - start.get(k, 0) for k, v in injected().items()
+             if v - start.get(k, 0)}
+    assert total, "the sweep never injected a fault"
+    print(f"sweep green: {trials} trials, injections by seam: "
+          f"{dict(sorted(total.items()))}")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

@@ -189,3 +189,34 @@ def test_gang_mode_floor():
     assert out["pods_bound"] > 0
     # cliff floor, not a variance tripwire (plain runs 10k+ pods/s here)
     assert out["value"] >= 1000.0, out
+
+
+@pytest.mark.slow
+def test_chaos_mode_floor():
+    """`bench.py --mode chaos` (the round-13 fault-plane lane): one JSON
+    line with per-seam injection counts, the in-bench correctness audit
+    passed (every measured pod bound exactly once under injection), and
+    DEGRADED throughput still above the measured serial-oracle baseline —
+    the graceful-degradation contract: a fault costs throughput, never
+    correctness, and the mixed run must still beat a scheduler that never
+    used the device at all."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "chaos",
+         "--nodes", "300", "--pods", "5000"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"].endswith("_chaos")
+    ch = out["chaos"]
+    # the plan actually fired: the run is a chaos run, not a happy path
+    # (seed 42 at the default rates/cell injects across >= 5 seams)
+    assert ch["injections_total"] >= 5, ch
+    assert len(ch["injections"]) >= 3, ch
+    # the scoreboard fields the soak PR inherits
+    assert ch["seed"] == 42 and ch["breaker"] is not None, ch
+    assert out["pods_completed"] == 5000, out
+    # degraded mode must still beat the serial-oracle floor
+    assert out["vs_measured_oracle"] is not None
+    assert out["vs_measured_oracle"] > 1.0, out

@@ -591,7 +591,7 @@ class TestGangBurstParity:
 
     @pytest.mark.parametrize("wave_size", [None, 3, 4])
     @pytest.mark.parametrize("seed", [2, 13, 29, 41])
-    def test_gang_parity(self, seed, wave_size):
+    def test_gang_parity(self, seed, wave_size, chaos=False):
         from kubernetes_tpu.api.types import (
             Affinity, ContainerPort, PodAntiAffinity, PodAffinityTerm,
             LabelSelector)
@@ -641,9 +641,11 @@ class TestGangBurstParity:
                     f"s{j}", cpu=rng.choice([200, 400, 800]),
                     priority=rng.choice([0, 0, 0, 5, 9])))
 
+        from tests.test_tpu_parity import set_world_chaos
         rng_state = rng.getstate()
         outs = []
         for use_tpu in (True, False):
+            set_world_chaos(chaos, seed, use_tpu)
             rng.setstate(rng_state)
             clock = FakeClock(100.0)
             s = build()
@@ -675,3 +677,14 @@ class TestGangBurstParity:
         assert outs[0] == outs[1], (
             f"seed={seed} wave={wave_size}: gang decisions diverged: "
             f"{[a for a, b in zip(*outs) if a != b][:6]}")
+
+    def test_gang_parity_under_injection(self):
+        """Round-13 acceptance: gang atomicity + parity hold with the
+        fault plane firing in the TPU world — a faulted gang window is
+        refused whole (never a partial gang), retried trials re-derive
+        identically, and the per-round atomicity audit stays green."""
+        from kubernetes_tpu import chaos as chaos_mod
+        try:
+            self.test_gang_parity(13, 3, chaos=True)
+        finally:
+            chaos_mod.disable()

@@ -574,6 +574,47 @@ class TestBanElimBurstParity:
         assert len(placed) == 7
 
 
+#: blanket injection rates for the under-fire parity variants — every seam
+#: of the round-13 contract (device, commit_wave, fanout, native, watch).
+#: Rates are high enough that a single fuzz trial fires several seams; the
+#: oracle world always runs clean (it IS the referee).
+CHAOS_FUZZ_RATES = {
+    "device.dispatch": 0.2, "device.fetch": 0.2,
+    "store.commit_wave": 0.15, "store.commit_wave.ambiguous": 0.1,
+    "store.fanout": 0.15, "native.commitcore": 0.1,
+    "native.heapcore": 0.1, "watch.drop": 0.1,
+}
+
+
+def set_world_chaos(chaos, seed: int, use_tpu: bool) -> None:
+    """Install the injection plan for the TPU world of a differential
+    fuzz; the oracle world (and chaos=False) disables the plane. `chaos`
+    is False, True (blanket CHAOS_FUZZ_RATES), or a rates dict targeting
+    one or a few seams (the per-seam smoke).
+
+    store.commit_wave is always capped BELOW the scheduler's 4-attempt
+    commit retry budget: a wave whose EVERY retry fails must re-queue its
+    pods with backoff — correctness holds but bit-parity with the
+    never-faulted oracle cannot, so the parity harness makes exhaustion
+    structurally impossible rather than probabilistically rare."""
+    from kubernetes_tpu import chaos as chaos_mod
+    if chaos and use_tpu:
+        rates = CHAOS_FUZZ_RATES if chaos is True else dict(chaos)
+        chaos_mod.plan(seed=seed, rates=rates,
+                       limits={"store.commit_wave": 3})
+    else:
+        chaos_mod.disable()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_teardown():
+    """A fuzz trial that dies mid-TPU-world must not leak its injection
+    plan into the next test (the plane is process-global)."""
+    yield
+    from kubernetes_tpu import chaos as chaos_mod
+    chaos_mod.disable()
+
+
 @pytest.fixture
 def flight_replay():
     """Round-12 fuzz harness: record every TPU burst in replay mode so a
@@ -621,7 +662,8 @@ class TestMixedWorkloadShellFuzz:
     # must stay bit-identical with and without the pipeline
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
-    def test_bindings_identical(self, seed, wave_size, flight_replay):
+    def test_bindings_identical(self, seed, wave_size, flight_replay,
+                                chaos=False):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -689,6 +731,7 @@ class TestMixedWorkloadShellFuzz:
         rng_state = rng.getstate()
         bindings = []
         for use_tpu in (True, False):
+            set_world_chaos(chaos, seed, use_tpu)
             rng.setstate(rng_state)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
@@ -714,6 +757,14 @@ class TestMixedWorkloadShellFuzz:
             flight_replay, f"mixed-{seed}-{wave_size}", not diff,
             f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}")
 
+    def test_bindings_identical_under_injection(self, flight_replay):
+        """Round-13 acceptance: the same differential fuzz stays
+        bit-identical with the fault plane injecting at every seam in the
+        TPU world (device faults degrade bursts to the serial path, store
+        faults retry under the wave token, native cores demote, watches
+        drop and resync) — a fault costs throughput, never a decision."""
+        self.test_bindings_identical(23, 4, flight_replay, chaos=True)
+
 
 class TestPreemptionPressureShellFuzz:
     """Capacity-starved clusters with mixed priorities: pods fail, preempt
@@ -728,7 +779,7 @@ class TestPreemptionPressureShellFuzz:
     @pytest.mark.parametrize("wave_size", [None, 3])
     @pytest.mark.parametrize("seed", [3, 5, 17, 7, 29])
     def test_preemptive_convergence_identical(self, seed, wave_size,
-                                              flight_replay):
+                                              flight_replay, chaos=False):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -752,6 +803,7 @@ class TestPreemptionPressureShellFuzz:
         rng_state = rng.getstate()
         outs = []
         for use_tpu in (True, False):
+            set_world_chaos(chaos, seed, use_tpu)
             rng.setstate(rng_state)
             clock = FakeClock(100.0)
             s = build()
@@ -787,6 +839,14 @@ class TestPreemptionPressureShellFuzz:
         finish_with_flight(flight_replay, f"pressure-{seed}-{wave_size}",
                            outs[0] == outs[1],
                            f"seed={seed}: {outs[0]} != {outs[1]}")
+
+    def test_preemptive_convergence_under_injection(self, flight_replay):
+        """Round-13 acceptance: preemption pressure (device victim scans,
+        pressure batches, nominate/evict/backoff rounds) stays
+        bit-identical under the fault plane — a faulted scan falls back to
+        the oracle Preemptor, a refused pressure wave reruns serially."""
+        self.test_preemptive_convergence_identical(17, 3, flight_replay,
+                                                   chaos=True)
 
     # mid-burst churn: a bound pod is DELETED and a fresh pod created
     # between pressure scans — the round-9 persistent victim table must
@@ -933,7 +993,8 @@ class TestSpreadBurstParity:
 
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [13, 37, 71])
-    def test_burst_matches_oracle_with_existing_pods(self, seed, wave_size):
+    def test_burst_matches_oracle_with_existing_pods(self, seed, wave_size,
+                                                     chaos=False):
         """The vectorized spread encode counts pre-existing pods through
         the columnar table: some existing pods match the Service selector
         (non-zero spread0 carried into the burst), some differ only in
@@ -976,6 +1037,7 @@ class TestSpreadBurstParity:
         rng_state = rng.getstate()
         outs = []
         for use_tpu in (True, False):
+            set_world_chaos(chaos, seed, use_tpu)
             rng.setstate(rng_state)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
@@ -999,6 +1061,12 @@ class TestSpreadBurstParity:
             outs.append(sorted((p.key, p.node_name)
                                for p in s.list(PODS)[0]))
         assert outs[0] == outs[1]
+
+    def test_spread_under_injection(self):
+        """Round-13 acceptance: the carried-spread scan path (rotation
+        orders, spread0, the generic packed block) stays bit-identical
+        with the fault plane firing in the TPU world."""
+        self.test_burst_matches_oracle_with_existing_pods(37, 4, chaos=True)
 
 
 class TestMidBurstPreemptionConsistency:
