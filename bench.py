@@ -229,6 +229,196 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
     return result
 
 
+def run_churn_bench(n_nodes: int, n_pods: int, burst: int,
+                    churn_seed: int = 42, kill_every: int = 2,
+                    rounds: int = 10) -> dict:
+    """`--mode churn`: steady bursts under a node kill/restore schedule
+    (the round-14 robustness lane). Every `kill_every`-th round one node
+    is DELETED mid-burst through the node.dead seam (the launch-refusal
+    contract replans in-flight decision blocks) and one node flips
+    NotReady (its pods ride the zone-paced NoExecute eviction queue
+    through the PDB-guarded verb); both return two rounds later. PodGC
+    force-deletes pods stranded on deleted nodes (NodeLost) and the
+    bench's workload controller recreates everything lost, so the lane
+    measures DEGRADED pods/s with the full churn plane active. The JSON
+    reports evictions paced per zone, stale-launch refusals, NodeLost
+    recreates, and the end-state audit (every surviving pod bound)."""
+    import random
+    from kubernetes_tpu import chaos as chaos_mod
+    from kubernetes_tpu.api.types import Container, NodeCondition, Pod
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController)
+    from kubernetes_tpu.controllers.podgc import PodGCController
+    from kubernetes_tpu.store.store import (
+        Store, EVICTIONS, NODES, PODS, NotFoundError)
+    from kubernetes_tpu.scheduler import Scheduler, STALE_BINDS
+
+    MI = 1024 ** 2
+    rng = random.Random(churn_seed)
+    store = Store(watch_log_size=max(65536, 4 * (n_nodes + n_pods)))
+    build_cluster(store, n_nodes)
+    node_spec = {n.name: n.clone() for n in store.list(NODES)[0]}
+    sched = Scheduler(store, use_tpu=True,
+                      percentage_of_nodes_to_score=100)
+    sched.sync()
+    # eviction pacing fast enough to SEE in a seconds-long bench window,
+    # still visibly paced (not unbounded): 50 evictions/s/zone, burst 8
+    nlc = NodeLifecycleController(store, eviction_rate=50.0,
+                                  eviction_burst=8.0)
+    gc = PodGCController(store)
+    nlc.sync()
+    gc.sync()
+
+    # warmup: jit compiles outside the timed window
+    make_pods(store, min(64, n_pods), start=10_000_000)
+    sched.pump()
+    while sched.schedule_burst(max_pods=burst):
+        pass
+    sched.pump()
+
+    pending_kill: list = []
+
+    def hook(point):
+        if pending_kill:
+            victim = pending_kill.pop()
+            try:
+                store.delete(NODES, victim)
+            except NotFoundError:
+                pass
+    chaos_mod.plan(seed=churn_seed, rates={"node.dead": 1.0})
+    chaos_mod.set_node_hook(hook)
+
+    stale0 = STALE_BINDS.value
+    evict0 = {tuple(k): c.value
+              for k, c in EVICTIONS._children.items()}
+    dead: list = []          # (round_killed, name)
+    not_ready: list = []     # (round_flipped, name)
+    killed = restored = recreated = 0
+    rec_seq = 0
+    per_round = max(1, n_pods // rounds)
+    bound_total = 0
+    t0 = time.perf_counter()
+    for rnd in range(rounds):
+        # restore: deleted nodes return (fresh object, same name) and
+        # NotReady nodes heal after two rounds
+        while dead and dead[0][0] <= rnd - 2:
+            _r, name = dead.pop(0)
+            store.create(NODES, node_spec[name].clone())
+            restored += 1
+        while not_ready and not_ready[0][0] <= rnd - 2:
+            _r, name = not_ready.pop(0)
+
+            def heal(n):
+                n.conditions = (NodeCondition(type="Ready", status="True"),)
+                return n
+            try:
+                store.guaranteed_update(NODES, name, heal)
+            except NotFoundError:
+                pass
+        if rnd % kill_every == 0:
+            live = sorted(n.name for n in store.list(NODES)[0]
+                          if not any(c.status != "True"
+                                     for c in n.conditions))
+            if len(live) > 2:
+                victim = rng.choice(live)
+                pending_kill.append(victim)   # dies MID-BURST via the seam
+                dead.append((rnd, victim))
+                killed += 1
+                flip = rng.choice([n for n in live if n != victim])
+
+                def sicken(n):
+                    n.conditions = (NodeCondition(type="Ready",
+                                                  status="False"),)
+                    return n
+                try:
+                    store.guaranteed_update(NODES, flip, sicken)
+                    not_ready.append((rnd, flip))
+                except NotFoundError:
+                    pass
+        make_pods(store, per_round, start=rnd * per_round)
+        sched.pump()
+        while True:
+            n = sched.schedule_burst(max_pods=burst)
+            if n == 0:
+                break
+            bound_total += n
+            sched.pump()
+        if pending_kill:          # idle round: apply at the boundary
+            hook("boundary")
+        # lifecycle plane: health grading + taints + paced evictions,
+        # then PodGC sweeps pods stranded on deleted nodes
+        before_ct = store.count(PODS)
+        nlc.pump()
+        gc.pump()
+        destroyed = before_ct - store.count(PODS)
+        # the workload controller recreates what churn destroyed
+        # (taint-manager evictions + NodeLost force-deletes)
+        for _i in range(max(0, destroyed)):
+            store.create(PODS, Pod(
+                name=f"pod-r{rec_seq}", labels={"app": "density"},
+                containers=(Container.make(
+                    name="c",
+                    requests={"cpu": 100, "memory": 500 * MI}),)))
+            rec_seq += 1
+            recreated += 1
+        sched.pump()
+    elapsed = time.perf_counter() - t0
+    chaos_mod.disable()
+    # convergence drain: heal everything, reschedule whatever churn threw
+    # back into the queue (real-clock backoffs expire in wall time)
+    while dead:
+        _r, name = dead.pop(0)
+        store.create(NODES, node_spec[name].clone())
+        restored += 1
+    while not_ready:
+        _r, name = not_ready.pop(0)
+
+        def heal(n):
+            n.conditions = (NodeCondition(type="Ready", status="True"),)
+            return n
+        try:
+            store.guaranteed_update(NODES, name, heal)
+        except NotFoundError:
+            pass
+    deadline = time.perf_counter() + 60
+    while time.perf_counter() < deadline:
+        sched.pump()
+        nlc.pump()
+        gc.pump()
+        n = sched.schedule_burst(max_pods=burst)
+        bound_total += n
+        pending_now = [p for p in store.list(PODS)[0] if not p.node_name]
+        if not pending_now and n == 0:
+            break
+        time.sleep(0.05)
+    unbound = sum(1 for p in store.list(PODS)[0] if not p.node_name)
+    evict_by_reason = {
+        k[0]: c.value - evict0.get(tuple(k), 0.0)
+        for k, c in EVICTIONS._children.items()
+        if c.value - evict0.get(tuple(k), 0.0) > 0}
+    zones = nlc.debug_state()["zones"]
+    return {
+        "metric": f"churn_throughput_{n_nodes}n_{n_pods}p",
+        "value": round(bound_total / elapsed if elapsed > 0 else 0.0, 1),
+        "unit": "pods/s",
+        "baseline_note": "degraded pods/s: binds (incl. churn-recreated "
+                         "pods) over the kill/restore window",
+        "rounds": rounds,
+        "nodes_killed": killed,
+        "nodes_restored": restored,
+        "pods_recreated": recreated,
+        "stale_launch_refusals": int(STALE_BINDS.value - stale0),
+        "evictions_by_reason": evict_by_reason,
+        "evictions_per_zone": {z: v["evicted"] for z, v in zones.items()
+                               if v["evicted"]},
+        "zone_pacing": {z: {"state": v["state"], "rate": v["rate"],
+                            "tokens": v["tokens"]}
+                        for z, v in zones.items()},
+        "audit_all_bound": unbound == 0,
+        "pods_unbound_final": unbound,
+    }
+
+
 def run_preempt_bench(n_nodes: int, n_victims: int,
                       n_preemptors: int = 128) -> dict:
     """BASELINE.md configs[3]: preemption victim scans over `n_victims`
@@ -495,7 +685,7 @@ def main():
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
-                             "gang", "commit", "chaos"],
+                             "gang", "commit", "chaos", "churn"],
                     default="burst")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
@@ -568,9 +758,11 @@ def main():
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     n_nodes = args.nodes if args.nodes is not None \
-        else (1000 if args.mode in ("preempt", "chaos") else 15000)
+        else (1000 if args.mode in ("preempt", "chaos")
+              else (300 if args.mode == "churn" else 15000))
     n_pods = args.pods if args.pods is not None \
-        else (5000 if args.mode == "chaos" else 10000)
+        else (5000 if args.mode == "chaos"
+              else (3000 if args.mode == "churn" else 10000))
     if args.mode == "preempt":
         result = retry_transient(
             lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors))
@@ -591,6 +783,15 @@ def main():
         # just the matrix lanes + ratio-to-plain, one JSON line (transient
         # isolation happens per lane inside run_matrix)
         finish(run_matrix_only(repeat=args.matrix_repeat))
+        return
+    if args.mode == "churn":
+        # the round-14 node-churn lane: kill/restore schedule + zone-paced
+        # evictions around steady bursts; smaller default cell than the
+        # headline (churn reruns ride the degraded paths)
+        churn_burst = args.burst if args.burst != 10000 else 512
+        result = retry_transient(lambda: run_churn_bench(
+            n_nodes, n_pods, churn_burst, churn_seed=args.chaos_seed))
+        finish(result)
         return
     if args.mode == "chaos":
         from kubernetes_tpu import chaos as chaos_mod

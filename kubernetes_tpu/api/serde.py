@@ -42,11 +42,10 @@ KIND_TYPES = {
     store_mod.CRONJOBS: T.CronJob,
 }
 
-# coordination.k8s.io/Lease (resourcelock) — registered so leader election
-# works over the remote transport too (leaselock semantics need the same
-# CAS surface whichever store a component holds)
-from kubernetes_tpu.utils.leader_election import Lease as _Lease  # noqa: E402
-KIND_TYPES[store_mod.LEASES] = _Lease
+# coordination.k8s.io/Lease — one kind serves the leader-election
+# resourcelock AND the node-heartbeat NodeLease, so leader election and
+# the node-lifecycle health monitor work over the remote transport too
+KIND_TYPES[store_mod.LEASES] = T.Lease
 
 # rbac.authorization.k8s.io policy objects: the store-backed authorizer
 # and the clusterrole-aggregation controller read these

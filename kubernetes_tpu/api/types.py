@@ -826,6 +826,36 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease, pruned: one record serves BOTH the
+    leader-election resourcelock (LeaderElectionRecord analog — `holder`,
+    transitions) and the node heartbeat (NodeLease, kubelet
+    nodelease.NewController): a node's kubelet renews `node-<name>` every
+    lease interval, and the node-lifecycle controller grades Ready→Unknown
+    from renew_time staleness instead of polling status fields."""
+    name: str
+    holder: str = ""
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_duration: float = 15.0
+    leader_transitions: int = 0
+    resource_version: int = 0
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def clone(self) -> "Lease":
+        return copy.copy(self)
+
+
+def node_lease_key(node_name: str) -> str:
+    """The per-node heartbeat Lease key (kube-node-lease namespace analog;
+    shared by the hollow kubelet's renewer and the health monitor)."""
+    return f"node-{node_name}"
+
+
+@dataclass
 class Endpoints:
     """Pruned v1.Endpoints — one subset: the ready backends of a Service.
     Addresses are (pod_key, node_name) pairs (no pod IPs exist in this

@@ -35,8 +35,8 @@ from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
 from kubernetes_tpu.store.store import (
-    Store, PODS, PODGROUPS, AlreadyExistsError, ConflictError, NotFoundError,
-    ExpiredError,
+    Store, PODS, PODGROUPS, AlreadyExistsError, ConflictError,
+    DisruptionBudgetError, NotFoundError, ExpiredError,
 )
 
 API_PREFIX = "/api/v1"
@@ -165,18 +165,22 @@ def make_handler(store: Store, admission: AdmissionChain,
             return self.headers.get("X-Remote-User")
 
         # -- helpers --------------------------------------------------------
-        def _send(self, code: int, payload, chunked: bool = False) -> None:
+        def _send(self, code: int, payload, chunked: bool = False,
+                  headers: dict | None = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _error(self, code: int, reason: str, message: str) -> None:
+        def _error(self, code: int, reason: str, message: str,
+                   headers: dict | None = None) -> None:
             self._send(code, {"kind": "Status", "status": "Failure",
                               "reason": reason, "message": message,
-                              "code": code})
+                              "code": code}, headers=headers)
 
         def _route(self):
             u = urlparse(self.path)
@@ -343,6 +347,37 @@ def make_handler(store: Store, admission: AdmissionChain,
                     self._error(404, "NotFound", key)
                     return
                 self._send(201, {"kind": "Status", "status": "Success"})
+                return
+            # eviction subresource: POST /api/v1/pods/{ns}/{name}/eviction
+            # — PDB-guarded delete (reference: registry/core/pod/rest/
+            # eviction.go). An exhausted budget answers 429 TooManyRequests
+            # with Retry-After; the caller backs off and retries, like the
+            # reference's EvictionsRetry contract.
+            if len(parts) == 6 and parts[2] == PODS \
+                    and parts[5] == "eviction":
+                key = f"{parts[3]}/{parts[4]}"
+                if not self._authorized(user, "create", PODS, key):
+                    return
+                # delete admission runs first (NodeRestriction: a kubelet
+                # may evict only pods bound to its own node)
+                try:
+                    admission.admit_delete(PODS, store.get(PODS, key),
+                                           store,
+                                           user=self._user_name(user))
+                    gone = store.evict_pod(key, reason="api")
+                except AdmissionError as e:
+                    self._error(422, "Invalid", str(e))
+                    return
+                except DisruptionBudgetError as e:
+                    self._error(
+                        429, "TooManyRequests", str(e),
+                        headers={"Retry-After":
+                                 str(int(e.retry_after))})
+                    return
+                except NotFoundError:
+                    self._error(404, "NotFound", key)
+                    return
+                self._send(201, serde.to_dict(gone))
                 return
             if len(parts) != 3 or parts[2] not in serde.KIND_TYPES:
                 self._error(404, "NotFound", path)

@@ -267,6 +267,14 @@ class SchedulerCache:
         with self._lock:
             return len(self._nodes)
 
+    def get_node(self, name: str) -> Optional[Node]:
+        """The cached Node object (None when absent or a placeholder) —
+        the dead-node invalidation path needs the real object so the
+        NodeTree removal lands in the right zone."""
+        with self._lock:
+            item = self._nodes.get(name)
+            return item.info.node if item is not None else None
+
     def node_generation(self, name: str) -> Optional[int]:
         """Current generation of one node's NodeInfo (None when absent);
         lets the TPU mirror sync after self-inflicted mutations."""

@@ -91,6 +91,11 @@ class NodeTree:
         """One full interleaved enumeration — the per-cycle node order."""
         return [self.next() for _ in range(self.num_nodes)]
 
+    def all_names(self) -> list[str]:
+        """Every member name WITHOUT advancing the enumeration cursor
+        (the node-death reconciliation sweep's view)."""
+        return [n for ns in self._tree.values() for n in ns]
+
     # -- rotation structure (device-burst support) ---------------------------
     # A full enumeration's order is determined entirely by the zone index it
     # starts from (cursors reset lazily at the first next() of each
@@ -144,15 +149,36 @@ class NodeTree:
         exhausted set). A discarded gang trial restores it so the rotation
         walk replays EXACTLY as if the gang was never attempted — the next
         cycle (gang retry or the singleton behind it) sees the same
-        interleaved order either way. Only valid across a window with no
-        membership changes (the single-threaded scheduling loop's case)."""
+        interleaved order either way. Exact across a window with no
+        membership changes (the single-threaded scheduling loop's case);
+        restore() additionally survives nodes/zones added or REMOVED in
+        between (mid-burst node death) by re-grounding the cursor state
+        in the current membership."""
         return (self._zone_index, dict(self._last_index),
-                set(self._exhausted))
+                set(self._exhausted), self.epoch)
 
     def restore(self, chk: tuple) -> None:
-        self._zone_index = chk[0]
-        self._last_index = dict(chk[1])
-        self._exhausted = set(chk[2])
+        zone_index, cursors, exhausted, epoch = chk
+        if epoch == self.epoch:
+            # membership unchanged: exact cursor replay (the gang/crash
+            # rewind contract)
+            self._zone_index = zone_index
+            self._last_index = dict(cursors)
+            self._exhausted = set(exhausted)
+            return
+        # nodes/zones were added or removed under the checkpoint (mid-burst
+        # node death): the recorded cursors describe lists that no longer
+        # exist, so exact replay is impossible — re-ground to the
+        # post-enumeration state (every zone exhausted, cursors at their
+        # ends) so the NEXT enumeration resets and walks the live
+        # membership exactly once. The zone index (the rotation cursor) is
+        # kept when still valid; a removal already reset it to 0 in both
+        # worlds (remove_node), so post-churn rotation stays aligned with
+        # a serial oracle that observed the same removal.
+        self._last_index = {z: len(self._tree[z]) for z in self._zones}
+        self._exhausted = set(self._zones)
+        z = max(len(self._zones), 1)
+        self._zone_index = zone_index if zone_index < z else 0
 
     def advance_enumerations(self, count: int) -> None:
         """Fast-forward the tree as if `count` more full enumerations ran.

@@ -32,6 +32,19 @@ at the exact point the real failure would surface):
 - ``sched.crash`` — a scheduler-crash seam for crash-restart tests: the
   consumer (tests) raises SchedulerCrash at a commit boundary and then
   exercises Scheduler.recover().
+- ``node.dead`` — node churn at the WORST moments: the pipeline calls
+  `node_dead_point(point)` at its churn-vulnerable crossings —
+  ``dispatch-fetch`` / ``fetch-commit`` around every burst launch's
+  packed fetch (a kill there is caught by the launch-level stale scan,
+  which refuses the launch WHOLE and replans post-churn), ``pre-bind``
+  inside the wave commit (caught by the per-wave stale filter:
+  requeue-with-backoff), and ``pre-cycle`` before a serial cycle's
+  decision (caught by the pre-decision reconciliation sweep). A firing
+  seam invokes the harness-registered node hook (`set_node_hook`), which
+  deletes a node from the store. Opt-in: blanket ``all=`` rates skip it
+  (it needs a hook and — unlike every other seam — legitimately changes
+  the post-churn world, so the churn parity harnesses drive the SAME
+  kill schedule through their serial-oracle referee).
 
 Configuration:
 - programmatic: ``chaos.plan(seed=42, rates={"device.fetch": 0.1})`` or
@@ -69,7 +82,12 @@ SEAMS = (
     "watch.drop",
     "clock.jump",
     "sched.crash",
+    "node.dead",
 )
+
+#: seams a blanket `all=<rate>` never seeds: they need explicit opt-in
+#: plumbing (a wrapped clock, a crash-driving harness, a node-kill hook)
+OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead")
 
 INJECTIONS = obs.counter(
     "chaos_injections_total",
@@ -139,6 +157,7 @@ _FAULT_FOR = {
     "watch.drop": InjectedFault,
     "clock.jump": InjectedFault,
     "sched.crash": SchedulerCrash,
+    "node.dead": InjectedFault,
 }
 
 
@@ -250,9 +269,8 @@ def _parse_spec(spec: str) -> ChaosPlan:
             raise ValueError(f"KTPU_CHAOS: unknown seam {k!r}")
     if all_rate is not None:
         for s in SEAMS:
-            # the clock/crash seams are opt-in only (they need a wrapped
-            # clock / a test harness); blanket rates skip them
-            if s in ("clock.jump", "sched.crash"):
+            # opt-in seams need dedicated plumbing; blanket rates skip them
+            if s in OPT_IN_SEAMS:
                 continue
             rates.setdefault(s, all_rate)
     return ChaosPlan(seed=seed, rates=rates, limit=limit, limits=limits)
@@ -290,7 +308,7 @@ def plan(seed: int = 0, rates: Optional[dict] = None, limit: int = 0,
     merged = dict(rates or {})
     if all_rate is not None:
         for s in SEAMS:
-            if s in ("clock.jump", "sched.crash"):
+            if s in OPT_IN_SEAMS:
                 continue
             merged.setdefault(s, all_rate)
     _ENV_LOADED = True          # programmatic plan overrides the env
@@ -300,10 +318,12 @@ def plan(seed: int = 0, rates: Optional[dict] = None, limit: int = 0,
 
 
 def disable() -> None:
-    """Remove the active plan (and suppress KTPU_CHAOS re-parsing)."""
-    global _PLAN, _ENV_LOADED
+    """Remove the active plan (and suppress KTPU_CHAOS re-parsing); the
+    node-death hook is cleared too — it is plan-scoped harness plumbing."""
+    global _PLAN, _ENV_LOADED, _NODE_HOOK
     _ENV_LOADED = True
     _PLAN = None
+    _NODE_HOOK = None
 
 
 def take(seam: str) -> bool:
@@ -324,6 +344,35 @@ def check(seam: str) -> None:
 def counts() -> dict[str, int]:
     p = active()
     return p.counts() if p is not None else {}
+
+
+# -- node.dead: churn at the worst moments -----------------------------------
+_NODE_HOOK = None
+
+
+def set_node_hook(fn) -> None:
+    """Install the node-death hook (None to clear): `fn(point)` is called
+    when the node.dead seam fires at a pipeline point ("dispatch-fetch"
+    or "fetch-commit") and performs the actual store deletion. The hook
+    owns victim choice and any pending-kill bookkeeping — the seam only
+    supplies deterministic timing."""
+    global _NODE_HOOK
+    _NODE_HOOK = fn
+
+
+def node_dead_point(point: str) -> None:
+    """Called by the pipeline at its node-churn-vulnerable moments
+    (dispatch-fetch / fetch-commit / pre-bind / pre-cycle). Inert (one
+    global read) without a hook AND a plan rating the seam — the hot
+    path cost matches every other seam."""
+    hook = _NODE_HOOK
+    if hook is None:
+        return
+    p = active()
+    if p is None or p.rates.get("node.dead", 0.0) <= 0.0:
+        return
+    if p.should("node.dead"):
+        hook(point)
 
 
 class ChaosClock:

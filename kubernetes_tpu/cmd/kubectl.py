@@ -232,44 +232,32 @@ def cmd_uncordon(args) -> int:
 
 
 def cmd_drain(args) -> int:
-    """Cordon + evict every pod on the node. Eviction consults matching
-    PodDisruptionBudgets' controller-reconciled disruptions_allowed (the
-    eviction subresource's check, reference pkg/registry/core/pod/rest/
-    eviction.go): a pod whose PDB is exhausted is refused and left running.
-    --disable-eviction deletes unconditionally (the reference flag that
-    bypasses the eviction API)."""
+    """Cordon + evict every pod on the node THROUGH the eviction
+    subresource (POST pods/{ns}/{name}/eviction): the server's atomic
+    PDB check refuses with 429 + Retry-After when a pod's disruption
+    budget is exhausted — the pod is left running and reported, exactly
+    the reference drain behavior. --disable-eviction deletes directly
+    (the reference flag that bypasses the eviction API)."""
     _patch_node(args.server, args.name, unschedulable=True)
     pods = _req(args.server, "GET", "/api/v1/pods").get("items", [])
-    budgets = []
-    if not getattr(args, "disable_eviction", False):
-        from kubernetes_tpu.api import serde
-        from kubernetes_tpu.store.store import PDBS
-        raw = _req(args.server, "GET", "/api/v1/poddisruptionbudgets")
-        budgets = [serde.from_dict(PDBS, d) for d in raw.get("items", [])]
-        # track this drain's own evictions against each budget so a burst
-        # of deletes can't overshoot before the disruption controller
-        # re-reconciles the status
-        allowed = {b.key: b.disruptions_allowed for b in budgets}
+    use_eviction = not getattr(args, "disable_eviction", False)
     refused = 0
     for p in pods:
         if p.get("node_name") != args.name:
             continue
         key = f"{p['namespace']}/{p['name']}"
-        if budgets:
-            labels = p.get("labels") or {}
-            blockers = [b for b in budgets
-                        if b.namespace == p.get("namespace", "default")
-                        and b.selector is not None
-                        and b.selector.matches(labels)]
-            if any(allowed[b.key] <= 0 for b in blockers):
-                print(f"error when evicting pod {key}: Cannot evict pod as "
-                      "it would violate the pod's disruption budget.",
+        if use_eviction:
+            out = _req(args.server, "POST",
+                       f"/api/v1/pods/{key}/eviction", {},
+                       return_codes=(429,))
+            if isinstance(out, tuple):   # (429, status): budget exhausted
+                print(f"error when evicting pod {key}: "
+                      f"{out[1].get('message', 'disruption budget')}",
                       file=sys.stderr)
                 refused += 1
                 continue
-            for b in blockers:
-                allowed[b.key] -= 1
-        _req(args.server, "DELETE", f"/api/v1/pods/{key}")
+        else:
+            _req(args.server, "DELETE", f"/api/v1/pods/{key}")
         print(f"pod/{key} evicted")
     print(f"node/{args.name} drained" + (f" ({refused} refused)" if refused else ""))
     return 1 if refused else 0

@@ -31,12 +31,12 @@ class PodGCController:
         self.informers.pump_all()
         return self.gc()
 
-    def _delete(self, pod, reason: str) -> bool:
+    def _delete(self, pod, reason: str, event: str = "PodGC") -> bool:
         try:
             self.store.delete(PODS, pod.key)
         except NotFoundError:
             return False
-        self.recorder.pod_event(pod, NORMAL, "PodGC",
+        self.recorder.pod_event(pod, NORMAL, event,
                                 f"{reason}: deleting pod {pod.key}")
         return True
 
@@ -52,10 +52,16 @@ class PodGCController:
                 terminated.sort(key=lambda p: p.creation_timestamp)
                 for p in terminated[:excess]:
                     deleted += self._delete(p, "terminated pods over threshold")
-        # gcOrphaned: bound to a vanished node
+        # gcOrphaned: bound to a vanished node — force-delete with a
+        # NodeLost audit record (the reference's node-lost eviction
+        # reason); the pod's controller recreates it, and the recreated
+        # pods sort by CREATION time in the scheduler's activeQ (pinned
+        # by tests/test_node_churn.py, mirroring the crash-recovery
+        # ordering contract)
         for p in pods:
             if p.node_name and p.node_name not in nodes:
-                deleted += self._delete(p, f"node {p.node_name} gone")
+                deleted += self._delete(
+                    p, f"node {p.node_name} gone", event="NodeLost")
         # gcUnscheduledTerminating
         for p in pods:
             if p.deleted and not p.node_name:

@@ -32,6 +32,7 @@ from kubernetes_tpu.ops.node_state import (
 )
 from kubernetes_tpu.ops import kernels as K
 from kubernetes_tpu import chaos, obs
+from kubernetes_tpu.core import StaleNodeRefusal
 from kubernetes_tpu.core.breaker import DeviceCircuitBreaker
 from kubernetes_tpu.obs import trace as obs_trace
 from kubernetes_tpu.obs import flight as obs_flight
@@ -203,6 +204,13 @@ class TPUScheduler:
         # calls observe encode/kernel/fetch phase durations
         # (scheduling_duration_seconds{operation}, metrics.go:67-169)
         self.metrics = None
+        # mid-burst node-death scan (the shell injects
+        # `(decided_hosts, all_names) -> dead set` against its store):
+        # when a node vanishes between dispatch and commit, the wave
+        # driver raises StaleNodeRefusal BEFORE any of the launch's
+        # decisions commit — the shell invalidates the node and replans
+        # post-churn
+        self.stale_scan = None
         self.encoder = NodeStateEncoder()
         # device-resident node matrix: full upload on rebuild, dirty-row
         # scatter otherwise (SURVEY §2.4 delta uploader)
@@ -588,8 +596,10 @@ class TPUScheduler:
                          fail_first=out["fail_first"],
                          general_bits=out["general_bits"])
         t_fetch = obs_trace.now()
+        chaos.node_dead_point("dispatch-fetch")
         chaos.check("device.fetch")
         h = jax.device_get(fetch)
+        chaos.node_dead_point("fetch-commit")
         self.breaker.record_success()
         DEVICE_DISPATCH.labels("cycle").inc()
         DEVICE_FETCHES.labels("cycle").inc()
@@ -1224,8 +1234,10 @@ class TPUScheduler:
                 if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
                     dispatch(inflight[0][0] + 1)  # keep one chunk in flight
                 ci, lo, chunk, fut, t_d = inflight.pop(0)
+                chaos.node_dead_point("dispatch-fetch")
                 chaos.check("device.fetch")
                 h = fut.result()  # ONE fetch per launch: selections + lni
+                chaos.node_dead_point("fetch-commit")
                 t_done = obs_trace.now()
                 DEVICE_FETCHES.labels("burst_uniform").inc()
                 DEVICE_FETCHED_BYTES.labels("burst_uniform").inc(h.nbytes)
@@ -1233,11 +1245,30 @@ class TPUScheduler:
                                    cat="device", args={"chunk": ci})
                 obs_flight.RECORDER.note_block(fl, h)
                 _t = _obs("fetch", _t)
-                lni_chunk_start = self.last_node_index
-                self.last_node_index += int(h[cap])
                 chunk_sel = h[:chunk].tolist()
                 bad = next((i for i, s in enumerate(chunk_sel) if s < 0),
                            chunk)
+                if commit is not None and self.stale_scan is not None:
+                    # mid-burst node death: none of THIS chunk's decisions
+                    # have committed and its lni advance is not yet
+                    # applied, so earlier (already-committed) chunks stand
+                    # and this chunk refuses whole — the shell invalidates
+                    # the dead rows and replans the remainder post-churn
+                    decided = [b.names[s] for s in chunk_sel[:bad]]
+                    dead = self.stale_scan(decided, b.names[:n])
+                    if dead:
+                        for item in inflight:
+                            item[3].cancel()
+                        inflight.clear()
+                        self.discard_burst_folds()
+                        obs_flight.RECORDER.note_outcome(fl, {
+                            "hosts": [b.names[s] for s in sel],
+                            "failed": False, "aborted": True})
+                        raise StaleNodeRefusal(
+                            dead,
+                            max(1, sum(1 for hn in decided if hn in dead)))
+                lni_chunk_start = self.last_node_index
+                self.last_node_index += int(h[cap])
                 # commit consumes the single fetched block wave-by-wave
                 for wlo in range(0, bad, W):
                     hi = min(wlo + W, bad)
@@ -1358,8 +1389,10 @@ class TPUScheduler:
                 spread0=spread0, rotation_pos=rotp)
             DEVICE_DISPATCH.labels("burst_scan").inc()
             _t = _obs("kernel", _t)
+            chaos.node_dead_point("dispatch-fetch")
             chaos.check("device.fetch")
             h = np.asarray(self._submit_fetch(outs["packed"]).result())
+            chaos.node_dead_point("fetch-commit")
         except _DEVICE_FAULTS as e:
             # the single dispatch+fetch happens BEFORE any commit or
             # counter update: refuse the whole burst — the shell reruns
@@ -1387,6 +1420,21 @@ class TPUScheduler:
         committed = bad
         aborted = False
         li_entry = self.last_index
+        if commit is not None and self.stale_scan is not None:
+            # mid-burst node death: a node from this launch's world is
+            # gone from the store. NOTHING has committed (single fetch
+            # precedes the first wave commit) and the walk counters are
+            # untouched — drop the folds and refuse the launch whole; the
+            # shell invalidates the dead rows and replans against the
+            # post-churn world
+            decided = [b.names[s] for s in sel_arr[:bad].tolist()]
+            dead = self.stale_scan(decided, b.names[:n])
+            if dead:
+                self.discard_burst_folds()
+                obs_flight.RECORDER.note_outcome(fl, {
+                    "hosts": [], "failed": False, "aborted": True})
+                raise StaleNodeRefusal(
+                    dead, max(1, sum(1 for hn in decided if hn in dead)))
         if commit is not None:
             committed = 0
             for wlo in range(0, bad, W):
@@ -1585,8 +1633,10 @@ class TPUScheduler:
                 rotation_pos=rotation_pos)
             DEVICE_DISPATCH.labels("burst_fused").inc()
             _t = _obs("kernel", _t)
+            chaos.node_dead_point("dispatch-fetch")
             chaos.check("device.fetch")
             h = np.asarray(self._submit_fetch(packed).result())
+            chaos.node_dead_point("fetch-commit")
         except _DEVICE_FAULTS as e:
             # the single dispatch+fetch happens BEFORE any counter update
             # or commit: refuse the window — the shell reruns every entry
@@ -2150,6 +2200,24 @@ class TPUScheduler:
         if self._dev_nodes is not None:
             DISCARDED_FOLDS.inc()
         self._dev_nodes = None
+
+    def invalidate_node(self, host: str) -> None:
+        """Mid-burst node death (the shell's _invalidate_dead_node): the
+        device-resident node matrix and victim table carry a row for a
+        node the store no longer has — drop both, and forget the
+        encoder's per-node generation entries for `host` so nothing
+        keyed to the dead row survives. The cache removal (which the
+        shell performs first) changed NodeTree membership, so the next
+        encode() sees a different node_order and rebuilds the mirror;
+        the victim table rebuilds from its generation cache on the next
+        scan. In-flight burst decisions past the detection point are
+        discarded by the driver's abort/rewind contract."""
+        self.discard_burst_folds()
+        self._dev_vic = None
+        self._dev_vic_key = None
+        enc = self.encoder
+        enc._generations.pop(host, None)
+        enc._vt_gens.pop(host, None)
 
     def recover_device(self, li: Optional[int] = None,
                        lni: Optional[int] = None) -> None:

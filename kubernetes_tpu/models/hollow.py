@@ -19,10 +19,21 @@ from kubernetes_tpu.api.types import (
     LabelSelector, IN,
     LABEL_ZONE_FAILURE_DOMAIN, LABEL_ZONE_REGION, LABEL_HOSTNAME, NO_SCHEDULE,
 )
+from kubernetes_tpu import obs
 from kubernetes_tpu.store.store import Store, NODES, PODS
 
 GI = 1024 ** 3
 MI = 1024 ** 2
+
+# node heartbeat observability (kubelet nodelease controller analog):
+# registered at import so /metrics exposes the family before the first
+# heartbeat — a fleet whose renewals stop is visible as a flat counter
+LEASE_RENEWS = obs.counter(
+    "node_lease_renew_total",
+    "Node heartbeat Lease renewals by outcome: renewed (CAS on the "
+    "existing record), created (first heartbeat), failed (the store "
+    "rejected the write — the node will grade Unknown after the "
+    "monitor grace period).", ("outcome",))
 
 # the scheduler_perf node shape (reference: scheduler_test.go:49-64)
 PERF_NODE_CPU = 4000
@@ -183,22 +194,29 @@ class HollowKubelet:
         round O(nodes x pods) in pod clones."""
         if self._stopped:
             return
-        from kubernetes_tpu.api.types import NodeCondition
-        from kubernetes_tpu.utils.leader_election import Lease
+        from kubernetes_tpu.api.types import Lease, NodeCondition, \
+            node_lease_key
         from kubernetes_tpu.store.store import LEASES, NotFoundError
         now = self.clock.now()
         self._run_pods(now, pods)
-        lease_key = f"node-{self.node_name}"
+        lease_key = node_lease_key(self.node_name)
         try:
             def renew(lease):
                 lease.holder = self.node_name
                 lease.renew_time = now
                 return lease
             self.store.guaranteed_update(LEASES, lease_key, renew)
+            LEASE_RENEWS.labels("renewed").inc()
         except NotFoundError:
-            self.store.create(LEASES, Lease(
-                name=lease_key, holder=self.node_name,
-                acquire_time=now, renew_time=now))
+            try:
+                self.store.create(LEASES, Lease(
+                    name=lease_key, holder=self.node_name,
+                    acquire_time=now, renew_time=now))
+                LEASE_RENEWS.labels("created").inc()
+            except Exception:   # lost a create race / store fault
+                LEASE_RENEWS.labels("failed").inc()
+        except Exception:       # transport/store fault: next tick retries
+            LEASE_RENEWS.labels("failed").inc()
 
         def set_ready(node):
             conds = [c for c in node.conditions if c.type != "Ready"]

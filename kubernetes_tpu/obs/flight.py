@@ -162,6 +162,10 @@ class FlightRecorder:
         t._zones = list(snap["zones"])
         t.num_nodes = sum(len(ns) for ns in t._tree.values())
         t._last_index = {z: 0 for z in t._zones}
+        # adopt the recorded epoch so restore() replays the cursors
+        # exactly (an epoch mismatch means membership churned under the
+        # checkpoint and restore re-grounds instead)
+        t.epoch = snap["chk"][3]
         t.restore(snap["chk"])
         return t
 

@@ -52,6 +52,11 @@ class GangTrial:
         lni = self.algorithm.last_node_index
         assumed: list = []
         hosts: list[str] = []
+        # exposed so the shell can roll a SUCCESSFUL trial back too (a
+        # node death detected between trial and commit re-trials the gang
+        # rather than binding a partial one)
+        self.last_assumed = assumed
+        self.last_chk = (tree_chk, li, lni)
         try:
             for pod in pods:
                 refresh_snapshot_fn()

@@ -483,30 +483,56 @@ def run_benchmark_cell(workload: str, nodes: int, existing: int,
 
 
 def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
-                    use_tpu: bool = True) -> dict:
+                    use_tpu: bool = True, node_churn: bool = False) -> dict:
     """e2e scalability density analog (test/e2e/scalability/density.go):
     pods created through the FULL cluster-in-a-process pipeline (apiserver
     admission -> scheduler -> hollow kubelets running them), reporting
     cluster-wide saturation throughput (SLO >= 8 pods/s, density.go:58) and
     pod startup latency percentiles against the <= 5s SLO
-    (density.go:56,987-992). Startup = create time -> observed Running."""
+    (density.go:56,987-992). Startup = create time -> observed Running.
+
+    `node_churn=True` is the round-14 soak ingredient (ROADMAP item 5's
+    "node drains + evictions" lane): one node is DELETED at half-load
+    while the scheduler is saturated — in-flight decisions referencing it
+    refuse stale and replan — and re-added shortly after; the SLOs must
+    hold through the churn and the report carries the refusal count."""
     import time as _t
     from kubernetes_tpu.cmd.cluster import Cluster
     from kubernetes_tpu.api.types import Pod, Container
     from kubernetes_tpu.models.hollow import MI
     from kubernetes_tpu.obs.ledger import LEDGER
+    from kubernetes_tpu.scheduler import STALE_BINDS
+    from kubernetes_tpu.store.store import NODES, NotFoundError
     LEDGER.reset()   # scope the decomposition to this density run
+    stale0 = STALE_BINDS.value
+    churn_report = None
     with Cluster(n_nodes=n_nodes, api_port=-1, use_tpu=use_tpu,
                  kubelet_interval=0.02) as cluster:
         created: dict[str, float] = {}
         started: dict[str, float] = {}
         t0 = _t.perf_counter()
+        victim = None
         for j in range(n_pods):
             p = Pod(name=f"density-{j}", labels={"app": "density"},
                     containers=(Container.make(
                         name="c", requests={"cpu": 100, "memory": 200 * MI}),))
             cluster.store.create(PODS, p)
             created[p.key] = _t.perf_counter()
+            if node_churn and j == n_pods // 2:
+                # node death at half-load, while the scheduler is mid-drain
+                nodes = sorted(n.name for n in cluster.store.list(NODES)[0])
+                victim = nodes[len(nodes) // 2]
+                victim_obj = cluster.store.get(NODES, victim)
+                try:
+                    cluster.store.delete(NODES, victim)
+                except NotFoundError:
+                    victim_obj = None
+        if node_churn and victim is not None and victim_obj is not None:
+            _t.sleep(0.2)   # let in-flight launches observe the death
+            restored = victim_obj.clone()
+            restored.resource_version = 0
+            cluster.store.create(NODES, restored)
+            churn_report = {"victim": victim, "restored": True}
 
         def all_running():
             pods, _rv = cluster.store.list(PODS)
@@ -535,4 +561,7 @@ def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
         "sched_startup_p50": led["startup_p50"],
         "sched_startup_p99": led["startup_p99"],
         "sched_phase_split": led["phase_split"],
+        "node_churn": (dict(churn_report,
+                            stale_refusals=int(STALE_BINDS.value - stale0))
+                       if churn_report is not None else None),
     }

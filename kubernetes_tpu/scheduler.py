@@ -28,6 +28,7 @@ from kubernetes_tpu.coscheduling.types import (
 )
 from kubernetes_tpu.store.record import EventRecorder, NORMAL, WARNING
 from kubernetes_tpu.cache.cache import SchedulerCache, Snapshot
+from kubernetes_tpu.core import StaleNodeRefusal
 from kubernetes_tpu.oracle.gang import GangTrial
 from kubernetes_tpu.oracle.generic_scheduler import (
     GenericScheduler, FitError, ScheduleResult, default_priority_configs,
@@ -62,6 +63,13 @@ GANG_WAIT = obs.histogram(
     "Seconds from PodGroup creation (or first scheduler sighting) to the "
     "gang's committed placement.",
     buckets=(0.1, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600))
+STALE_BINDS = obs.counter(
+    "stale_bind_requeues_total",
+    "Bind decisions refused because the target node vanished between "
+    "decision and commit (mid-burst node death): the pod is re-queued "
+    "with backoff in creation order, and the dead node's device-mirror "
+    "row, victim-table row, cache entry, and NodeTree slot are "
+    "invalidated eagerly (the informer's DELETED event confirms later).")
 COMMIT_RETRIES = obs.counter(
     "store_commit_retries_total",
     "commit_wave store-write retries by the scheduler's idempotent retry "
@@ -268,6 +276,13 @@ class Scheduler:
                 # host_priority, run on the oracle path)
                 collect_host_priority=False)
             self.algorithm.metrics = self.metrics   # encode/kernel/fetch phases
+            if hasattr(store, "contains"):
+                # mid-burst node-death detection: the wave drivers scan
+                # each launch's decisions against the store after the
+                # packed fetch and refuse the launch whole (StaleNodeRefusal
+                # -> _burst_segment invalidates + replans) when a node
+                # vanished under it
+                self.algorithm.stale_scan = self._stale_scan
             if priority_weights is not None:
                 from kubernetes_tpu.factory import tpu_kernel_weights
                 self.algorithm.weights = tpu_kernel_weights(priority_weights)
@@ -491,6 +506,21 @@ class Scheduler:
     def _process_one_traced(self, pod: Pod, cycle: int,
                             names: Optional[list[str]], start: float,
                             cycle_trace: Trace) -> bool:
+        # mid-stream node death, serial twin: the node.dead seam's
+        # pre-cycle crossing lands a kill HERE — before this cycle's
+        # decision — and the reconciliation sweep folds any store-side
+        # node deletion into the cache/tree/mirror immediately, so the
+        # decision (and a FitError's preemption scan) runs against the
+        # post-churn world exactly like a burst launch the stale scan
+        # refused. O(1) when nothing died.
+        chaos.node_dead_point("pre-cycle")
+        if self._reconcile_node_deaths() and names is not None:
+            # the enumeration the caller consumed (a refused burst's
+            # pre-drawn walk, or a burst tail's) describes a world that
+            # still contained the dead node: discard it and re-ground on
+            # a fresh post-churn enumeration, exactly what a serial loop
+            # that saw the death before this cycle would draw
+            names = None
         self._snapshot = self.cache.update_snapshot(self._snapshot)
         cycle_trace.step("snapshot updated")
         if names is None:
@@ -630,6 +660,16 @@ class Scheduler:
                 REASON_UNSCHEDULABLE if unschedulable else REASON_SCHEDULER_ERROR,
                 message)
 
+        # mid-cycle node death: the chaos seam may kill the target here,
+        # and the stale check refuses the bind exactly like a NotFound
+        # store write — forget + re-queue with backoff (the serial twin
+        # of _commit_burst's per-wave stale-host check)
+        chaos.node_dead_point("pre-bind")
+        if self._host_is_stale(host):
+            STALE_BINDS.inc()
+            self._invalidate_dead_node(host)
+            fail(False, f"{NODES}/{host} (node deleted before bind)")
+            return False
         st = self.framework.run_permit_plugins(ctx, assumed, host)
         if not st.is_success():
             fail(st.code == FW_UNSCHEDULABLE, st.message)
@@ -1034,6 +1074,30 @@ class Scheduler:
             hosts = self.algorithm.schedule_burst(
                 pods, self._snapshot.node_infos, names, bucket=bucket)
             if hosts is not None and all(h is not None for h in hosts):
+                dead = self._stale_scan(hosts, names)
+                if dead:
+                    # mid-burst node death during the gang trial: letting
+                    # _commit_burst's wave filter fail just the stale
+                    # members would bind a PARTIAL gang — rewind the trial
+                    # whole (nothing committed), invalidate the dead
+                    # nodes, and re-trial against the post-churn world
+                    STALE_BINDS.inc(max(1, sum(1 for h in hosts
+                                               if h in dead)))
+                    if has_gchk:
+                        self.algorithm.gang_rewind(chk)
+                    else:
+                        self.algorithm.last_index = chk[0]
+                        self.algorithm.last_node_index = chk[1]
+                        discard = getattr(self.algorithm,
+                                          "discard_burst_folds", None)
+                        if discard is not None:
+                            discard()
+                    tree.restore(tree_chk)
+                    self._crash_ctx = None
+                    for h in dead:
+                        self._invalidate_dead_node(h)
+                    return self._gang_segment(group_key, members,
+                                              bucket=bucket)
                 # crash bracket: the gang commits as ONE atomic window —
                 # before = the pre-gang checkpoint, after = the post-trial
                 # counters (a crash mid-commit recovers to whichever side
@@ -1088,6 +1152,16 @@ class Scheduler:
             if hosts is None:
                 self._reject_gang(group, pods, 0)
                 return 0
+            dead = self._stale_scan(hosts, list(self._snapshot.node_infos))
+            if dead:
+                # same contract as the device trial: never bind a partial
+                # gang across a node death — roll the trial's assumes back
+                # and re-trial post-churn
+                STALE_BINDS.inc(max(1, sum(1 for h in hosts if h in dead)))
+                trial.rollback(trial.last_assumed, *trial.last_chk)
+                for h in dead:
+                    self._invalidate_dead_node(h)
+                return self._gang_segment(group_key, members, bucket=bucket)
             committed = self._commit_burst(pods, hosts, cycles,
                                            assume=False)
         if committed < len(pods):
@@ -1220,6 +1294,8 @@ class Scheduler:
                 segments.append(([p for p, _c in members], True))
             else:
                 segments.append(([p for p, _c in e[1]], False))
+        li0 = getattr(self.algorithm, "last_index", None)
+        lni0 = getattr(self.algorithm, "last_node_index", None)
         res = self.algorithm.schedule_burst_fused(
             segments, self._snapshot.node_infos, names, bucket=bucket)
         if res is None:
@@ -1228,6 +1304,33 @@ class Scheduler:
             tree.restore(tree_chk)
             self._crash_ctx = None
             return self._run_entries_unfused(entries, bucket)
+        # mid-burst node death: a node deleted between this window's
+        # snapshot and now (the node.dead seam fires between dispatch and
+        # fetch, and between the fetch and the first wave commit) leaves
+        # the fetched block holding decisions for a node that no longer
+        # exists. NOTHING from the launch has committed yet, so the launch
+        # refuses WHOLE: walk counters and the rotation walk rewind to the
+        # pre-launch boundary, the dead node's cache entry, NodeTree slot,
+        # device-mirror row, and victim-table row are invalidated, and the
+        # same entries replan against the post-churn world — so the
+        # decision stream stays bit-identical to a serial oracle that
+        # observed the death before the same decisions (a fault costs
+        # throughput, never a decision). Deletions landing after this
+        # check are caught per-wave by _commit_burst's stale filter (the
+        # requeue-with-backoff safety net).
+        if li0 is not None:
+            decided = [h for seg in res["segments"]
+                       for h in (seg.get("hosts") or ())]
+            dead = self._stale_scan(decided, names)
+            if dead:
+                STALE_BINDS.inc(max(1, sum(1 for h in decided
+                                           if h in dead)))
+                self.algorithm.fused_rewind(li0, lni0)
+                tree.restore(tree_chk)   # exact: membership untouched yet
+                self._crash_ctx = None
+                for h in dead:
+                    self._invalidate_dead_node(h)
+                return self._fused_window(entries, bucket)
         bound = 0
         consumed = res["consumed"]
         aborted = False
@@ -1385,13 +1488,35 @@ class Scheduler:
                 return False
             return True
 
-        if getattr(self.algorithm, "supports_wave_commit", False):
-            hosts = self.algorithm.schedule_burst(
-                pods, self._snapshot.node_infos, names, bucket=bucket,
-                commit=commit_wave)
-        else:
-            hosts = self.algorithm.schedule_burst(
-                pods, self._snapshot.node_infos, names, bucket=bucket)
+        try:
+            if getattr(self.algorithm, "supports_wave_commit", False):
+                hosts = self.algorithm.schedule_burst(
+                    pods, self._snapshot.node_infos, names, bucket=bucket,
+                    commit=commit_wave)
+            else:
+                hosts = self.algorithm.schedule_burst(
+                    pods, self._snapshot.node_infos, names, bucket=bucket)
+        except StaleNodeRefusal as e:
+            # mid-burst node death (round 14): the launch's decision block
+            # references vanished nodes and was refused before any of its
+            # decisions committed (the driver reconciled the committed
+            # prefix — earlier chunks — and dropped its folds). Invalidate
+            # the dead nodes everywhere and replan the uncommitted
+            # remainder against the post-churn world: every surviving
+            # decision is made with the node gone, exactly like a serial
+            # loop that observed the death here.
+            STALE_BINDS.inc(e.n_stale)
+            done = progress["committed"]
+            if done == 0:
+                # the enumeration this segment consumed was never used
+                self.cache.node_tree.restore(tree_chk)
+            else:
+                self.cache.node_tree.advance_enumerations(done - 1)
+            self._crash_ctx = None
+            for h in e.dead:
+                self._invalidate_dead_node(h)
+            return progress["bound"] + self._burst_segment(
+                pods[done:], cycles[done:], bucket)
         if hosts is None:
             # the algorithm refused the whole burst (it can't reproduce the
             # serial walk for this cluster/workload; refusals happen before
@@ -1450,6 +1575,78 @@ class Scheduler:
                     bound += 1
         return bound
 
+    # -- mid-burst node-death tolerance ---------------------------------------
+    def _stale_scan(self, decided: list, names: list) -> set:
+        """The launch-level node-death scan (wave drivers + fused window
+        call it after the packed fetch, before the first commit): returns
+        the set of nodes from this launch's world that no longer exist in
+        the store. Decided hosts are probed individually (cheap, and the
+        production-critical case — never bind to a dead node); a death
+        whose rows received NO decisions still shifts rotation and
+        tie-breaking, so a node-count shrink triggers the full-name probe.
+        Stores without the O(1) count verb (remote) keep the decided-host
+        probe only."""
+        contains = getattr(self.store, "contains", None)
+        if contains is None:
+            return set()
+        dead = {h for h in set(decided) if not contains(NODES, h)}
+        if not dead:
+            count = getattr(self.store, "count", None)
+            if count is not None and count(NODES) < len(names):
+                dead = {h for h in names if not contains(NODES, h)}
+        return dead
+
+    def _host_is_stale(self, host: str) -> bool:
+        """True when the decision's target node no longer exists in the
+        store (deleted between the packed fetch and this commit). Stores
+        without the existence probe (no `contains`) skip the check — the
+        bind write itself then resolves the race."""
+        contains = getattr(self.store, "contains", None)
+        return contains is not None and not contains(NODES, host)
+
+    def _invalidate_dead_node(self, host: str) -> None:
+        """Eagerly invalidate every decision structure referencing a node
+        the store no longer has: the cache entry + NodeTree slot (the
+        informer's DELETED event confirms later — both removals are
+        idempotent) and the algorithm's device-mirror/victim-table rows.
+        Runs in BOTH worlds (the oracle shell shares this path), so
+        post-churn decision streams stay bit-identical: every subsequent
+        cycle sees the node gone, whichever path detected it."""
+        info = self._snapshot.node_infos.get(host)
+        node = info.node if info is not None else None
+        if node is None:
+            # the snapshot can lag the cache (pre-cycle reconciliation
+            # runs before the refresh) — the cache's object carries the
+            # zone labels the NodeTree removal needs
+            node = self.cache.get_node(host)
+        if node is not None:
+            self.cache.remove_node(node)
+        inv = getattr(self.algorithm, "invalidate_node", None)
+        if inv is not None:
+            inv(host)
+
+    def _reconcile_node_deaths(self) -> bool:
+        """Serial twin of the launch-level stale scan: fold store-side
+        node deletions the informers haven't delivered yet into the
+        cache/tree/mirror before a serial cycle decides. O(1) (one store
+        count) when nothing died; the informer's DELETED event later
+        confirms — both removals are idempotent. Returns True when a
+        death was found (the caller re-grounds any pre-drawn
+        enumeration)."""
+        count = getattr(self.store, "count", None)
+        if count is None or not hasattr(self.store, "contains"):
+            return False
+        tree = self.cache.node_tree
+        if count(NODES) >= tree.num_nodes:
+            return False
+        contains = self.store.contains
+        found = False
+        for host in tree.all_names():
+            if not contains(NODES, host):
+                self._invalidate_dead_node(host)
+                found = True
+        return found
+
     def _commit_burst(self, pods: list[Pod], hosts: list[str],
                       cycles: list[int], assume: bool = True) -> int:
         """Commit a burst's decided prefix (or one pipelined wave of it):
@@ -1478,6 +1675,38 @@ class Scheduler:
         assert not (self.framework.reserve or self.framework.permit
                     or self.framework.prebind), \
             "burst commit reached with framework plugins configured"
+        # mid-burst node death (the round-14 tolerance contract): the
+        # chaos seam may kill a node right here — between the packed
+        # fetch and this wave's store write — and the stale-host check
+        # then fails EXACTLY the decisions targeting vanished nodes:
+        # those pods are never assumed, re-queue with backoff in creation
+        # order (wave order is creation order), and the dead node's
+        # mirror/victim/NodeTree rows invalidate eagerly. The short wave
+        # count makes the burst driver abort + rewind, so undecided
+        # successors reschedule against the post-churn world — the same
+        # state a serial loop's failed bind leaves behind.
+        chaos.node_dead_point("pre-bind")
+        contains = getattr(self.store, "contains", None)
+        if contains is not None:
+            stale_hosts = {h for h in set(hosts) if not contains(NODES, h)}
+            if stale_hosts:
+                for h in stale_hosts:
+                    self._invalidate_dead_node(h)
+                live: list[tuple[Pod, str, int]] = []
+                for pod, host, cycle in zip(pods, hosts, cycles):
+                    if host not in stale_hosts:
+                        live.append((pod, host, cycle))
+                        continue
+                    STALE_BINDS.inc()
+                    self.metrics.observe("error")
+                    self._record_failure(
+                        pod, cycle, REASON_SCHEDULER_ERROR,
+                        f"{NODES}/{host} (node deleted before bind)")
+                pods = [p for p, _h, _c in live]
+                hosts = [h for _p, h, _c in live]
+                cycles = [c for _p, _h, c in live]
+                if not pods:
+                    return 0
         eb = self._extender_binder
         if eb is not None and any(eb.is_interested(p) for p in pods):
             n_bound = 0

@@ -34,8 +34,9 @@ from typing import Any, Callable, Optional
 from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.store.store import (
-    Event, PODS, AlreadyExistsError, ConflictError, ExpiredError,
-    NotFoundError, nominated_node_mutator, pod_condition_mutator,
+    Event, PODS, AlreadyExistsError, ConflictError, DisruptionBudgetError,
+    ExpiredError, NotFoundError, nominated_node_mutator,
+    pod_condition_mutator,
 )
 
 # client-runtime metrics (rest_client_requests_total /
@@ -70,7 +71,8 @@ class APIStatusError(Exception):
         self.message = message
 
 
-def _raise_for(code: int, reason: str, message: str) -> None:
+def _raise_for(code: int, reason: str, message: str,
+               retry_after: Optional[str] = None) -> None:
     if code == 404:
         raise NotFoundError(message)
     if code == 409:
@@ -79,6 +81,14 @@ def _raise_for(code: int, reason: str, message: str) -> None:
         raise ConflictError(message)
     if code == 410:
         raise ExpiredError(message)
+    if code == 429:
+        # eviction subresource budget refusal: Retry-After carries the
+        # server's suggested backoff (same error type as the embedded verb)
+        try:
+            ra = float(retry_after) if retry_after else 10.0
+        except ValueError:
+            ra = 10.0
+        raise DisruptionBudgetError(message, retry_after=ra)
     raise APIStatusError(code, reason, message)
 
 
@@ -288,7 +298,8 @@ class RemoteStore:
         except urllib.error.HTTPError as e:
             b = _status_body(e)
             _raise_for(e.code, b.get("reason", ""),
-                       b.get("message", str(e)))
+                       b.get("message", str(e)),
+                       retry_after=e.headers.get("Retry-After"))
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  verb_class: str = "read") -> Any:
@@ -338,6 +349,26 @@ class RemoteStore:
     def delete(self, kind: str, key: str) -> Any:
         return serde.from_dict(kind, self._request(
             "DELETE", f"/api/v1/{kind}/{key}", verb_class="write"))
+
+    def contains(self, kind: str, key: str) -> bool:
+        """Existence probe (the stale-host check's verb): GET mapped to
+        bool. Rides the read retry policy."""
+        try:
+            self._request("GET", f"/api/v1/{kind}/{key}")
+            return True
+        except NotFoundError:
+            return False
+
+    def evict_pod(self, pod_key: str, reason: str = "api") -> Any:
+        """POST pods/{ns}/{name}/eviction — the PDB-guarded delete. An
+        exhausted budget surfaces as DisruptionBudgetError (429 +
+        Retry-After mapped by _raise_for). NOT idempotent (a retry whose
+        first attempt landed would double-charge the budget): no
+        auto-retry, matching create/delete."""
+        del reason   # the server books its own reason label for HTTP evicts
+        return serde.from_dict(PODS, self._request(
+            "POST", f"/api/v1/{PODS}/{pod_key}/eviction", {},
+            verb_class="write"))
 
     def bind_pod(self, pod_key: str, node_name: str) -> Any:
         """POST pods/{ns}/{name}/binding (factory.go:710), idempotent

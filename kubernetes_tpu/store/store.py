@@ -97,6 +97,14 @@ WAVE_DEDUP = obs.counter(
     "the retry returned the recorded result instead of double-landing "
     "binds or double-emitting events.")
 
+EVICTIONS = obs.counter(
+    "evictions_total",
+    "Pods evicted through the PDB-guarded eviction verb, by reason "
+    "(taint-manager = NoExecute taint eviction via the zone-paced "
+    "queue, drain = kubectl drain, api = the HTTP subresource). A "
+    "refused eviction (budget exhausted -> 429) does NOT count.",
+    ("reason",))
+
 #: retained dedupe tokens (one per wave; the retry window is one wave, so
 #: a small multiple of any realistic pipeline depth is plenty)
 WAVE_TOKEN_CAP = 1024
@@ -104,6 +112,17 @@ WAVE_TOKEN_CAP = 1024
 
 class ConflictError(Exception):
     """resourceVersion precondition failed (optimistic-concurrency loss)."""
+
+
+class DisruptionBudgetError(Exception):
+    """Eviction refused: a matching PodDisruptionBudget has no disruptions
+    left (the eviction subresource's 429 TooManyRequests — reference
+    pkg/registry/core/pod/rest/eviction.go). `retry_after` is the
+    suggested backoff seconds the server sends as Retry-After."""
+
+    def __init__(self, message: str, retry_after: float = 10.0):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class NotFoundError(Exception):
@@ -407,6 +426,21 @@ class Store:
         with self._lock:
             return self._core.rv()
 
+    def contains(self, kind: str, key: str) -> bool:
+        """Existence probe without the clone a get() pays — the burst
+        commit's stale-host check runs this once per unique host per
+        wave."""
+        with self._lock:
+            return key in self._objs.get(kind, {})
+
+    def count(self, kind: str) -> int:
+        """O(1) object count — the burst launch's stale scan compares it
+        against the enumeration length to catch a node death whose rows
+        received no decisions (the removal still shifts rotation and
+        tie-breaking, so the launch must be refused either way)."""
+        with self._lock:
+            return len(self._objs.get(kind, {}))
+
     # -- writes -------------------------------------------------------------
     # Every verb's per-object body lives in the commit core (shared by the
     # serial verbs and the burst wave): one snapshot serves the bucket, the
@@ -644,6 +678,40 @@ class Store:
         with self._lock:
             self._fanout_deferred = False
             self._flush()
+
+    def evict_pod(self, pod_key: str, reason: str = "api") -> Any:
+        """POST pods/{ns}/{name}/eviction analog (reference:
+        pkg/registry/core/pod/rest/eviction.go): delete the pod ONLY if
+        every matching PodDisruptionBudget has disruptions left, and
+        charge each matching budget's `disruptions_allowed` in the same
+        critical section — two evictors racing a budget of 1 see exactly
+        one success and one DisruptionBudgetError (the HTTP surface maps
+        it to 429 + Retry-After). The disruption controller's recompute
+        reconciles the charged status from pod state afterwards, exactly
+        like the reference's trySync."""
+        with self._lock:
+            pod = self._objs.get(PODS, {}).get(pod_key)
+            if pod is None:
+                raise NotFoundError(f"{PODS}/{pod_key}")
+            blockers = [
+                b for b in self._objs.get(PDBS, {}).values()
+                if b.namespace == pod.namespace and b.selector is not None
+                and b.selector.matches(pod.labels)]
+            exhausted = next(
+                (b for b in blockers if b.disruptions_allowed <= 0), None)
+            if exhausted is not None:
+                # the reference eviction handler's exact message wording
+                raise DisruptionBudgetError(
+                    f"Cannot evict pod as it would violate the pod's "
+                    f"disruption budget. ({exhausted.key} exhausted "
+                    f"for {pod_key})")
+            for b in blockers:
+                charged = _clone(b)
+                charged.disruptions_allowed -= 1
+                self.update(PDBS, charged)   # reentrant: emits MODIFIED
+            gone = self.delete(PODS, pod_key)
+        EVICTIONS.labels(reason).inc()
+        return gone
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,

@@ -12,30 +12,13 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from kubernetes_tpu.api.types import Lease
 from kubernetes_tpu.store.store import (
     Store, LEASES, NotFoundError, ConflictError, AlreadyExistsError,
 )
 from kubernetes_tpu.utils.clock import Clock, RealClock
 
-
-@dataclass
-class Lease:
-    """resourcelock LeaderElectionRecord analog."""
-    name: str
-    holder: str = ""
-    acquire_time: float = 0.0
-    renew_time: float = 0.0
-    lease_duration: float = 15.0
-    leader_transitions: int = 0
-    resource_version: int = 0
-
-    @property
-    def key(self) -> str:
-        return self.name
-
-    def clone(self) -> "Lease":
-        import copy
-        return copy.copy(self)
+__all__ = ["Lease", "LeaderElectionConfig", "LeaderElector"]
 
 
 @dataclass

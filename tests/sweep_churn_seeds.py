@@ -1,0 +1,90 @@
+"""Node-churn parity sweep: the 42-trial extra-seed run UNDER NODE DEATH.
+
+Not collected by pytest (no test_ prefix; the tier-1-speed variants are
+the three `*_under_node_churn` fuzzes): run by hand after any change to
+the stale-bind tolerance paths — the launch-level stale scan /
+StaleNodeRefusal replan, the per-wave stale filter, gang re-trials,
+NodeTree churn restore, or the mirror/victim-table invalidation —
+
+    JAX_PLATFORMS=cpu python tests/sweep_churn_seeds.py [trials] [base_seed]
+
+Each trial re-runs one long-range differential fuzz (mixed workload,
+preemption pressure, gang burst) with a fresh seed and a wave-boundary
+variant while nodes DIE on a seeded schedule: mid-burst through the
+node.dead seam in the TPU world (the kill lands between dispatch and
+fetch of the round's first launch, where the launch-refusal contract
+replans the in-flight block), and at the equivalent round boundary in
+the serial-oracle world. Bindings, nominations, and gang atomicity must
+stay bit-identical — a node death may cost a trial throughput, never a
+decision. Any divergence prints the failing (class, seed, wave_size)
+plus the trial's stale-refusal count so the exact churn schedule can be
+replayed.
+"""
+import random
+import sys
+from contextlib import contextmanager
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+@contextmanager
+def _flight_recorder():
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    try:
+        yield flight.RECORDER
+    finally:
+        flight.RECORDER.configure(mode="digest")
+        flight.RECORDER.clear()
+
+
+def _with_flight(fn, s, w):
+    with _flight_recorder() as rec:
+        fn(s, w, rec)
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    from kubernetes_tpu import chaos as chaos_mod
+    from kubernetes_tpu.scheduler import STALE_BINDS
+    from tests.test_tpu_parity import (TestMixedWorkloadShellFuzz,
+                                       TestPreemptionPressureShellFuzz)
+    from tests.test_coscheduling import TestGangBurstParity
+    rng = random.Random(base_seed)
+    classes = [
+        ("mixed", TestMixedWorkloadShellFuzz(),
+         lambda t, s, w: _with_flight(
+             t.test_bindings_identical_under_node_churn, s, w)),
+        ("pressure", TestPreemptionPressureShellFuzz(),
+         lambda t, s, w: _with_flight(
+             t.test_preemptive_convergence_under_node_churn, s, w)),
+        ("gang", TestGangBurstParity(),
+         lambda t, s, w: t.test_gang_parity_under_node_churn(s, w)),
+    ]
+    stale_start = STALE_BINDS.value
+    for trial in range(trials):
+        name, inst, fn = classes[trial % len(classes)]
+        seed = rng.randint(1, 10_000)
+        wave = rng.choice([None, 3, 4])
+        before = STALE_BINDS.value
+        try:
+            fn(inst, seed, wave)
+        except Exception:
+            print(f"FAIL class={name} seed={seed} wave_size={wave} "
+                  f"stale_refusals={STALE_BINDS.value - before}")
+            raise
+        finally:
+            chaos_mod.disable()
+        print(f"ok {trial + 1}/{trials} {name} seed={seed} wave={wave} "
+              f"stale_refusals={STALE_BINDS.value - before}")
+    total = STALE_BINDS.value - stale_start
+    assert total > 0, "the sweep never refused a stale launch"
+    print(f"sweep green: {trials} trials, "
+          f"{int(total)} in-flight decisions refused stale")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

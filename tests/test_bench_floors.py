@@ -220,3 +220,34 @@ def test_chaos_mode_floor():
     # degraded mode must still beat the serial-oracle floor
     assert out["vs_measured_oracle"] is not None
     assert out["vs_measured_oracle"] > 1.0, out
+
+
+@pytest.mark.slow
+def test_churn_mode_floor():
+    """`bench.py --mode churn` (the round-14 node-churn lane): steady
+    bursts while nodes die mid-burst (node.dead seam -> launch refusal)
+    and return, NotReady nodes feed the zone-paced NoExecute eviction
+    queue, and PodGC + the workload controller recycle what churn
+    destroys. The lane must actually churn (kills, stale refusals, paced
+    evictions all nonzero), converge (every surviving pod bound), and
+    hold a cliff-floor throughput (the default cell runs ~800+ pods/s
+    degraded on CPU; 100 is the collapse tripwire, not a variance one)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "churn"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"].startswith("churn_throughput_"), out
+    # the schedule actually churned, mid-burst
+    assert out["nodes_killed"] >= 3, out
+    assert out["nodes_restored"] == out["nodes_killed"], out
+    assert out["stale_launch_refusals"] >= 1, out
+    # evictions flowed through the PDB-guarded verb, paced per zone
+    assert sum(out["evictions_by_reason"].values()) >= 1, out
+    assert out["evictions_per_zone"], out
+    # ...and everything the churn destroyed was recycled and re-landed
+    assert out["pods_recreated"] >= 1, out
+    assert out["audit_all_bound"] is True, out
+    assert out["value"] >= 100.0, out

@@ -65,8 +65,10 @@ class TestPlanMechanics:
             "store.commit_wave", "store.commit_wave.ambiguous",
             "store.fanout", "native.commitcore", "native.heapcore",
             "remote.http", "watch.drop", "clock.jump", "sched.crash",
+            "node.dead",
         }
         assert set(chaos._FAULT_FOR) == set(chaos.SEAMS)
+        assert set(chaos.OPT_IN_SEAMS) <= set(chaos.SEAMS)
 
     def test_spec_grammar(self):
         p = chaos._parse_spec("seed=7 all=0.5,device.fetch=0.9 limit=3")
@@ -76,6 +78,7 @@ class TestPlanMechanics:
         # blanket rates skip the opt-in seams
         assert "clock.jump" not in p.rates
         assert "sched.crash" not in p.rates
+        assert "node.dead" not in p.rates
 
     def test_spec_rejects_unknown(self):
         with pytest.raises(ValueError):

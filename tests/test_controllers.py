@@ -224,8 +224,16 @@ class TestNodeLifecycle:
             NodeCondition, Toleration, TOLERATION_OP_EXISTS)
         from kubernetes_tpu.utils.clock import FakeClock
         store = self._store()
+        # a second healthy node keeps the zone out of FullDisruption (a
+        # fully-disrupted zone performs zero evictions by contract);
+        # eviction_rate=1.0 covers the second (tolerationSeconds) eviction
+        # within the test's 6s clock step
+        store.create(NODES, Node(
+            name="n1", allocatable={"cpu": 4000, "memory": 8 * GI,
+                                    "pods": 110},
+            conditions=(NodeCondition(type="Ready", status="True"),)))
         clock = FakeClock(1000.0)
-        c = NodeLifecycleController(store, clock=clock)
+        c = NodeLifecycleController(store, clock=clock, eviction_rate=1.0)
         tol_forever = Toleration(key=TAINT_UNREACHABLE,
                                  op=TOLERATION_OP_EXISTS, effect="NoExecute")
         tol_5s = Toleration(key=TAINT_UNREACHABLE, op=TOLERATION_OP_EXISTS,

@@ -688,3 +688,79 @@ class TestGangBurstParity:
             self.test_gang_parity(13, 3, chaos=True)
         finally:
             chaos_mod.disable()
+
+    # round-14: nodes DIE under gangs + preemption pressure — mid-burst
+    # through the node.dead seam in the TPU world (a gang trial that
+    # crossed the death re-trials WHOLE: never a partial gang), at the
+    # round boundary in the serial world; bindings, nominations, and the
+    # per-round atomicity audit must stay identical
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("seed", [5, 17, 31])
+    def test_gang_parity_under_node_churn(self, seed, wave_size):
+        from kubernetes_tpu import chaos as chaos_mod
+        from tests.test_tpu_parity import node_churn_driver
+        rng = random.Random(seed)
+        n_nodes = rng.randint(6, 12)
+        zones = rng.choice([2, 3])
+        cap = rng.choice([2000, 4000])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, mknode(f"n{i}", cpu=cap,
+                                       zone=f"z{i % zones}"))
+            return s
+
+        def make_workload(s, wave: int):
+            n_groups = rng.randint(1, 2)
+            for g in range(n_groups):
+                size = rng.randint(2, 4)
+                gname = f"w{wave}g{g}"
+                s.create(PODGROUPS, PodGroup(name=gname, min_member=size))
+                for r in range(size):
+                    s.create(PODS, member(
+                        f"{gname}r{r}", gname,
+                        cpu=rng.choice([100, 300, 500])))
+            for j in range(rng.randint(2, 6)):
+                s.create(PODS, singleton(
+                    f"w{wave}s{j}", cpu=rng.choice([200, 400, 800]),
+                    priority=rng.choice([0, 0, 0, 5, 9])))
+
+        kill_rounds = set(rng.sample(range(1, 6), 2))
+        rng_state = rng.getstate()
+        outs = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            clock = FakeClock(100.0)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
+                              percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
+                sched.fused_run_split = wave_size
+            sched.sync()
+            kill, flush = node_churn_driver(use_tpu, s, seed)
+            try:
+                for _round in range(25):
+                    if _round in kill_rounds:
+                        live = sorted(n.name for n in s.list(NODES)[0])
+                        if live:
+                            kill(rng.choice(live))
+                    if _round < 6:
+                        # arrivals every round keep gang trials in flight
+                        # when the kills land
+                        make_workload(s, _round)
+                    sched.pump()
+                    drain_burst(sched, max_pods=8)
+                    flush()
+                    sched.pump()
+                    assert_no_partial_gang(s)
+                    clock.step(2.0)
+            finally:
+                chaos_mod.disable()
+            outs.append(sorted(
+                (p.key, p.node_name, p.nominated_node_name)
+                for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1], (
+            f"seed={seed} wave={wave_size}: churn gang decisions diverged: "
+            f"{[a for a, b in zip(*outs) if a != b][:6]}")

@@ -115,6 +115,18 @@ class TestE2EDensity:
         assert r["saturated"]
         assert r["throughput_slo_8pps"], r
         assert r["startup_slo_5s"], r
+        assert r["node_churn"] is None   # off by default
+
+    def test_density_survives_node_churn(self):
+        """Round-14 soak ingredient: a node deleted at half-load (and
+        restored shortly after) must not cost saturation or the SLOs —
+        in-flight decisions referencing it refuse stale and replan."""
+        from kubernetes_tpu.perf.harness import run_e2e_density
+        r = run_e2e_density(n_nodes=10, n_pods=30, use_tpu=True,
+                            node_churn=True)
+        assert r["saturated"], r
+        assert r["throughput_slo_8pps"], r
+        assert r["node_churn"] is not None and r["node_churn"]["restored"]
 
 
 class TestTransientRetry:

@@ -606,6 +606,48 @@ def set_world_chaos(chaos, seed: int, use_tpu: bool) -> None:
         chaos_mod.disable()
 
 
+def node_churn_driver(use_tpu, store, seed):
+    """Per-world node-kill delivery for the churn fuzz variants. The TPU
+    world arms the node.dead seam, so the kill lands MID-BURST at the
+    round's first launch crossing — between dispatch and fetch — where
+    the launch-refusal contract (StaleNodeRefusal / the fused window's
+    stale scan) replans the in-flight block against the post-churn world.
+    The serial world deletes at the round boundary. The two are
+    equivalent precisely because a refused launch commits nothing decided
+    against the pre-churn world. Returns (kill, flush): call
+    kill(victim) when the schedule says a node dies this round, flush()
+    after the round's scheduling (a round with no launch crossing applies
+    the kill at the boundary, where neither world decided anything)."""
+    from kubernetes_tpu import chaos as chaos_mod
+    from kubernetes_tpu.store.store import NODES, NotFoundError
+    pending = []
+
+    def do_kill(victim):
+        try:
+            store.delete(NODES, victim)
+        except NotFoundError:
+            pass
+
+    def hook(point):
+        if pending:
+            do_kill(pending.pop())
+
+    if use_tpu:
+        chaos_mod.plan(seed=seed, rates={"node.dead": 1.0})
+        chaos_mod.set_node_hook(hook)
+
+    def kill(victim):
+        if use_tpu:
+            pending.append(victim)
+        else:
+            do_kill(victim)
+
+    def flush():
+        if pending:
+            do_kill(pending.pop())
+    return kill, flush
+
+
 @pytest.fixture(autouse=True)
 def _chaos_teardown():
     """A fuzz trial that dies mid-TPU-world must not leak its injection
@@ -765,6 +807,108 @@ class TestMixedWorkloadShellFuzz:
         drop and resync) — a fault costs throughput, never a decision."""
         self.test_bindings_identical(23, 4, flight_replay, chaos=True)
 
+    # round-14: nodes DIE on a seeded schedule while pods keep arriving —
+    # mid-burst through the node.dead seam in the TPU world, at the round
+    # boundary in the serial world (see node_churn_driver); bindings incl.
+    # pods stranded on dead nodes must stay bit-identical
+    @pytest.mark.parametrize("wave_size", [None, 4])
+    @pytest.mark.parametrize("seed", [13, 37, 53])
+    def test_bindings_identical_under_node_churn(self, seed, wave_size,
+                                                 flight_replay):
+        import random
+        from kubernetes_tpu import chaos as chaos_mod
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.utils.clock import FakeClock
+        from kubernetes_tpu.api.types import (
+            Taint, Toleration, ContainerPort, NO_SCHEDULE,
+            LABEL_ZONE_FAILURE_DOMAIN)
+        rng = random.Random(seed)
+        GI = 1024 ** 3
+        n_nodes = rng.randint(8, 16)
+        zones = rng.choice([2, 3])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                labels = {LABEL_HOSTNAME: f"n{i}",
+                          LABEL_ZONE_FAILURE_DOMAIN: f"z{i % zones}"}
+                if i % 3 == 0:
+                    labels["disk"] = "ssd"
+                taints = (Taint(key="ded", value="x", effect=NO_SCHEDULE),) \
+                    if i % 5 == 0 else ()
+                s.create(NODES, Node(
+                    name=f"n{i}", labels=labels, taints=taints,
+                    allocatable={"cpu": rng.choice([2000, 4000]),
+                                 "memory": 8 * GI, "pods": 110}))
+            return s
+
+        def make_pod(j):
+            cls = rng.choice(["plain", "plain", "selector", "tolerate",
+                              "port", "prio"])
+            kw = {"labels": {"app": cls}}
+            if cls == "selector":
+                kw["node_selector"] = {"disk": "ssd"}
+            elif cls == "tolerate":
+                kw["tolerations"] = (Toleration(
+                    key="ded", value="x", effect=NO_SCHEDULE),)
+            elif cls == "port":
+                ports = (ContainerPort(host_port=8080,
+                                       container_port=8080),)
+                kw["containers"] = (Container.make(
+                    name="c", requests={"cpu": 100}, ports=ports),)
+            elif cls == "prio":
+                kw["priority"] = rng.randint(1, 3)
+            if "containers" not in kw:
+                kw["containers"] = (Container.make(
+                    name="c", requests={"cpu": rng.choice([100, 300, 700]),
+                                        "memory": GI}),)
+            return Pod(name=f"p{j}", **kw)
+
+        kill_rounds = set(rng.sample(range(1, 6), 2))
+        rng_state = rng.getstate()
+        bindings = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            clock = FakeClock(100.0)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
+                              percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
+            sched.sync()
+            kill, flush = node_churn_driver(use_tpu, s, seed)
+            next_pod = 0
+            try:
+                for rnd in range(8):
+                    if rnd in kill_rounds:
+                        live = sorted(n.name for n in s.list(NODES)[0])
+                        kill(rng.choice(live))
+                    sched.pump()
+                    if rnd < 5:
+                        for _ in range(rng.randint(4, 8)):
+                            s.create(PODS, make_pod(next_pod))
+                            next_pod += 1
+                        sched.pump()
+                    if use_tpu:
+                        while sched.schedule_burst(max_pods=16):
+                            pass
+                    else:
+                        while sched.schedule_one(timeout=0.0):
+                            pass
+                    flush()
+                    sched.pump()
+                    clock.step(2.0)
+            finally:
+                chaos_mod.disable()
+            bindings.append({p.key: p.node_name for p in s.list(PODS)[0]})
+        diff = {k: (bindings[0].get(k), bindings[1].get(k))
+                for k in set(bindings[0]) | set(bindings[1])
+                if bindings[0].get(k) != bindings[1].get(k)}
+        finish_with_flight(
+            flight_replay, f"nodechurn-{seed}-{wave_size}", not diff,
+            f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}")
+
 
 class TestPreemptionPressureShellFuzz:
     """Capacity-starved clusters with mixed priorities: pods fail, preempt
@@ -847,6 +991,96 @@ class TestPreemptionPressureShellFuzz:
         the oracle Preemptor, a refused pressure wave reruns serially."""
         self.test_preemptive_convergence_identical(17, 3, flight_replay,
                                                    chaos=True)
+
+    # round-14: nodes DIE under preemption pressure — mid-burst via the
+    # node.dead seam in the TPU world (launch refusal + victim-table/
+    # mirror invalidation), at the round boundary in the serial world;
+    # bindings AND nominations (incl. pods stranded on or nominated to
+    # dead nodes) must stay bit-identical
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("seed", [7, 19, 43])
+    def test_preemptive_convergence_under_node_churn(self, seed, wave_size,
+                                                     flight_replay):
+        import random
+        from kubernetes_tpu import chaos as chaos_mod
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.utils.clock import FakeClock
+        rng = random.Random(seed)
+        GI = 1024 ** 3
+        n_nodes = rng.randint(4, 8)
+        cap = rng.choice([1000, 2000])
+
+        def build():
+            s = Store(watch_log_size=65536)
+            for i in range(n_nodes):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={LABEL_HOSTNAME: f"n{i}",
+                            "failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % 2}"},
+                    allocatable={"cpu": cap, "memory": 8 * GI, "pods": 110}))
+            return s
+
+        kill_rounds = set(rng.sample(range(2, 10), 2))
+        rng_state = rng.getstate()
+        outs = []
+        for use_tpu in (True, False):
+            rng.setstate(rng_state)
+            clock = FakeClock(100.0)
+            s = build()
+            sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
+                              percentage_of_nodes_to_score=100)
+            if use_tpu and wave_size:
+                sched.algorithm.wave_size = wave_size
+            sched.sync()
+            for j in range(rng.randint(10, 20)):
+                s.create(PODS, Pod(
+                    name=f"p{j}", labels={"app": "x"},
+                    priority=rng.choice([0, 0, 0, 5, 9]),
+                    containers=(Container.make(name="c", requests={
+                        "cpu": rng.choice([300, 500, 900])}),)))
+            kill, flush = node_churn_driver(use_tpu, s, seed)
+            idle = 0
+            try:
+                for _round in range(60):
+                    if _round in kill_rounds:
+                        live = sorted(n.name for n in s.list(NODES)[0])
+                        if live:
+                            kill(rng.choice(live))
+                        # fresh arrivals at the kill round keep the queue
+                        # non-empty, so the TPU world's kill lands
+                        # MID-BURST (at the round's first launch), not at
+                        # an idle boundary
+                        for _k in range(rng.randint(2, 4)):
+                            s.create(PODS, Pod(
+                                name=f"r{_round}k{_k}", labels={"app": "x"},
+                                priority=rng.choice([0, 0, 5, 9]),
+                                containers=(Container.make(
+                                    name="c", requests={"cpu": rng.choice(
+                                        [300, 500, 900])}),)))
+                    sched.pump()
+                    before = sched.metrics.schedule_attempts["scheduled"]
+                    if use_tpu:
+                        while sched.schedule_burst(max_pods=8):
+                            pass
+                    else:
+                        while sched.schedule_one(timeout=0.0):
+                            pass
+                    flush()
+                    sched.pump()
+                    idle = 0 if sched.metrics.schedule_attempts["scheduled"] \
+                        > before else idle + 1
+                    if idle >= 8 and _round >= max(kill_rounds):
+                        break
+                    clock.step(2.0)   # deterministic backoff expiry
+            finally:
+                chaos_mod.disable()
+            outs.append(sorted((p.key, p.node_name, p.nominated_node_name)
+                               for p in s.list(PODS)[0]))
+        finish_with_flight(flight_replay, f"pressure-churn-{seed}-{wave_size}",
+                           outs[0] == outs[1],
+                           f"seed={seed}: {outs[0]} != {outs[1]}")
 
     # mid-burst churn: a bound pod is DELETED and a fresh pod created
     # between pressure scans — the round-9 persistent victim table must
