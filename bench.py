@@ -62,9 +62,37 @@ def make_pods(store, n_pods: int, start: int = 0):
                 name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
 
 
-def _make_mesh():
+def _make_mesh(n_devices=None):
     from kubernetes_tpu.parallel import sharding as S
-    return S.make_mesh()
+    return S.make_mesh(n_devices)
+
+
+def _ici_total() -> float:
+    """Current sum of the analytic ICI all-gather counter across ops."""
+    from kubernetes_tpu.core.tpu_scheduler import ICI_ALLGATHER
+    return sum(c.value for c in ICI_ALLGATHER._children.values())
+
+
+def _pad_capacity(n: int) -> int:
+    cap = 8
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def attach_device_report(result: dict, mesh, n_nodes: int,
+                         ici0: float) -> dict:
+    """The round-15 multi-chip fields every mode's one-line JSON carries:
+    `devices` (mesh size; 1 off-mesh), `per_device_node_rows` (the node
+    matrix's padded rows per shard — the HBM scale axis), and
+    `ici_allgather_bytes` (the analytic cross-device traffic model booked
+    by the sharded kernels during the run; 0 off-mesh)."""
+    devices = int(mesh.devices.size) if mesh is not None else 1
+    result["devices"] = devices
+    result["per_device_node_rows"] = (
+        _pad_capacity(n_nodes) // devices if n_nodes else 0)
+    result["ici_allgather_bytes"] = int(_ici_total() - ici0)
+    return result
 
 
 def measure_oracle(n_nodes: int, n_pods: int) -> float:
@@ -231,7 +259,7 @@ def run_bench(n_nodes: int, n_pods: int, mode: str, burst: int,
 
 def run_churn_bench(n_nodes: int, n_pods: int, burst: int,
                     churn_seed: int = 42, kill_every: int = 2,
-                    rounds: int = 10) -> dict:
+                    rounds: int = 10, mesh=None) -> dict:
     """`--mode churn`: steady bursts under a node kill/restore schedule
     (the round-14 robustness lane). Every `kill_every`-th round one node
     is DELETED mid-burst through the node.dead seam (the launch-refusal
@@ -259,7 +287,7 @@ def run_churn_bench(n_nodes: int, n_pods: int, burst: int,
     build_cluster(store, n_nodes)
     node_spec = {n.name: n.clone() for n in store.list(NODES)[0]}
     sched = Scheduler(store, use_tpu=True,
-                      percentage_of_nodes_to_score=100)
+                      percentage_of_nodes_to_score=100, mesh=mesh)
     sched.sync()
     # eviction pacing fast enough to SEE in a seconds-long bench window,
     # still visibly paced (not unbounded): 50 evictions/s/zone, burst 8
@@ -420,7 +448,7 @@ def run_churn_bench(n_nodes: int, n_pods: int, burst: int,
 
 
 def run_preempt_bench(n_nodes: int, n_victims: int,
-                      n_preemptors: int = 128) -> dict:
+                      n_preemptors: int = 128, mesh=None) -> dict:
     """BASELINE.md configs[3]: preemption victim scans over `n_victims`
     lower-priority pods. A pressure wave of `n_preemptors` failed pods runs
     as ONE schedule-else-preempt launch on the device
@@ -435,7 +463,7 @@ def run_preempt_bench(n_nodes: int, n_victims: int,
     encode vs device-scan phase split, mirroring the matrix lanes.
     Decisions are asserted identical before timing is reported."""
     from kubernetes_tpu.perf.harness import run_preempt_cell
-    r = run_preempt_cell(n_nodes, n_victims, n_preemptors)
+    r = run_preempt_cell(n_nodes, n_victims, n_preemptors, mesh=mesh)
     return {
         "metric": f"preempt_scan_{n_nodes}n_{n_victims}victims",
         "value": r["scans_per_s"],
@@ -451,7 +479,7 @@ def run_preempt_bench(n_nodes: int, n_victims: int,
 
 
 def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
-                   gang_sizes: tuple = (8, 64, 512)) -> dict:
+                   gang_sizes: tuple = (8, 64, 512), mesh=None) -> dict:
     """`--mode gang`: all-or-nothing PodGroup throughput over the same
     cell as the headline bench. Gangs of 8/64/512 spec-identical members
     (the SPMD-rank shape) split `pods_budget` three ways; every group must
@@ -470,7 +498,8 @@ def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
     n_pods = sum(size for _, size in plan)
     store = Store(watch_log_size=max(65536, 4 * (n_nodes + n_pods)))
     build_cluster(store, n_nodes)
-    sched = Scheduler(store, use_tpu=True, percentage_of_nodes_to_score=100)
+    sched = Scheduler(store, use_tpu=True, percentage_of_nodes_to_score=100,
+                      mesh=mesh)
     sched.sync()
 
     def create_gangs(tag: str, the_plan) -> int:
@@ -716,6 +745,18 @@ def main():
     ap.add_argument("--mesh", action="store_true",
                     help="shard the node axis over every visible device "
                          "(1-device mesh on a single chip)")
+    # the round-15 multi-chip lane: mesh size for the headline run. Bare
+    # `--devices` (or 0) = every visible device; `--devices N` = the first
+    # N. Applies to every mode that dispatches device work (burst/serial/
+    # preempt/gang/chaos/churn); the JSON always reports `devices`,
+    # `per_device_node_rows`, and `ici_allgather_bytes`.
+    ap.add_argument("--devices", type=int, nargs="?", const=0, default=None,
+                    help="shard the node axis over a mesh of N devices "
+                         "(bare flag or 0 = all visible)")
+    ap.add_argument("--multichip-out", metavar="PATH", default=None,
+                    help="run __graft_entry__.dryrun_multichip(8) in a "
+                         "subprocess and write the MULTICHIP artifact "
+                         "JSON (n_devices/rc/ok/tail) to PATH, then exit")
     ap.add_argument("--no-mesh", dest="mesh_check", action="store_false",
                     help="skip the mesh-mode sub-benchmark")
     ap.add_argument("--no-matrix", dest="matrix", action="store_false",
@@ -732,7 +773,38 @@ def main():
                          "line — the soak scoreboard artifact")
     args = ap.parse_args()
 
+    if args.multichip_out:
+        import os
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        art = {"n_devices": 8, "rc": p.returncode, "ok": p.returncode == 0,
+               "skipped": False, "tail": (p.stderr + p.stdout)[-2000:]}
+        with open(args.multichip_out, "w") as f:
+            json.dump(art, f, indent=2)
+        print(json.dumps({"multichip_out": args.multichip_out,
+                          "ok": art["ok"]}))
+        if not art["ok"]:
+            sys.exit(1)
+        return
+
+    # one mesh decision for the whole run: --devices N (0/bare = all
+    # visible) or the legacy --mesh switch (all visible)
+    mesh = None
+    if args.devices is not None:
+        mesh = _make_mesh(args.devices if args.devices > 0 else None)
+    elif args.mesh:
+        mesh = _make_mesh()
+    ici0 = _ici_total()
+    report_nodes = [0]   # the node count the device report derives rows from
+
     def finish(result: dict) -> None:
+        attach_device_report(result, mesh, report_nodes[0], ici0)
         if args.metrics_out:
             from kubernetes_tpu import obs
             with open(args.metrics_out, "w") as f:
@@ -763,14 +835,16 @@ def main():
     n_pods = args.pods if args.pods is not None \
         else (5000 if args.mode == "chaos"
               else (3000 if args.mode == "churn" else 10000))
+    report_nodes[0] = n_nodes if args.mode != "commit" else 0
     if args.mode == "preempt":
         result = retry_transient(
-            lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors))
+            lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors,
+                                      mesh=mesh))
         finish(result)
         return
     if args.mode == "gang":
         result = retry_transient(
-            lambda: run_gang_bench(n_nodes, pods_budget=n_pods))
+            lambda: run_gang_bench(n_nodes, pods_budget=n_pods, mesh=mesh))
         finish(result)
         return
     if args.mode == "commit":
@@ -790,7 +864,8 @@ def main():
         # headline (churn reruns ride the degraded paths)
         churn_burst = args.burst if args.burst != 10000 else 512
         result = retry_transient(lambda: run_churn_bench(
-            n_nodes, n_pods, churn_burst, churn_seed=args.chaos_seed))
+            n_nodes, n_pods, churn_burst, churn_seed=args.chaos_seed,
+            mesh=mesh))
         finish(result)
         return
     if args.mode == "chaos":
@@ -805,12 +880,11 @@ def main():
         chaos_burst = args.burst if args.burst != 10000 else 512
         result = retry_transient(lambda: run_bench(
             n_nodes, n_pods, "burst", chaos_burst, compare=True,
-            chaos_rates=rates, chaos_seed=args.chaos_seed,
+            mesh=mesh, chaos_rates=rates, chaos_seed=args.chaos_seed,
             chaos_limit=args.chaos_limit))
         result["baseline_note"] = BASELINE_NOTE
         finish(result)
         return
-    mesh = _make_mesh() if args.mesh else None
     # each timed repeat individually survives a dropped tunnel response
     # (bounded retry on transient JaxRuntimeErrors only; real failures
     # still propagate — see perf.harness.retry_transient)
@@ -838,7 +912,7 @@ def main():
         result["oracle_pods_sampled"] = sample
         result["vs_measured_oracle"] = (
             round(result["value"] / oracle, 2) if oracle else None)
-    if args.mode == "burst" and not args.mesh and args.mesh_check:
+    if args.mode == "burst" and mesh is None and args.mesh_check:
         # the north-star multi-chip config on whatever devices exist: the
         # uniform kernel sharded over a mesh must NOT regress vs single-chip
         # (VERDICT r03 weak #1 — mesh mode used to silently cost 8x)

@@ -80,6 +80,18 @@ ORACLE_FALLBACKS = obs.counter(
     "tpu_oracle_fallback_total",
     "Decisions routed off the device path (host twin / serial rerun), "
     "by reason.", ("reason",))
+ICI_ALLGATHER = obs.counter(
+    "tpu_ici_allgather_bytes_total",
+    "Analytic model of the cross-device bytes the sharded kernels ship "
+    "per burst, by op: each scheduling cycle's ICI all-gather moves the "
+    "per-node feasibility bit, the i32 walk cumsum, and the i64 score "
+    "lane (~16B/node-row) to the d-1 peer shards; the replicated select "
+    "epilogue adds nothing per pod. Zero when the mesh is single-device "
+    "or absent. XLA does not expose actual collective bytes, so this is "
+    "the documented traffic model, not a NIC counter.", ("op",))
+# per-cycle cross-device payload of the sharded select epilogue (bytes per
+# node row): feasible bool (4 padded) + i32 rank/cumsum lane + i64 score
+ICI_BYTES_PER_ROW = 16
 PRESSURE_GATES = obs.counter(
     "tpu_pressure_gate_rejections_total",
     "preempt_pressure_burst refusals, by gate.", ("gate",))
@@ -112,6 +124,15 @@ _PHASE_SLOTS = {"encode": obs_ledger.ENCODE,
 # "victims-"; test_obs pins the set.
 VICTIM_GATE_REASONS = ("affinity-terms", "ports", "scalar", "term-match",
                        "overflow")
+
+# fallback/gate labels RETIRED in round 15: the sharded kernels now model
+# rotation, carried spread, gang segments, and pressure scans, so these
+# refusal paths were deleted outright. A dead label reading 0 forever would
+# mask a silent regression back to host scheduling — test_obs pins that no
+# live code path (and no eager registration) resurrects them.
+RETIRED_FALLBACK_REASONS = ("burst-sharded-rotation", "burst-sharded-spread",
+                            "fused-mesh-mode")
+RETIRED_PRESSURE_GATES = ("mesh-mode",)
 
 
 def _fetched_nbytes(obj) -> int:
@@ -199,7 +220,6 @@ class TPUScheduler:
                 mesh = S.make_mesh()
         self.mesh = mesh
         self._sharded_cycle = None
-        self._sharded_batch = None
         # optional SchedulerMetrics handle (the shell injects it): burst
         # calls observe encode/kernel/fetch phase durations
         # (scheduling_duration_seconds{operation}, metrics.go:67-169)
@@ -266,6 +286,19 @@ class TPUScheduler:
         if arr is None:
             arr = self._zero_scalars[n] = np.zeros(n, dtype=np.int64)
         return arr
+
+    def _note_ici(self, op: str, n_cycles: int, n_pad: int) -> None:
+        """Book the analytic ICI all-gather traffic of a sharded launch:
+        `n_cycles` scheduling cycles (for the uniform kernel, decisions —
+        an upper bound on O(N) passes), ICI_BYTES_PER_ROW per node row,
+        shipped to the d-1 peer shards. No-op off the mesh."""
+        if self.mesh is None:
+            return
+        d = int(self.mesh.devices.size)
+        if d <= 1:
+            return
+        ICI_ALLGATHER.labels(op).inc(
+            int(n_cycles) * int(n_pad) * ICI_BYTES_PER_ROW * (d - 1) // d)
 
     # -- device input assembly ----------------------------------------------
     _NODE_FIELDS = ("valid", "alloc_cpu", "alloc_mem", "alloc_eph",
@@ -1082,86 +1115,12 @@ class TPUScheduler:
             ORACLE_FALLBACKS.labels("burst-spread-shape").inc()
             return None
         z_pad = _pad_pow2(len(b.zone_names), 4)
-        if self.mesh is not None:
-            if rotation is not None or rotation_pos is not None:
-                # identity-only rotation (the zone cursor sits at a fixed
-                # point this burst) is just data — run sharded without the
-                # rotation machinery; real rotation still refuses (the
-                # sharded scan doesn't model it yet)
-                seq = (rotation[2] if rotation is not None
-                       else rotation_pos[1])
-                if np.asarray(seq[:len(pods)]).any():
-                    ORACLE_FALLBACKS.labels("burst-sharded-rotation").inc()
-                    return None
-                rotation = rotation_pos = None
-            if carry_spread:
-                # the sharded scan doesn't model this yet
-                ORACLE_FALLBACKS.labels("burst-sharded-spread").inc()
-                return None
-            # pad the burst to a power-of-two bucket so lax.scan compiles
-            # once per bucket instead of once per burst length
-            if len(per_pod) < bucket:
-                pad = dict(per_pod[-1])
-                pad["skip"] = self._true
-                per_pod.extend([pad] * (bucket - len(per_pod)))
-            stacked = self._stack_pods(per_pod)
-            _t = _obs("encode", _t0)
-            from kubernetes_tpu.parallel import sharding as S
-            if self._sharded_batch is None or self._sharded_batch[0] != z_pad:
-                self._sharded_batch = (z_pad, S.sharded_batch_fn(
-                    self.mesh, z_pad=z_pad, weights=self.weights))
-            try:
-                chaos.check("device.dispatch")
-                pods_sharded = S.shard_pod_batch(self.mesh, stacked)
-                state, li, lni, outs = self._sharded_batch[1](
-                    nodes, pods_sharded, K._i64(self.last_index),
-                    K._i64(self.last_node_index), K._i64(num_to_find),
-                    K._i64(n))
-                DEVICE_DISPATCH.labels("burst_scan").inc()
-                _t = _obs("kernel", _t)
-                chaos.check("device.fetch")
-                selected = np.asarray(outs["selected"])[: len(pods)]
-                li, lni = int(li), int(lni)
-            except _DEVICE_FAULTS as e:
-                # nothing committed / no counters mutated yet: refuse the
-                # burst (the shell's serial rerun re-derives identical
-                # decisions against the untouched host mirror)
-                self._device_fault(e)
-                self.discard_burst_folds()
-                ORACLE_FALLBACKS.labels("device-fault").inc()
-                return None
-            self.breaker.record_success()
-            DEVICE_FETCHES.labels("burst_scan").inc()
-            DEVICE_FETCHED_BYTES.labels("burst_scan").inc(selected.nbytes + 16)
-            _obs("fetch", _t)
-            if (selected < 0).any():
-                # burst contract: everything from the first failure on is
-                # returned undecided (None) and counters/folds rewind to the
-                # prefix — the shell commits the prefix and reruns the tail
-                # serially (a failed pod's serial rerun may preempt, which
-                # the post-failure kernel decisions never saw)
-                kf = int(np.argmax(selected < 0))
-                ev = np.asarray(outs["evaluated"])[:kf]
-                fo = np.asarray(outs["found"])[:kf]
-                self.last_index = int((self.last_index + ev.sum())
-                                      % max(n, 1))
-                self.last_node_index += int((fo > 1).sum())
-                # the device matrix holds folds from post-failure successes
-                # the serial tail may invalidate: drop it (the host mirror
-                # reflects exactly the committed prefix after
-                # note_burst_assumed)
-                self.discard_burst_folds()
-                return [b.names[s] if i < kf else None
-                        for i, s in enumerate(selected.tolist())]
-            # persist the folds: the device-resident matrix is authoritative
-            # for rows the scan mutated (the host mirror catches up via
-            # note_burst_assumed; external changes still arrive via dirty
-            # rows)
-            self._dev_nodes = {**self._dev_nodes, **state}
-            self.last_index = int(li)
-            self.last_node_index = int(lni)
-            return [b.names[s] if s >= 0 else None
-                    for s in selected.tolist()]
+        # mesh mode rides the SAME _scan_waves driver below: since round 15
+        # the generic scan kernel is one code path parameterized by the
+        # sharding spec (K.schedule_batch(mesh=...)), so rotation, carried
+        # spread counts, and the single-dispatch/single-fetch contract all
+        # run sharded — the old burst-sharded-rotation / burst-sharded-
+        # spread oracle fallbacks are deleted, not dodged.
         fl = obs_flight.RECORDER.begin("scan", self, [(pods, False)],
                                        all_node_names, node_infos)
         _t = _obs("encode", _t0)
@@ -1218,6 +1177,7 @@ class TPUScheduler:
                 self._dev_nodes, dict(cls), chunk, lni_dev, n,
                 self.check_resources, weights=self.weights, rotation=rot,
                 extra_ok=extra_ok, ban=ban, mesh=self.mesh, cap=cap)
+            self._note_ici("burst_uniform", chunk, b.n_pad)
             lni_dev = lni_out
             self._dev_nodes = {**self._dev_nodes, **rows}
             DEVICE_DISPATCH.labels("burst_uniform").inc()
@@ -1386,7 +1346,8 @@ class TPUScheduler:
                 self._dev_nodes, stacked, self.last_index,
                 self.last_node_index, num_to_find, n, z_pad,
                 weights=self.weights, rotation=rot,
-                spread0=spread0, rotation_pos=rotp)
+                spread0=spread0, rotation_pos=rotp, mesh=self.mesh)
+            self._note_ici("burst_scan", n_pods, b.n_pad)
             DEVICE_DISPATCH.labels("burst_scan").inc()
             _t = _obs("kernel", _t)
             chaos.node_dead_point("dispatch-fetch")
@@ -1538,10 +1499,6 @@ class TPUScheduler:
             # loop, where schedule() picks the host twin
             ORACLE_FALLBACKS.labels("circuit-open").inc()
             return None
-        if self.mesh is not None:
-            # the sharded scan models neither segments nor rotation
-            ORACLE_FALLBACKS.labels("fused-mesh-mode").inc()
-            return None
         if self.nominated is not None and self.nominated.has_any():
             ORACLE_FALLBACKS.labels("fused-nominated-ghosts").inc()
             return None
@@ -1630,7 +1587,8 @@ class TPUScheduler:
                 nodes, stacked, seg_start, gang, n_total, self.last_index,
                 self.last_node_index, num_to_find, n, z_pad,
                 weights=self.weights, rotation=rotation,
-                rotation_pos=rotation_pos)
+                rotation_pos=rotation_pos, mesh=self.mesh)
+            self._note_ici("burst_fused", n_total, b.n_pad)
             DEVICE_DISPATCH.labels("burst_fused").inc()
             _t = _obs("kernel", _t)
             chaos.node_dead_point("dispatch-fetch")
@@ -1844,7 +1802,9 @@ class TPUScheduler:
             chaos.check("device.fetch")
             out = np.asarray(K.preemption_scan(
                 nodes, vic, pod_in, feas, order_rank, b.n_real,
-                self.check_resources, f.has_request, pod.priority))
+                self.check_resources, f.has_request, pod.priority,
+                mesh=self.mesh))
+            self._note_ici("preempt_scan", 1, b.n_pad)
         except _DEVICE_FAULTS as e:
             # the scan reads resident state and mutates nothing: refuse —
             # the caller falls back to the oracle Preemptor, whose
@@ -1936,8 +1896,15 @@ class TPUScheduler:
         key = (vt.P, vt.valid.shape[0])
         if (self._dev_vic is None or self._dev_vic_key != key
                 or vt.dirty_rows is None):
-            self._dev_vic = {k: jnp.asarray(getattr(vt, f))
-                             for k, f in self._VIC_FIELDS}
+            host = {k: getattr(vt, f) for k, f in self._VIC_FIELDS}
+            if self.mesh is not None:
+                # the round-9 victim table under NamedSharding(mesh,
+                # P("nodes")): [N, P] slot planes split on the node axis,
+                # same residency/delta contract as the node matrix
+                from kubernetes_tpu.parallel import sharding as S
+                self._dev_vic = S.shard_victim_planes(self.mesh, host)
+            else:
+                self._dev_vic = {k: jnp.asarray(v) for k, v in host.items()}
             self._dev_vic_key = key
             DEVICE_DISPATCH.labels("vic_upload").inc()
             vt.dirty_rows = []
@@ -2005,9 +1972,6 @@ class TPUScheduler:
             # runs the tail instead — decisions identical
             PRESSURE_GATES.labels("circuit-open").inc()
             return None
-        if self.mesh is not None:
-            PRESSURE_GATES.labels("mesh-mode").inc()
-            return None
         if self.nominated is not None and self.nominated.has_any():
             PRESSURE_GATES.labels("nominated-ghosts").inc()
             return None
@@ -2069,11 +2033,18 @@ class TPUScheduler:
             n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
         mut0 = {k: nodes[k] for k in K._MUTABLE}
-        ghost0 = self._ghost_zeros.get(b.n_pad)
+        ghost_key = (b.n_pad, self.mesh)
+        ghost0 = self._ghost_zeros.get(ghost_key)
         if ghost0 is None:
-            ghost0 = self._ghost_zeros[b.n_pad] = {
-                k: jnp.zeros(b.n_pad, jnp.int64)
-                for k in ("cpu", "mem", "eph", "cnt")}
+            ghost0 = {k: jnp.zeros(b.n_pad, jnp.int64)
+                      for k in ("cpu", "mem", "eph", "cnt")}
+            if self.mesh is not None:
+                # ghost load lives on the node axis: split it like the rows
+                from kubernetes_tpu.parallel import sharding as S
+                ghost0 = {k: jax.device_put(v, S.node_sharding(self.mesh))
+                          if v.shape[0] % self.mesh.devices.size == 0 else v
+                          for k, v in ghost0.items()}
+            self._ghost_zeros[ghost_key] = ghost0
         li, lni = self.last_index, self.last_node_index
         # flight recorder: pressure waves are dump-only records (no oracle
         # replay harness) — the digest still pins inputs + outcomes
@@ -2097,7 +2068,8 @@ class TPUScheduler:
                 stacked = self._stack_pods(chunk)
                 mut0, ghost0, li, lni, outs = K.pressure_batch(
                     nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find,
-                    n, z_pad, weights=self.weights)
+                    n, z_pad, weights=self.weights, mesh=self.mesh)
+                self._note_ici("pressure_batch", len(chunk), b.n_pad)
                 DEVICE_DISPATCH.labels("pressure_batch").inc()
                 outs_chunks.append(outs)
             # ONE fetch for every chunk's outputs + the final counters
@@ -2264,6 +2236,8 @@ class TPUScheduler:
             "last_node_index": self.last_node_index,
             "victim_table": vic,
             "mesh": self.mesh is not None,
+            "devices": (1 if self.mesh is None
+                        else int(self.mesh.devices.size)),
             "serial_path": self.serial_path,
             "serial_lat_ms": {
                 "host_twin": (None if self._lat_ora is None

@@ -490,13 +490,18 @@ def _fold_state(state, pod, sel, hit):
     }
 
 
-@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
-                                   "carry_spread", "rotate_pos"))
-def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
-                        num_to_find, n_real, perms, inv_perms, oid_seq,
-                        spread0, z_pad, weights_tuple, rotate, carry_spread,
-                        rotate_pos=False):
-    weights = dict(weights_tuple)
+def _batch_core(nodes, mut0, pods, last_index, last_node_index,
+                num_to_find, n_real, perms, inv_perms, oid_seq,
+                spread0, z_pad, weights, rotate, carry_spread,
+                rotate_pos=False, constrain=None):
+    """Body of the generic lax.scan burst kernel. `constrain` (optional)
+    pins the node-axis carry — the mutable state rows and the carried
+    spread vector — to a mesh sharding every iteration, so the O(N) sweep
+    stays split across chips while the scalar select epilogue replicates
+    (parallel/sharding.py wraps this for mesh mode; None = single-chip
+    identity, the exact program the jit wrapper below compiles)."""
+    if constrain is None:
+        constrain = lambda v: v
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
     # selector-spread counts evolve with in-burst placements: the caller
     # guarantees every pod shares one selector set (spec-identical), so the
@@ -524,10 +529,10 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
                           z_pad, perm=perm, inv_perm=inv_perm, pos=pos)
         sel = out["selected"]
         hit = out["found"] > 0
-        new_state = _fold_state(state, pod, sel, hit)
+        new_state = constrain(_fold_state(state, pod, sel, hit))
         if carry_spread:
-            spread = spread.at[jnp.maximum(sel, 0)].add(
-                jnp.where(hit & ~pod["skip"], 1, 0))
+            spread = constrain(spread.at[jnp.maximum(sel, 0)].add(
+                jnp.where(hit & ~pod["skip"], 1, 0)))
         return ((new_state, out["next_last_index"],
                  out["next_last_node_index"], spread), {
             "selected": sel,
@@ -541,7 +546,7 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
     if carry_spread:
         pods = {k: v for k, v in pods.items() if k != "spread_counts"}
     xs = (pods, oid_seq) if (rotate or rotate_pos) else pods
-    init = (mut0, last_index, last_node_index, spread0)
+    init = (constrain(mut0), last_index, last_node_index, constrain(spread0))
     (state, li, lni, spread), outs = jax.lax.scan(step, init, xs)
     # ONE packed fetch block [3B] i32: selections, then the walk counters
     # AFTER each pod (li absolute — it is < n; lni as a delta from the
@@ -555,9 +560,21 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
     return state, li, lni, spread, outs
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
+                                   "carry_spread", "rotate_pos"))
+def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
+                        num_to_find, n_real, perms, inv_perms, oid_seq,
+                        spread0, z_pad, weights_tuple, rotate, carry_spread,
+                        rotate_pos=False):
+    return _batch_core(nodes, mut0, pods, last_index, last_node_index,
+                       num_to_find, n_real, perms, inv_perms, oid_seq,
+                       spread0, z_pad, dict(weights_tuple), rotate,
+                       carry_spread, rotate_pos=rotate_pos)
+
+
 def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
                    z_pad, weights=None, rotation=None, spread0=None,
-                   rotation_pos=None, carry_in=None):
+                   rotation_pos=None, carry_in=None, mesh=None):
     """Schedule a burst of pods against one snapshot, decisions serially
     equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays.
 
@@ -579,7 +596,15 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     (state, li, lni, spread, outs); outs["packed"] is the ONE-fetch block
     [3B] i32 — selected | li-after-each-pod | lni-delta-after-each-pod —
     so a caller fetches a single array per launch and re-derives any
-    failure-prefix rewind from slices of it."""
+    failure-prefix rewind from slices of it.
+
+    `mesh` shards the node axis of the scan across a jax.sharding.Mesh
+    (parallel/sharding.py): the SAME _batch_core program runs with the
+    carried state pinned to NamedSharding(mesh, P("nodes")) and the select
+    epilogue's tiny per-node vectors riding an ICI all-gather — sharded vs
+    single-device is one code path parameterized by the sharding spec, so
+    decisions are bit-identical by construction (pinned by
+    tests/test_sharding.py + the sharded fuzz variants)."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -603,6 +628,14 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
         mut0 = {k: nodes[k] for k in _MUTABLE}
         s0 = jnp.asarray(spread0, jnp.int64) if spread0 is not None \
             else jnp.zeros((), jnp.int64)
+    if mesh is not None:
+        from kubernetes_tpu.parallel import sharding as S
+        fn = S.sharded_scan_fn(mesh, z_pad, weights_tuple,
+                               rotation is not None, carry_spread,
+                               rotation_pos is not None)
+        return fn(nodes, mut0, pods, _i64(last_index),
+                  _i64(last_node_index), _i64(num_to_find), _i64(n_real),
+                  perms, inv_perms, oid_seq, s0)
     return _schedule_batch_jit(
         nodes, mut0, pods, _i64(last_index), _i64(last_node_index),
         _i64(num_to_find), _i64(n_real), perms, inv_perms, oid_seq, s0,
@@ -638,12 +671,10 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
 # walk counters so the prefix rewind costs no second fetch.
 
 
-@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rot_mode",
-                                   "carry_spread"))
-def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
-                            last_index, last_node_index, num_to_find, n_real,
-                            perms, inv_perms, oid_seq, spread0, z_pad,
-                            weights_tuple, rot_mode, carry_spread):
+def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
+                   last_index, last_node_index, num_to_find, n_real,
+                   perms, inv_perms, oid_seq, spread0, z_pad,
+                   weights, rot_mode, carry_spread, constrain=None):
     """rot_mode: 0 = stable axis order, 1 = perm/inv-perm gathers,
     2 = gather-free positions (full-scan regime).
 
@@ -651,8 +682,17 @@ def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
     uniform kernel's trick): the [B, ...] operands are padded to the
     caller's bucket for one compile per bucket, but the loop runs exactly
     `n_pods` iterations — a 1.5k-pod gang window inside a 16k bucket pays
-    for 1.5k cycles, not 16k padded scan steps."""
-    weights = dict(weights_tuple)
+    for 1.5k cycles, not 16k padded scan steps.
+
+    `constrain` (optional) pins the node-axis pieces of BOTH carries — the
+    live mutable rows/spread AND the in-scan gang checkpoint — to a mesh
+    sharding each iteration (parallel/sharding.py wraps this for mesh
+    mode; None = single-chip identity). The checkpoint/rewind pick() is a
+    per-element where over identically-sharded operands, so a gang rewind
+    stays shard-local — no collective beyond the select epilogue's
+    all-gather."""
+    if constrain is None:
+        constrain = lambda v: v
     i32 = jnp.int32
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
     B = seg_start.shape[0]
@@ -692,11 +732,11 @@ def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
                             pos=pos)
         sel = out_c["selected"]
         hit = out_c["found"] > 0
-        new_state = _fold_state(state, pod, sel, hit)
+        new_state = constrain(_fold_state(state, pod, sel, hit))
         new_spread = spread
         if carry_spread:
-            new_spread = spread.at[jnp.maximum(sel, 0)].add(
-                jnp.where(hit & ~eskip, 1, 0))
+            new_spread = constrain(spread.at[jnp.maximum(sel, 0)].add(
+                jnp.where(hit & ~eskip, 1, 0)))
         new_cur = (new_state, out_c["next_last_index"],
                    out_c["next_last_node_index"], new_spread)
         new_t = t + jnp.where(eskip, 0, jnp.int32(1))
@@ -714,7 +754,8 @@ def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
             t2])
         return (cur2, chk, t2, chk_t, failed, i + 1, out.at[:, i].set(col))
 
-    init_cur = (mut0, last_index, last_node_index, spread0)
+    init_cur = (constrain(mut0), last_index, last_node_index,
+                constrain(spread0))
     out0 = jnp.full((4, B), -1, i32)
     init = (init_cur, init_cur, jnp.int32(0), jnp.int32(0),
             jnp.zeros((), bool), jnp.int32(0), out0)
@@ -730,10 +771,22 @@ def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
     return state, li, lni, spread, out.reshape(4 * B)
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rot_mode",
+                                   "carry_spread"))
+def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
+                            last_index, last_node_index, num_to_find, n_real,
+                            perms, inv_perms, oid_seq, spread0, z_pad,
+                            weights_tuple, rot_mode, carry_spread):
+    return _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
+                          last_index, last_node_index, num_to_find, n_real,
+                          perms, inv_perms, oid_seq, spread0, z_pad,
+                          dict(weights_tuple), rot_mode, carry_spread)
+
+
 def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
                             last_index, last_node_index, num_to_find,
                             n_real, z_pad, weights=None, rotation=None,
-                            rotation_pos=None, spread0=None):
+                            rotation_pos=None, spread0=None, mesh=None):
     """Schedule a segmented drain window — singleton runs and all-or-nothing
     gang segments — in ONE launch with ONE packed fetch (see block comment).
 
@@ -747,7 +800,13 @@ def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
     rewinds restore the cursor), so it must be the plain burst-wide walk,
     unsliced. Returns (state, li, lni, spread, packed[4B] i32) with
     packed = selected | li_after | lni_delta | t_after (entries past
-    n_pods are -1 filler)."""
+    n_pods are -1 filler).
+
+    `mesh` runs the SAME _segments_core program with the node axis of the
+    live carry AND the gang checkpoint sharded across the mesh
+    (parallel/sharding.py) — in-scan gang rewinds, rotation by consumed
+    count t, and spread carries all run sharded, decisions bit-identical
+    to the single-device kernel."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -768,6 +827,14 @@ def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
     carry_spread = spread0 is not None
     s0 = jnp.asarray(spread0, jnp.int64) if carry_spread \
         else jnp.zeros((), jnp.int64)
+    if mesh is not None:
+        from kubernetes_tpu.parallel import sharding as S
+        fn = S.sharded_segments_fn(mesh, z_pad, weights_tuple, rot_mode,
+                                   carry_spread)
+        return fn(nodes, mut0, pods, jnp.asarray(seg_start, bool),
+                  jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
+                  _i64(last_node_index), _i64(num_to_find), _i64(n_real),
+                  perms, inv_perms, oid_seq, s0)
     return _schedule_batch_seg_jit(
         nodes, mut0, pods, jnp.asarray(seg_start, bool),
         jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
@@ -1169,7 +1236,7 @@ PREEMPT_P = 128    # victim slots per node (>= AllowedPodNumber cap of 110)
 
 
 def _victim_select(nodes, vic, valid_v, req_cpu, req_mem, req_eph,
-                   ghost, feas_static, check_res, has_req):
+                   ghost, feas_static, check_res, has_req, constrain=None):
     """selectVictimsOnNode over every node at once (:1054): remove all
     masked victims, check fit, then the order-dependent reprieve scan.
     `valid_v` [N, P] masks which slots are potential victims FOR THIS
@@ -1178,7 +1245,11 @@ def _victim_select(nodes, vic, valid_v, req_cpu, req_mem, req_eph,
     fit runs the two-pass with them added (preemption.py:277), and for
     resource-only ghosts the without-pass is implied. `check_res`/`has_req`
     may be Python bools or traced booleans. Returns (feas0[N], victims[N,P],
-    aggregates dict for the node pick)."""
+    aggregates dict for the node pick). `constrain` (optional) pins the
+    reprieve scan's [N] carry to a mesh sharding — the per-slot scan then
+    runs every node row shard-local."""
+    if constrain is None:
+        constrain = lambda v: v
     i64, f64 = jnp.int64, jnp.float64
     n_pad = nodes["alloc_cpu"].shape[0]
     cr = jnp.asarray(check_res, bool)
@@ -1213,8 +1284,8 @@ def _victim_select(nodes, vic, valid_v, req_cpu, req_mem, req_eph,
         nrc, nrm, nre = rc + vcpu, rm + vmem, re + veph
         npc = pc + jnp.where(vval, 1, 0)
         keep = fits(nrc, nrm, nre, npc) & vval & feas0
-        return ((jnp.where(keep, nrc, rc), jnp.where(keep, nrm, rm),
-                 jnp.where(keep, nre, re), jnp.where(keep, npc, pc)),
+        return (constrain((jnp.where(keep, nrc, rc), jnp.where(keep, nrm, rm),
+                           jnp.where(keep, nre, re), jnp.where(keep, npc, pc))),
                 vval & ~keep)
 
     xs = (vic["cpu"].T, vic["mem"].T, vic["eph"].T, valid_v.T)   # [P, N]
@@ -1268,9 +1339,8 @@ def _pick_one_node(feas0, agg, order_rank):
     return jnp.where(any_cand, winner, -1)
 
 
-@partial(jax.jit, static_argnames=("check_res", "has_req"))
-def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
-                         max_prio, check_res, has_req):
+def _preempt_scan_core(nodes, vic, pod, feas_static, order_rank, n_real,
+                       max_prio, check_res, has_req, constrain=None):
     i32 = jnp.int32
     n_pad = nodes["alloc_cpu"].shape[0]
     in_range = jnp.arange(n_pad, dtype=i32) < jnp.asarray(n_real, i32)
@@ -1280,7 +1350,8 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
     valid_v = vic["valid"] & (vic["prio"] < max_prio)
     feas0, victims, agg = _victim_select(
         nodes, vic, valid_v, pod["req_cpu"], pod["req_mem"],
-        pod["req_eph"], None, feas_static & in_range, check_res, has_req)
+        pod["req_eph"], None, feas_static & in_range, check_res, has_req,
+        constrain=constrain)
     winner = _pick_one_node(feas0, agg, order_rank)
     w = jnp.maximum(winner, 0)
     out = jnp.concatenate([
@@ -1290,15 +1361,29 @@ def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
     return out
 
 
+@partial(jax.jit, static_argnames=("check_res", "has_req"))
+def _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank, n_real,
+                         max_prio, check_res, has_req):
+    return _preempt_scan_core(nodes, vic, pod, feas_static, order_rank,
+                              n_real, max_prio, check_res, has_req)
+
+
 def preemption_scan(nodes, vic, pod, feas_static, order_rank, n_real,
-                    check_resources, has_request, max_prio):
+                    check_resources, has_request, max_prio, mesh=None):
     """One launch over all candidate nodes. `vic` arrays are [N, P] slot
     planes of the persistent victim table — ALL snapshot pods pre-sorted
     into reprieve processing order per node; slots of priority >= `max_prio`
     (the preemptor's) are masked out on device. Returns packed i32
     [3 + P]: winner node index (-1 = no candidate), its victim count and
     PDB-violation count, then the winner's per-slot victim flags (aligned
-    to the sorted order the host supplied)."""
+    to the sorted order the host supplied). `mesh` runs the same scan with
+    the node axis (rows + victim planes) sharded across the mesh."""
+    if mesh is not None:
+        from kubernetes_tpu.parallel import sharding as S
+        fn = S.sharded_preempt_fn(mesh, bool(check_resources),
+                                  bool(has_request))
+        return fn(nodes, vic, pod, feas_static, order_rank, _i64(n_real),
+                  _i64(max_prio))
     return _preemption_scan_jit(nodes, vic, pod, feas_static, order_rank,
                                 _i64(n_real), _i64(max_prio),
                                 bool(check_resources), bool(has_request))
@@ -1346,11 +1431,16 @@ def _resolvable_candidates(fail_first, general_bits):
     return ~unresolv
 
 
-@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
-def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
-                        last_node_index, num_to_find, n_real, z_pad,
-                        weights_tuple):
-    weights = dict(weights_tuple)
+def _pressure_core(nodes, mut0, ghost0, pods, vic, last_index,
+                   last_node_index, num_to_find, n_real, z_pad,
+                   weights, constrain=None):
+    """Body of the schedule-else-preempt pressure kernel. `constrain`
+    (optional) pins the node-axis carries — the mutable rows and the
+    accumulated nominated-ghost load — to a mesh sharding each step
+    (parallel/sharding.py; None = single-chip identity). The victim planes
+    are [N, P] node-axis-first and ride the callers' sharded upload."""
+    if constrain is None:
+        constrain = lambda v: v
     i32 = jnp.int32
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
     n_pad = nodes["alloc_cpu"].shape[0]
@@ -1365,7 +1455,7 @@ def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
         sel = out["selected"]
         hit = out["found"] > 0
         skip = jnp.any(pod["skip"])
-        mut2 = _fold_state(mut, pod, sel, hit)
+        mut2 = constrain(_fold_state(mut, pod, sel, hit))
         # victim scan with this preemptor's mask and the ghost base. The
         # static feasibility is the pod's own mask families (victim removal
         # cannot change them — eligibility host-gated): a winner must pass
@@ -1380,7 +1470,7 @@ def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
         feas0, victims, agg = _victim_select(
             {**static, **mut}, vic, valid_k, pod["req_cpu"], pod["req_mem"],
             pod["req_eph"], ghost, feas_stat, pod["check_resources"],
-            pod["has_request"])
+            pod["has_request"], constrain=constrain)
         winner_raw = _pick_one_node(feas0, agg, axis_rank)
         cand = in_range & _resolvable_candidates(out["fail_first"],
                                                  out["general_bits"])
@@ -1388,7 +1478,7 @@ def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
         preempted = (~hit) & (~skip) & (winner_raw >= 0)
         winner = jnp.where(hit, -2, jnp.where(skip, -1, winner_raw))
         w = jnp.maximum(winner_raw, 0)
-        ghost2 = {
+        ghost2 = constrain({
             "cpu": ghost["cpu"].at[w].add(
                 jnp.where(preempted, pod["upd_cpu"], 0)),
             "mem": ghost["mem"].at[w].add(
@@ -1396,7 +1486,7 @@ def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
             "eph": ghost["eph"].at[w].add(
                 jnp.where(preempted, pod["upd_eph"], 0)),
             "cnt": ghost["cnt"].at[w].add(jnp.where(preempted, 1, 0)),
-        }
+        })
         return ((mut2, ghost2, out["next_last_index"],
                  out["next_last_node_index"]), {
             "selected": jnp.where(hit, sel, -1),
@@ -1405,13 +1495,23 @@ def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
             "victims": victims[w].astype(jnp.int8),
         })
 
-    init = (mut0, ghost0, last_index, last_node_index)
+    init = (constrain(mut0), constrain(ghost0), last_index, last_node_index)
     (mut, ghost, li, lni), outs = jax.lax.scan(step, init, pods)
     return mut, ghost, li, lni, outs
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _pressure_batch_jit(nodes, mut0, ghost0, pods, vic, last_index,
+                        last_node_index, num_to_find, n_real, z_pad,
+                        weights_tuple):
+    return _pressure_core(nodes, mut0, ghost0, pods, vic, last_index,
+                          last_node_index, num_to_find, n_real, z_pad,
+                          dict(weights_tuple))
+
+
 def pressure_batch(nodes, mut0, ghost0, pods, vic, last_index,
-                   last_node_index, num_to_find, n_real, z_pad, weights=None):
+                   last_node_index, num_to_find, n_real, z_pad, weights=None,
+                   mesh=None):
     """Schedule-else-preempt a failed burst tail in one launch. `pods` is a
     dict of [B, ...] stacked arrays (including `pprio` [B] preemptor
     priorities and the upd_* fold fields); `vic` arrays are [N, P] with ALL
@@ -1419,8 +1519,15 @@ def pressure_batch(nodes, mut0, ghost0, pods, vic, last_index,
     reprieve processing order. Returns (mut_state, ghost, li, lni, outs)
     where outs carries per-pod: selected (>=0 bound host row, -1 failed),
     winner (-2 bound, -1 no preemption, >=0 nominated node row), any_cand,
-    and the winner's victim slot flags [P]."""
+    and the winner's victim slot flags [P]. `mesh` runs the same
+    _pressure_core program with the node axis (mutable rows, ghost load,
+    victim planes) sharded across the mesh — decisions bit-identical."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    if mesh is not None:
+        from kubernetes_tpu.parallel import sharding as S
+        fn = S.sharded_pressure_fn(mesh, z_pad, weights_tuple)
+        return fn(nodes, mut0, ghost0, pods, vic, _i64(last_index),
+                  _i64(last_node_index), _i64(num_to_find), _i64(n_real))
     return _pressure_batch_jit(nodes, mut0, ghost0, pods, vic,
                                _i64(last_index), _i64(last_node_index),
                                _i64(num_to_find), _i64(n_real), z_pad,

@@ -175,51 +175,178 @@ def sharded_uniform_fn(mesh: Mesh, weights_tuple, flags, b_cap, k_batch,
     return fn
 
 
+def node_constrainer(mesh: Mesh):
+    """A pytree-aware `constrain` hook for the kernel cores: node-axis
+    leaves ([N] vectors, [N, *] planes — the axis is FIRST on every
+    carried state/spread/ghost/victim structure) are pinned to the mesh's
+    node sharding; leaves whose leading dim can't split evenly (inert [1]
+    broadcasts, scalars, scratch-padded odd lengths) pass through
+    untouched and replicate. The cores call this on every loop carry, so
+    GSPMD keeps the O(N) sweep distributed across iterations instead of
+    collapsing the carry onto one chip."""
+    n_dev = mesh.devices.size
+    s1 = node_sharding(mesh)
+    s2 = node_sharding_2d(mesh)
+
+    def one(v):
+        if v.ndim >= 1 and v.shape[0] > 1 and v.shape[0] % n_dev == 0:
+            return jax.lax.with_sharding_constraint(
+                v, s2 if v.ndim == 2 else s1)
+        return v
+
+    return lambda tree: jax.tree_util.tree_map(one, tree)
+
+
+# jit caches for the sharded kernel programs, keyed on (mesh, statics) —
+# Mesh is hashable/eq-comparable, so content-equal meshes share entries
+_SCAN_CACHE: dict = {}
+_SEG_CACHE: dict = {}
+_PRESSURE_CACHE: dict = {}
+_PREEMPT_CACHE: dict = {}
+
+
+def sharded_scan_fn(mesh: Mesh, z_pad: int, weights_tuple, rotate: bool,
+                    carry_spread: bool, rotate_pos: bool):
+    """The generic lax.scan burst kernel (kernels._batch_core) with the
+    node axis sharded over the mesh — the SAME program single-device runs,
+    parameterized by the sharding spec: each chip folds the selected pod's
+    deltas into its node rows every step (the carried _MUTABLE state and
+    spread vector are pinned to the node sharding), rotation perm rows
+    replicate (they are tiny [L, N] index tables), and the per-node
+    feasibility/score vectors ride XLA collectives (all-gather over ICI)
+    into the replicated select epilogue. Decisions are bit-identical to
+    the single-device scan (tests/test_sharding.py + the sharded fuzz
+    variants). Compiled once per (mesh, statics) and cached."""
+    key = (mesh, z_pad, weights_tuple, rotate, carry_spread, rotate_pos)
+    fn = _SCAN_CACHE.get(key)
+    if fn is not None:
+        return fn
+    c = node_constrainer(mesh)
+
+    def f(nodes, mut0, pods, last_index, last_node_index, num_to_find,
+          n_real, perms, inv_perms, oid_seq, spread0):
+        nodes = _constrain_nodes(mesh, nodes)
+        return K._batch_core(nodes, mut0, pods, last_index, last_node_index,
+                             num_to_find, n_real, perms, inv_perms, oid_seq,
+                             spread0, z_pad, dict(weights_tuple), rotate,
+                             carry_spread, rotate_pos=rotate_pos,
+                             constrain=c)
+
+    fn = _SCAN_CACHE[key] = jax.jit(f)
+    return fn
+
+
+def sharded_segments_fn(mesh: Mesh, z_pad: int, weights_tuple,
+                        rot_mode: int, carry_spread: bool):
+    """The fused segmented drain-window kernel (kernels._segments_core)
+    sharded over the mesh: the whole while_loop carry — live mutable rows,
+    spread, AND the in-scan gang checkpoint — stays under
+    NamedSharding(mesh, P("nodes")); a gang rewind is a shard-local
+    element-wise select between two identically-sharded carries, rotation
+    stays indexed by the consumed-count t with the perm tables replicated,
+    and the single [4B] packed output replicates (per-pod, tiny).
+    Decisions bit-identical to the single-device fused kernel."""
+    key = (mesh, z_pad, weights_tuple, rot_mode, carry_spread)
+    fn = _SEG_CACHE.get(key)
+    if fn is not None:
+        return fn
+    c = node_constrainer(mesh)
+
+    def f(nodes, mut0, pods, seg_start, gang, n_pods, last_index,
+          last_node_index, num_to_find, n_real, perms, inv_perms, oid_seq,
+          spread0):
+        nodes = _constrain_nodes(mesh, nodes)
+        return K._segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
+                                last_index, last_node_index, num_to_find,
+                                n_real, perms, inv_perms, oid_seq, spread0,
+                                z_pad, dict(weights_tuple), rot_mode,
+                                carry_spread, constrain=c)
+
+    fn = _SEG_CACHE[key] = jax.jit(f)
+    return fn
+
+
+def sharded_pressure_fn(mesh: Mesh, z_pad: int, weights_tuple):
+    """The schedule-else-preempt pressure kernel (kernels._pressure_core)
+    sharded over the mesh: mutable rows, the accumulated nominated-ghost
+    load, and the [N, P] victim planes all split on the node axis; the
+    5-criteria node pick reduces over tiny per-node aggregates and
+    replicates. Decisions bit-identical to the single-device kernel."""
+    key = (mesh, z_pad, weights_tuple)
+    fn = _PRESSURE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    c = node_constrainer(mesh)
+
+    def f(nodes, mut0, ghost0, pods, vic, last_index, last_node_index,
+          num_to_find, n_real):
+        nodes = _constrain_nodes(mesh, nodes)
+        return K._pressure_core(nodes, c(mut0), c(ghost0), pods, c(vic),
+                                last_index, last_node_index, num_to_find,
+                                n_real, z_pad, dict(weights_tuple),
+                                constrain=c)
+
+    fn = _PRESSURE_CACHE[key] = jax.jit(f)
+    return fn
+
+
+def sharded_preempt_fn(mesh: Mesh, check_res: bool, has_req: bool):
+    """The single-preemptor victim scan (kernels._preempt_scan_core)
+    sharded over the mesh — per-node victim selection and the reprieve
+    scan run shard-local; the staged pick replicates."""
+    key = (mesh, check_res, has_req)
+    fn = _PREEMPT_CACHE.get(key)
+    if fn is not None:
+        return fn
+    c = node_constrainer(mesh)
+
+    def f(nodes, vic, pod, feas_static, order_rank, n_real, max_prio):
+        nodes = _constrain_nodes(mesh, nodes)
+        return K._preempt_scan_core(nodes, c(vic), pod, c(feas_static),
+                                    c(order_rank), n_real, max_prio,
+                                    check_res, has_req, constrain=c)
+
+    fn = _PREEMPT_CACHE[key] = jax.jit(f)
+    return fn
+
+
+def shard_victim_planes(mesh: Mesh, planes: dict) -> dict:
+    """device_put the resident [N, P] victim-table planes with the node
+    axis (axis 0) split across the mesh — the round-9 VictimStack under
+    NamedSharding(mesh, P("nodes")). Planes whose row count can't split
+    evenly replicate (tiny clusters)."""
+    n_dev = mesh.devices.size
+    s2 = node_sharding_2d(mesh)
+    repl = replicated(mesh)
+    return {k: jax.device_put(
+                v, s2 if np.ndim(v) == 2 and np.shape(v)[0] % n_dev == 0
+                else repl)
+            for k, v in planes.items()}
+
+
 def sharded_batch_fn(mesh: Mesh, z_pad: int, weights=None):
     """The full scheduling *step* over the mesh: a `lax.scan` burst with the
     node axis sharded and the complete mutable-state fold (kernels._MUTABLE —
     req_cpu/mem/eph/scalar, nz_cpu/nz_mem, pod_count) constrained back onto
     the node sharding every iteration.
 
-    This is the multi-chip twin of kernels.schedule_batch: each chip folds
-    the selected pod's deltas into its node rows; the per-node feasibility /
-    score vectors ride XLA collectives (all-gather over ICI) for the
-    replicated selection epilogue inside _cycle_core. Decisions are
-    bit-identical to the single-device scan (see tests/test_sharding.py).
-    """
+    This is the multi-chip twin of kernels.schedule_batch, now riding the
+    SAME _batch_core the single-device jit compiles (one code path
+    parameterized by the sharding spec): each chip folds the selected
+    pod's deltas into its node rows; the per-node feasibility / score
+    vectors ride XLA collectives (all-gather over ICI) for the replicated
+    selection epilogue inside _cycle_core. Decisions are bit-identical to
+    the single-device scan (see tests/test_sharding.py)."""
     weights_tuple = tuple(sorted((weights or K.DEFAULT_WEIGHTS).items()))
-    shard = node_sharding(mesh)
-    shard2 = node_sharding_2d(mesh)
-
-    def constrain(state):
-        return {
-            k: jax.lax.with_sharding_constraint(
-                v, shard2 if v.ndim == 2 else shard)
-            for k, v in state.items()
-        }
+    inner = sharded_scan_fn(mesh, z_pad, weights_tuple, rotate=False,
+                            carry_spread=False, rotate_pos=False)
 
     def fn(nodes, pods, last_index, last_node_index, num_to_find, n_real):
-        w = dict(weights_tuple)
-        nodes = _constrain_nodes(mesh, nodes)
-        static = {k: v for k, v in nodes.items() if k not in K._MUTABLE}
-
-        def step(carry, pod):
-            state, li, lni = carry
-            full = {**static, **state}
-            out = K._cycle_core(full, pod, li, lni, num_to_find, n_real, w, z_pad)
-            sel = out["selected"]
-            hit = out["found"] > 0
-            new_state = constrain(K._fold_state(state, pod, sel, hit))
-            return (new_state, out["next_last_index"], out["next_last_node_index"]), {
-                "selected": sel,
-                "found": out["found"],
-                "evaluated": out["evaluated"],
-                "max_score": out["max_score"],
-            }
-
-        init = (constrain({k: nodes[k] for k in K._MUTABLE}),
-                last_index, last_node_index)
-        (state, li, lni), outs = jax.lax.scan(step, init, pods)
+        z = jnp.zeros((1, 1), jnp.int32)
+        mut0 = {k: nodes[k] for k in K._MUTABLE}
+        state, li, lni, _spread, outs = inner(
+            nodes, mut0, pods, last_index, last_node_index, num_to_find,
+            n_real, z, z, jnp.zeros(1, jnp.int32), jnp.zeros((), jnp.int64))
         return state, li, lni, outs
 
-    return jax.jit(fn)
+    return fn

@@ -201,7 +201,7 @@ def _drain(sched: Scheduler, cfg: PerfConfig) -> None:
 
 
 def run_preempt_cell(n_nodes: int, n_victims: int,
-                     n_preemptors: int = 128) -> dict:
+                     n_preemptors: int = 128, mesh=None) -> dict:
     """Preemption pressure-wave cell (BASELINE configs[3]): `n_preemptors`
     failed pods run as ONE schedule-else-preempt launch on the device
     (kernels.pressure_batch) against `n_victims` lower-priority pods spread
@@ -249,8 +249,9 @@ def run_preempt_cell(n_nodes: int, n_victims: int,
         assert out is not None
         return out
 
-    device_wave(TPUScheduler(percentage_of_nodes_to_score=100))  # compile
-    tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+    device_wave(TPUScheduler(percentage_of_nodes_to_score=100,
+                             mesh=mesh))  # compile
+    tpu = TPUScheduler(percentage_of_nodes_to_score=100, mesh=mesh)
     tpu.prewarm_preempt(infos, names, [])   # steady-state victim table
     t0 = _t.perf_counter()
     got = device_wave(tpu)
@@ -309,6 +310,89 @@ def run_preempt_cell(n_nodes: int, n_victims: int,
     }
 
 
+def run_shard_cell(n_nodes: int, n_pods: int = 2000, devices=None,
+                   verify: bool = False, existing_per_node: int = 0) -> dict:
+    """Mesh-sharded burst cell at fleet scale (50k-200k nodes) — the
+    node-axis cells one chip's HBM cannot hold once the resident state is
+    counted (at 200k nodes the [N_pad, P=128] victim slot planes alone are
+    7 planes x 256k x 128 x 8B ~ 1.8 GiB, plus the [N_pad] node planes and
+    the fused carry + checkpoint copies; PROFILE.md round-15 carries the
+    arithmetic). The node axis rides NamedSharding(mesh, P("nodes")) over
+    `devices` chips (default: every visible device), the burst runs the
+    single-dispatch/single-fetch fused contract, and throughput counts
+    decided pods.
+
+    `verify=True` additionally reruns the identical cell single-device and
+    asserts bit-identical placements — the parity referee for the scale
+    cells (expensive: doubles the runtime; the fuzz suites + shard sweep
+    pin parity at small N every run, so the matrix cells default to the
+    sharded timing only)."""
+    import time as _t
+    import numpy as np
+    from kubernetes_tpu.api.types import Node, Pod, Container
+    from kubernetes_tpu.cache.node_info import NodeInfo
+    from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+    from kubernetes_tpu.parallel import sharding as S
+    GI = 1024 ** 3
+    infos = {}
+    names = []
+    for i in range(n_nodes):
+        # uneven zones (n % 3 != 0 at the matrix sizes) keep the NodeTree
+        # rotation machinery live at scale in callers that attach a tree
+        node = Node(name=f"n{i}",
+                    labels={"failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % 3}"},
+                    allocatable={"cpu": 4000, "memory": 32 * GI,
+                                 "pods": 110})
+        ni = NodeInfo(node)
+        for e in range(existing_per_node):
+            ni.add_pod(Pod(name=f"w{i}-{e}", node_name=node.name,
+                           containers=(Container.make(
+                               name="c", requests={"cpu": 100}),)))
+        infos[node.name] = ni
+        names.append(node.name)
+
+    def mk_pods(tag: str, count: int):
+        return [Pod(name=f"{tag}{j}", labels={"app": "shard"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100, "memory": GI}),))
+                for j in range(count)]
+
+    mesh = S.make_mesh(devices)
+    n_dev = int(mesh.devices.size)
+
+    def cell(mesh_arg):
+        ts = TPUScheduler(percentage_of_nodes_to_score=100, mesh=mesh_arg)
+        # warmup: compile the (bucket, class) signature outside the window
+        warm = ts.schedule_burst(mk_pods("warm", 8), infos, names,
+                                 bucket=n_pods)
+        assert warm is not None, "shard cell refused the warmup burst"
+        t0 = _t.perf_counter()
+        hosts = ts.schedule_burst(mk_pods("p", n_pods), infos, names,
+                                  bucket=n_pods)
+        dt = _t.perf_counter() - t0
+        assert hosts is not None, "shard cell refused the measured burst"
+        return ts, hosts, dt
+
+    ts, hosts, dt = cell(mesh)
+    if verify:
+        _ts1, hosts1, _dt1 = cell(None)
+        assert hosts == hosts1, (
+            "sharded cell diverged from single-device at "
+            f"{n_nodes} nodes: first diff at "
+            f"{next(i for i, (a, b) in enumerate(zip(hosts, hosts1)) if a != b)}")
+    n_pad = ts.encoder._batch.n_pad
+    return {
+        "nodes": n_nodes,
+        "pods": n_pods,
+        "pods_bound": sum(1 for h in hosts if h is not None),
+        "pods_per_s": round(n_pods / dt, 1) if dt else 0.0,
+        "devices": n_dev,
+        "per_device_node_rows": n_pad // max(n_dev, 1),
+        "verified_vs_single_device": bool(verify),
+    }
+
+
 # the benchmark matrices (scheduler_bench_test.go:40-118)
 BENCHMARK_MATRIX = {
     "plain": [(100, 0), (100, 1000), (1000, 0), (1000, 1000), (5000, 1000)],
@@ -325,6 +409,11 @@ BENCHMARK_MATRIX = {
     # run_commit_cell (the round-11 store-write + fan-out tail; the
     # 4096-pod cell is one full default scheduler wave)
     "commit": [(1024, 8, 8), (4096, 8, 8)],
+    # mesh-sharded scale cells: (nodes, pods) — run via run_shard_cell
+    # over every visible device. These node counts cannot fit one chip's
+    # HBM once the resident planes + victim table are counted (PROFILE.md
+    # round-15); the 50k cell is the slow-marked tier-2 gate
+    "shard": [(50_000, 2000), (100_000, 2000), (200_000, 1000)],
 }
 
 

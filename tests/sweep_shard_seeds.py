@@ -1,0 +1,86 @@
+"""Sharded-parity seed sweep (the round-15 42-trial run).
+
+Not collected by pytest (no test_ prefix): run by hand after any kernel,
+sharding-spec, or shell-burst change —
+
+    JAX_PLATFORMS=cpu python tests/sweep_shard_seeds.py [trials] [base_seed]
+
+Each trial re-runs one of the long-range differential fuzzes (mixed
+workload, preemption pressure, spread burst, gang burst) with a fresh seed
+and the TPU world's node axis SHARDED over the conftest 8-device virtual
+mesh, asserting bit-identical bindings vs the pure-oracle world. The
+non-sharded sweep (sweep_extra_seeds.py) pins single-device vs oracle on
+the same fuzz bodies, so a green run here transitively pins sharded vs the
+single-device fused kernel referee as well.
+
+Mandatory coverage the trial mix guarantees (ISSUE 11):
+- uneven zones: the mixed/spread/gang fuzz clusters draw zone counts that
+  leave n_nodes % zones != 0 on most seeds (live NodeTree rotation);
+- N % devices != 0: node counts are drawn from ranges like [8, 24] — the
+  padded tail then lives entirely in the trailing shards of the 8-way
+  mesh, so every trial exercises uneven shard padding.
+"""
+import random
+import sys
+from contextlib import contextmanager
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+@contextmanager
+def _flight_recorder():
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    try:
+        yield flight.RECORDER
+    finally:
+        flight.RECORDER.configure(mode="digest")
+        flight.RECORDER.clear()
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    from kubernetes_tpu.parallel import sharding as S
+    from tests.test_tpu_parity import (TestMixedWorkloadShellFuzz,
+                                       TestPreemptionPressureShellFuzz,
+                                       TestSpreadBurstParity)
+    from tests.test_coscheduling import TestGangBurstParity
+    mesh = S.make_mesh(8)
+    rng = random.Random(base_seed)
+
+    def mixed(t, s, w):
+        with _flight_recorder() as rec:
+            t.test_bindings_identical(s, w, rec, mesh=mesh)
+
+    def pressure(t, s, w):
+        with _flight_recorder() as rec:
+            t.test_preemptive_convergence_identical(s, w, rec, mesh=mesh)
+
+    classes = [
+        ("mixed", TestMixedWorkloadShellFuzz(), mixed),
+        ("pressure", TestPreemptionPressureShellFuzz(), pressure),
+        ("spread", TestSpreadBurstParity(),
+         lambda t, s, w: t.test_burst_matches_oracle_with_existing_pods(
+             s, w, mesh=mesh)),
+        ("gang", TestGangBurstParity(),
+         lambda t, s, w: t.test_gang_parity(s, w, mesh=mesh)),
+    ]
+    for trial in range(trials):
+        name, inst, fn = classes[trial % len(classes)]
+        seed = rng.randint(1, 10_000)
+        wave = rng.choice([None, 3, 4])
+        try:
+            fn(inst, seed, wave)
+        except Exception:
+            print(f"FAIL class={name} seed={seed} wave_size={wave} sharded")
+            raise
+        print(f"ok {trial + 1}/{trials} {name} seed={seed} wave={wave} "
+              f"devices=8")
+    print(f"shard sweep green: {trials} trials over the 8-device mesh")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

@@ -251,3 +251,48 @@ def test_churn_mode_floor():
     assert out["pods_recreated"] >= 1, out
     assert out["audit_all_bound"] is True, out
     assert out["value"] >= 100.0, out
+
+
+@pytest.mark.slow
+def test_sharded_lane_floor():
+    """Round-15 sharded lane: `bench.py --devices` must (a) report the
+    multi-chip fields — devices > 1, per_device_node_rows, a non-zero
+    ici_allgather_bytes — with the single-fetch-per-burst contract intact,
+    and (b) NOT regress the one-chip case: the sharded program on a
+    1-device mesh stays >= 0.9x the unsharded program at small N (the
+    VERDICT r03 guard — mesh mode once silently cost 8x). The 8-way ratio
+    itself is not floored here: 8 virtual XLA CPU devices timeshare one
+    host, so its collective overhead measures the harness, not the
+    sharding (the real multi-chip ratio is the tunneled-TPU bench's job).
+    """
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+
+    def run(extra):
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--nodes", "500", "--pods", "800",
+             "--burst", "800", "--repeat", "3", "--no-matrix", "--no-mesh",
+             *extra],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    plain = run([])
+    assert plain["devices"] == 1
+    assert plain["ici_allgather_bytes"] == 0
+
+    one = run(["--devices", "1"])
+    assert one["devices"] == 1
+    ratio = one["value"] / plain["value"]
+    assert ratio >= 0.9, (
+        f"sharding regressed the one-chip case: 1-device mesh "
+        f"{one['value']} vs plain {plain['value']} ({ratio:.2f}x)")
+
+    eight = run(["--devices", "8"])
+    assert eight["devices"] == 8
+    assert eight["per_device_node_rows"] == 512 // 8
+    # ONE fetch for the single 800-pod burst of the timed loop — the
+    # single-dispatch/single-fetch contract survives sharding
+    assert eight["device_fetches"] == 1, eight
+    assert eight["ici_allgather_bytes"] > 0, eight
+    assert eight["pods_completed"] == 800

@@ -591,7 +591,7 @@ class TestGangBurstParity:
 
     @pytest.mark.parametrize("wave_size", [None, 3, 4])
     @pytest.mark.parametrize("seed", [2, 13, 29, 41])
-    def test_gang_parity(self, seed, wave_size, chaos=False):
+    def test_gang_parity(self, seed, wave_size, chaos=False, mesh=None):
         from kubernetes_tpu.api.types import (
             Affinity, ContainerPort, PodAntiAffinity, PodAffinityTerm,
             LabelSelector)
@@ -650,7 +650,8 @@ class TestGangBurstParity:
             clock = FakeClock(100.0)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
-                              percentage_of_nodes_to_score=100)
+                              percentage_of_nodes_to_score=100,
+                              mesh=mesh if use_tpu else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
                 # also force small SCAN SEGMENTS inside fused windows, so
@@ -688,6 +689,16 @@ class TestGangBurstParity:
             self.test_gang_parity(13, 3, chaos=True)
         finally:
             chaos_mod.disable()
+
+    # round-15: gangs + singletons + pressure with the TPU world's node
+    # axis sharded over the conftest 8-device mesh — in-scan gang
+    # checkpoint/rewind runs inside the SHARDED fused carry and the
+    # per-round atomicity audit must hold identically
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("seed", [2, 29])
+    def test_gang_parity_sharded(self, seed, wave_size):
+        from kubernetes_tpu.parallel import sharding as S
+        self.test_gang_parity(seed, wave_size, mesh=S.make_mesh(8))
 
     # round-14: nodes DIE under gangs + preemption pressure — mid-burst
     # through the node.dead seam in the TPU world (a gang trial that

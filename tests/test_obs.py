@@ -676,3 +676,31 @@ class TestVictimGateReasonLabels:
         assert tpu.preempt_pressure_burst(
             [mk("hi", priority=9)], self._snapshot(v2), ["n0"], []) is None
         assert d() == 1
+
+
+class TestRetiredShardedFallbackLabels:
+    """Round-15 satellite: the sharded-path refusal labels
+    (burst-sharded-rotation / burst-sharded-spread / fused-mesh-mode and
+    the pressure gate's mesh-mode) were DELETED when the sharded kernels
+    learned rotation, spread, gang segments, and pressure scans. A dead
+    fallback label reading 0 forever would mask a silent regression back
+    to host scheduling, so this pin fails if any code path (or eager
+    registration) resurrects them."""
+
+    def test_retired_labels_never_materialize(self):
+        import inspect
+        from kubernetes_tpu.core import tpu_scheduler as ts
+        retired = ts.RETIRED_FALLBACK_REASONS + ts.RETIRED_PRESSURE_GATES
+        assert set(retired) == {"burst-sharded-rotation",
+                                "burst-sharded-spread", "fused-mesh-mode",
+                                "mesh-mode"}
+        src = inspect.getsource(ts)
+        for label in retired:
+            # the ONLY mention left in the module is the RETIRED tuple
+            # itself — no .labels("...") call site survives
+            assert src.count(f'"{label}"') == 1, (
+                f"retired label {label!r} has a live call site again")
+            assert not any(label in tuple(k)
+                           for k in ts.ORACLE_FALLBACKS._children), label
+            assert not any(label in tuple(k)
+                           for k in ts.PRESSURE_GATES._children), label

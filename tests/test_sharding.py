@@ -328,3 +328,339 @@ class TestMeshPipelineDenseFeatures:
         single = self._pipeline(None)
         assert sharded == single
         assert sum(1 for v in sharded.values() if v) == 12
+
+
+# ---------------------------------------------------------------------------
+# Round 15: the fused single-dispatch drain window, rotation, carried spread,
+# gangs, and the preemption scans all run SHARDED — one code path
+# parameterized by the sharding spec (the burst-sharded-* fallbacks are gone)
+# ---------------------------------------------------------------------------
+
+
+def _uneven_pipeline(mesh_arg, n_nodes=13, zones=3, gangs=2, web_pods=20,
+                     wave_size=None):
+    """Full store->queue->cache->fused-burst pipeline on an UNEVEN-zone
+    cluster (n % zones != 0 -> live NodeTree rotation) with gangs AND
+    Service-matched spread pods — exactly the feature set the pre-round-15
+    sharded path refused (burst-sharded-rotation / burst-sharded-spread /
+    fused-mesh-mode)."""
+    from kubernetes_tpu.api.types import Service
+    from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+    from kubernetes_tpu.store.store import (Store, PODS, NODES, PODGROUPS,
+                                            SERVICES)
+    from kubernetes_tpu.scheduler import Scheduler
+    s = Store(watch_log_size=65536)
+    for i in range(n_nodes):
+        s.create(NODES, Node(
+            name=f"n{i}",
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    "failure-domain.beta.kubernetes.io/zone": f"z{i % zones}",
+                    "failure-domain.beta.kubernetes.io/region": "r1"},
+            allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+    s.create(SERVICES, Service(name="svc", selector={"app": "web"}))
+    sched = Scheduler(s, use_tpu=True, percentage_of_nodes_to_score=100,
+                      mesh=mesh_arg)
+    if wave_size:
+        sched.algorithm.wave_size = wave_size
+        sched.fused_run_split = wave_size
+    sched.sync()
+    for g in range(gangs):
+        s.create(PODGROUPS, PodGroup(name=f"g{g}", min_member=3))
+        for r in range(3):
+            s.create(PODS, Pod(
+                name=f"g{g}r{r}", labels={LABEL_POD_GROUP: f"g{g}",
+                                          "app": "gang"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": 500, "memory": GI}),)))
+    for j in range(web_pods):
+        s.create(PODS, Pod(name=f"w{j}", labels={"app": "web"},
+                           containers=(Container.make(
+                               name="c",
+                               requests={"cpu": 200, "memory": GI}),)))
+    sched.pump()
+    while sched.schedule_burst(max_pods=32):
+        pass
+    sched.pump()
+    return sched, {p.key: p.node_name for p in s.list(PODS)[0]}
+
+
+class TestShardedFusedSegments:
+    """The fused segmented drain window (gangs + singleton runs, in-scan
+    checkpoint/rewind, rotation indexed by the consumed-count t, carried
+    spread) sharded over the mesh vs the single-device fused kernel."""
+
+    @pytest.mark.parametrize("wave_size", [None, 4])
+    def test_fused_window_parity(self, mesh, wave_size):
+        _s1, sharded = _uneven_pipeline(mesh, wave_size=wave_size)
+        _s2, single = _uneven_pipeline(None, wave_size=wave_size)
+        assert sharded == single
+        assert sum(1 for v in sharded.values() if v) == 26
+
+    def test_no_sharded_fallback_labels_fire(self, mesh):
+        """The deleted burst-sharded-* / fused-mesh-mode refusals must not
+        fire (or even exist) when the fused pipeline runs in mesh mode."""
+        from kubernetes_tpu.core.tpu_scheduler import (
+            ORACLE_FALLBACKS, PRESSURE_GATES, RETIRED_FALLBACK_REASONS,
+            RETIRED_PRESSURE_GATES)
+        _uneven_pipeline(mesh)
+        live = {k[0] for k in ORACLE_FALLBACKS._children}
+        assert not (live & set(RETIRED_FALLBACK_REASONS)), live
+        live_p = {k[0] for k in PRESSURE_GATES._children}
+        assert not (live_p & set(RETIRED_PRESSURE_GATES)), live_p
+
+    def test_gang_rejection_rewinds_sharded(self, mesh):
+        """A gang that cannot fit rewinds the sharded carry in-scan: the
+        post-rewind decisions must match single-device exactly."""
+        from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+        from kubernetes_tpu.store.store import Store, PODS, NODES, PODGROUPS
+        from kubernetes_tpu.scheduler import Scheduler
+
+        def pipeline(mesh_arg):
+            s = Store(watch_log_size=65536)
+            for i in range(9):
+                s.create(NODES, Node(
+                    name=f"n{i}",
+                    labels={"failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % 2}"},
+                    allocatable={"cpu": 2000, "memory": 32 * GI,
+                                 "pods": 110}))
+            sched = Scheduler(s, use_tpu=True,
+                              percentage_of_nodes_to_score=100,
+                              mesh=mesh_arg)
+            sched.sync()
+            # g0 fits; g1 (full-node members, more members than nodes)
+            # can never place whole and must rewind in-scan
+            for g, (size, cpu) in enumerate([(3, 500), (11, 2000)]):
+                s.create(PODGROUPS, PodGroup(name=f"g{g}", min_member=size))
+                for r in range(size):
+                    s.create(PODS, Pod(
+                        name=f"g{g}r{r}",
+                        labels={LABEL_POD_GROUP: f"g{g}", "app": "gang"},
+                        containers=(Container.make(
+                            name="c", requests={"cpu": cpu}),)))
+            for j in range(6):
+                s.create(PODS, Pod(name=f"s{j}", labels={"app": "x"},
+                                   containers=(Container.make(
+                                       name="c", requests={"cpu": 900}),)))
+            sched.pump()
+            while sched.schedule_burst(max_pods=32):
+                pass
+            sched.pump()
+            return {p.key: p.node_name for p in s.list(PODS)[0]}
+
+        sharded = pipeline(mesh)
+        single = pipeline(None)
+        assert sharded == single
+        # the rejected gang must be bound nowhere, in both worlds
+        assert all(not v for k, v in sharded.items() if "/g1r" in k)
+
+
+class TestShardedPressureParity:
+    """preempt_pressure_burst and the single-preemptor victim scan sharded
+    over the mesh (the round-9 victim table under P('nodes'))."""
+
+    def _world(self, n_nodes=24, per_node=4):
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        infos, names = {}, []
+        uid = 0
+        for i in range(n_nodes):
+            node = Node(name=f"node-{i}",
+                        allocatable={"cpu": 4000, "memory": 32 * GI,
+                                     "pods": 110})
+            ni = NodeInfo(node)
+            for _ in range(per_node):
+                uid += 1
+                ni.add_pod(Pod(name=f"victim-{uid}", priority=1,
+                               node_name=node.name,
+                               containers=(Container.make(
+                                   name="c", requests={"cpu": 1000}),)))
+            infos[node.name] = ni
+            names.append(node.name)
+        return infos, names
+
+    def test_pressure_wave_parity(self, mesh):
+        infos, names = self._world()
+        preemptors = [Pod(name=f"hi-{k}", priority=10,
+                          containers=(Container.make(
+                              name="c", requests={"cpu": 1000}),))
+                      for k in range(40)]
+        outs = []
+        for m in (mesh, None):
+            t = TPUScheduler(percentage_of_nodes_to_score=100, mesh=m)
+            o = t.preempt_pressure_burst(preemptors, infos, names, [])
+            assert o is not None, f"pressure refused under mesh={m}"
+            outs.append([
+                (x[0], x[1], sorted(v.name for v in x[2]))
+                if x[0] == "nominated" else x for x in o])
+        assert outs[0] == outs[1]
+
+    def test_preempt_scan_parity(self, mesh):
+        from kubernetes_tpu.oracle.generic_scheduler import FitError
+        infos, names = self._world()
+        incoming = Pod(name="in", priority=9,
+                       containers=(Container.make(
+                           name="c", requests={"cpu": 1000}),))
+        err = FitError(incoming, len(names),
+                       {n: ["x"] for n in names})
+        res = []
+        for m in (mesh, None):
+            t = TPUScheduler(percentage_of_nodes_to_score=100, mesh=m)
+            r = t.preempt(incoming, infos, names, err, [])
+            res.append((r.node.name if r.node else None,
+                        sorted(v.name for v in r.victims)))
+        assert res[0] == res[1]
+
+
+class TestShardPaddingSafety:
+    """Uneven shard padding: n_real=17 pads to n_pad=32 over 8 shards of 4
+    rows — rows 17..31 are padding living entirely in the tail shards.
+    Padded rows must never win the top-k, shard-BOUNDARY rows (feasible
+    node last-in-shard / first-in-next-shard) must win exactly when the
+    single-device kernel says so, and the round-robin tie walk must cross
+    shard boundaries in the identical order."""
+
+    def _cluster17(self, feasible_labels=None):
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        infos, names = {}, []
+        for i in range(17):
+            labels = {"kubernetes.io/hostname": f"n{i}",
+                      "failure-domain.beta.kubernetes.io/zone":
+                      f"zone-{i % 3}"}
+            if feasible_labels and i in feasible_labels:
+                labels.update(feasible_labels[i])
+            node = Node(name=f"n{i}", labels=labels,
+                        allocatable={"cpu": 4000, "memory": 32 * GI,
+                                     "pods": 110})
+            infos[node.name] = NodeInfo(node)
+            names.append(node.name)
+        return infos, names
+
+    def _burst(self, mesh_arg, infos, names, pods):
+        t = TPUScheduler(percentage_of_nodes_to_score=100, mesh=mesh_arg)
+        return t.schedule_burst(pods, infos, names)
+
+    @pytest.mark.parametrize("target", [3, 4, 16])
+    def test_boundary_row_wins_identically(self, mesh, target):
+        """target=3: last row of shard 0; 4: first row of shard 1; 16: the
+        ONLY real row of shard 4 (rows 17-19 of that shard are padding)."""
+        infos, names = self._cluster17(
+            feasible_labels={target: {"disk": "ssd"}})
+        pods = [Pod(name=f"p{j}", labels={"app": "x"},
+                    node_selector={"disk": "ssd"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100, "memory": GI}),))
+                for j in range(3)]
+        h1 = self._burst(None, infos, names, pods)
+        hs = self._burst(mesh, infos, names, pods)
+        assert hs == h1
+        assert h1 is not None and h1[0] == f"n{target}"
+        # the padded tail (rows 17..31) can never be named
+        assert all(h is None or h in names for h in h1)
+
+    def test_tie_walk_crosses_shards_identically(self, mesh):
+        """All 17 rows feasible and score-tied: 60 identical pods drive the
+        round-robin tie walk across every shard boundary (and through the
+        padded tail's shard) repeatedly."""
+        infos, names = self._cluster17()
+        pods = [Pod(name=f"p{j}", labels={"app": "t"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100, "memory": GI}),))
+                for j in range(60)]
+        h1 = self._burst(None, infos, names, pods)
+        hs = self._burst(mesh, infos, names, pods)
+        assert hs == h1
+        assert all(h in names for h in h1)
+
+    def test_invalidate_node_hits_shard_local_row(self, mesh):
+        """Mid-burst node death in mesh mode: invalidate_node must drop the
+        dead node's shard-local mirror/victim rows so the post-churn replan
+        is bit-identical to a single-device world that saw the same death
+        (the StaleNodeRefusal contract's device half)."""
+        infos, names = self._cluster17()
+        warm = [Pod(name=f"w{j}", labels={"app": "x"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 300, "memory": GI}),))
+                for j in range(8)]
+        post = [Pod(name=f"q{j}", labels={"app": "x"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 300, "memory": GI}),))
+                for j in range(8)]
+        dead = "n4"   # first row of shard 1
+
+        def run(mesh_arg):
+            t = TPUScheduler(percentage_of_nodes_to_score=100,
+                             mesh=mesh_arg)
+            first = t.schedule_burst(warm, infos, names)
+            assert first is not None
+            # the node dies: the shell would remove it from cache/tree and
+            # call invalidate_node; replan the next burst post-churn
+            t.invalidate_node(dead)
+            infos2 = {k: v for k, v in infos.items() if k != dead}
+            names2 = [n for n in names if n != dead]
+            second = t.schedule_burst(post, infos2, names2)
+            assert second is not None
+            assert all(h != dead for h in second)
+            return first, second
+
+        f1, s1 = run(None)
+        fs, ss = run(mesh)
+        assert fs == f1 and ss == s1
+
+
+@pytest.mark.slow
+class TestShardedFusedContract:
+    """Tier-2 gate: one fused sharded burst end-to-end under the conftest
+    8-device mesh — the single-dispatch / single-fetch contract must
+    survive sharding (device_dispatches == device_fetches == 1 for the
+    burst) with devices == 8 and the analytic ICI traffic booked."""
+
+    def test_one_dispatch_one_fetch_at_8_devices(self, mesh):
+        from kubernetes_tpu.core.tpu_scheduler import (
+            DEVICE_DISPATCH, DEVICE_FETCHES, ICI_ALLGATHER)
+        from kubernetes_tpu.store.store import Store, PODS, NODES
+        from kubernetes_tpu.scheduler import Scheduler
+        assert int(mesh.devices.size) == 8
+        s = Store(watch_log_size=65536)
+        for i in range(48):
+            s.create(NODES, Node(
+                name=f"n{i}",
+                labels={"failure-domain.beta.kubernetes.io/zone":
+                        f"z{i % 3}"},
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+        sched = Scheduler(s, use_tpu=True,
+                          percentage_of_nodes_to_score=100, mesh=mesh)
+        sched.sync()
+        assert sched.algorithm.debug_state()["devices"] == 8
+        mixed = []   # mixed classes -> the FUSED window, not uniform
+        for j in range(24):
+            kw = {"labels": {"app": "x"}}
+            cpu = 100 + 100 * (j % 3)
+            mixed.append(Pod(name=f"p{j}", **kw,
+                             containers=(Container.make(
+                                 name="c", requests={"cpu": cpu,
+                                                     "memory": GI}),)))
+        # warmup compiles the bucket outside the counted burst
+        for p in mixed[:4]:
+            s.create(PODS, p.clone())
+        sched.pump()
+        while sched.schedule_burst(max_pods=32):
+            pass
+        sched.pump()
+        fused_ops = ("burst_fused", "burst_scan", "burst_uniform")
+        d0 = {op: DEVICE_DISPATCH.labels(op).value for op in fused_ops}
+        f0 = {op: DEVICE_FETCHES.labels(op).value for op in fused_ops}
+        i0 = sum(c.value for c in ICI_ALLGATHER._children.values())
+        for j, p in enumerate(mixed):
+            s.create(PODS, Pod(name=f"m{j}", labels=dict(p.labels),
+                               containers=p.containers))
+        sched.pump()
+        n = sched.schedule_burst(max_pods=64)
+        assert n == 24
+        dd = sum(DEVICE_DISPATCH.labels(op).value - d0[op]
+                 for op in fused_ops)
+        ff = sum(DEVICE_FETCHES.labels(op).value - f0[op]
+                 for op in fused_ops)
+        assert dd == 1, f"fused sharded burst paid {dd} dispatches"
+        assert ff == 1, f"fused sharded burst paid {ff} fetches"
+        ici = sum(c.value for c in ICI_ALLGATHER._children.values()) - i0
+        assert ici > 0, "sharded launch booked no ICI traffic"

@@ -705,7 +705,7 @@ class TestMixedWorkloadShellFuzz:
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
     def test_bindings_identical(self, seed, wave_size, flight_replay,
-                                chaos=False):
+                                chaos=False, mesh=None):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -777,7 +777,8 @@ class TestMixedWorkloadShellFuzz:
             rng.setstate(rng_state)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
-                              percentage_of_nodes_to_score=100)
+                              percentage_of_nodes_to_score=100,
+                              mesh=mesh if use_tpu else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
             sched.sync()
@@ -806,6 +807,20 @@ class TestMixedWorkloadShellFuzz:
         faults retry under the wave token, native cores demote, watches
         drop and resync) — a fault costs throughput, never a decision."""
         self.test_bindings_identical(23, 4, flight_replay, chaos=True)
+
+    # round-15: the identical differential fuzz with the TPU world's node
+    # axis sharded over the conftest 8-device mesh — rotation, spread,
+    # uniform/ELIM, refusals and the serial fallback all run SHARDED (the
+    # non-mesh variants on the same seeds pin single-device vs oracle, so
+    # mesh-vs-oracle here transitively pins mesh vs the single-device
+    # fused kernel referee on the same decision stream)
+    @pytest.mark.parametrize("wave_size", [None, 4])
+    @pytest.mark.parametrize("seed", [11, 47, 61])
+    def test_bindings_identical_sharded(self, seed, wave_size,
+                                        flight_replay):
+        from kubernetes_tpu.parallel import sharding as S
+        self.test_bindings_identical(seed, wave_size, flight_replay,
+                                     mesh=S.make_mesh(8))
 
     # round-14: nodes DIE on a seeded schedule while pods keep arriving —
     # mid-burst through the node.dead seam in the TPU world, at the round
@@ -923,7 +938,8 @@ class TestPreemptionPressureShellFuzz:
     @pytest.mark.parametrize("wave_size", [None, 3])
     @pytest.mark.parametrize("seed", [3, 5, 17, 7, 29])
     def test_preemptive_convergence_identical(self, seed, wave_size,
-                                              flight_replay, chaos=False):
+                                              flight_replay, chaos=False,
+                                              mesh=None):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -952,7 +968,8 @@ class TestPreemptionPressureShellFuzz:
             clock = FakeClock(100.0)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
-                              percentage_of_nodes_to_score=100)
+                              percentage_of_nodes_to_score=100,
+                              mesh=mesh if use_tpu else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
             sched.sync()
@@ -991,6 +1008,17 @@ class TestPreemptionPressureShellFuzz:
         the oracle Preemptor, a refused pressure wave reruns serially."""
         self.test_preemptive_convergence_identical(17, 3, flight_replay,
                                                    chaos=True)
+
+    # round-15: preemption pressure with the TPU world sharded — the
+    # victim planes, ghost-load carry, and schedule-else-preempt scans run
+    # under NamedSharding(mesh, P("nodes")) and must converge identically
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("seed", [3, 17])
+    def test_preemptive_convergence_sharded(self, seed, wave_size,
+                                            flight_replay):
+        from kubernetes_tpu.parallel import sharding as S
+        self.test_preemptive_convergence_identical(
+            seed, wave_size, flight_replay, mesh=S.make_mesh(8))
 
     # round-14: nodes DIE under preemption pressure — mid-burst via the
     # node.dead seam in the TPU world (launch refusal + victim-table/
@@ -1228,7 +1256,8 @@ class TestSpreadBurstParity:
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [13, 37, 71])
     def test_burst_matches_oracle_with_existing_pods(self, seed, wave_size,
-                                                     chaos=False):
+                                                     chaos=False,
+                                                     mesh=None):
         """The vectorized spread encode counts pre-existing pods through
         the columnar table: some existing pods match the Service selector
         (non-zero spread0 carried into the burst), some differ only in
@@ -1275,7 +1304,8 @@ class TestSpreadBurstParity:
             rng.setstate(rng_state)
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
-                              percentage_of_nodes_to_score=100)
+                              percentage_of_nodes_to_score=100,
+                              mesh=mesh if use_tpu else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
             sched.sync()
@@ -1301,6 +1331,16 @@ class TestSpreadBurstParity:
         orders, spread0, the generic packed block) stays bit-identical
         with the fault plane firing in the TPU world."""
         self.test_burst_matches_oracle_with_existing_pods(37, 4, chaos=True)
+
+    # round-15: carried spread + uneven-zone rotation SHARDED — exactly
+    # the two features the pre-round-15 mesh path refused
+    # (burst-sharded-rotation / burst-sharded-spread, now deleted)
+    @pytest.mark.parametrize("wave_size", [None, 4])
+    @pytest.mark.parametrize("seed", [13, 71])
+    def test_spread_sharded(self, seed, wave_size):
+        from kubernetes_tpu.parallel import sharding as S
+        self.test_burst_matches_oracle_with_existing_pods(
+            seed, wave_size, mesh=S.make_mesh(8))
 
 
 class TestMidBurstPreemptionConsistency:
