@@ -557,6 +557,49 @@ def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
     }
 
 
+def run_serve_bench(n_nodes: int, arrival_rate: float, duration: float,
+                    window: int = 2048, depth: int = 3,
+                    max_depth: Optional[int] = None, mesh=None) -> dict:
+    """`--mode serve`: the round-16 arrival-driven lane — pods ARRIVE at
+    `arrival_rate`/s for `duration` seconds (hollow arrival clients with
+    429-aware retry) while the ServeLoop cuts fused windows from the
+    live activeQ under the N-deep launch queue, and the backpressure
+    gate sheds past the watermark. Scores SUSTAINED pods/s (not a
+    backlog drain) and the ledger's admission->commit startup
+    percentiles against the density.go 5 s SLO; the cell's own audits
+    (all-admitted-or-429'd, flight-recorder replay parity) gate the
+    numbers. One JSON line, same multi-chip fields as every mode."""
+    from kubernetes_tpu.perf.harness import run_serve_cell
+    r = run_serve_cell(n_nodes, arrival_rate, duration, window=window,
+                       depth=depth, max_depth=max_depth, mesh=mesh)
+    adm = r["admission"]
+    return {
+        "metric": (f"serve_sustained_{n_nodes}n_"
+                   f"{int(arrival_rate)}rps_{int(duration)}s"),
+        "value": r["sustained_pods_per_s"],
+        "unit": "pods/s",
+        "baseline_note": "sustained pods/s over the arrival window "
+                         "(bounded above by the arrival rate; the drain "
+                         "benches measure peak, this lane measures "
+                         "serving)",
+        "arrival_rate": arrival_rate,
+        "duration_s": r["duration"],
+        "window": r["window"],
+        "launch_depth": r["depth"],
+        "windows_cut": r["windows_cut"],
+        "startup_p50": r["startup_p50"],
+        "startup_p99": r["startup_p99"],
+        "startup_slo_5s": r["startup_slo_ok"],
+        "phase_split": r["phase_split"],
+        "pods_completed": r["pods_completed"],
+        "admission_admitted": adm["admitted"],
+        "admission_rejected": adm["rejected"],
+        "arrivals": r["arrivals"],
+        "audit_all_admitted_or_429": r["audit_all_admitted_or_429"],
+        "parity_violations": r["parity_violations"],
+    }
+
+
 def run_commit_bench(n_pods: int = 4096, waves: int = 8,
                      watchers: int = 8) -> dict:
     """`--mode commit`: the round-11 commit-core lane — the store-write +
@@ -714,8 +757,27 @@ def main():
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
-                             "gang", "commit", "chaos", "churn"],
+                             "gang", "commit", "chaos", "churn", "serve"],
                     default="burst")
+    # `--mode serve` (round 16): arrival-driven serving — pods arrive at
+    # --arrival-rate for --duration seconds (minutes-scale soaks: raise
+    # --duration) while the ServeLoop cuts --serve-window-sized launch
+    # windows at launch-queue depth --serve-depth and the backpressure
+    # gate sheds past --max-queue-depth (default: 2s of arrivals)
+    ap.add_argument("--arrival-rate", type=float, default=2000.0,
+                    help="serve mode: pod arrivals per second")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="serve mode: seconds of sustained arrivals")
+    ap.add_argument("--serve-window", type=int, default=2048,
+                    help="serve mode: launch-window size (commit/failure "
+                         "granularity)")
+    ap.add_argument("--serve-depth", type=int, default=3,
+                    help="serve mode: launch-queue depth (windows in "
+                         "flight while the oldest commits)")
+    ap.add_argument("--max-queue-depth", type=int, default=None,
+                    help="serve mode: admission watermark (activeQ + "
+                         "unpumped backlog); creates past it shed with "
+                         "429 + Retry-After")
     # big bursts amortize the fixed per-launch cost (dispatch + tunnel RTT);
     # the uniform kernel's pod count is dynamic, so no padding waste at any
     # size — the cap is kernels.B_CAP per launch
@@ -830,12 +892,19 @@ def main():
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     n_nodes = args.nodes if args.nodes is not None \
-        else (1000 if args.mode in ("preempt", "chaos")
+        else (1000 if args.mode in ("preempt", "chaos", "serve")
               else (300 if args.mode == "churn" else 15000))
     n_pods = args.pods if args.pods is not None \
         else (5000 if args.mode == "chaos"
               else (3000 if args.mode == "churn" else 10000))
     report_nodes[0] = n_nodes if args.mode != "commit" else 0
+    if args.mode == "serve":
+        result = retry_transient(lambda: run_serve_bench(
+            n_nodes, args.arrival_rate, args.duration,
+            window=args.serve_window, depth=args.serve_depth,
+            max_depth=args.max_queue_depth, mesh=mesh))
+        finish(result)
+        return
     if args.mode == "preempt":
         result = retry_transient(
             lambda: run_preempt_bench(n_nodes, n_pods, args.preemptors,

@@ -35,8 +35,8 @@ from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
 from kubernetes_tpu.store.store import (
-    Store, PODS, PODGROUPS, AlreadyExistsError, ConflictError,
-    DisruptionBudgetError, NotFoundError, ExpiredError,
+    Store, PODS, PODGROUPS, AlreadyExistsError, BackpressureError,
+    ConflictError, DisruptionBudgetError, NotFoundError, ExpiredError,
 )
 
 API_PREFIX = "/api/v1"
@@ -393,6 +393,19 @@ def make_handler(store: Store, admission: AdmissionChain,
                 created = store.create(kind, obj)
             except AdmissionError as e:
                 self._error(422, "Invalid", str(e))
+                return
+            except BackpressureError as e:
+                # serving load shed (store.admission_gate): the write
+                # never landed, so the client may safely retry after the
+                # suggested backoff. Reason "Backpressure" distinguishes
+                # this 429 from the eviction subresource's budget refusal
+                # on the wire (RemoteStore maps them to distinct errors).
+                # The admitted chain's side effects roll back like any
+                # refused write (quota charges must not leak per shed).
+                admission.refund(kind, admitted, store)
+                self._error(429, "Backpressure", str(e),
+                            headers={"Retry-After":
+                                     f"{e.retry_after:.3f}"})
                 return
             except AlreadyExistsError as e:
                 # the admitted write never landed: roll back side-effecting

@@ -45,6 +45,14 @@ at the exact point the real failure would surface):
   (it needs a hook and — unlike every other seam — legitimately changes
   the post-churn world, so the churn parity harnesses drive the SAME
   kill schedule through their serial-oracle referee).
+- ``serve.shed`` — the serving admission gate sheds a pod create it
+  would otherwise have admitted (429 + Retry-After with the gate's
+  normal suggested backoff): deterministic backpressure injection for
+  the serve parity/chaos harnesses. Opt-in: it only fires where a
+  BackpressureGate is attached, and — like node.dead — it legitimately
+  changes which pods enter the cluster, so a blanket ``all=`` rate must
+  not seed it (the serve referee drives the SAME shed schedule through
+  both worlds).
 
 Configuration:
 - programmatic: ``chaos.plan(seed=42, rates={"device.fetch": 0.1})`` or
@@ -83,11 +91,13 @@ SEAMS = (
     "clock.jump",
     "sched.crash",
     "node.dead",
+    "serve.shed",
 )
 
 #: seams a blanket `all=<rate>` never seeds: they need explicit opt-in
-#: plumbing (a wrapped clock, a crash-driving harness, a node-kill hook)
-OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead")
+#: plumbing (a wrapped clock, a crash-driving harness, a node-kill hook,
+#: an attached serving backpressure gate)
+OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead", "serve.shed")
 
 INJECTIONS = obs.counter(
     "chaos_injections_total",
@@ -158,6 +168,7 @@ _FAULT_FOR = {
     "clock.jump": InjectedFault,
     "sched.crash": SchedulerCrash,
     "node.dead": InjectedFault,
+    "serve.shed": InjectedFault,
 }
 
 

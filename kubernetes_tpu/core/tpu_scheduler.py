@@ -934,6 +934,23 @@ class TPUScheduler:
     # the shell passes a per-wave commit callback when the algorithm
     # advertises this (Scheduler._burst_segment)
     supports_wave_commit = True
+    # -- N-deep launch queue (round 16) --------------------------------------
+    # The round-7 pipeline kept ONE chunk in flight ahead of the chunk
+    # being committed (2-deep). Serving at arrival rate needs the tunnel
+    # RTT hidden ACROSS windows, not just inside one burst: launch_depth
+    # is the number of launch windows planned+encoded+dispatched at once
+    # (2 = the historical behavior), and launch_cap (None = B_CAP) caps
+    # the chunk size so a serve window IS a launch chunk — while window k
+    # commits, windows k+1..k+depth-1 are already on the device. Each
+    # window stays ONE dispatch + ONE packed fetch (TestDeviceFetchContract
+    # pins it at depth >= 3), and the rewind contract extends unchanged: a
+    # refused/failed/aborted window cancels its in-flight successors
+    # UNFETCHED and replans from the packed-block boundaries.
+    launch_depth = 2
+    launch_cap: Optional[int] = None
+    # live launch-queue occupancy (windows dispatched, not yet consumed) —
+    # the serving backpressure gate's inflight_fn reads it lock-free
+    inflight_launches = 0
 
     def _fetch_pool_get(self):
         pool = self._fetch_pool
@@ -1148,10 +1165,14 @@ class TPUScheduler:
         returned False) stops consumption — the rest of the block is
         discarded along with the resident folds, and the returned prefix
         ends at the last window handed to the callback."""
-        # the launch cap IS the caller's burst bucket (clamped to B_CAP):
-        # the warmup burst rides the same bucket, so the one compile per
-        # (bucket, class-flags) signature happens outside any timed loop
-        cap = _pad_pow2(max(1, min(bucket, K.B_CAP)), 16)
+        # the launch cap IS the caller's burst bucket (clamped to B_CAP,
+        # and to launch_cap when the serve loop pinned window-sized
+        # chunks): the warmup burst rides the same bucket, so the one
+        # compile per (bucket, class-flags) signature happens outside any
+        # timed loop
+        hard = K.B_CAP if not self.launch_cap \
+            else min(K.B_CAP, int(self.launch_cap))
+        cap = _pad_pow2(max(1, min(bucket, hard)), 16)
         W = max(1, min(int(self.wave_size), cap))
         n_pods = len(pods)
         chunks = [(lo, min(cap, n_pods - lo))
@@ -1184,16 +1205,24 @@ class TPUScheduler:
             _t = _obs("kernel", _t)   # dispatch (async; fetch waits)
             inflight.append((ci, lo, chunk, self._submit_fetch(packed),
                              t_d))
+            self.inflight_launches = len(inflight)
 
         aborted = False
         failed = False
         faulted = False
+        depth = max(1, int(self.launch_depth))
+        next_ci = 1
         try:
             dispatch(0)
             while inflight:
-                if len(inflight) == 1 and inflight[0][0] + 1 < len(chunks):
-                    dispatch(inflight[0][0] + 1)  # keep one chunk in flight
+                # N-deep launch queue: keep up to `depth` windows
+                # planned/encoded/dispatched while the oldest commits
+                # (depth=2 is the historical one-ahead pipeline)
+                while len(inflight) < depth and next_ci < len(chunks):
+                    dispatch(next_ci)
+                    next_ci += 1
                 ci, lo, chunk, fut, t_d = inflight.pop(0)
+                self.inflight_launches = len(inflight)
                 chaos.node_dead_point("dispatch-fetch")
                 chaos.check("device.fetch")
                 h = fut.result()  # ONE fetch per launch: selections + lni
@@ -1294,6 +1323,8 @@ class TPUScheduler:
                 obs_flight.RECORDER.note_outcome(fl, {
                     "hosts": [], "failed": False, "aborted": True})
                 return None
+        finally:
+            self.inflight_launches = 0
         if not (failed or aborted or faulted):
             self.breaker.record_success()
         obs_flight.RECORDER.note_outcome(fl, {

@@ -6,9 +6,13 @@ this round no pod could answer "where did my 5 seconds go?". The ledger is
 a low-overhead per-pod phase stamper: monotonic (`time.perf_counter`)
 timestamps at each lifecycle boundary,
 
-    enqueue -> pop -> encode -> dispatch -> fetch -> commit -> copyout
+    admission -> enqueue -> pop -> encode -> dispatch -> fetch
+              -> commit -> copyout
 
-stamped by the queue (enqueue/pop), the TPU burst drivers
+stamped by the admission surface (admission — the apiserver/store accept
+of the pod create, BEFORE the informer delivers it to queue.add; absent
+for pods that never crossed an admission gate, where it collapses onto
+enqueue), the queue (enqueue/pop), the TPU burst drivers
 (encode/dispatch/fetch — one shared stamp per launch, so a 10k-pod burst
 pays O(1) clock reads plus O(pods) dict writes, never a per-pod syscall),
 the store's commit verbs (commit — the `commit_wave` landing), and the
@@ -16,10 +20,16 @@ commit core's watch copy-out sink (copyout — stamped from inside BOTH
 `native/commitcore.cpp` and the `PyCommitCore` twin via the fan-out sink).
 
 Phase durations are differences of consecutive stamps, so they telescope:
-the six phases sum EXACTLY to copyout - enqueue (the contract test pins
-per-pod sums against measured burst wall time). Folds are batched: one
-vectorized `observe_batch` per phase per committed wave, not 6 histogram
-walks per pod.
+the seven phases sum EXACTLY to copyout - admission (the contract test
+pins per-pod sums against measured burst wall time; admission collapses
+onto enqueue when no gate stamped it). Folds are batched: one vectorized
+`observe_batch` per phase per committed wave, not 7 histogram walks per
+pod.
+
+A 429-shed pod's record is EVICTED at rejection (`evict`): first-stamp-
+wins semantics would otherwise carry the shed attempt's timestamp into
+the readmitted pod's record and bill the client's backoff as startup
+latency — the readmit must measure from its own accepted create.
 
 Exposed families:
 - pod_e2e_duration_seconds{phase} — the decomposition histograms
@@ -40,14 +50,18 @@ from kubernetes_tpu import obs
 from kubernetes_tpu.obs.registry import LATENCY_BUCKETS
 
 # stamp slots (indices into a pod's record)
-ENQUEUE, POP, ENCODE, DISPATCH, FETCH, COMMIT, COPYOUT = range(7)
+(ADMISSION, ENQUEUE, POP, ENCODE, DISPATCH, FETCH, COMMIT,
+ COPYOUT) = range(8)
 
 #: phase names, in stamp order; PHASES[i] = stamps[i+1] - stamps[i]
-PHASES = ("queue", "encode", "dispatch", "fetch", "commit", "fanout")
+PHASES = ("admission", "queue", "encode", "dispatch", "fetch", "commit",
+          "fanout")
 
 POD_E2E = obs.histogram(
     "pod_e2e_duration_seconds",
-    "Per-pod lifecycle phase durations: queue (enqueue->pop), encode "
+    "Per-pod lifecycle phase durations: admission (apiserver/store "
+    "accept->informer-delivered enqueue; zero for pods that never "
+    "crossed an admission gate), queue (enqueue->pop), encode "
     "(pop->features encoded), dispatch (encode->device program "
     "dispatched), fetch (dispatch->packed block fetched), commit "
     "(fetch->commit_wave landed in the store), fanout (commit->first "
@@ -71,9 +85,9 @@ class PodLifecycleLedger:
                  reservoir: int = 1 << 16):
         self._lock = threading.Lock()
         self._capacity = capacity
-        self._recs: dict[str, list] = {}      # key -> [t0..t5] (pre-commit)
+        self._recs: dict[str, list] = {}      # key -> [t0..t6] (pre-commit)
         self._awaiting: dict[str, float] = {}  # key -> commit ts (fan-out)
-        self._e2e: deque = deque(maxlen=reservoir)   # enqueue->commit
+        self._e2e: deque = deque(maxlen=reservoir)   # admission->commit
         self._phase_sum = {p: 0.0 for p in PHASES}
         self._completed = 0
         self._trace: Optional[dict] = None    # key -> stamps (test mode)
@@ -96,19 +110,40 @@ class PodLifecycleLedger:
                 self._trace = {}
 
     # -- stamping ------------------------------------------------------------
-    def stamp_enqueue(self, key: str, t: Optional[float] = None) -> None:
-        """First enqueue wins: a re-queued (backoff) pod keeps its original
-        arrival, so queue time honestly includes backoff waits."""
+    def _open_rec(self, key: str, slot: int, t: Optional[float]) -> None:
+        """First stamp wins per slot: a re-queued (backoff) pod keeps its
+        original arrival, so queue time honestly includes backoff waits —
+        and an admission-stamped pod's later enqueue fills ENQUEUE without
+        disturbing the accepted-create stamp."""
         with self._lock:
-            if key in self._recs:
-                return
-            if len(self._recs) >= self._capacity:
-                # bound in-flight records: evict the oldest insertion
-                self._recs.pop(next(iter(self._recs)))
-                LEDGER_EVICTED.inc()
-            rec = [None] * 7
-            rec[ENQUEUE] = t if t is not None else time.perf_counter()
-            self._recs[key] = rec
+            rec = self._recs.get(key)
+            if rec is None:
+                if len(self._recs) >= self._capacity:
+                    # bound in-flight records: evict the oldest insertion
+                    self._recs.pop(next(iter(self._recs)))
+                    LEDGER_EVICTED.inc()
+                rec = self._recs[key] = [None] * 8
+            if rec[slot] is None:
+                rec[slot] = t if t is not None else time.perf_counter()
+
+    def stamp_admission(self, key: str, t: Optional[float] = None) -> None:
+        """Apiserver/store accept of the pod create — stamped BEFORE the
+        informer delivers the pod to queue.add, so the admission phase
+        measures watch-to-enqueue time. First accept wins."""
+        self._open_rec(key, ADMISSION, t)
+
+    def stamp_enqueue(self, key: str, t: Optional[float] = None) -> None:
+        """First enqueue wins (see _open_rec)."""
+        self._open_rec(key, ENQUEUE, t)
+
+    def evict(self, key: str) -> None:
+        """Admission rejected the pod (429 shed): drop its in-flight
+        record outright. First-stamp-wins would otherwise let a
+        shed-then-readmitted pod keep the SHED attempt's stamps and bill
+        the client's backoff as startup latency — the readmit opens a
+        fresh record at its own accepted create."""
+        with self._lock:
+            self._recs.pop(key, None)
 
     def stamp(self, key: str, slot: int, t: Optional[float] = None) -> None:
         with self._lock:
@@ -142,9 +177,10 @@ class PodLifecycleLedger:
     def commit_many(self, keys, t: Optional[float] = None) -> None:
         """A wave of bindings landed (`Store.commit_wave` / bind verbs):
         fold each pod's pre-commit phases into the histograms in one
-        vectorized batch per phase, record the enqueue->commit latency in
-        the startup reservoir, and park the commit stamp for the fan-out
-        phase (completed by the commit core's copy-out sink)."""
+        vectorized batch per phase, record the admission->commit latency
+        in the startup reservoir (= enqueue->commit for pods no admission
+        gate stamped), and park the commit stamp for the fan-out phase
+        (completed by the commit core's copy-out sink)."""
         tt = t if t is not None else time.perf_counter()
         folds: list[list] = []
         with self._lock:
@@ -154,10 +190,14 @@ class PodLifecycleLedger:
                 if rec is None:
                     continue
                 rec[COMMIT] = tt
+                # a pod that never crossed an admission gate collapses the
+                # admission phase to zero width at its enqueue stamp
+                if rec[ADMISSION] is None:
+                    rec[ADMISSION] = rec[ENQUEUE]
                 # missing intermediate stamps (a path that skipped a
                 # boundary) inherit the previous stamp: the phase reads 0
                 # and the telescoping identity survives
-                for i in range(1, COMMIT + 1):
+                for i in range(ENQUEUE, COMMIT + 1):
                     if rec[i] is None:
                         rec[i] = rec[i - 1]
                 folds.append(rec)
@@ -169,12 +209,12 @@ class PodLifecycleLedger:
             if not folds:
                 return
             for rec in folds:
-                self._e2e.append(rec[COMMIT] - rec[ENQUEUE])
+                self._e2e.append(rec[COMMIT] - rec[ADMISSION])
             self._completed += len(folds)
         # histogram folds outside the ledger lock (families self-lock)
-        for slot, phase in ((POP, "queue"), (ENCODE, "encode"),
-                            (DISPATCH, "dispatch"), (FETCH, "fetch"),
-                            (COMMIT, "commit")):
+        for slot, phase in ((ENQUEUE, "admission"), (POP, "queue"),
+                            (ENCODE, "encode"), (DISPATCH, "dispatch"),
+                            (FETCH, "fetch"), (COMMIT, "commit")):
             vals = [max(0.0, r[slot] - r[slot - 1]) for r in folds]
             POD_E2E.labels(phase).observe_batch(vals)
             self._phase_sum[PHASES[slot - 1]] += sum(vals)
@@ -202,7 +242,8 @@ class PodLifecycleLedger:
             return None if self._trace is None else self._trace.get(key)
 
     def percentile(self, q: float) -> float:
-        """Startup (enqueue->commit) latency percentile over the bounded
+        """Startup (admission->commit; enqueue->commit when no admission
+        gate stamped the pod) latency percentile over the bounded
         reservoir; 0.0 with no data."""
         with self._lock:
             vals = sorted(self._e2e)

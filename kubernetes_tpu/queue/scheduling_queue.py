@@ -454,6 +454,14 @@ class PriorityQueue:
         with self._lock:
             return len(self._active) + len(self._backoffq) + len(self._unschedulable)
 
+    def active_depth(self) -> int:
+        """O(1) activeQ depth — the serving backpressure gate's watermark
+        input (deliberately NOT num_pending: backoff/unschedulable pods
+        re-enter on their own timers and shedding new arrivals on their
+        account would starve a recovering cluster)."""
+        with self._lock:
+            return len(self._active)
+
     def parked_gangs(self) -> dict[str, dict]:
         """Gangs currently under a group backoff window, with deadlines —
         the /debug/sched view of why a PodGroup isn't being attempted."""

@@ -1,0 +1,145 @@
+"""Arrival generator — the hollow client feeding the serving pipeline.
+
+Creates pods against any Store surface (embedded `Store` or
+`RemoteStore` — the verb is just `create(PODS, pod)`) at a target
+arrival rate, batch-paced: each tick creates the number of pods the
+elapsed wall time owes at `rate`, so a generator thread that loses the
+GIL to the scheduler catches up instead of silently under-delivering.
+
+Backpressure is honored exactly like a well-behaved client: a shed
+create (`BackpressureError`, the 429 + Retry-After contract) books the
+rejection and RE-QUEUES the arrival locally for after the server's
+suggested backoff (with jitter) — arrivals are never silently dropped,
+so the bench's all-admitted-or-429'd audit can account for every one.
+Arrivals still pending re-admission when the run ends are reported as
+`shed_final` (the client gave up, as a real client eventually would).
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+from kubernetes_tpu.api.types import Container, Pod
+from kubernetes_tpu.store.store import (AlreadyExistsError,
+                                        BackpressureError, PODS)
+
+MI = 1024 ** 2
+
+
+def default_pod(name: str) -> Pod:
+    """The density-shaped arrival pod (the headline bench's spec)."""
+    return Pod(name=name, labels={"app": "serve"},
+               containers=(Container.make(
+                   name="c", requests={"cpu": 100, "memory": 500 * MI}),))
+
+
+class ArrivalGenerator:
+    """Paced pod creation with 429-aware retry (see module docstring).
+
+    Drive it cooperatively: `tick()` creates whatever is due now and
+    returns quickly, so a single-threaded serve bench interleaves
+    arrivals with serve windows without thread scheduling noise — or
+    call `run()` on a thread for wall-clock pacing. `seed` fixes the
+    retry-jitter stream and the name sequence, so two generators fed the
+    same accept/shed answers produce identical arrival sequences (the
+    serve parity fuzz's requirement)."""
+
+    def __init__(self, store, rate: float, total: Optional[int] = None,
+                 pod_fn=default_pod, name_prefix: str = "arr-",
+                 seed: int = 0, give_up_after: int = 64):
+        self.store = store
+        self.rate = float(rate)
+        self.total = total            # None = unbounded (duration-paced)
+        self.pod_fn = pod_fn
+        self.name_prefix = name_prefix
+        self.give_up_after = int(give_up_after)
+        self._rng = random.Random(seed)
+        self._seq = 0
+        self._t0: Optional[float] = None
+        self._owed = 0.0
+        # locally re-queued sheds: (due_time, name, attempts)
+        self._retry: list = []
+        self.attempted = 0            # distinct arrivals tried at least once
+        self.created = 0
+        self.rejected = 0             # total 429 sheds (incl. retries)
+        self.gave_up = 0              # arrivals dropped after give_up_after
+
+    def _create(self, name: str, attempts: int, now: float) -> None:
+        try:
+            self.store.create(PODS, self.pod_fn(name))
+            self.created += 1
+        except BackpressureError as e:
+            self.rejected += 1
+            if attempts + 1 >= self.give_up_after:
+                self.gave_up += 1
+                return
+            # capped jittered client backoff off the server's suggestion
+            delay = min(e.retry_after, 5.0) * (0.5 + self._rng.random())
+            self._retry.append((now + delay, name, attempts + 1))
+        except AlreadyExistsError:
+            # a retried create whose first attempt actually landed
+            self.created += 1
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Create every arrival due by `now` (fresh ones owed by the rate
+        plus re-queued sheds whose backoff expired). Returns creates
+        attempted this tick."""
+        now = time.perf_counter() if now is None else now
+        if self._t0 is None:
+            self._t0 = now
+        n = 0
+        # re-admissions first: they arrived earlier and queue earlier
+        due = [r for r in self._retry if r[0] <= now]
+        if due:
+            self._retry = [r for r in self._retry if r[0] > now]
+            for _t, name, attempts in sorted(due):
+                self._create(name, attempts, now)
+                n += 1
+        self._owed += (now - self._t0) * self.rate
+        self._t0 = now
+        fresh = int(self._owed)
+        if self.total is not None:
+            fresh = min(fresh, self.total - self.attempted)
+        self._owed -= fresh
+        for _ in range(max(0, fresh)):
+            name = f"{self.name_prefix}{self._seq}"
+            self._seq += 1
+            self.attempted += 1
+            self._create(name, 0, now)
+            n += 1
+        return n
+
+    def finished(self) -> bool:
+        return (self.total is not None and self.attempted >= self.total
+                and not self._retry)
+
+    def flush_retries(self, timeout: float = 30.0) -> None:
+        """Drive pending re-admissions to an outcome (created or given
+        up) — the post-run settlement the audit runs after."""
+        deadline = time.perf_counter() + timeout
+        while self._retry and time.perf_counter() < deadline:
+            nxt = min(t for t, _n, _a in self._retry)
+            time.sleep(max(0.0, min(nxt - time.perf_counter(), 0.05)))
+            self.tick()
+
+    def run(self, duration: float, stop=None) -> None:
+        """Wall-clock pacing loop (thread entry): tick until `duration`
+        elapses (or `stop()` is true), sleeping between ticks."""
+        end = time.perf_counter() + duration
+        while time.perf_counter() < end:
+            if stop is not None and stop():
+                return
+            self.tick()
+            if self.finished():
+                return
+            time.sleep(min(0.002, 1.0 / max(self.rate, 1.0)))
+
+    def stats(self) -> dict:
+        return {
+            "attempted": self.attempted,
+            "created": self.created,
+            "rejected_429": self.rejected,
+            "gave_up": self.gave_up,
+            "pending_retry": len(self._retry),
+        }

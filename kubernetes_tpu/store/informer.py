@@ -132,6 +132,27 @@ class SharedInformer:
     def has_synced(self) -> bool:
         return self._synced
 
+    def backlog(self) -> int:
+        """Events published for this informer's watch but not yet pumped
+        (embedded store: the commit core's cursor backlog; remote: the
+        client reader's queue). The serving backpressure gate adds this
+        to the activeQ depth so a burst of creates BETWEEN informer pumps
+        cannot blow past the watermark unobserved — it counts every
+        undelivered event for the kind (binds included), which only
+        overcounts, so the gate errs toward shedding under churn."""
+        w = self._watch
+        if w is None:
+            return 0
+        core = getattr(self.store, "_core", None)
+        wid = getattr(w, "_wid", None)
+        if core is not None and wid is not None:
+            try:
+                return int(core.backlog(wid))
+            except Exception:
+                return 0
+        q = getattr(w, "_queue", None)   # RemoteWatch's reader queue
+        return q.qsize() if q is not None else 0
+
     # -- relist backoff guard ------------------------------------------------
     def _note_expired(self) -> None:
         """One step of the consecutive-ExpiredError streak: sleep the

@@ -34,9 +34,9 @@ from typing import Any, Callable, Optional
 from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.store.store import (
-    Event, PODS, AlreadyExistsError, ConflictError, DisruptionBudgetError,
-    ExpiredError, NotFoundError, nominated_node_mutator,
-    pod_condition_mutator,
+    Event, PODS, AlreadyExistsError, BackpressureError, ConflictError,
+    DisruptionBudgetError, ExpiredError, NotFoundError,
+    nominated_node_mutator, pod_condition_mutator,
 )
 
 # client-runtime metrics (rest_client_requests_total /
@@ -55,9 +55,13 @@ TRANSIENT_RETRIES = obs.counter(
     ("kind",))
 REQUEST_RETRIES = obs.counter(
     "remote_request_retries_total",
-    "Unary requests retried after a transient transport failure or 5xx, "
-    "by verb class (read / cas / bind / status). Write classes that are "
-    "not idempotent (create / delete) never auto-retry.", ("verb",))
+    "Unary requests retried, by verb/outcome class: read / cas / bind / "
+    "status retries follow a transient transport failure or 5xx; the "
+    "distinct 'backpressure' label counts creates re-sent after a 429 "
+    "admission shed, honoring the server's Retry-After with capped "
+    "jittered backoff (the shed write never landed, so the retry is "
+    "safe). Write classes that are not idempotent (create / delete) "
+    "never retry on TRANSPORT failures.", ("verb",))
 
 
 class APIStatusError(Exception):
@@ -82,12 +86,18 @@ def _raise_for(code: int, reason: str, message: str,
     if code == 410:
         raise ExpiredError(message)
     if code == 429:
-        # eviction subresource budget refusal: Retry-After carries the
-        # server's suggested backoff (same error type as the embedded verb)
+        # two distinct 429 contracts share the status code, split by
+        # reason: "Backpressure" is the serving admission shed (the write
+        # never landed — retry after the suggested backoff is SAFE),
+        # anything else is the eviction subresource's budget refusal
+        # (same error type as the embedded verb; never auto-retried).
+        # Retry-After carries the server's suggested backoff either way.
         try:
             ra = float(retry_after) if retry_after else 10.0
         except ValueError:
             ra = 10.0
+        if reason == "Backpressure":
+            raise BackpressureError(message, retry_after=ra)
         raise DisruptionBudgetError(message, retry_after=ra)
     raise APIStatusError(code, reason, message)
 
@@ -327,14 +337,34 @@ class RemoteStore:
         return RemoteWatch(self.base_url, kind, since_rv, self.timeout,
                            token=self.token)
 
+    #: (total attempts, cap seconds) for 429-Backpressure retries on
+    #: create: the server's Retry-After is honored but capped (a server
+    #: suggesting minutes must not stall the client thread), with the
+    #: same 0.5-1.0x jitter stream as the transport backoff so a shed
+    #: wave of clients doesn't re-arrive in phase. Distinct from the
+    #: transport RETRY_POLICY: a 429 means the write definitively did
+    #: NOT land, so re-POSTing is safe even though POST isn't idempotent.
+    BACKPRESSURE_RETRY = (6, 2.0)
+
     # -- writes --------------------------------------------------------------
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
         # `move` is the embedded store's no-clone fast path; over the wire
         # serialization copies regardless. POST is not idempotent (a retry
-        # whose first attempt landed would AlreadyExists) — no auto-retry.
-        return serde.from_dict(kind, self._request(
-            "POST", f"/api/v1/{kind}", serde.to_dict(obj),
-            verb_class="write"))
+        # whose first attempt landed would AlreadyExists) — no auto-retry
+        # on TRANSPORT failures; only the 429-Backpressure shed (which
+        # proves the write never landed) re-sends, on its own policy.
+        attempts, cap = self.BACKPRESSURE_RETRY
+        body = serde.to_dict(obj)
+        for attempt in range(attempts):
+            try:
+                return serde.from_dict(kind, self._request(
+                    "POST", f"/api/v1/{kind}", body, verb_class="write"))
+            except BackpressureError as e:
+                if attempt + 1 >= attempts:
+                    raise
+                REQUEST_RETRIES.labels("backpressure").inc()
+                self._sleep(min(e.retry_after, cap)
+                            * (0.5 + self._rng.random() / 2))
 
     def update(self, kind: str, obj: Any,
                expect_rv: Optional[int] = None) -> Any:

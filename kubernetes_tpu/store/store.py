@@ -125,6 +125,20 @@ class DisruptionBudgetError(Exception):
         self.retry_after = retry_after
 
 
+class BackpressureError(Exception):
+    """Pod create shed by the serving admission gate (activeQ depth or
+    in-flight launch windows over the watermark) — the apiserver's
+    429 TooManyRequests on CREATE, with Retry-After carrying the server's
+    suggested backoff. Distinct from DisruptionBudgetError (the eviction
+    subresource's 429): a shed create definitively did NOT land, so
+    clients retry it safely after the suggested backoff; a refused
+    eviction must never auto-retry."""
+
+    def __init__(self, message: str, retry_after: float = 0.25):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 class NotFoundError(Exception):
     pass
 
@@ -278,6 +292,13 @@ class Store:
         # chaos store.fanout seam: a deferred wave delivery is flushed by
         # the next fan-out call or the next consumer poll (never lost)
         self._fanout_deferred = False
+        # serving admission gate (serve.backpressure.BackpressureGate):
+        # when attached, pod creates are checked against the activeQ-depth
+        # / in-flight-window watermarks and shed with BackpressureError
+        # (HTTP: 429 + Retry-After) — and accepted pod creates stamp the
+        # lifecycle ledger's admission slot, opening the watch-to-enqueue
+        # phase. None (the default) admits everything unstamped.
+        self.admission_gate = None
         # live watcher ids (wid -> kind) for the /debug/sched cursor-lag
         # view; pruned on Watch.stop()
         self._watch_ids: dict[int, str] = {}
@@ -458,6 +479,12 @@ class Store:
         """`move=True` transfers ownership: the caller promises never to
         touch `obj` again, skipping the write snapshot (the event recorder's
         fire-and-forget records use this)."""
+        gate = self.admission_gate
+        if gate is not None and kind == PODS:
+            # serving backpressure: shed BEFORE anything is written (a
+            # 429'd create definitively did not land), and evict any
+            # ledger record the shed attempt would otherwise poison
+            gate.admit(obj)
         with self._lock:
             self._core_guard()
             try:
@@ -466,7 +493,13 @@ class Store:
             finally:
                 self._flush()
             self._record_entry(kind, _key_of(stored), stored)
-            return stored
+        if gate is not None and kind == PODS:
+            # admission accepted: open the pod's lifecycle record at the
+            # accepted create, BEFORE the informer delivers it to
+            # queue.add (the watch-to-enqueue phase's left boundary)
+            from kubernetes_tpu.obs.ledger import LEDGER
+            LEDGER.stamp_admission(stored.key)
+        return stored
 
     def update(self, kind: str, obj: Any, expect_rv: Optional[int] = None) -> Any:
         with self._lock:

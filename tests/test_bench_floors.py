@@ -154,8 +154,8 @@ def test_headline_ledger_fields_and_metrics_out(tmp_path):
     assert out["pods_completed"] == 2000, out
     assert 0 < out["startup_p50"] <= out["startup_p99"], out
     split = out["phase_split"]
-    assert set(split) == {"queue", "encode", "dispatch", "fetch",
-                          "commit", "fanout"}, split
+    assert set(split) == {"admission", "queue", "encode", "dispatch",
+                          "fetch", "commit", "fanout"}, split
     # the burst path pays real time in fetch (the packed readback) and
     # commit (store write tail) — a zeroed phase means a dead stamp
     assert split["fetch"] > 0 and split["commit"] > 0, split
@@ -251,6 +251,61 @@ def test_churn_mode_floor():
     assert out["pods_recreated"] >= 1, out
     assert out["audit_all_bound"] is True, out
     assert out["value"] >= 100.0, out
+
+
+def _run_serve(extra, timeout=900):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "serve", *extra],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_serve_mode_floor():
+    """`bench.py --mode serve` (the round-16 arrival-driven lane) at the
+    acceptance cell — 1000 nodes, 2000 arrivals/s sustained for 30 s:
+    one JSON line whose own audits passed (every arrival admitted-and-
+    bound or 429'd-and-accounted; zero flight-recorder replay parity
+    violations), sustained pods/s within 10% of the arrival rate (the
+    lane is bounded above by arrivals — a serving scheduler that keeps
+    up scores ~rate; 0.9x is the fell-behind tripwire), and the
+    ledger-derived startup_p99 under the density.go 5 s SLO. The
+    multi-chip fields ride every mode's JSON, serve included."""
+    out = _run_serve(["--nodes", "1000", "--arrival-rate", "2000",
+                      "--duration", "30"])
+    assert out["unit"] == "pods/s"
+    assert out["audit_all_admitted_or_429"] is True
+    assert out["parity_violations"] == 0, out
+    assert out["value"] >= 0.9 * 2000, out
+    assert 0 < out["startup_p50"] <= out["startup_p99"], out
+    assert out["startup_p99"] <= 5.0, out
+    assert out["startup_slo_5s"] is True, out
+    # shed accounting is present (zero is fine when the device keeps up)
+    assert out["admission_rejected"] == out["arrivals"]["rejected_429"] \
+        or out["admission_rejected"] >= out["arrivals"]["rejected_429"]
+    assert out["pods_completed"] > 0
+    # admission phase actually stamped (the gate opened the records)
+    assert out["phase_split"]["admission"] > 0, out["phase_split"]
+    # the round-15 device-report fields ride the serve lane too
+    assert out["devices"] == 1 and "per_device_node_rows" in out
+    assert out["launch_depth"] >= 3
+
+
+@pytest.mark.slow
+def test_serve_mode_soak():
+    """The long soak variant: minutes-scale sustained serving (90 s at
+    the acceptance cell) — the SLO and both audits must hold over a
+    window long enough for backlog drift to surface (a loop that slowly
+    falls behind passes a 30 s cell and fails here as p99 climbs)."""
+    out = _run_serve(["--nodes", "1000", "--arrival-rate", "2000",
+                      "--duration", "90"], timeout=1500)
+    assert out["value"] >= 0.9 * 2000, out
+    assert out["startup_p99"] <= 5.0, out
+    assert out["audit_all_admitted_or_429"] is True
+    assert out["parity_violations"] == 0, out
 
 
 @pytest.mark.slow

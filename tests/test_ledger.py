@@ -90,12 +90,15 @@ class TestPhaseDecompositionContract:
             rec = traced_ledger.trace_record(p.key)
             assert rec is not None, f"{p.key} never completed in the ledger"
             assert all(s is not None for s in rec), (p.key, rec)
-            diffs = [rec[i + 1] - rec[i] for i in range(6)]
+            diffs = [rec[i + 1] - rec[i] for i in range(7)]
             # monotone stamps -> non-negative phases
             assert all(d >= 0 for d in diffs), (p.key, diffs)
-            # telescoping identity: the six phases sum EXACTLY to the
-            # pod's copyout - enqueue span (float-addition tolerance)
+            # telescoping identity: the seven phases sum EXACTLY to the
+            # pod's copyout - admission span (float-addition tolerance)
             assert sum(diffs) == pytest.approx(rec[-1] - rec[0], abs=1e-9)
+            # no admission gate in this world: the admission phase
+            # collapses to zero width at the enqueue stamp
+            assert rec[L.ADMISSION] == rec[L.ENQUEUE]
             # and the pre-fanout span sits inside the measured wall window
             assert rec[L.ENQUEUE] >= t0 - self.EPS, p.key
             assert rec[L.COMMIT] <= t1 + self.EPS, p.key
@@ -170,6 +173,39 @@ class TestLedgerBookkeeping:
         led.copyout("x", t=9.0)         # second watcher: first wins
         assert led.snapshot()["phase_split"]["fanout"] == \
             pytest.approx(0.5)
+
+    def test_admission_phase_telescopes(self):
+        # round-16: the admission stamp (apiserver accept, before
+        # queue.add) opens the record; enqueue fills its own slot without
+        # disturbing it, and the contract's telescoping identity now
+        # covers watch-to-enqueue time
+        led = L.PodLifecycleLedger()
+        led.set_trace(True)
+        led.stamp_admission("x", t=1.0)
+        led.stamp_admission("x", t=9.0)   # first accept wins
+        led.stamp_enqueue("x", t=1.5)
+        led.stamp("x", L.POP, t=2.0)
+        led.commit_many(["x"], t=3.0)
+        rec = led.trace_record("x")
+        assert rec[L.ADMISSION] == 1.0 and rec[L.ENQUEUE] == 1.5
+        split = led.snapshot()["phase_split"]
+        assert split["admission"] == pytest.approx(0.5)
+        assert split["queue"] == pytest.approx(0.5)
+        # startup is admission->commit once the gate stamped the pod
+        assert led.percentile(0.5) == pytest.approx(2.0)
+
+    def test_evict_on_admission_rejection_resets_startup(self):
+        # the round-16 bugfix: a 429-shed pod's record must NOT survive
+        # into its readmitted life — without evict() the first-stamp-wins
+        # rule would bill the client's backoff as startup latency
+        led = L.PodLifecycleLedger()
+        led.stamp_admission("x", t=1.0)   # shed attempt stamped...
+        led.evict("x")                    # ...and evicted at the 429
+        led.stamp_admission("x", t=5.0)   # readmitted after backoff
+        led.stamp_enqueue("x", t=5.1)
+        led.commit_many(["x"], t=6.0)
+        # true startup: 1s from the ACCEPTED create, not 5s from the shed
+        assert led.percentile(0.5) == pytest.approx(1.0)
 
     def test_slo_gauges_render_through_registry(self):
         from kubernetes_tpu import obs

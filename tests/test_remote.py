@@ -496,3 +496,86 @@ class TestRemoteScheduler:
             assert rc == 0
             pods, _ = store.list(PODS)
             assert all(p.node_name for p in pods)
+
+
+class TestBackpressure429:
+    """Round-16 serving backpressure over the wire: a shed pod create
+    answers 429 + reason=Backpressure + Retry-After, the client maps it
+    to BackpressureError (DISTINCT from the eviction subresource's
+    DisruptionBudgetError) and re-sends with capped jittered backoff,
+    counted on remote_request_retries_total{backpressure} — the pinned
+    contract the serve lane's arrival clients ride."""
+
+    class _ShedGate:
+        """Admission gate stub: shed the first `n` pod creates with a
+        deliberately huge Retry-After (the cap must bite)."""
+
+        def __init__(self, n, retry_after=10.0):
+            self.n = n
+            self.retry_after = retry_after
+
+        def admit(self, pod):
+            from kubernetes_tpu.store.store import BackpressureError
+            if self.n > 0:
+                self.n -= 1
+                raise BackpressureError(f"{pod.key}: shed",
+                                        retry_after=self.retry_after)
+
+    def test_create_honors_retry_after_capped_and_jittered(self, served):
+        from kubernetes_tpu.store.remote import REQUEST_RETRIES
+        store, remote = served
+        store.admission_gate = self._ShedGate(2)
+        sleeps = []
+        remote._sleep = sleeps.append
+        before = REQUEST_RETRIES.labels("backpressure").value
+        out = remote.create(PODS, mkpod("p1"))
+        assert out.name == "p1"
+        assert store.get(PODS, "default/p1").name == "p1"
+        # two sheds -> two backoffs, each the server's 10s suggestion
+        # CAPPED at 2s and jittered into [0.5, 1.0]x
+        assert len(sleeps) == 2
+        cap = remote.BACKPRESSURE_RETRY[1]
+        assert all(0.5 * cap <= s <= cap for s in sleeps), sleeps
+        assert REQUEST_RETRIES.labels("backpressure").value - before == 2
+
+    def test_sub_second_retry_after_passes_through(self, served):
+        store, remote = served
+        store.admission_gate = self._ShedGate(1, retry_after=0.25)
+        sleeps = []
+        remote._sleep = sleeps.append
+        remote.create(PODS, mkpod("p2"))
+        assert len(sleeps) == 1
+        assert 0.125 <= sleeps[0] <= 0.25, sleeps
+
+    def test_exhausted_backpressure_raises_the_mapped_error(self, served):
+        from kubernetes_tpu.store.store import BackpressureError
+        store, remote = served
+        store.admission_gate = self._ShedGate(10 ** 9)
+        remote._sleep = lambda _s: None
+        with pytest.raises(BackpressureError) as ei:
+            remote.create(PODS, mkpod("p3"))
+        # the mapped error carries the server's Retry-After verbatim
+        assert ei.value.retry_after == pytest.approx(10.0)
+        with pytest.raises(NotFoundError):
+            store.get(PODS, "default/p3")
+
+    def test_eviction_429_still_maps_to_budget_error(self, served):
+        """The eviction subresource's 429 keeps its own error type and is
+        NEVER auto-retried (a landed retry would double-charge the
+        budget) — the reason-split must not blur the two contracts."""
+        from kubernetes_tpu.api.types import (LabelSelector,
+                                              PodDisruptionBudget)
+        from kubernetes_tpu.store.store import (DisruptionBudgetError,
+                                                PDBS)
+        store, remote = served
+        remote.create(PODS, mkpod("guarded"))
+        store.create(PDBS, PodDisruptionBudget(
+            name="budget",
+            selector=LabelSelector(match_labels=()),
+            disruptions_allowed=0))
+        sleeps = []
+        remote._sleep = sleeps.append
+        with pytest.raises(DisruptionBudgetError):
+            remote.evict_pod("default/guarded")
+        assert sleeps == []          # no auto-retry on budget refusals
+        assert store.get(PODS, "default/guarded").name == "guarded"

@@ -1664,6 +1664,49 @@ class TestDeviceFetchContract:
         assert DEVICE_DISPATCH.labels("burst_scan").value - d0 == 1
         assert DEVICE_FETCHES.labels("burst_scan").value - f0 == 1
 
+    def test_launch_queue_depth3_one_fetch_per_window(self):
+        """Round 16: the N-deep launch queue at depth 3 with window-sized
+        chunks (launch_cap) — a 4-window burst is exactly 4 dispatches
+        and 4 fetches, ONE per window (never per wave or per pod), with
+        decisions bit-identical to the historical 2-deep pipeline."""
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+
+        def mk_pods():
+            return [Pod(name=f"p{k}", labels={"app": "x"},
+                        containers=(Container.make(
+                            name="c", requests={"cpu": 100}),))
+                    for k in range(64)]
+
+        def run_world(depth):
+            infos, names = self._uniform_world()
+            tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+            tpu.launch_depth = depth
+            tpu.launch_cap = 16          # 64 pods -> 4 launch windows
+            tpu.wave_size = 16           # commit windows = launch windows
+            d0 = DEVICE_DISPATCH.labels("burst_uniform").value
+            f0 = DEVICE_FETCHES.labels("burst_uniform").value
+            occupancy = []
+            hosts = tpu.schedule_burst(
+                pods=mk_pods(), node_infos=infos, all_node_names=names,
+                commit=lambda lo, hs:
+                occupancy.append(tpu.inflight_launches) or True)
+            assert hosts is not None and all(h is not None for h in hosts)
+            d = DEVICE_DISPATCH.labels("burst_uniform").value - d0
+            f = DEVICE_FETCHES.labels("burst_uniform").value - f0
+            return hosts, d, f, occupancy, tpu
+
+        deep_hosts, d, f, occupancy, tpu = run_world(3)
+        assert d == 4 and f == 4, (d, f)   # 1 dispatch + 1 fetch / window
+        # the launch queue actually ran deep: while the first window
+        # committed, BOTH successors were already dispatched (depth 3 =
+        # the consumed window's 2 in-flight successors)
+        assert max(occupancy) == 2, occupancy
+        assert tpu.inflight_launches == 0   # drained at return
+        base_hosts, d2, f2, _occ, _t = run_world(2)
+        assert d2 == 4 and f2 == 4
+        assert deep_hosts == base_hosts    # depth changes latency, not bits
+
     def test_fused_gang_burst_one_fetch(self):
         """A drain window containing gang segments — one decided, one
         REJECTED (rewound in the device carry) — plus singletons before
