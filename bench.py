@@ -591,6 +591,7 @@ def run_serve_bench(n_nodes: int, arrival_rate: float, duration: float,
         "startup_p99": r["startup_p99"],
         "startup_slo_5s": r["startup_slo_ok"],
         "phase_split": r["phase_split"],
+        "prologue_phase_split": r["prologue_phase_split"],
         "pods_completed": r["pods_completed"],
         "admission_admitted": adm["admitted"],
         "admission_rejected": adm["rejected"],

@@ -177,10 +177,13 @@ def make_handler(store: Store, admission: AdmissionChain,
             self.wfile.write(body)
 
         def _error(self, code: int, reason: str, message: str,
-                   headers: dict | None = None) -> None:
-            self._send(code, {"kind": "Status", "status": "Failure",
-                              "reason": reason, "message": message,
-                              "code": code}, headers=headers)
+                   headers: dict | None = None,
+                   extra: dict | None = None) -> None:
+            body = {"kind": "Status", "status": "Failure",
+                    "reason": reason, "message": message, "code": code}
+            if extra:
+                body.update(extra)
+            self._send(code, body, headers=headers)
 
         def _route(self):
             u = urlparse(self.path)
@@ -385,9 +388,16 @@ def make_handler(store: Store, admission: AdmissionChain,
             kind = parts[2]
             if not self._authorized(user, "create", kind):
                 return
+            body = self._body()
+            if isinstance(body, dict) and "items" in body:
+                # collection create (round 17): the serving lane's batched
+                # arrival ingest — one admission-gate evaluation and one
+                # ledger admission batch land server-side in create_many
+                self._create_collection(kind, body["items"], user)
+                return
             admitted = None
             try:
-                obj = serde.from_dict(kind, self._body())
+                obj = serde.from_dict(kind, body)
                 obj = admitted = admission.admit(
                     kind, obj, store, user=self._user_name(user))
                 created = store.create(kind, obj)
@@ -417,6 +427,50 @@ def make_handler(store: Store, admission: AdmissionChain,
                 self._error(400, "BadRequest", str(e))
                 return
             self._send(201, serde.to_dict(created))
+
+        def _create_collection(self, kind, items, user) -> None:
+            """Batched create: every item rides the admission chain, then
+            ONE store.create_many (one gate evaluation + one ledger
+            admission batch for pods). A partial shed answers 429
+            reason=Backpressure with `accepted` in the status body (the
+            first `accepted` items landed) + Retry-After — shed items'
+            admission side effects (quota charges) are refunded, landed
+            ones are not."""
+            admitted: list = []
+            try:
+                for d in items:
+                    obj = serde.from_dict(kind, d)
+                    admitted.append(admission.admit(
+                        kind, obj, store, user=self._user_name(user)))
+            except AdmissionError as e:
+                for a in admitted:
+                    admission.refund(kind, a, store)
+                self._error(422, "Invalid", str(e))
+                return
+            except (TypeError, ValueError, KeyError) as e:
+                for a in admitted:
+                    admission.refund(kind, a, store)
+                self._error(400, "BadRequest", str(e))
+                return
+            try:
+                stored = store.create_many(kind, admitted)
+            except BackpressureError as e:
+                k = max(0, min(int(getattr(e, "accepted", 0)),
+                               len(admitted)))
+                for a in admitted[k:]:
+                    admission.refund(kind, a, store)
+                self._error(429, "Backpressure", str(e),
+                            headers={"Retry-After": f"{e.retry_after:.3f}"},
+                            extra={"accepted": k})
+                return
+            except AlreadyExistsError as e:
+                # callers pass fresh uniquely-named objects (create_many
+                # contract); a duplicate is a caller bug, answered like
+                # the single-create path
+                self._error(409, "AlreadyExists", str(e))
+                return
+            self._send(201, {"kind": "Status", "status": "Success",
+                             "created": len(stored or admitted)})
 
         def _serve_PUT(self):
             path, parts, q = self._route()

@@ -22,6 +22,19 @@ class NodeTree:
         self._exhausted: set[str] = set()
         self.num_nodes = 0
         self._rotation_cache: Optional[list[int]] = None  # keyed by membership
+        # start-zone-index -> full enumeration order (membership-keyed,
+        # like the rotation map): a serving loop consumes one enumeration
+        # per window against a stable tree, and there are at most
+        # len(zones) distinct orders — list_names serves boundary-state
+        # enumerations from here instead of walking next() N times
+        self._order_cache: dict[int, list[str]] = {}
+        # start index of the most recent boundary-state list_names() (None
+        # when the last enumeration was mid-state or membership moved):
+        # lets the burst driver prove "this enumeration IS
+        # order_for_start(r)" in O(1) and keep its device axis stable
+        # across rotated windows (cycle 0 rides the rotation program
+        # instead of forcing a mirror permute + full re-upload per window)
+        self.last_enum_start: Optional[int] = None
         # membership epoch: bumps on add/remove — burst records pin it so a
         # replayed burst can prove the tree it captured is the tree it ran
         self.epoch = 0
@@ -39,6 +52,8 @@ class NodeTree:
         names.append(node.name)
         self.num_nodes += 1
         self._rotation_cache = None
+        self._order_cache = {}
+        self.last_enum_start = None
         self.epoch += 1
 
     def remove_node(self, node: Node) -> None:
@@ -49,6 +64,8 @@ class NodeTree:
         names.remove(node.name)
         self.num_nodes -= 1
         self._rotation_cache = None
+        self._order_cache = {}
+        self.last_enum_start = None
         self.epoch += 1
         if not names:
             del self._tree[zone]
@@ -88,8 +105,34 @@ class NodeTree:
                 return names[idx]
 
     def list_names(self) -> list[str]:
-        """One full interleaved enumeration — the per-cycle node order."""
-        return [self.next() for _ in range(self.num_nodes)]
+        """One full interleaved enumeration — the per-cycle node order.
+
+        At an enumeration BOUNDARY (pristine cursors, or the
+        post-enumeration state every full enumeration leaves — the
+        scheduling loop's steady state), the order is a pure function of
+        the starting zone index, so it is served from the membership-keyed
+        order cache and the cursor state advances to exactly what N
+        next() calls would leave (cursors at their ends, every zone
+        exhausted, zone index at rotation_map()[start]). Mid-enumeration
+        states (a consumer that mixed in bare next() calls) keep the
+        step-by-step walk."""
+        if not self._zones:
+            return []
+        at_boundary = (len(self._exhausted) == len(self._zones)
+                       or (not self._exhausted
+                           and not any(self._last_index.values())))
+        if not at_boundary:
+            self.last_enum_start = None   # mid-state order: not a pure
+            return [self.next() for _ in range(self.num_nodes)]
+        start = self._zone_index
+        order = self._order_cache.get(start)
+        if order is None:
+            order = self._order_cache[start] = self._simulate(start)[0]
+        self._last_index = {z: len(self._tree[z]) for z in self._zones}
+        self._exhausted = set(self._zones)
+        self._zone_index = self.rotation_map()[start]
+        self.last_enum_start = start
+        return list(order)
 
     def all_names(self) -> list[str]:
         """Every member name WITHOUT advancing the enumeration cursor

@@ -43,6 +43,10 @@ from kubernetes_tpu.obs import ledger as obs_ledger
 # dropped tunnel dispatch/readback actually raises)
 _DEVICE_FAULTS = chaos.device_fault_types()
 
+#: rotation-row cache miss sentinel (None is a legal cached value:
+#: "this order IS the identity")
+_ROT_MISS = object()
+
 import jax
 import jax.numpy as jnp
 
@@ -280,6 +284,14 @@ class TPUScheduler:
         # callback — the scheduler shell's crash-restart checkpoint source
         # (None = no exact per-window counters on this path)
         self.commit_marker: Optional[dict] = None
+        # rotation-row cache (round 17): order_for_start(rr) -> axis-index
+        # row, keyed per NodeBatch OBJECT (a rebuild/permute makes a fresh
+        # batch, invalidating by identity). A serving loop cuts hundreds
+        # of small windows per second against a stable tree; without this
+        # every window re-extracts each distinct enumeration order as an
+        # O(N) python walk — the encode prologue's top cost at 1k nodes.
+        self._rot_rows: dict[int, np.ndarray] = {}
+        self._rot_rows_b: Optional[int] = None
 
     def _shared_zero_scalar(self, n: int) -> np.ndarray:
         arr = self._zero_scalars.get(n)
@@ -688,11 +700,12 @@ class TPUScheduler:
     @staticmethod
     def _class_signature(pod: Pod):
         """Spec fields that determine a pod's device features against a fixed
-        snapshot — equal signatures imply identical encoder output."""
-        return (pod.namespace, tuple(sorted(pod.labels.items())),
-                tuple(sorted(pod.node_selector.items())), pod.affinity,
-                pod.tolerations, pod.node_name, pod.containers,
-                pod.init_containers)
+        snapshot — equal signatures imply identical encoder output. The
+        canonical definition lives in ops.pod_rows (the encode-at-admission
+        row cache stores it); this staticmethod stays the public twin the
+        parity tests pin against the native batch."""
+        from kubernetes_tpu.ops.pod_rows import pod_class_signature
+        return pod_class_signature(pod)
 
     @staticmethod
     def class_signatures(pods: list) -> list:
@@ -707,6 +720,17 @@ class TPUScheduler:
             return mod.class_signatures(pods)
         sig = TPUScheduler._class_signature
         return [sig(p) for p in pods]
+
+    def _signatures(self, pods: list) -> list:
+        """Window-prologue signatures: gathered from the encode-at-
+        admission row cache when the shell attached one (interned — equal
+        sigs are the SAME tuple object, so uniformity checks and the
+        per-sig memos below hit by identity), else the batched native
+        build. Values are bit-identical either way (pod_rows fuzz)."""
+        rc = self.pod_rows
+        if rc is not None:
+            return rc.signatures(pods)
+        return self.class_signatures(pods)
 
     def _uniform_class(self, p0: Pod, f0, b: NodeBatch,
                        node_infos: dict[str, NodeInfo]) -> Optional[tuple]:
@@ -809,7 +833,91 @@ class TPUScheduler:
                 ban = True
         return cls, extra, ban
 
-    def _burst_rotation(self, b: NodeBatch, n_pods: int):
+    def _axis_order(self, all_node_names: list):
+        """(axis_order, start0) for a burst launch: the node order to
+        encode the mirror on, plus the zone-start index whose enumeration
+        equals `all_node_names` when the resident axis is KEPT STALE.
+
+        A rotating tree hands every window a differently-ordered
+        enumeration; re-encoding the mirror on it forces an O(N) host
+        permute plus a FULL device re-upload per window — the serving
+        prologue's biggest fixed cost. But the kernels model per-cycle
+        enumerations through the rotation program uniformly (cycle 0 is
+        only special by convention), so when this launch's enumeration is
+        provably order_for_start(r) of the resident axis's tree
+        (NodeTree.last_enum_start + the membership-keyed order cache),
+        the mirror keeps its axis and cycle 0 rides order id r — a
+        gather, not a recompute. Any doubt (membership moved, caller-fed
+        name lists, mid-state enumerations, non-rotating trees) falls
+        back to axis == enumeration, the pre-round-17 behavior."""
+        tree = self.node_tree
+        b = self.encoder._batch
+        if tree is None or b is None or b.names == all_node_names \
+                or not self._tree_rotates():
+            return all_node_names, None
+        rr = tree.last_enum_start
+        if rr is None:
+            return all_node_names, None
+        order = tree._order_cache.get(rr)
+        if order is None or order != all_node_names:
+            return all_node_names, None
+        if len(b.names) != len(all_node_names) \
+                or set(b.names) != set(all_node_names):
+            return all_node_names, None   # membership moved: rebuild
+        return b.names, rr
+
+    def _rot_cached(self, b: NodeBatch, rr: int, identity: np.ndarray,
+                    kind: str):
+        """Padded axis-index row for the enumeration starting at zone
+        index `rr`, or None when it equals the identity (axis) order —
+        cached per NodeBatch object (`kind` keys the two pad layouts:
+        "u" pads with the n_pad scratch row, "g" with the invalid-row
+        tail). The tree's orders are a function of its membership, and
+        membership changes always rebuild/permute the batch (a fresh
+        object), so identity-keyed invalidation is exact."""
+        if self._rot_rows_b != id(b):
+            self._rot_rows = {}
+            self._rot_rows_b = id(b)
+        key = (kind, rr)
+        got = self._rot_rows.get(key, _ROT_MISS)
+        if got is not _ROT_MISS:
+            return got
+        names = self.node_tree.order_for_start(rr)
+        raw = np.fromiter((b.index[nm] for nm in names), np.int32,
+                          len(names))
+        if np.array_equal(raw, identity[: len(raw)]):
+            row = None
+        elif kind == "u":
+            row = np.concatenate([
+                raw, np.full(b.n_pad + 1 - len(raw), b.n_pad,
+                             dtype=np.int32)])
+        else:
+            row = np.concatenate([
+                raw, np.arange(b.n_real, b.n_pad, dtype=np.int32)])
+        self._rot_rows[key] = row
+        return row
+
+    def _rot_identity(self, b: NodeBatch, kind: str) -> np.ndarray:
+        """The axis-order (identity) permutation row, cached with the
+        per-order rows."""
+        if self._rot_rows_b != id(b):
+            self._rot_rows = {}
+            self._rot_rows_b = id(b)
+        key = ("id", kind)
+        row = self._rot_rows.get(key)
+        if row is None:
+            if kind == "u":
+                row = np.concatenate([
+                    np.arange(b.n_real, dtype=np.int32),
+                    np.full(b.n_pad + 1 - b.n_real, b.n_pad,
+                            dtype=np.int32)])
+            else:
+                row = np.arange(b.n_pad, dtype=np.int32)
+            self._rot_rows[key] = row
+        return row
+
+    def _burst_rotation(self, b: NodeBatch, n_pods: int,
+                        start0: Optional[int] = None):
         """Per-cycle enumeration orders for a burst: pod 0 rides the device
         axis (the list_names() enumeration the shell just consumed); pod
         i >= 1 rides the order starting at the tree's current zone index
@@ -826,29 +934,28 @@ class TPUScheduler:
         nxt = tree.rotation_map()
         r = tree.zone_index
         length = n_pods + K.K_BATCH
-        n_pad = b.n_pad
-        perm_rows = [np.concatenate([
-            np.arange(b.n_real, dtype=np.int32),
-            np.full(n_pad + 1 - b.n_real, n_pad, dtype=np.int32)])]
+        identity = self._rot_identity(b, "u")
+        perm_rows = [identity]
         id_of_r: dict[int, int] = {}
 
         def order_id(rr: int) -> int:
             iid = id_of_r.get(rr)
             if iid is None:
-                names = tree.order_for_start(rr)
-                row = np.fromiter((b.index[nm] for nm in names), np.int32,
-                                  len(names))
-                if np.array_equal(row, perm_rows[0][: len(names)]):
+                row = self._rot_cached(b, rr, identity, "u")
+                if row is None:
                     iid = 0
                 else:
-                    perm_rows.append(np.concatenate([
-                        row, np.full(n_pad + 1 - len(names), n_pad,
-                                     dtype=np.int32)]))
+                    perm_rows.append(row)
                     iid = len(perm_rows) - 1
                 id_of_r[rr] = iid
             return iid
 
         seq = np.zeros(length, dtype=np.int32)
+        if start0 is not None:
+            # stale-axis mode (_axis_order): cycle 0's enumeration is
+            # order_for_start(start0) of the RESIDENT axis, shipped as a
+            # rotation order like every later cycle — no mirror permute
+            seq[0] = order_id(start0)
         if nxt[r] == r:
             # fixed-point walk: every cycle >= 1 repeats P_r
             seq[1:] = order_id(r)
@@ -856,11 +963,20 @@ class TPUScheduler:
             for i in range(1, length):
                 seq[i] = order_id(r)
                 r = nxt[r]
-        perms = np.stack(perm_rows)
-        l_pad = _pad_pow2(len(perm_rows), 4)
-        if len(perm_rows) < l_pad:
-            perms = np.concatenate(
-                [perms, np.repeat(perms[:1], l_pad - len(perm_rows), axis=0)])
+        # stacked table cached by the row set (rows are pinned in the
+        # per-batch cache, so the id tuple is stable): windows against a
+        # stable tree reuse ONE host array — and downstream, one device
+        # conversion (kernels._PERM_DEV_CACHE keys on its identity)
+        skey = ("stack-u", tuple(map(id, perm_rows)))
+        perms = self._rot_rows.get(skey)
+        if perms is None:
+            perms = np.stack(perm_rows)
+            l_pad = _pad_pow2(len(perm_rows), 4)
+            if len(perm_rows) < l_pad:
+                perms = np.concatenate(
+                    [perms,
+                     np.repeat(perms[:1], l_pad - len(perm_rows), axis=0)])
+            self._rot_rows[skey] = perms
         return perms, seq
 
     def _tree_rotates(self) -> bool:
@@ -874,7 +990,8 @@ class TPUScheduler:
         sizes = {len(tree._tree[z]) for z in tree._zones}
         return len(sizes) > 1
 
-    def _generic_rotation(self, b: NodeBatch, bucket: int):
+    def _generic_rotation(self, b: NodeBatch, bucket: int,
+                          start0: Optional[int] = None):
         """(perms[L, n_pad], inv_perms, oid_seq[bucket]) for the generic
         scan: each in-burst cycle's enumeration order as axis indices
         (invalid rows tail every permutation so position-space feasibility
@@ -885,27 +1002,26 @@ class TPUScheduler:
             return None
         nxt = tree.rotation_map()
         r = tree.zone_index
-        n_pad, n_real = b.n_pad, b.n_real
-        pad_tail = np.arange(n_real, n_pad, dtype=np.int32)
-        perm_rows = [np.concatenate([np.arange(n_real, dtype=np.int32),
-                                     pad_tail])]
+        n_pad = b.n_pad
+        identity = self._rot_identity(b, "g")
+        perm_rows = [identity]
         id_of_r: dict[int, int] = {}
 
         def order_id(rr: int) -> int:
             iid = id_of_r.get(rr)
             if iid is None:
-                names = tree.order_for_start(rr)
-                row = np.fromiter((b.index[nm] for nm in names), np.int32,
-                                  len(names))
-                if np.array_equal(row, perm_rows[0][: len(names)]):
+                row = self._rot_cached(b, rr, identity, "g")
+                if row is None:
                     iid = 0
                 else:
-                    perm_rows.append(np.concatenate([row, pad_tail]))
+                    perm_rows.append(row)
                     iid = len(perm_rows) - 1
                 id_of_r[rr] = iid
             return iid
 
         seq = np.zeros(bucket, dtype=np.int32)
+        if start0 is not None:
+            seq[0] = order_id(start0)   # stale-axis mode (_axis_order)
         for t in range(1, bucket):
             seq[t] = order_id(r)
             r = nxt[r]
@@ -914,10 +1030,15 @@ class TPUScheduler:
         l_pad = _pad_pow2(len(perm_rows), 4)
         while len(perm_rows) < l_pad:
             perm_rows.append(perm_rows[0])
-        perms = np.stack(perm_rows)
-        inv = np.empty_like(perms)
-        for l in range(perms.shape[0]):
-            inv[l, perms[l]] = np.arange(n_pad, dtype=np.int32)
+        skey = ("stack-g", tuple(map(id, perm_rows)))
+        got = self._rot_rows.get(skey)
+        if got is None:
+            perms = np.stack(perm_rows)
+            inv = np.empty_like(perms)
+            for l in range(perms.shape[0]):
+                inv[l, perms[l]] = np.arange(n_pad, dtype=np.int32)
+            got = self._rot_rows[skey] = (perms, inv)
+        perms, inv = got
         return perms, inv, seq
 
     # -- fused bursts, wave-windowed commit ----------------------------------
@@ -951,6 +1072,11 @@ class TPUScheduler:
     # live launch-queue occupancy (windows dispatched, not yet consumed) —
     # the serving backpressure gate's inflight_fn reads it lock-free
     inflight_launches = 0
+    # encode-at-admission pod-row cache (ops.pod_rows.PodRowCache),
+    # attached by the scheduler shell: window planning gathers prebuilt
+    # per-pod rows/signatures instead of re-encoding at line rate. None =
+    # the pre-round-17 per-window encode (identical decisions either way)
+    pod_rows = None
 
     def _fetch_pool_get(self):
         pool = self._fetch_pool
@@ -1027,7 +1153,12 @@ class TPUScheduler:
             obs_trace.add_span(name, t_start, now, cat=cat)
             obs_ledger.LEDGER.stamp_many(_keys, _PHASE_SLOTS[phase], t=now)
             return now
-        b = self.encoder.encode(node_infos, all_node_names)
+        # stable-axis mode: keep the resident mirror/device axis when this
+        # enumeration is a proven rotation of it (cycle 0 rides order id
+        # start0) — the serving lane's windows skip the per-window permute
+        # + full re-upload entirely
+        axis_order, start0 = self._axis_order(all_node_names)
+        b = self.encoder.encode(node_infos, axis_order)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(), self.replicasets_fn(),
                          hard_pod_affinity_weight=self.hard_pod_affinity_weight,
@@ -1040,10 +1171,11 @@ class TPUScheduler:
         bucket = _pad_pow2(bucket if bucket else len(pods), 16)
         uniform = None
         feats: Optional[list] = None
-        # batched signature build (one native call — the drain/encode
-        # prologue's dominant per-pod tuple cost)
-        sigs = self.class_signatures(pods)
-        uniform_spec = all(s == sigs[0] for s in sigs)
+        # signatures from the encode-at-admission row cache (interned —
+        # the identity fast path below) or the batched native build
+        sigs = self._signatures(pods)
+        s0 = sigs[0]
+        uniform_spec = all(s is s0 or s == s0 for s in sigs)
         if num_to_find >= n and self.last_index == 0:
             # spec-identical pods produce identical encoder output against a
             # fixed snapshot, so the uniform path encodes ONE pod — per-pod
@@ -1057,7 +1189,7 @@ class TPUScheduler:
             # burst size), carried int32 scores, consecutive-tie-rank batch
             # resolution with exact prefix validation (kernels.py K_BATCH)
             cls, extra_ok, ban = uniform
-            rotation = self._burst_rotation(b, len(pods))
+            rotation = self._burst_rotation(b, len(pods), start0)
             # flight recorder: capture BEFORE any wave commit can mutate
             # the cache's NodeInfos (deep capture clones the world here)
             fl = obs_flight.RECORDER.begin("uniform", self, [(pods, False)],
@@ -1082,12 +1214,19 @@ class TPUScheduler:
             ORACLE_FALLBACKS.labels("burst-affinity-mixed").inc()
             return None
         # spec-identical pods produce identical encoder output against a
-        # fixed snapshot: encode ONE pod and share (the O(N) python feature
-        # loops — spread counting especially — dominate otherwise)
+        # fixed snapshot: encode ONE pod per signature and share (the O(N)
+        # python feature loops — spread counting especially — dominate
+        # otherwise; interned sigs make the memo an identity-hit dict)
         if uniform_spec:
             feats = [enc.encode(pods[0])] * len(pods)
         else:
-            feats = [enc.encode(p) for p in pods]
+            feat_by_sig: dict = {}
+            feats = []
+            for p, sig in zip(pods, sigs):
+                f = feat_by_sig.get(sig)
+                if f is None:
+                    f = feat_by_sig[sig] = enc.encode(p)
+                feats.append(f)
         # selector-spread counts change with every in-burst placement; the
         # scan carries them only for spec-identical pods (one selector set)
         carry_spread = any(f.spread_counts is not None for f in feats)
@@ -1107,7 +1246,7 @@ class TPUScheduler:
             # identity is just data (order id 0), while flip-flopping the
             # jit signature between bursts costs a fresh 10s+ XLA compile
             # mid-workload each time the zone cursor lands on a fixed point
-            rot = self._generic_rotation(b, bucket)
+            rot = self._generic_rotation(b, bucket, start0)
             if num_to_find >= n:
                 rotation_pos = (rot[1], rot[2])   # inv_perms ARE positions
             else:
@@ -1124,8 +1263,18 @@ class TPUScheduler:
                 base["spread_counts"] = self._defaults["zeros_i64"]
             per_pod = [base] * len(pods)   # _stack_pods broadcasts by identity
         else:
-            per_pod = [self._pod_arrays(f, b.n_pad, upd_fields=True, pod=p)
-                       for p, f in zip(pods, feats)]
+            # one device-array dict per SIGNATURE (equal sigs -> identical
+            # _pod_arrays output by construction), so _stack_pods
+            # broadcasts repeated specs by identity instead of stacking B
+            # copies — the mixed-window twin of the uniform fast path
+            arr_by_sig: dict = {}
+            per_pod = []
+            for p, f, sig in zip(pods, feats, sigs):
+                pp = arr_by_sig.get(sig)
+                if pp is None:
+                    pp = arr_by_sig[sig] = self._pod_arrays(
+                        f, b.n_pad, upd_fields=True, pod=p)
+                per_pod.append(pp)
         if carry_spread and (spread0 is None
                              or spread0.shape[-1] != b.n_pad):
             # inert/dense mix — shouldn't happen, stay exact
@@ -1553,7 +1702,8 @@ class TPUScheduler:
             obs_ledger.LEDGER.stamp_many(_keys, _PHASE_SLOTS[phase], t=now)
             return now
 
-        b = self.encoder.encode(node_infos, all_node_names)
+        axis_order, start0 = self._axis_order(all_node_names)
+        b = self.encoder.encode(node_infos, axis_order)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(),
                          self.replicasets_fn(),
@@ -1563,8 +1713,9 @@ class TPUScheduler:
                          volume_binder=self.volume_binder,
                          state_encoder=self.encoder)
         feat_by_sig: dict = {}
+        arr_by_sig: dict = {}
         per_pod = []
-        for p, sig in zip(flat, self.class_signatures(flat)):
+        for p, sig in zip(flat, self._signatures(flat)):
             f = feat_by_sig.get(sig)
             if f is None:
                 f = feat_by_sig[sig] = enc.encode(p)
@@ -1574,8 +1725,14 @@ class TPUScheduler:
                 # already excludes; refuse rather than drift
                 ORACLE_FALLBACKS.labels("fused-spread-selectors").inc()
                 return None
-            per_pod.append(self._pod_arrays(f, b.n_pad, upd_fields=True,
-                                            pod=p))
+            pp = arr_by_sig.get(sig)
+            if pp is None:
+                # one array dict per signature: repeated specs broadcast
+                # by identity through _stack_pods (same values — equal
+                # sigs imply identical _pod_arrays output)
+                pp = arr_by_sig[sig] = self._pod_arrays(
+                    f, b.n_pad, upd_fields=True, pod=p)
+            per_pod.append(pp)
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(
             n, self.percentage_of_nodes_to_score)
@@ -1585,7 +1742,7 @@ class TPUScheduler:
             # one burst-wide walk, indexed by enumerations CONSUMED inside
             # the kernel (the carried t) — a rejected gang rewinds the
             # cursor, so the walk must NOT be pre-sliced by pod position
-            rot = self._generic_rotation(b, B)
+            rot = self._generic_rotation(b, B, start0)
             if num_to_find >= n:
                 rotation_pos = (rot[1], rot[2])
             else:
@@ -2023,7 +2180,8 @@ class TPUScheduler:
             if get_resource_request(p).scalar:
                 PRESSURE_GATES.labels("pod-features").inc()
                 return None
-        b = self.encoder.encode(node_infos, all_node_names)
+        axis_order, start0 = self._axis_order(all_node_names)
+        b = self.encoder.encode(node_infos, axis_order)
         nodes = self._node_arrays(b)
         enc = PodEncoder(node_infos, b, self.services_fn(),
                          self.replicasets_fn(),

@@ -59,6 +59,22 @@ PyObject* S_affinity;
 PyObject* S_tolerations;
 PyObject* S_containers;
 PyObject* S_init_containers;
+// Scheduled-record construction (commit_wave_binds): attr names + the
+// constant field values of a burst bind's audit record
+PyObject* S_name;
+PyObject* S_involved_kind;
+PyObject* S_involved_key;
+PyObject* S_type;
+PyObject* S_reason;
+PyObject* S_message;
+PyObject* S_count;
+PyObject* S_component;
+PyObject* V_Pod;        // "Pod"
+PyObject* V_Normal;     // "Normal"
+PyObject* V_Scheduled;  // "Scheduled"
+PyObject* V_default;    // "default"
+PyObject* ONE;
+PyObject* ZERO;
 PyObject* EMPTY_TUPLE;
 PyObject* DEEPCOPY;   // copy.deepcopy (clone() fallback, as store._clone)
 
@@ -422,6 +438,154 @@ PyObject* core_commit_wave(CommitCore* self, PyObject* args) {
     return missing;
 }
 
+// Build one Scheduled EventRecord payload for a landed binding (key,
+// node): name = "{name}.{seq:x}", message = the burst commit's exact
+// wording. Mirrors store/record.build_scheduled_records field for field
+// (the twin-parity tests compare stored objects attribute-wise).
+PyObject* build_scheduled_record(PyObject* record_cls, PyObject* key,
+                                 PyObject* node, PyObject* component,
+                                 long long seq) {
+    // cls.__new__(cls): allocate without running the dataclass __init__
+    // (exactly the twin's EventRecord.__new__ + attribute fill)
+    PyObject* new_m = PyObject_GetAttrString(record_cls, "__new__");
+    if (!new_m) return nullptr;
+    PyObject* rec = PyObject_CallOneArg(new_m, record_cls);
+    Py_DECREF(new_m);
+    if (!rec) return nullptr;
+    // split "ns/name" (namespaced keys; cluster-scoped fall back whole)
+    Py_ssize_t klen = PyUnicode_GET_LENGTH(key);
+    Py_ssize_t slash = PyUnicode_FindChar(key, '/', 0, klen, 1);
+    PyObject* ns = nullptr;
+    PyObject* nm = nullptr;
+    int ok = 1;
+    if (slash >= 0 && slash + 1 < klen) {
+        ns = PyUnicode_Substring(key, 0, slash);
+        nm = PyUnicode_Substring(key, slash + 1, klen);
+        if (!ns || !nm) ok = 0;
+    } else {
+        Py_INCREF(V_default);
+        ns = V_default;
+        Py_INCREF(key);
+        nm = key;
+    }
+    PyObject* name = nullptr;
+    PyObject* msg = nullptr;
+    if (ok) {
+        // lowercase-hex seq suffix ("{name}.{seq:x}"); snprintf because
+        // PyUnicode_FromFormat has no long-long hex conversion
+        char hexbuf[24];
+        snprintf(hexbuf, sizeof hexbuf, "%llx", (unsigned long long)seq);
+        name = PyUnicode_FromFormat("%U.%s", nm, hexbuf);
+        msg = PyUnicode_FromFormat("Successfully assigned %U to %U",
+                                   key, node);
+        if (!name || !msg) ok = 0;
+    }
+    if (ok) {
+        struct { PyObject* attr; PyObject* val; } fields[] = {
+            {S_name, name}, {S_namespace, ns},
+            {S_involved_kind, V_Pod}, {S_involved_key, key},
+            {S_type, V_Normal}, {S_reason, V_Scheduled},
+            {S_message, msg}, {S_count, ONE},
+            {S_component, component}, {S_resource_version, ZERO},
+        };
+        for (auto& f : fields) {
+            if (PyObject_SetAttr(rec, f.attr, f.val) < 0) { ok = 0; break; }
+        }
+    }
+    Py_XDECREF(ns);
+    Py_XDECREF(nm);
+    Py_XDECREF(name);
+    Py_XDECREF(msg);
+    if (!ok) { Py_XDECREF(rec); return nullptr; }
+    return rec;
+}
+
+PyObject* core_commit_wave_binds(CommitCore* self, PyObject* args) {
+    // commit_wave with the Scheduled payloads built HERE (one native
+    // call, zero per-pod Python on the commit thread): binding i's
+    // record is named seq0+i; vanished pods consume their seq but emit
+    // nothing, exactly like the serial path that never reaches its
+    // Scheduled event. Twin: PyCommitCore.commit_wave_binds.
+    PyObject* pod_bucket;
+    const char* pod_kind;
+    PyObject* bindings;
+    PyObject* ev_bucket;
+    const char* ev_kind;
+    PyObject* record_cls;
+    PyObject* component;
+    long long seq0;
+    if (!PyArg_ParseTuple(args, "O!sOO!sOUL", &PyDict_Type, &pod_bucket,
+                          &pod_kind, &bindings, &PyDict_Type, &ev_bucket,
+                          &ev_kind, &record_cls, &component, &seq0))
+        return nullptr;
+    PyObject* missing = PyList_New(0);
+    if (!missing) return nullptr;
+    std::vector<Entry> pod_staged, ev_staged, evicted;
+    if (bind_batch_body(self, pod_bucket, bindings, missing,
+                        pod_staged) < 0) {
+        splice(self, pod_kind, pod_staged, evicted);
+        drop_entries(evicted);
+        Py_DECREF(missing);
+        return nullptr;
+    }
+    int rc = 0;
+    PyObject* seq = PySequence_Fast(bindings, "bindings must be a sequence");
+    if (!seq) rc = -1;
+    PyObject* miss_set = nullptr;
+    if (rc == 0 && PyList_GET_SIZE(missing) > 0) {
+        miss_set = PySet_New(missing);
+        if (!miss_set) rc = -1;
+    }
+    Py_ssize_t n = rc == 0 ? PySequence_Fast_GET_SIZE(seq) : 0;
+    for (Py_ssize_t i = 0; i < n && rc == 0; ++i) {
+        PyObject* pair = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(pair) || PyTuple_GET_SIZE(pair) != 2) {
+            PyErr_SetString(PyExc_TypeError, "binding must be (key, node)");
+            rc = -1;
+            break;
+        }
+        PyObject* key = PyTuple_GET_ITEM(pair, 0);
+        PyObject* node = PyTuple_GET_ITEM(pair, 1);
+        if (miss_set != nullptr) {
+            int found = PySet_Contains(miss_set, key);
+            if (found < 0) { rc = -1; break; }
+            if (found) continue;     // vanished: seq consumed, no record
+        }
+        PyObject* rec = build_scheduled_record(record_cls, key, node,
+                                               component, seq0 + i);
+        if (!rec) { rc = -1; break; }
+        // create_batch body for ONE prebuilt record (move=True: the
+        // record was born here, ownership transfers to the bucket)
+        PyObject* rkey = PyObject_GetAttr(rec, S_key);
+        int dup = rkey == nullptr ? -1 : PyDict_Contains(ev_bucket, rkey);
+        if (dup != 0) {
+            if (dup > 0)
+                PyErr_Format(self->already_exc, "%s/%U", ev_kind, rkey);
+            Py_XDECREF(rkey);
+            Py_DECREF(rec);
+            rc = -1;
+            break;
+        }
+        long long rv = assign_rv(self, rec);
+        if (rv < 0 || PyDict_SetItem(ev_bucket, rkey, rec) < 0) {
+            Py_DECREF(rkey);
+            Py_DECREF(rec);
+            rc = -1;
+            break;
+        }
+        Py_DECREF(rkey);
+        Py_INCREF(S_ADDED);
+        ev_staged.push_back(Entry{S_ADDED, rec, rv});  // rec ref moves
+    }
+    Py_XDECREF(miss_set);
+    Py_XDECREF(seq);
+    splice(self, pod_kind, pod_staged, evicted);
+    splice(self, ev_kind, ev_staged, evicted);
+    drop_entries(evicted);
+    if (rc < 0) { Py_DECREF(missing); return nullptr; }
+    return missing;
+}
+
 PyObject* core_flush(CommitCore* self, PyObject*) {
     long long dropped = 0;
     {
@@ -774,6 +938,10 @@ PyMethodDef core_methods[] = {
     {"commit_wave", (PyCFunction)core_commit_wave, METH_VARARGS,
      "commit_wave(pod_bucket, pod_kind, bindings, ev_bucket, ev_kind, "
      "recs) -> missing keys"},
+    {"commit_wave_binds", (PyCFunction)core_commit_wave_binds, METH_VARARGS,
+     "commit_wave_binds(pod_bucket, pod_kind, bindings, ev_bucket, "
+     "ev_kind, record_cls, component, seq0) -> missing keys; builds the "
+     "Scheduled audit payloads natively for every landed binding"},
     {"flush", (PyCFunction)core_flush, METH_NOARGS,
      "publish pending entries to watchers -> events dropped"},
     {"attach", (PyCFunction)core_attach, METH_VARARGS,
@@ -891,8 +1059,23 @@ PyMODINIT_FUNC PyInit__commitcore(void) {
         || intern(&S_affinity, "affinity") < 0
         || intern(&S_tolerations, "tolerations") < 0
         || intern(&S_containers, "containers") < 0
-        || intern(&S_init_containers, "init_containers") < 0)
+        || intern(&S_init_containers, "init_containers") < 0
+        || intern(&S_name, "name") < 0
+        || intern(&S_involved_kind, "involved_kind") < 0
+        || intern(&S_involved_key, "involved_key") < 0
+        || intern(&S_type, "type") < 0
+        || intern(&S_reason, "reason") < 0
+        || intern(&S_message, "message") < 0
+        || intern(&S_count, "count") < 0
+        || intern(&S_component, "component") < 0
+        || intern(&V_Pod, "Pod") < 0
+        || intern(&V_Normal, "Normal") < 0
+        || intern(&V_Scheduled, "Scheduled") < 0
+        || intern(&V_default, "default") < 0)
         return nullptr;
+    ONE = PyLong_FromLong(1);
+    ZERO = PyLong_FromLong(0);
+    if (!ONE || !ZERO) return nullptr;
     EMPTY_TUPLE = PyTuple_New(0);
     if (!EMPTY_TUPLE) return nullptr;
     PyObject* copy_mod = PyImport_ImportModule("copy");

@@ -180,6 +180,69 @@ PyObject* heap_pop_many(HeapCore* self, PyObject* arg) {
     return out;
 }
 
+PyObject* heap_push_many(HeapCore* self, PyObject* arg) {
+    // batched insert: a list of (key, a, b, c, payload) entries lands as
+    // ONE call, the sifts running with the GIL RELEASED (the informer
+    // ingest prologue's twin of pop_many). Per-entry semantics identical
+    // to add(): insert or replace by key.
+    PyObject* seq = PySequence_Fast(arg, "entries must be a sequence");
+    if (!seq) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    std::vector<Entry> staged;
+    staged.reserve((size_t)n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* t = PySequence_Fast_GET_ITEM(seq, i);
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 5) {
+            PyErr_SetString(PyExc_TypeError,
+                            "entry must be (key, a, b, c, payload)");
+            for (Entry& e : staged) Py_DECREF(e.payload);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        Py_ssize_t klen;
+        const char* key = PyUnicode_AsUTF8AndSize(PyTuple_GET_ITEM(t, 0),
+                                                  &klen);
+        double a = PyFloat_AsDouble(PyTuple_GET_ITEM(t, 1));
+        double b = PyFloat_AsDouble(PyTuple_GET_ITEM(t, 2));
+        double c = PyFloat_AsDouble(PyTuple_GET_ITEM(t, 3));
+        if (!key || PyErr_Occurred()) {
+            for (Entry& e : staged) Py_DECREF(e.payload);
+            Py_DECREF(seq);
+            return nullptr;
+        }
+        PyObject* payload = PyTuple_GET_ITEM(t, 4);
+        Py_INCREF(payload);
+        staged.push_back(Entry{a, b, c, std::string(key, (size_t)klen),
+                               payload});
+    }
+    Py_DECREF(seq);
+    // replaced payloads must be decref'd with the GIL held — collect
+    // under the mutex (GIL released), release after re-acquiring it
+    std::vector<PyObject*> replaced;
+    Py_BEGIN_ALLOW_THREADS
+    {
+        std::lock_guard<std::mutex> lk(*self->mu);
+        for (Entry& e : staged) {
+            auto it = self->index->find(e.key);
+            if (it != self->index->end()) {
+                Entry& cur = (*self->items)[it->second];
+                replaced.push_back(cur.payload);
+                cur.a = e.a; cur.b = e.b; cur.c = e.c;
+                cur.payload = e.payload;
+                sift_down(self, sift_up(self, it->second));
+            } else {
+                self->items->push_back(std::move(e));
+                size_t i = self->items->size() - 1;
+                (*self->index)[(*self->items)[i].key] = i;
+                sift_up(self, i);
+            }
+        }
+    }
+    Py_END_ALLOW_THREADS
+    for (PyObject* p : replaced) Py_DECREF(p);
+    Py_RETURN_NONE;
+}
+
 PyObject* heap_peek(HeapCore* self, PyObject*) {
     std::lock_guard<std::mutex> lk(*self->mu);
     if (self->items->empty()) Py_RETURN_NONE;
@@ -245,6 +308,9 @@ PyMethodDef heap_methods[] = {
     {"pop_many", (PyCFunction)heap_pop_many, METH_O,
      "pop_many(limit) — up to limit ascending pops as one call (GIL "
      "released during the sifts)"},
+    {"push_many", (PyCFunction)heap_push_many, METH_O,
+     "push_many(entries) — batched add of (key, a, b, c, payload) tuples "
+     "as one call (GIL released during the sifts)"},
     {"peek", (PyCFunction)heap_peek, METH_NOARGS, "the min without removal"},
     {"list", (PyCFunction)heap_list, METH_NOARGS, "payloads, heap order"},
     {nullptr, nullptr, 0, nullptr},

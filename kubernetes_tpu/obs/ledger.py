@@ -74,6 +74,14 @@ LEDGER_EVICTED = obs.counter(
     "records; an eviction means a pod sat pending longer than the "
     "ledger's capacity window).")
 
+LEDGER_FINALIZED = obs.counter(
+    "pod_ledger_finalized_total",
+    "Pod ledger records finalized at pod DELETION while still holding an "
+    "in-flight slot (pending record, or bound and awaiting the copy-out "
+    "stamp): the completion reaper and PodGC delete pods whose bind "
+    "events no watcher may ever copy out — without this hook those "
+    "records would be retained until the capacity bound evicts them.")
+
 #: density.go:56 — the pod-startup latency SLO the gauges score against
 STARTUP_SLO_SECONDS = 5.0
 
@@ -136,6 +144,33 @@ class PodLifecycleLedger:
         """First enqueue wins (see _open_rec)."""
         self._open_rec(key, ENQUEUE, t)
 
+    def _open_many(self, keys, slot: int, t: Optional[float]) -> None:
+        """Batched _open_rec: one lock + one shared timestamp for a whole
+        accepted-create / enqueue batch (first stamp still wins per
+        slot)."""
+        tt = t if t is not None else time.perf_counter()
+        with self._lock:
+            recs = self._recs
+            for key in keys:
+                rec = recs.get(key)
+                if rec is None:
+                    if len(recs) >= self._capacity:
+                        recs.pop(next(iter(recs)))
+                        LEDGER_EVICTED.inc()
+                    rec = recs[key] = [None] * 8
+                if rec[slot] is None:
+                    rec[slot] = tt
+
+    def stamp_admission_many(self, keys,
+                             t: Optional[float] = None) -> None:
+        """One batched admission stamp per accepted create_many flush —
+        the serving ingest path's one-ledger-call-per-batch contract."""
+        self._open_many(keys, ADMISSION, t)
+
+    def stamp_enqueue_many(self, keys, t: Optional[float] = None) -> None:
+        """One batched enqueue stamp per queue.add_many batch."""
+        self._open_many(keys, ENQUEUE, t)
+
     def evict(self, key: str) -> None:
         """Admission rejected the pod (429 shed): drop its in-flight
         record outright. First-stamp-wins would otherwise let a
@@ -144,6 +179,29 @@ class PodLifecycleLedger:
         fresh record at its own accepted create."""
         with self._lock:
             self._recs.pop(key, None)
+
+    def evict_many(self, keys) -> None:
+        """Batched evict — one lock for a whole shed batch (the gated
+        create_many path's 429 tail)."""
+        with self._lock:
+            recs = self._recs
+            for key in keys:
+                recs.pop(key, None)
+
+    def finalize_delete(self, key: str) -> None:
+        """The pod was DELETED from the store: drop whatever in-flight
+        slot it still holds — a pending record (arrived, never bound) or
+        the awaiting-copy-out commit stamp (bound, but its bind event was
+        never copied out by a watcher and now never will be). Without
+        this hook a completion reaper or PodGC deleting bound pods leaks
+        one awaiting entry per deletion until the capacity bound evicts
+        them — the round-17 leak fix; the soak-shaped unit test pins the
+        steady-state map sizes."""
+        with self._lock:
+            dropped = self._recs.pop(key, None) is not None
+            dropped = (self._awaiting.pop(key, None) is not None) or dropped
+        if dropped:
+            LEDGER_FINALIZED.inc()
 
     def stamp(self, key: str, slot: int, t: Optional[float] = None) -> None:
         with self._lock:

@@ -896,6 +896,12 @@ def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
 K_BATCH = 512        # pods resolved per O(N) pass (static)
 B_CAP = 16384        # output-buffer capacity (static); callers chunk above it
 
+# per-window device-arg conversion caches (round 17, serving prologue):
+# uniform class scalars keyed by VALUE, the rotation perm table keyed by
+# host-array identity (the entry pins the np object so ids cannot recycle)
+_UNIFORM_CLS_CACHE: dict = {}
+_PERM_DEV_CACHE: dict = {}
+
 
 def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
                   perm, oid_seq, extra_ok, weights, flags,
@@ -1177,22 +1183,49 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     if n_pods > cap:
         raise ValueError(f"uniform burst of {n_pods} exceeds cap={cap}")
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
-    has_req = bool(cls.pop("has_request"))
-    carry_eph = bool(cls["upd_eph"] != 0)
-    static_eph = bool(not carry_eph and cls["req_eph"] != 0)
-    carried_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
-                      if cls["upd_scalar"][s] != 0)
-    static_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
-                     if cls["req_scalar"][s] != 0 and cls["upd_scalar"][s] == 0)
+    # class scalars + derived flags + device conversion, cached by VALUE:
+    # a serving loop dispatches hundreds of same-class windows per second,
+    # and the eleven per-field jnp conversions were a measurable slice of
+    # each window's encode span
+    cls_key = (int(cls["req_cpu"]), int(cls["req_mem"]),
+               int(cls["req_eph"]), cls["req_scalar"].tobytes(),
+               int(cls["nz_cpu"]), int(cls["nz_mem"]),
+               int(cls["upd_cpu"]), int(cls["upd_mem"]),
+               int(cls["upd_eph"]), cls["upd_scalar"].tobytes(),
+               bool(cls["has_request"]))
+    hit = _UNIFORM_CLS_CACHE.get(cls_key)
+    if hit is None:
+        has_req = bool(cls.pop("has_request"))
+        carry_eph = bool(cls["upd_eph"] != 0)
+        static_eph = bool(not carry_eph and cls["req_eph"] != 0)
+        carried_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
+                          if cls["upd_scalar"][s] != 0)
+        static_s = tuple(int(s) for s in range(len(cls["req_scalar"]))
+                         if cls["req_scalar"][s] != 0
+                         and cls["upd_scalar"][s] == 0)
+        cls_dev = {k: jnp.asarray(v, jnp.int64) for k, v in cls.items()}
+        if len(_UNIFORM_CLS_CACHE) >= 64:
+            _UNIFORM_CLS_CACHE.clear()
+        hit = _UNIFORM_CLS_CACHE[cls_key] = (
+            has_req, carry_eph, static_eph, carried_s, static_s, cls_dev)
+    has_req, carry_eph, static_eph, carried_s, static_s, cls = hit
     flags = (bool(check_resources), has_req, carry_eph, static_eph,
              carried_s, static_s)
-    cls = {k: jnp.asarray(v, jnp.int64) for k, v in cls.items()}
     if rotation is None:
         perm = jnp.zeros((1, 1), jnp.int32)      # unused placeholder
         oid_seq = jnp.zeros(1, jnp.int32)
     else:
-        perm, oid_seq = (jnp.asarray(rotation[0], jnp.int32),
-                         jnp.asarray(rotation[1], jnp.int32))
+        # the perm table is stable across a serving run's windows (cached
+        # rows upstream): convert once per distinct host array, verified
+        # by identity (the cache pins the np object, so ids can't recycle)
+        ent = _PERM_DEV_CACHE.get(id(rotation[0]))
+        if ent is None or ent[0] is not rotation[0]:
+            if len(_PERM_DEV_CACHE) >= 64:
+                _PERM_DEV_CACHE.clear()
+            ent = (rotation[0], jnp.asarray(rotation[0], jnp.int32))
+            _PERM_DEV_CACHE[id(rotation[0])] = ent
+        perm = ent[1]
+        oid_seq = jnp.asarray(rotation[1], jnp.int32)
     has_extra = extra_ok is not None
     extra = jnp.asarray(extra_ok, bool) if has_extra \
         else jnp.zeros(1, dtype=bool)

@@ -130,37 +130,49 @@ class NodeStateEncoder:
         self._vt: Optional[VictimStack] = None
         self._vt_gens: dict[str, int] = {}
         self._vt_pdb_key: Optional[tuple] = None
+        # per-row SPEC flag planes (round 17): node-spec facts the
+        # PodEncoder's cluster-wide feature gates read (taints present,
+        # unschedulable, prefer-avoid annotations, image states) —
+        # maintained in _write_row exactly like the aggregate mirror, so
+        # a serving window reads four numpy any()s instead of four O(N)
+        # python attribute scans per window. Spec fields are untouched by
+        # assumes (which sync generations without _write_row), so the
+        # generation-gated maintenance is exact.
+        self._spec_flags: Optional[dict] = None
 
-    def _collect_vocab(self, node_infos: dict[str, NodeInfo]) -> None:
-        """Grow the scalar/zone vocabs from nodes whose generation moved
-        since the last encode (vocab inputs are node state — allocatable/
-        requested scalars and zone labels — so an unchanged generation
-        contributed on a previous call). The steady state skips the whole
-        per-node walk, which at cluster scale was a full O(N) Python pass
-        per cycle."""
-        known = set(self._scalar_vocab)
-        zones = set(self._zone_vocab)
+    def encode(self, node_infos: dict[str, NodeInfo],
+               node_order: list[str]) -> NodeBatch:
+        # ONE generation walk collects the vocab additions AND the dirty
+        # row list (the old _collect_vocab pass folded in): the serving
+        # loop re-encodes every window, and at cluster scale each full
+        # O(N) python pass over the snapshot is a measurable slice of the
+        # window's host prologue
         gens = self._generations
-        for name, ni in node_infos.items():
+        dirty_pairs: list = []
+        known = zones = None
+        scalar_vocab = self._scalar_vocab
+        zone_vocab = self._zone_vocab
+        for i, name in enumerate(node_order):
+            ni = node_infos[name]
             if gens.get(name) == ni.generation:
                 continue
+            dirty_pairs.append((i, name, ni))
+            if known is None:
+                known = set(scalar_vocab)
+                zones = set(zone_vocab)
             for sname in ni.allocatable.scalar:
                 if sname not in known:
                     known.add(sname)
-                    self._scalar_vocab.append(sname)
+                    scalar_vocab.append(sname)
             for sname in ni.requested.scalar:
                 if sname not in known:
                     known.add(sname)
-                    self._scalar_vocab.append(sname)
+                    scalar_vocab.append(sname)
             if ni.node is not None:
                 z = get_zone_key(ni.node)
                 if z not in zones:
                     zones.add(z)
-                    self._zone_vocab.append(z)
-
-    def encode(self, node_infos: dict[str, NodeInfo],
-               node_order: list[str]) -> NodeBatch:
-        self._collect_vocab(node_infos)
+                    zone_vocab.append(z)
         n_real = len(node_order)
         n_pad = _pad_capacity(n_real)
         s = max(1, len(self._scalar_vocab))
@@ -180,6 +192,7 @@ class NodeStateEncoder:
                 # generations are name-keyed, so they stay valid. The
                 # victim table's row planes ride the same permutation.
                 self._vt_permute(b, node_order, n_real)
+                self._flags_permute(b, node_order, n_real)
                 b = self._permuted(b, node_order, n_real)
                 MIRROR_PERMUTES.inc()
             else:
@@ -187,15 +200,25 @@ class NodeStateEncoder:
                 self._generations = {}
                 self._vt = None           # rows realign on next victim scan
                 self._vt_gens = {}
+                self._spec_flags = {
+                    k: np.zeros(n_pad, dtype=bool)
+                    for k in ("taints", "unsched", "avoid", "images")}
                 MIRROR_REBUILDS.inc()
             self._batch = b
         scalar_idx = {name: i for i, name in enumerate(self._scalar_vocab)}
         zone_idx = {name: i for i, name in enumerate(self._zone_vocab)}
         dirty = []
         reencoded = 0
-        gens = self._generations
-        for i, name in enumerate(node_order):
-            ni = node_infos[name]
+        gens = self._generations   # rebind: _fresh resets the map
+        if gens:
+            # steady state: only the rows the single walk above found
+            # dirty (positions in node_order == batch rows, permute
+            # included — _permuted rebuilds the index from node_order)
+            iter_rows = dirty_pairs
+        else:
+            iter_rows = [(i, name, node_infos[name])
+                         for i, name in enumerate(node_order)]
+        for i, name, ni in iter_rows:
             if gens.get(name) == ni.generation:
                 continue
             gens[name] = ni.generation
@@ -302,7 +325,47 @@ class NodeStateEncoder:
             changed = True
         if ni.node is not None:
             setf(b.zone_id, zone_idx[get_zone_key(ni.node)])
+        flags = self._spec_flags
+        if flags is not None:
+            # spec facts for the PodEncoder's cluster-wide gates (not
+            # device-visible: never feeds `changed`)
+            flags["taints"][i] = bool(ni.taints)
+            flags["unsched"][i] = (ni.node is not None
+                                   and ni.node.unschedulable)
+            flags["avoid"][i] = (ni.node is not None
+                                 and bool(ni.node.prefer_avoid_pod_uids))
+            flags["images"][i] = bool(ni.image_states)
         return changed
+
+    def _flags_permute(self, b_old: NodeBatch, node_order: list[str],
+                       n_real: int) -> None:
+        """Reorder the spec-flag planes to a rotated enumeration of the
+        same node set, mirroring _permuted."""
+        flags = self._spec_flags
+        if flags is None:
+            return
+        perm = np.fromiter((b_old.index[nm] for nm in node_order),
+                           np.int64, n_real)
+        for k, arr in flags.items():
+            out = arr.copy()
+            out[:n_real] = arr[perm]
+            flags[k] = out
+
+    def cluster_spec_flags(self, b: NodeBatch) -> Optional[dict]:
+        """The four cluster-wide spec gates as O(1)-ish numpy any()s —
+        valid only for the encoder's CURRENT batch (every row written at
+        its generation); None tells the caller to fall back to the
+        per-node scans."""
+        if self._spec_flags is None or self._batch is not b:
+            return None
+        n = b.n_real
+        f = self._spec_flags
+        return {
+            "any_taints": bool(f["taints"][:n].any()),
+            "any_unschedulable": bool(f["unsched"][:n].any()),
+            "any_prefer_avoid": bool(f["avoid"][:n].any()),
+            "any_images": bool(f["images"][:n].any()),
+        }
 
     # -- columnar pod table --------------------------------------------------
     def _pt_val_id(self, v: str) -> int:
@@ -844,15 +907,31 @@ class PodEncoder:
         self._image_locality_rows: Optional[dict] = None
         self._ipa = InterPodAffinityChecker(node_infos)
         self._ipa.set_table_source(self._table, self._topo_values)
-        # cluster-wide feature flags: skip whole mask families when inert
-        self._any_taints = any(ni.taints for ni in node_infos.values())
-        self._any_unschedulable = any(
-            ni.node is not None and ni.node.unschedulable for ni in node_infos.values())
-        self._any_affinity_pods = any(ni.pods_with_affinity for ni in node_infos.values())
-        self._any_prefer_avoid = any(
-            ni.node is not None and ni.node.prefer_avoid_pod_uids
-            for ni in node_infos.values())
-        self._any_images = any(ni.image_states for ni in node_infos.values())
+        # cluster-wide feature flags: skip whole mask families when inert.
+        # Spec-derived flags read the state encoder's maintained planes
+        # (four numpy any()s) instead of four O(N) python attribute scans
+        # per window — bit-identical by the generation-gated row contract;
+        # the affinity flag depends on held PODS (assumes change it), so
+        # it keeps the direct scan.
+        flags = state_encoder.cluster_spec_flags(batch) \
+            if state_encoder is not None else None
+        if flags is None:
+            self._any_taints = any(ni.taints for ni in node_infos.values())
+            self._any_unschedulable = any(
+                ni.node is not None and ni.node.unschedulable
+                for ni in node_infos.values())
+            self._any_prefer_avoid = any(
+                ni.node is not None and ni.node.prefer_avoid_pod_uids
+                for ni in node_infos.values())
+            self._any_images = any(
+                ni.image_states for ni in node_infos.values())
+        else:
+            self._any_taints = flags["any_taints"]
+            self._any_unschedulable = flags["any_unschedulable"]
+            self._any_prefer_avoid = flags["any_prefer_avoid"]
+            self._any_images = flags["any_images"]
+        self._any_affinity_pods = any(
+            ni.pods_with_affinity for ni in node_infos.values())
         # per-(topologyKey) dictionary encoding of node label values, built
         # lazily for the inter-pod segment-sum counting (SURVEY §2.3)
         self._topo_cache: dict[str, tuple[np.ndarray, dict]] = {}

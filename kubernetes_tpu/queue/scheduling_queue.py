@@ -180,6 +180,29 @@ class PriorityQueue:
             obs_ledger.LEDGER.stamp_enqueue(pod.key)
             self._cond.notify()
 
+    def add_many(self, pods: list) -> None:
+        """Batched add for informer-delivered arrival runs: ONE lock
+        acquisition, one shared enqueue timestamp (relative order inside
+        the batch rides the seq counter, exactly like per-pod adds), one
+        heap-core push for the whole batch, and one batched ledger
+        stamp — the round-17 ingest prologue (per-pod add() semantics
+        otherwise identical)."""
+        if not pods:
+            return
+        with self._cond:
+            now = self.clock.now()
+            qs = []
+            for pod in pods:
+                q = _QueuedPod(pod, now, next(self._seq))
+                self._unschedulable.pop(pod.key, None)
+                self._backoffq.delete(pod.key)
+                self.nominated.add(pod)
+                qs.append(q)
+            self._active.add_many(qs)
+            obs_ledger.LEDGER.stamp_enqueue_many(
+                [p.key for p in pods], t=now)
+            self._cond.notify_all()
+
     def add_if_not_present(self, pod: Pod) -> None:
         with self._cond:
             if pod.key in self._active or pod.key in self._backoffq \

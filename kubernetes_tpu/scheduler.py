@@ -233,6 +233,14 @@ class Scheduler:
         self._gang_first_seen: dict[str, float] = {}
         self._predicate_names = predicate_names
         self._priority_weights = priority_weights
+        # encode-at-admission pod-row cache (round 17): per-pod feature
+        # rows + interned class signatures are computed ONCE at informer
+        # delivery and gathered at window planning, instead of re-encoded
+        # on every window's critical path. Only the TPU burst algorithm
+        # reads it (the oracle shell decides per pod anyway); the
+        # bit-identity contract (cached row == fresh encode, pod_rows
+        # fuzz) keeps decisions oracle-parity by construction.
+        self.pod_rows = None
         self.extenders = extenders or []
         self._extender_binder = next(
             (e for e in self.extenders if e.is_binder), None)
@@ -276,6 +284,9 @@ class Scheduler:
                 # host_priority, run on the oracle path)
                 collect_host_priority=False)
             self.algorithm.metrics = self.metrics   # encode/kernel/fetch phases
+            from kubernetes_tpu.ops.pod_rows import PodRowCache
+            self.pod_rows = PodRowCache()
+            self.algorithm.pod_rows = self.pod_rows
             if hasattr(store, "contains"):
                 # mid-burst node-death detection: the wave drivers scan
                 # each launch's decisions against the store after the
@@ -359,9 +370,13 @@ class Scheduler:
             on_update=self._update_pod_in_cache,
             on_delete=self._delete_pod_from_cache,
             filter_fn=lambda p: bool(p.node_name))
-        # unassigned pods owned by this scheduler -> queue
+        # unassigned pods owned by this scheduler -> queue (adds arrive in
+        # informer batches: one queue lock + one native heap push per
+        # batch, and the pod-row cache encodes each row here — at
+        # delivery — so window planning gathers instead of re-encoding)
         pods.add_event_handler(
-            on_add=self.queue.add,
+            on_add=self._add_pod_to_queue,
+            on_add_many=self._add_pods_to_queue,
             on_update=self._update_pod_in_queue,
             on_delete=self._delete_pod_from_queue,
             filter_fn=lambda p: not p.node_name and self._responsible_for(p))
@@ -417,10 +432,31 @@ class Scheduler:
         self.cache.remove_pod(pod)
         self.queue.move_all_to_active()
 
+    def _add_pod_to_queue(self, pod: Pod) -> None:
+        if self.pod_rows is not None:
+            self.pod_rows.insert(pod)
+        self.queue.add(pod)
+
+    def _add_pods_to_queue(self, pods: list) -> None:
+        """Batched informer delivery: encode every row once, then ONE
+        queue lock + one heap-core push for the whole batch."""
+        if self.pod_rows is not None:
+            self.pod_rows.insert_many(pods)
+        self.queue.add_many(pods)
+
     def _update_pod_in_queue(self, old: Pod, new: Pod) -> None:
+        if self.pod_rows is not None:
+            # update-in-place: same uid, new resourceVersion — re-encode
+            # at delivery so the window gathers the NEW spec's row
+            self.pod_rows.insert(new)
         self.queue.update(old, new)
 
     def _delete_pod_from_queue(self, pod: Pod) -> None:
+        if self.pod_rows is not None:
+            # covers real deletes AND the unassigned->assigned transition
+            # (the filtering handler delivers it as a delete of the old
+            # object): a bound or gone pod's row is never gathered again
+            self.pod_rows.invalidate(pod)
         self.queue.delete(pod)
 
     def _add_node(self, node: Node) -> None:
@@ -900,7 +936,28 @@ class Scheduler:
         services = self._services_fn()
         replicasets = self._replicasets_fn()
 
+        # plain-burstable classification from the pod-row cache: one
+        # np.take per flag field for the whole drain window instead of
+        # per-pod predicate walks (selector-spread needs live service/RS
+        # lists, so any registered selector source keeps the direct path;
+        # flag values are bit-identical to the predicates by the row
+        # contract — has_aff_terms/has_ports/has_volumes ARE those calls)
+        plain_map = None
+        if self.pod_rows is not None and not services and not replicasets:
+            flat_drained = [p for p, _c in drained]
+            g = self.pod_rows.gather(
+                flat_drained, ("has_aff_terms", "has_ports", "has_volumes"))
+            if g is not None:
+                plain = ~(g["has_aff_terms"] | g["has_ports"]
+                          | g["has_volumes"])
+                plain_map = {id(p): bool(v)
+                             for p, v in zip(flat_drained, plain)}
+
         def plain_burstable(pod: Pod) -> bool:
+            if plain_map is not None:
+                got = plain_map.get(id(pod))
+                if got is not None:
+                    return got
             return (self._pod_is_burstable(pod)
                     and self._burst_class(pod, services, replicasets)
                     == "plain")
@@ -1758,12 +1815,8 @@ class Scheduler:
             # every pod of this window
             chaos.check("sched.crash")
             if commit_wave is not None:
-                recs = self.recorder.make_pod_records([
-                    (a, NORMAL, "Scheduled",
-                     f"Successfully assigned {a.key} to {h}")
-                    for a, h in zip(assumed_list, hosts)])
                 missing = set(self._commit_wave_retrying(
-                    commit_wave, bindings, recs))
+                    commit_wave, bindings))
             else:
                 missing = set(self.store.bind_pods(bindings))
             # crash seam, post-write side: the wave LANDED but the cache
@@ -1829,8 +1882,7 @@ class Scheduler:
                  f"Successfully assigned {a.key} to {h}") for a, h in bound])
         return k
 
-    def _commit_wave_retrying(self, commit_wave, bindings: list,
-                              recs: list) -> list:
+    def _commit_wave_retrying(self, commit_wave, bindings: list) -> list:
         """Idempotent commit_wave: bounded exponential backoff with jitter
         on transient store failures, under ONE dedupe token for the wave.
         A pre-land failure (nothing written) simply re-runs the wave; an
@@ -1838,18 +1890,34 @@ class Scheduler:
         answered by the store's token map on retry — the wave can neither
         double-land nor double-emit its events. Exhausted retries fall
         back to the caller's per-pod crash resolution, which is also safe
-        (it reads back what actually landed)."""
+        (it reads back what actually landed).
+
+        Stores whose commit_wave takes `event_spec` (round 17) build the
+        wave's Scheduled records INSIDE the commit core — no per-pod
+        record construction on this thread; older/alternate stores get
+        host-built records (identical fields)."""
         import inspect
         try:
             # probed per wave, not cached: tests (and alternate stores)
             # swap commit_wave at runtime
-            takes_token = "token" in inspect.signature(
-                commit_wave).parameters
+            params = inspect.signature(commit_wave).parameters
+            takes_token = "token" in params
+            takes_spec = "event_spec" in params
         except (TypeError, ValueError):
-            takes_token = False
+            takes_token = takes_spec = False
         kwargs = {}
         if takes_token:
             kwargs["token"] = f"{self.name}:w{next(self._wave_seq)}"
+        if takes_spec:
+            recs = None
+            kwargs["event_spec"] = {"component": self.recorder.component}
+        else:
+            from kubernetes_tpu.api.types import EventRecord
+            from kubernetes_tpu.store.record import (
+                build_scheduled_records, reserve_seq)
+            recs = build_scheduled_records(
+                EventRecord, bindings, self.recorder.component,
+                reserve_seq(max(1, len(bindings))))
         delay = 0.005
         attempts = 4
         for attempt in range(attempts):

@@ -20,11 +20,19 @@ import random
 import time
 from typing import Optional
 
+from kubernetes_tpu import obs
 from kubernetes_tpu.api.types import Container, Pod
 from kubernetes_tpu.store.store import (AlreadyExistsError,
                                         BackpressureError, PODS)
 
 MI = 1024 ** 2
+
+INGEST_BATCH = obs.histogram(
+    "arrival_ingest_batch_size",
+    "Fresh arrivals per batched create_many flush (the round-17 ingest "
+    "contract: one admission-gate evaluation + one ledger admission "
+    "stamp per flush). Single-create fallbacks observe 1.",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 
 
 def default_pod(name: str) -> Pod:
@@ -70,16 +78,57 @@ class ArrivalGenerator:
             self.store.create(PODS, self.pod_fn(name))
             self.created += 1
         except BackpressureError as e:
-            self.rejected += 1
-            if attempts + 1 >= self.give_up_after:
-                self.gave_up += 1
-                return
-            # capped jittered client backoff off the server's suggestion
-            delay = min(e.retry_after, 5.0) * (0.5 + self._rng.random())
-            self._retry.append((now + delay, name, attempts + 1))
+            self._shed(name, attempts, now, e.retry_after)
         except AlreadyExistsError:
             # a retried create whose first attempt actually landed
             self.created += 1
+
+    def _shed(self, name: str, attempts: int, now: float,
+              retry_after: float) -> None:
+        self.rejected += 1
+        if attempts + 1 >= self.give_up_after:
+            self.gave_up += 1
+            return
+        # capped jittered client backoff off the server's suggestion
+        delay = min(retry_after, 5.0) * (0.5 + self._rng.random())
+        self._retry.append((now + delay, name, attempts + 1))
+
+    def _create_batch(self, names: list, now: float) -> None:
+        """Fresh arrivals ride ONE create_many: one admission-gate
+        evaluation + one batched ledger admission stamp server-side (the
+        round-17 ingest contract). A partial shed (429 with `accepted`)
+        books the landed prefix and re-queues the shed tail with the
+        usual jittered backoff — never silently dropped. Fresh names are
+        unique by construction, so the batch can't AlreadyExists.
+        Retries keep the per-pod path (a retry whose first attempt landed
+        must resolve individually)."""
+        pods = [self.pod_fn(nm) for nm in names]
+        try:
+            self.store.create_many(PODS, pods)
+            self.created += len(pods)
+        except BackpressureError as e:
+            k = max(0, min(int(getattr(e, "accepted", 0)), len(pods)))
+            self.created += k
+            for nm in names[k:]:
+                self._shed(nm, 0, now, e.retry_after)
+
+    def _retry_batch(self, due: list, now: float) -> None:
+        try:
+            self.store.create_many(
+                PODS, [self.pod_fn(name) for _t, name, _a in due])
+            self.created += len(due)
+        except BackpressureError as e:
+            k = max(0, min(int(getattr(e, "accepted", 0)), len(due)))
+            self.created += k
+            for _t, name, attempts in due[k:]:
+                self._shed(name, attempts, now, e.retry_after)
+        except AlreadyExistsError:
+            # some retry's first attempt landed after all (lossy
+            # transport): resolve the chunk per-pod — creates that
+            # landed in the raising batch re-resolve as AlreadyExists
+            # -> counted created, exactly the per-pod contract
+            for _t, name, attempts in due:
+                self._create(name, attempts, now)
 
     def tick(self, now: Optional[float] = None) -> int:
         """Create every arrival due by `now` (fresh ones owed by the rate
@@ -89,25 +138,44 @@ class ArrivalGenerator:
         if self._t0 is None:
             self._t0 = now
         n = 0
-        # re-admissions first: they arrived earlier and queue earlier
+        # re-admissions first: they arrived earlier and queue earlier.
+        # Batched like fresh arrivals (one gate evaluation per flush) —
+        # under sustained overload the retry pool is the DOMINANT create
+        # source, and per-pod retries were hammering the admission
+        # surface with six figures of creates/s. A retry whose first
+        # attempt actually landed (AlreadyExists) is only possible over
+        # a lossy transport; that chunk falls back to per-pod creates,
+        # which resolve it exactly as before.
         due = [r for r in self._retry if r[0] <= now]
         if due:
             self._retry = [r for r in self._retry if r[0] > now]
-            for _t, name, attempts in sorted(due):
-                self._create(name, attempts, now)
-                n += 1
+            due.sort()
+            n += len(due)
+            if len(due) > 1 and hasattr(self.store, "create_many"):
+                self._retry_batch(due, now)
+            else:
+                for _t, name, attempts in due:
+                    self._create(name, attempts, now)
         self._owed += (now - self._t0) * self.rate
         self._t0 = now
         fresh = int(self._owed)
         if self.total is not None:
             fresh = min(fresh, self.total - self.attempted)
         self._owed -= fresh
-        for _ in range(max(0, fresh)):
-            name = f"{self.name_prefix}{self._seq}"
-            self._seq += 1
-            self.attempted += 1
-            self._create(name, 0, now)
-            n += 1
+        fresh = max(0, fresh)
+        if fresh:
+            names = []
+            for _ in range(fresh):
+                names.append(f"{self.name_prefix}{self._seq}")
+                self._seq += 1
+            self.attempted += fresh
+            n += fresh
+            INGEST_BATCH.observe(fresh)
+            if fresh > 1 and hasattr(self.store, "create_many"):
+                self._create_batch(names, now)
+            else:
+                for name in names:
+                    self._create(name, 0, now)
         return n
 
     def finished(self) -> bool:

@@ -122,6 +122,47 @@ class BackpressureGate:
                            f"flight >= cap {self.max_inflight}")
         self.admitted += 1
 
+    def admit_many(self, pods) -> tuple:
+        """ONE gate evaluation for a whole create_many batch: returns
+        (n_admitted, retry_after) where pods[:n_admitted] are admitted
+        and the TAIL is shed (retry_after is None when nothing shed).
+
+        Semantics mirror per-pod admits exactly: each serial create
+        grows the informer backlog by one before the next gate read, so
+        pod i of the batch is evaluated against depth base+i — the depth
+        watermark therefore sheds a TAIL, never a middle. The in-flight
+        window count cannot change mid-batch (no window dispatches inside
+        a store create), so it is read once; a chaos serve.shed draw mid-
+        batch sheds from that pod on (flow control errs toward shedding —
+        the seam is an opt-in chaos path, and shed arrivals re-admit).
+        Ledger records of shed pods are evicted in one batch, exactly
+        like the per-pod _shed path."""
+        n = len(pods)
+        base = self.depth_fn()
+        accepted = 0
+        reason = None
+        if self.max_inflight is not None and self.inflight_fn is not None \
+                and self.inflight_fn() >= self.max_inflight:
+            reason = "inflight-windows"
+        else:
+            for pod in pods:
+                if chaos.take("serve.shed"):
+                    reason = "injected"
+                    break
+                if base + accepted >= self.max_depth:
+                    reason = "queue-depth"
+                    break
+                accepted += 1
+        self.admitted += accepted
+        if accepted == n:
+            return n, None
+        shed = pods[accepted:]
+        self.rejected += len(shed)
+        ADMISSION_REJECTED.labels(reason).inc(len(shed))
+        from kubernetes_tpu.obs.ledger import LEDGER
+        LEDGER.evict_many([p.key for p in shed])
+        return accepted, self.suggest_retry_after(base + accepted)
+
     def debug_state(self) -> dict:
         return {
             "max_depth": self.max_depth,

@@ -208,6 +208,33 @@ class PyCommitCore:
             self.create_batch(ev_bucket, ev_kind, recs, True)
         return missing
 
+    def commit_wave_binds(self, pod_bucket: dict, pod_kind: str,
+                          bindings: list[tuple[str, str]],
+                          ev_bucket: dict, ev_kind: str,
+                          record_cls, component: str,
+                          seq0: int) -> list[str]:
+        """commit_wave with the Scheduled-event payloads built INSIDE the
+        core (round 17): the caller passes only (key, node) bindings plus
+        the record class / component / reserved name-sequence start, and
+        the core constructs one `Successfully assigned {key} to {node}`
+        record per LANDED binding (binding i names its record seq0+i;
+        vanished pods consume their seq but emit nothing — exactly the
+        serial path that never reaches its Scheduled event). Deletes the
+        last per-pod Python construction from the commit thread when the
+        native core runs this; this twin is the referee."""
+        from kubernetes_tpu.store.record import build_scheduled_records
+        missing = self.bind_batch(pod_bucket, pod_kind, bindings)
+        if bindings:
+            recs = build_scheduled_records(record_cls, bindings,
+                                           component, seq0)
+            if missing:
+                miss = set(missing)
+                recs = [r for (k, _n), r in zip(bindings, recs)
+                        if k not in miss]
+            if recs:
+                self.create_batch(ev_bucket, ev_kind, recs, True)
+        return missing
+
     # -- fan-out -------------------------------------------------------------
     def flush(self) -> int:
         """Publish every pending entry to its kind's watchers (log order)

@@ -42,24 +42,51 @@ RELIST_BACKOFF = obs.histogram(
 
 Handler = Callable[[Any], None]
 UpdateHandler = Callable[[Any, Any], None]
+BatchHandler = Callable[[list], None]
 
 
 class ResourceEventHandler:
     """One registered handler set, optionally filtered
-    (reference: cache.FilteringResourceEventHandler)."""
+    (reference: cache.FilteringResourceEventHandler).
+
+    `on_add_many` is the batched-ingest extension (round 17): when set, a
+    pump that delivered a RUN of consecutive adds hands the whole run to
+    this callback in one call (per-object filter still applied) instead of
+    one `on_add` per object — per-handler delivery ORDER is unchanged, so
+    a handler never observes anything a per-event loop wouldn't."""
 
     def __init__(self,
                  on_add: Optional[Handler] = None,
                  on_update: Optional[UpdateHandler] = None,
                  on_delete: Optional[Handler] = None,
-                 filter_fn: Optional[Callable[[Any], bool]] = None):
+                 filter_fn: Optional[Callable[[Any], bool]] = None,
+                 on_add_many: Optional[BatchHandler] = None):
         self.on_add = on_add
+        self.on_add_many = on_add_many
         self.on_update = on_update
         self.on_delete = on_delete
         self.filter_fn = filter_fn
 
     def _passes(self, obj: Any) -> bool:
         return self.filter_fn is None or self.filter_fn(obj)
+
+    def handle_added_run(self, objs: list) -> None:
+        """A run of consecutive ADDED objects, in delivery order: one
+        `on_add_many` call for the filtered batch when registered, else
+        the per-object `on_add` loop."""
+        if self.on_add is None and self.on_add_many is None:
+            return
+        passing = objs if self.filter_fn is None \
+            else [o for o in objs if self.filter_fn(o)]
+        if not passing:
+            return
+        if self.on_add_many is not None and len(passing) > 1:
+            self.on_add_many(passing)
+        elif self.on_add is not None:
+            for o in passing:
+                self.on_add(o)
+        else:
+            self.on_add_many(passing)
 
     def handle(self, ev_type: str, old: Any, new: Any) -> None:
         if ev_type == ADDED:
@@ -116,8 +143,11 @@ class SharedInformer:
                           on_add: Optional[Handler] = None,
                           on_update: Optional[UpdateHandler] = None,
                           on_delete: Optional[Handler] = None,
-                          filter_fn: Optional[Callable[[Any], bool]] = None) -> None:
-        self._handlers.append(ResourceEventHandler(on_add, on_update, on_delete, filter_fn))
+                          filter_fn: Optional[Callable[[Any], bool]] = None,
+                          on_add_many: Optional[BatchHandler] = None) -> None:
+        self._handlers.append(ResourceEventHandler(
+            on_add, on_update, on_delete, filter_fn,
+            on_add_many=on_add_many))
 
     # -- lister (reference: informer.Lister()) ------------------------------
     def list(self) -> list[Any]:
@@ -208,16 +238,25 @@ class SharedInformer:
             if key not in new:
                 self._dispatch(DELETED, None, obj)
 
+    #: events copied out per watch poll during pump() — ONE core poll call
+    #: (GIL-released on the native core) serves a whole batch instead of
+    #: one call per event (the round-17 batched-ingest prologue)
+    pump_batch = 256
+
     def pump(self, max_events: Optional[int] = None,
              timeout: float = 0.0) -> int:
-        """Synchronously apply pending watch events. Returns count applied."""
+        """Synchronously apply pending watch events, copied out in
+        batches (one core poll per `pump_batch` events; consecutive adds
+        dispatch as one batch to handlers that registered on_add_many).
+        Returns count applied."""
         if self._watch is None:
             self.sync()
         n = 0
         while max_events is None or n < max_events:
+            limit = self.pump_batch if max_events is None \
+                else min(self.pump_batch, max_events - n)
             try:
-                ev = (self._watch.next(timeout=timeout) if timeout
-                      else self._watch.try_next())
+                evs = self._poll_batch(timeout, limit)
             except ExpiredError:
                 # the watch outran the server's event log: re-list
                 # (reflector 410 contract); consecutive expirations with
@@ -226,27 +265,72 @@ class SharedInformer:
                 self._note_expired()
                 self._relist()
                 continue
-            if ev is None:
+            if not evs:
                 break
-            self._apply(ev)
-            n += 1
+            self._apply_batch(evs)
+            n += len(evs)
         return n
 
+    def _poll_batch(self, timeout: float, limit: int) -> list:
+        """Copy out up to `limit` pending events: one cursor poll on the
+        embedded store's Watch (the core call is GIL-released on the
+        native commit core); transports without the batch poll
+        (RemoteWatch's reader queue) drain per event."""
+        w = self._watch
+        poll = getattr(w, "_poll", None)
+        if poll is not None:
+            return poll(timeout if timeout else 0, limit)
+        evs = []
+        ev = w.next(timeout=timeout) if timeout else w.try_next()
+        while ev is not None:
+            evs.append(ev)
+            if len(evs) >= limit:
+                break
+            ev = w.try_next()
+        return evs
+
     def _apply(self, ev: Event) -> None:
+        self._apply_batch([ev])
+
+    def _apply_batch(self, evs: list) -> None:
         # a delivered event ends any consecutive-ExpiredError streak
         self._expired_streak = 0
-        old = None
+        prepared = []   # (effective etype, old, new) in delivery order
         with self._lock:
-            if ev.type in (ADDED, MODIFIED):
-                old = self._cache.get(ev.obj.key)
-                self._cache[ev.obj.key] = ev.obj
-            elif ev.type == DELETED:
-                old = self._cache.pop(ev.obj.key, None)
-        # An ADDED for a key we already had behaves as update (re-list replay)
-        etype = ev.type
-        if etype == ADDED and old is not None:
-            etype = MODIFIED
-        self._dispatch(etype, old, ev.obj)
+            cache = self._cache
+            for ev in evs:
+                old = None
+                if ev.type in (ADDED, MODIFIED):
+                    old = cache.get(ev.obj.key)
+                    cache[ev.obj.key] = ev.obj
+                elif ev.type == DELETED:
+                    old = cache.pop(ev.obj.key, None)
+                # an ADDED for a key we already had behaves as update
+                # (re-list replay)
+                etype = ev.type
+                if etype == ADDED and old is not None:
+                    etype = MODIFIED
+                prepared.append((etype, old, ev.obj))
+        i = 0
+        n = len(prepared)
+        while i < n:
+            etype, old, new = prepared[i]
+            if etype != ADDED:
+                self._dispatch(etype, old, new)
+                i += 1
+                continue
+            # run of consecutive fresh adds: one batched dispatch per
+            # handler (per-handler order identical to the per-event loop)
+            j = i + 1
+            while j < n and prepared[j][0] == ADDED:
+                j += 1
+            run = [prepared[k][2] for k in range(i, j)]
+            if j - i == 1:
+                self._dispatch(ADDED, None, new)
+            else:
+                for h in self._handlers:
+                    h.handle_added_run(run)
+            i = j
 
     def _dispatch(self, ev_type: str, old: Any, new: Any) -> None:
         for h in self._handlers:

@@ -8,7 +8,6 @@ written through the store so any watcher (tests, CLI, controllers) sees them
 """
 from __future__ import annotations
 
-import itertools
 import threading
 from collections import OrderedDict
 
@@ -21,7 +20,51 @@ from kubernetes_tpu.store.remote import APIStatusError
 NORMAL = "Normal"
 WARNING = "Warning"
 
-_seq = itertools.count(1)
+_seq_val = 0
+_seq_lock = threading.Lock()
+
+
+def reserve_seq(n: int) -> int:
+    """Atomically reserve a contiguous block of `n` record-name sequence
+    numbers; returns the first. The commit core's batched Scheduled-event
+    build (native and twin) names its records seq0+i off one reservation
+    per wave, so wave records stay unique against every other emitter of
+    the process-global sequence (gaps from unlanded bindings are fine —
+    the sequence only guarantees uniqueness, like the per-record
+    next_seq() it generalizes)."""
+    global _seq_val
+    with _seq_lock:
+        first = _seq_val + 1
+        _seq_val += n
+        return first
+
+
+def next_seq() -> int:
+    return reserve_seq(1)
+
+
+def build_scheduled_records(record_cls, bindings: list, component: str,
+                            seq0: int) -> list:
+    """Pure-Python twin of the native core's batched Scheduled-record
+    build (commitcore.cpp commit_wave_binds): one EventRecord per binding
+    (key, node), named `{name}.{seq0+i:x}`, message exactly the burst
+    commit's wording. Used by PyCommitCore.commit_wave_binds and as the
+    stale-native-.so fallback; field-for-field parity with the native
+    build is pinned by tests/test_commit_core.py."""
+    recs = []
+    new = record_cls.__new__
+    for i, (key, node) in enumerate(bindings):
+        namespace, _, name = key.partition("/")
+        rec = new(record_cls)
+        rec.__dict__.update(
+            name=f"{name or key}.{seq0 + i:x}",
+            namespace=namespace if name else "default",
+            involved_kind="Pod", involved_key=key,
+            type=NORMAL, reason="Scheduled",
+            message=f"Successfully assigned {key} to {node}",
+            count=1, component=component, resource_version=0)
+        recs.append(rec)
+    return recs
 
 # correlation cache bound (the reference correlator is an LRU with TTL,
 # client-go/tools/record/events_cache.go); keys include per-pod messages, so
@@ -57,7 +100,7 @@ class EventRecorder:
                     pass   # expired/cleaned: fall through to re-create
             namespace, _, name = involved_key.partition("/")
             rec = EventRecord(
-                name=f"{name or involved_key}.{next(_seq):x}",
+                name=f"{name or involved_key}.{next_seq():x}",
                 namespace=namespace if name else "default",
                 involved_kind=involved_kind, involved_key=involved_key,
                 type=etype, reason=reason, message=message,
@@ -93,7 +136,7 @@ class EventRecorder:
             # runs 10k+ times inside the timed burst window
             rec = new(EventRecord)
             rec.__dict__.update(
-                name=f"{name or key}.{next(_seq):x}",
+                name=f"{name or key}.{next_seq():x}",
                 namespace=namespace if name else "default",
                 involved_kind="Pod", involved_key=key,
                 type=etype, reason=reason, message=message,

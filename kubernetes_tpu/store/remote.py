@@ -76,7 +76,8 @@ class APIStatusError(Exception):
 
 
 def _raise_for(code: int, reason: str, message: str,
-               retry_after: Optional[str] = None) -> None:
+               retry_after: Optional[str] = None,
+               accepted: int = 0) -> None:
     if code == 404:
         raise NotFoundError(message)
     if code == 409:
@@ -97,7 +98,11 @@ def _raise_for(code: int, reason: str, message: str,
         except ValueError:
             ra = 10.0
         if reason == "Backpressure":
-            raise BackpressureError(message, retry_after=ra)
+            # `accepted` rides the status body on batched creates: the
+            # first `accepted` items of the batch LANDED, only the tail
+            # was shed (0 on the single-create path)
+            raise BackpressureError(message, retry_after=ra,
+                                    accepted=accepted)
         raise DisruptionBudgetError(message, retry_after=ra)
     raise APIStatusError(code, reason, message)
 
@@ -307,9 +312,14 @@ class RemoteStore:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             b = _status_body(e)
+            try:
+                accepted = int(b.get("accepted", 0) or 0)
+            except (TypeError, ValueError):
+                accepted = 0
             _raise_for(e.code, b.get("reason", ""),
                        b.get("message", str(e)),
-                       retry_after=e.headers.get("Retry-After"))
+                       retry_after=e.headers.get("Retry-After"),
+                       accepted=accepted)
 
     def _request(self, method: str, path: str, body: Optional[dict] = None,
                  verb_class: str = "read") -> Any:
@@ -365,6 +375,21 @@ class RemoteStore:
                 REQUEST_RETRIES.labels("backpressure").inc()
                 self._sleep(min(e.retry_after, cap)
                             * (0.5 + self._rng.random() / 2))
+
+    def create_many(self, kind: str, objs: list, move: bool = False) -> None:
+        """Batched create: ONE collection POST ({"items": [...]}) so the
+        server runs ONE admission-gate evaluation + one batched ledger
+        stamp for the whole flush (the round-17 arrival-ingest contract).
+        A partial shed surfaces as BackpressureError carrying `accepted`
+        (how many items of the prefix landed) + the server's Retry-After;
+        NO auto-retry here — partial acceptance makes a blind re-POST
+        unsafe, so the caller (ArrivalGenerator) re-queues the shed tail
+        on its own backoff. Callers pass fresh uniquely-named objects,
+        exactly like the embedded verb."""
+        del move   # serialization copies regardless, as in create()
+        self._request("POST", f"/api/v1/{kind}",
+                      {"items": [serde.to_dict(o) for o in objs]},
+                      verb_class="write")
 
     def update(self, kind: str, obj: Any,
                expect_rv: Optional[int] = None) -> Any:

@@ -109,6 +109,19 @@ EVICTIONS = obs.counter(
 #: a small multiple of any realistic pipeline depth is plenty)
 WAVE_TOKEN_CAP = 1024
 
+#: retained audit EventRecords (the reference apiserver expires events
+#: after a TTL — default 1h — for exactly this reason: a serving process
+#: emits one Scheduled record per pod forever, and an unbounded events
+#: bucket is a heap leak whose growing gen2 GC passes land as multi-ms
+#: pauses inside scheduling windows). Oldest-first eviction past the cap,
+#: with a DELETED watch event so consumers stay consistent.
+DEFAULT_EVENTS_CAP = 1 << 16
+
+EVENTS_TRIMMED = obs.counter(
+    "store_events_trimmed_total",
+    "Audit EventRecords evicted oldest-first past the store's retention "
+    "cap (the reference's event TTL analog; each eviction emits DELETED).")
+
 
 class ConflictError(Exception):
     """resourceVersion precondition failed (optimistic-concurrency loss)."""
@@ -134,9 +147,14 @@ class BackpressureError(Exception):
     clients retry it safely after the suggested backoff; a refused
     eviction must never auto-retry."""
 
-    def __init__(self, message: str, retry_after: float = 0.25):
+    def __init__(self, message: str, retry_after: float = 0.25,
+                 accepted: int = 0):
         super().__init__(message)
         self.retry_after = retry_after
+        # batched-create partial acceptance (create_many): the first
+        # `accepted` objects of the batch LANDED; only the tail was shed.
+        # Always 0 on the single-create path.
+        self.accepted = accepted
 
 
 class NotFoundError(Exception):
@@ -272,7 +290,8 @@ class Store:
     def __init__(self, watch_log_size: int = DEFAULT_WATCH_LOG,
                  debug_integrity: Optional[bool] = None,
                  watch_queue_size: Optional[int] = None,
-                 commit_core: Optional[str] = None):
+                 commit_core: Optional[str] = None,
+                 events_cap: Optional[int] = DEFAULT_EVENTS_CAP):
         from kubernetes_tpu.store.commit_core import make_commit_core
         self._lock = threading.RLock()
         self._objs: dict[str, dict[str, Any]] = {}
@@ -284,6 +303,8 @@ class Store:
         self.core_impl = "native" if getattr(self._core, "is_native", False) \
             else "twin"
         self._log_size = watch_log_size
+        # audit-record retention (the event-TTL analog); None/0 = unbounded
+        self._events_cap = events_cap
         # wave-token dedupe map (idempotent commit retry): token -> the
         # missing-keys result of the wave that landed under it. A retried
         # commit_wave after an ambiguous failure replays the RESULT, not
@@ -475,6 +496,28 @@ class Store:
         if dropped:
             WATCH_DROPPED.labels("slow-consumer").inc(dropped)
 
+    def _trim_events_locked(self) -> None:
+        """Evict the oldest audit records past the retention cap (event
+        TTL analog; caller holds the lock and flushes after). The evicted
+        object moves into the DELETED log entry — it left the bucket, so
+        no clone is needed (the usual read-only aliasing convention)."""
+        cap = self._events_cap
+        if not cap:
+            return
+        bucket = self._objs.get(EVENTS)
+        if bucket is None or len(bucket) <= cap:
+            return
+        core = self._core
+        trimmed = 0
+        while len(bucket) > cap:
+            key = next(iter(bucket))
+            obj = bucket.pop(key)
+            if self._integrity is not None:
+                self._integrity.pop((EVENTS, key), None)
+            core.append(DELETED, EVENTS, obj, core.next_rv())
+            trimmed += 1
+        EVENTS_TRIMMED.inc(trimmed)
+
     def create(self, kind: str, obj: Any, move: bool = False) -> Any:
         """`move=True` transfers ownership: the caller promises never to
         touch `obj` again, skipping the write snapshot (the event recorder's
@@ -490,6 +533,8 @@ class Store:
             try:
                 stored = self._core.create_batch(
                     self._objs.setdefault(kind, {}), kind, [obj], move)[0]
+                if kind == EVENTS:
+                    self._trim_events_locked()
             finally:
                 self._flush()
             self._record_entry(kind, _key_of(stored), stored)
@@ -552,7 +597,42 @@ class Store:
             rv = self._core.next_rv()
             self._core.append(DELETED, kind, _clone(obj), rv)
             self._flush()
-            return obj
+        if kind == PODS:
+            # lifecycle-ledger finalize-on-delete: a pod deleted while
+            # still holding an in-flight slot (pending, or bound and
+            # awaiting its bind event's copy-out stamp) must not retain
+            # it forever — the completion reaper / PodGC would otherwise
+            # leak one record per deletion until the capacity bound
+            from kubernetes_tpu.obs.ledger import LEDGER
+            LEDGER.finalize_delete(key)
+        return obj
+
+    def delete_many(self, kind: str, keys: list) -> list:
+        """Batched delete under ONE lock and one flush (the completion
+        reaper's verb — per-pod deletes put one lock+flush per reaped pod
+        on the serving loop's critical path). Missing keys are skipped;
+        returns the deleted objects. Per-key semantics otherwise identical
+        to delete()."""
+        gone = []
+        with self._lock:
+            bucket = self._objs.get(kind, {})
+            self._core_guard()
+            core = self._core
+            for key in keys:
+                obj = bucket.pop(key, None)
+                if obj is None:
+                    continue
+                self._check_entry(kind, key, obj)
+                if self._integrity is not None:
+                    self._integrity.pop((kind, key), None)
+                core.append(DELETED, kind, _clone(obj), core.next_rv())
+                gone.append(obj)
+            self._flush()
+        if kind == PODS and gone:
+            from kubernetes_tpu.obs.ledger import LEDGER
+            for obj in gone:
+                LEDGER.finalize_delete(obj.key)
+        return gone
 
     # -- pod conveniences (the scheduler's write surface) --------------------
     def bind_pod(self, pod_key: str, node_name: str) -> Any:
@@ -608,25 +688,72 @@ class Store:
         LEDGER.commit_many([k for k, _n in bindings if k not in gone])
         return missing
 
-    def create_many(self, kind: str, objs: list, move: bool = False) -> None:
+    def create_many(self, kind: str, objs: list,
+                    move: bool = False) -> list:
         """Batch create under one lock and one core call (event records
-        from a burst commit); per-object semantics identical to create().
-        Raises on the first duplicate — callers pass fresh uniquely-named
-        objects."""
-        with self._lock:
-            self._core_guard()
-            try:
-                stored = self._core.create_batch(
-                    self._objs.setdefault(kind, {}), kind, objs, move)
-            finally:
-                self._flush()
-            if self._integrity is not None:
-                for o in stored:
-                    self._record_entry(kind, _key_of(o), o)
+        from a burst commit, and the serving lane's batched arrival
+        ingest); per-object semantics identical to create(). Raises on
+        the first duplicate — callers pass fresh uniquely-named objects.
+
+        Pod batches ride the serving admission surface exactly like
+        create(), but with ONE gate evaluation and ONE batched ledger
+        admission stamp per call: the gate admits a PREFIX (its depth
+        watermark grows monotonically across a batch — see
+        BackpressureGate.admit_many), the admitted prefix lands in one
+        core call, and a shed tail raises ONE BackpressureError carrying
+        `accepted` (how many landed) + the suggested Retry-After. Returns
+        the stored objects (admitted prefix)."""
+        gate = self.admission_gate
+        retry_after = None
+        shed = 0
+        if gate is not None and kind == PODS and objs:
+            admit_many = getattr(gate, "admit_many", None)
+            if admit_many is not None:
+                n_admit, retry_after = admit_many(objs)
+            else:
+                # a gate without the batch verb keeps per-pod admits;
+                # the first shed ends the batch (prefix semantics)
+                n_admit = 0
+                try:
+                    for o in objs:
+                        gate.admit(o)
+                        n_admit += 1
+                except BackpressureError as e:
+                    retry_after = e.retry_after
+            shed = len(objs) - n_admit
+            objs = objs[:n_admit]
+        stored: list = []
+        if objs:
+            with self._lock:
+                self._core_guard()
+                try:
+                    stored = self._core.create_batch(
+                        self._objs.setdefault(kind, {}), kind, objs, move)
+                    if kind == EVENTS:
+                        self._trim_events_locked()
+                finally:
+                    self._flush()
+                if self._integrity is not None:
+                    for o in stored:
+                        self._record_entry(kind, _key_of(o), o)
+            if gate is not None and kind == PODS:
+                # one batched admission stamp for the accepted prefix —
+                # the per-pod path's stamp_admission, amortized
+                from kubernetes_tpu.obs.ledger import LEDGER
+                LEDGER.stamp_admission_many([o.key for o in stored])
+        if shed:
+            raise BackpressureError(
+                f"{kind}: batched create shed {shed}/{shed + len(stored)} "
+                f"past the admission watermark",
+                retry_after=(retry_after if retry_after is not None
+                             else 0.25),
+                accepted=len(stored))
+        return stored
 
     def commit_wave(self, bindings: list[tuple[str, str]],
                     events: Optional[list] = None,
-                    token: Optional[str] = None) -> list[str]:
+                    token: Optional[str] = None,
+                    event_spec: Optional[dict] = None) -> list[str]:
         """One burst wave's whole store-write tail as ONE core call: the
         batched bind (bind_pods semantics) plus the audit-record creates
         for the bindings that landed (`events[i]` rides `bindings[i]`;
@@ -640,8 +767,25 @@ class Store:
         under the same token returns its recorded missing-keys result
         without touching the core — a retried bind after an AMBIGUOUS
         failure (the wave landed but the caller saw an exception) can
-        neither double-land nor double-emit its events."""
+        neither double-land nor double-emit its events.
+
+        `event_spec` (round 17, mutually exclusive with `events`) asks
+        the commit core to BUILD the Scheduled audit payloads itself:
+        `{"component": name}` makes the core construct one
+        `Successfully assigned {key} to {node}` record per landed binding
+        (record names ride a reserved block of the recorder's global
+        sequence), deleting the per-pod Python record construction from
+        the commit thread — natively in commitcore.cpp, with
+        PyCommitCore.commit_wave_binds as the twin, and a Python-side
+        build as the stale-.so fallback. Retries of the SAME token must
+        pass the same spec; the dedupe map answers them either way."""
         import time as _time
+        if event_spec is not None:
+            from kubernetes_tpu.api.types import EventRecord
+            from kubernetes_tpu.store.record import (build_scheduled_records,
+                                                     reserve_seq)
+            seq0 = reserve_seq(max(1, len(bindings)))
+            component = event_spec.get("component", "")
         with self._lock:
             if token is not None:
                 hit = self._wave_tokens.get(token)
@@ -660,8 +804,26 @@ class Store:
                     if current is not None:
                         self._check_entry(PODS, pod_key, current)
             t_core = _time.perf_counter()
-            missing = self._core.commit_wave(pods, PODS, bindings,
-                                             evs, EVENTS, events or [])
+            if event_spec is not None:
+                cwb = getattr(self._core, "commit_wave_binds", None)
+                if cwb is not None:
+                    # ONE core call builds the Scheduled payloads AND
+                    # lands binds + events (native: zero per-pod Python
+                    # on the commit thread)
+                    missing = cwb(pods, PODS, bindings, evs, EVENTS,
+                                  EventRecord, component, seq0)
+                else:
+                    # stale prebuilt .so without the verb: build the
+                    # records host-side (identical fields) and ride the
+                    # classic wave call
+                    recs = build_scheduled_records(
+                        EventRecord, bindings, component, seq0)
+                    missing = self._core.commit_wave(
+                        pods, PODS, bindings, evs, EVENTS, recs)
+            else:
+                missing = self._core.commit_wave(pods, PODS, bindings,
+                                                 evs, EVENTS, events or [])
+            self._trim_events_locked()   # audit retention (event TTL)
             t_landed = _time.perf_counter()
             if token is not None:
                 self._wave_tokens[token] = list(missing)

@@ -82,6 +82,12 @@ class KeyedHeap:
             out.append(self.pop())
         return out
 
+    def add_many(self, items: list) -> None:
+        """Batched insert (the native core's push_many twin): per-item
+        add() semantics, one call."""
+        for item in items:
+            self.add(item)
+
     # -- internals ----------------------------------------------------------
     def _swap(self, i: int, j: int) -> None:
         items = self._items
@@ -144,6 +150,12 @@ class _PyHeapCore:
 
     def pop_many(self, limit: int) -> list:
         return [e[2] for e in self._h.pop_many(limit)]
+
+    def push_many(self, entries: list) -> None:
+        """Batched add (native push_many twin): entries are
+        (key, a, b, c, payload) tuples, inserted in order."""
+        for key, a, b, c, item in entries:
+            self.add(key, a, b, c, item)
 
     def list(self) -> list:
         return [e[2] for e in self._h.list()]
@@ -216,6 +228,24 @@ class NumericKeyedHeap:
         self._core.add(self._key_fn(item), float(a), float(b), float(c), item)
 
     update = add
+
+    def add_many(self, items: list) -> None:
+        """Batched insert: ONE native push_many call for the whole batch
+        (the sifts run with the GIL released), per-item add() semantics.
+        A stale pre-push_many .so degrades to per-item adds."""
+        self._guard()
+        key_fn, triple = self._key_fn, self._triple
+        entries = []
+        for item in items:
+            a, b, c = triple(item)
+            entries.append((key_fn(item), float(a), float(b), float(c),
+                            item))
+        pm = getattr(self._core, "push_many", None)
+        if pm is not None:
+            pm(entries)
+            return
+        for key, a, b, c, item in entries:
+            self._core.add(key, a, b, c, item)
 
     def add_if_not_present(self, item: Any) -> None:
         if self._key_fn(item) not in self._core:

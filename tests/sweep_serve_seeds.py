@@ -46,6 +46,10 @@ def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
         ("death", {"death": True}),
         ("chaos", {"chaos": True}),
         ("shed", {"shed_rate": 0.3}),
+        # round-17: mid-window pod updates drive the encode-at-admission
+        # row cache's update-in-place invalidation (cached-row vs
+        # fresh-encode bit-identity asserted row-by-row inside the fuzz)
+        ("update", {"update_rate": 0.4}),
     ]
     inst = TestServeWindowParity()
     for trial in range(trials):

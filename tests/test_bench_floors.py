@@ -253,6 +253,11 @@ def test_churn_mode_floor():
     assert out["value"] >= 100.0, out
 
 
+#: PROFILE round 16's recorded host prologue at the 1000n/2000rps cell:
+#: encode ~853 + admission ~543 pod-seconds over ~60k scheduled pods
+ROUND16_PROLOGUE_PER_POD = (853.0 + 543.0) / 60_000
+
+
 def _run_serve(extra, timeout=900):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)
@@ -292,6 +297,33 @@ def test_serve_mode_floor():
     # the round-15 device-report fields ride the serve lane too
     assert out["devices"] == 1 and "per_device_node_rows" in out
     assert out["launch_depth"] >= 3
+    # round-17 host-prologue guard at 30 s: the short cell is dominated
+    # by the reaper-onset transient (one interval books 3-6x the steady
+    # state), so the tight 0.6x floor lives on the 90 s soak below; here
+    # we only trip on a gross regression past the round-16 baseline
+    pro = out["prologue_phase_split"]
+    assert pro["encode_pod_seconds"] > 0
+    assert pro["admission_pod_seconds"] > 0
+    assert pro["per_scheduled_pod"] <= ROUND16_PROLOGUE_PER_POD, pro
+
+
+@pytest.mark.slow
+def test_serve_raised_rate_cell():
+    """The round-17 raised sustained-rate cell: 4000 arrivals/s on CPU —
+    double the round-16 acceptance rate. Pre-round-17 this rate
+    collapsed the loop to ~2100 pods/s with p99 past 9 s: the gate's
+    50 ms Retry-After floor let shed clients re-create six-figure times
+    per second THROUGH THE PER-POD PATH, and the retry storm itself ate
+    the capacity. With batched retries, the calmer suggestion floor,
+    and the gathered prologue, the box sustains ~3990 pods/s at p99
+    ~0.2 s (watermark sized to ~1 s of rate per the PROFILE watermark
+    arithmetic; sheds allowed — backpressure IS the contract)."""
+    out = _run_serve(["--nodes", "1000", "--arrival-rate", "4000",
+                      "--duration", "30", "--max-queue-depth", "4096"])
+    assert out["audit_all_admitted_or_429"] is True
+    assert out["parity_violations"] == 0, out
+    assert out["startup_p99"] <= 5.0, out
+    assert out["value"] >= 0.8 * 4000, out
 
 
 @pytest.mark.slow
@@ -306,6 +338,14 @@ def test_serve_mode_soak():
     assert out["startup_p99"] <= 5.0, out
     assert out["audit_all_admitted_or_429"] is True
     assert out["parity_violations"] == 0, out
+    # round-17 host-prologue floor (the issue's acceptance cell): encode
+    # + admission pod-seconds per scheduled pod <= 0.6x the round-16
+    # recorded baseline — the encode-at-admission row cache, stable
+    # device axis, batched arrival ingest, and in-core event records.
+    # (Measured 0.54x on the reference CPU box; the reaper-onset
+    # transient amortizes over 90 s, which is why the floor lives here.)
+    pro = out["prologue_phase_split"]
+    assert pro["per_scheduled_pod"] <= 0.6 * ROUND16_PROLOGUE_PER_POD, pro
 
 
 @pytest.mark.slow

@@ -118,6 +118,17 @@ class _Recorderless:
         self.store.fanout_wave()
         return missing
 
+    def op_commit_wave_binds(self, names, node):
+        # the round-17 verb: Scheduled payloads built INSIDE the core
+        # (native) / twin — rv assignment for the records rides the same
+        # observable stream, so the compared logs pin identical
+        # record-count and ordering behavior
+        missing = self.store.commit_wave(
+            [(f"default/{n}", node) for n in names],
+            event_spec={"component": "parity-sched"})
+        self.store.fanout_wave()
+        return missing
+
     def op_watch(self, wid, since_rv):
         self.watches[wid] = self.store.watch(PODS, since_rv=since_rv)
         return None
@@ -153,8 +164,12 @@ def _random_program(seed: int, n_ops: int = 120):
             prog.append(("bind_many",
                          tuple(rng.sample(names, rng.randint(1, 5))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.80:
+        elif r < 0.74:
             prog.append(("commit_wave",
+                         tuple(rng.sample(names, rng.randint(1, 6))),
+                         f"n{rng.randint(0, 3)}"))
+        elif r < 0.80:
+            prog.append(("commit_wave_binds",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
         elif r < 0.86:
@@ -473,6 +488,98 @@ class TestPrologueTwins:
         twins = [mkpod("plain2"), mkpod("plain3")]
         sigs = TPUScheduler.class_signatures(twins)
         assert sigs[0] == sigs[1]
+
+
+# ---------------------------------------------------------------------------
+# commit_wave_binds: in-core Scheduled-record construction (round 17)
+# ---------------------------------------------------------------------------
+class TestCommitWaveBinds:
+    """The native core builds a landed binding's Scheduled payload itself
+    (zero per-pod Python on the commit thread); the twin is the referee.
+    Field-for-field record parity, seq0+i naming, vanished-pod skips, and
+    the store-level event_spec plumbing are pinned here."""
+
+    def _run_core(self, impl, bindings, present, seq0=100):
+        from kubernetes_tpu.api.types import EventRecord
+        from kubernetes_tpu.store.commit_core import make_commit_core
+        from kubernetes_tpu.store.store import (AlreadyExistsError as AE,
+                                                Event as Ev,
+                                                ExpiredError as EE)
+        core = make_commit_core(64, 64, Ev, EE, AE, force=impl)
+        pods = {}
+        core.create_batch(pods, PODS,
+                          [mkpod(n) for n in present], False)
+        evs: dict = {}
+        missing = core.commit_wave_binds(
+            pods, PODS, bindings, evs, "events", EventRecord,
+            "sched-x", seq0)
+        recs = sorted(evs.values(), key=lambda r: r.resource_version)
+        return (list(missing),
+                [(r.name, r.namespace, r.involved_kind, r.involved_key,
+                  r.type, r.reason, r.message, r.count, r.component)
+                 for r in recs],
+                core.rv())
+
+    @pytest.mark.skipif(not have_native(), reason="commitcore did not build")
+    def test_native_twin_record_parity_with_vanished_pod(self):
+        bindings = [(f"default/p{i}", f"n{i % 3}") for i in range(6)]
+        present = [f"p{i}" for i in range(6) if i not in (2, 4)]
+        native_out = self._run_core("native", bindings, present)
+        twin_out = self._run_core("twin", bindings, present)
+        assert native_out == twin_out
+        missing, recs, _rv = native_out
+        assert sorted(missing) == ["default/p2", "default/p4"]
+        # binding i names its record seq0+i; vanished pods consume their
+        # seq but emit nothing
+        names = [r[0] for r in recs]
+        assert names == [f"p{i}.{100 + i:x}" for i in (0, 1, 3, 5)]
+        assert recs[0][6] == "Successfully assigned default/p0 to n0"
+        assert all(r[2] == "Pod" and r[4] == "Normal"
+                   and r[5] == "Scheduled" and r[7] == 1
+                   and r[8] == "sched-x" for r in recs)
+
+    def test_event_spec_matches_prebuilt_records(self):
+        """Store.commit_wave(event_spec=...) lands records identical (up
+        to the reserved name seq) to the classic prebuilt-recs path."""
+        from kubernetes_tpu.store.store import EVENTS
+
+        def run(use_spec):
+            s = Store(watch_log_size=1 << 12)
+            for i in range(3):
+                s.create(PODS, mkpod(f"p{i}"))
+            bindings = [(f"default/p{i}", "n0") for i in range(3)]
+            if use_spec:
+                missing = s.commit_wave(bindings,
+                                        event_spec={"component": "cw"})
+            else:
+                from kubernetes_tpu.api.types import EventRecord
+                from kubernetes_tpu.store.record import (
+                    build_scheduled_records, reserve_seq)
+                recs = build_scheduled_records(
+                    EventRecord, bindings, "cw", reserve_seq(3))
+                missing = s.commit_wave(bindings, recs)
+            s.fanout_wave()
+            assert missing == []
+            return sorted(
+                (e.name.rsplit(".", 1)[0], e.namespace, e.involved_key,
+                 e.type, e.reason, e.message, e.count, e.component)
+                for e in s.list(EVENTS)[0])
+
+        assert run(True) == run(False)
+
+    def test_event_spec_dedupe_token_replays(self):
+        """A retried wave under the same token must not double-emit its
+        in-core-built records."""
+        from kubernetes_tpu.store.store import EVENTS
+        s = Store(watch_log_size=1 << 12)
+        s.create(PODS, mkpod("p0"))
+        bindings = [("default/p0", "n0")]
+        m1 = s.commit_wave(bindings, event_spec={"component": "cw"},
+                           token="t1")
+        m2 = s.commit_wave(bindings, event_spec={"component": "cw"},
+                           token="t1")
+        assert m1 == m2 == []
+        assert len(s.list(EVENTS)[0]) == 1
 
 
 # ---------------------------------------------------------------------------

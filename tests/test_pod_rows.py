@@ -1,0 +1,421 @@
+"""Round-17 encode-at-admission pod-row cache: the bit-identity contract
+(cached row == fresh encode, field for field), invalidation on
+update/delete/recreate, interned signatures, capacity bounding, and the
+batched-ingest plumbing around it (informer add-runs -> queue.add_many ->
+heap push_many; gated Store.create_many; Histogram.observe_batch edges;
+the ledger's finalize-on-delete leak fix)."""
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity, Container, ContainerPort, LabelSelector, Node,
+    PodAffinityTerm, PodAntiAffinity, Pod, Toleration, NO_SCHEDULE,
+)
+from kubernetes_tpu.ops.pod_rows import (
+    PodRowCache, encode_row, pod_class_signature,
+)
+from kubernetes_tpu.store.store import (
+    NODES, PODS, BackpressureError, Store,
+)
+
+GI = 1024 ** 3
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+def mkpod(name, cpu=100, rv=0, **kw):
+    p = Pod(name=name,
+            containers=(Container.make(name="c", requests={"cpu": cpu}),),
+            **kw)
+    p.resource_version = rv
+    return p
+
+
+def fuzz_pod(rng, j):
+    """A pod drawn from the serve fuzz's class mix (plus scalars and
+    init containers, which exercise the req-vs-upd split)."""
+    cls = rng.choice(["plain", "plain", "selector", "tolerate", "anti",
+                      "port", "prio", "scalar", "init"])
+    kw = {"labels": {"app": cls, "j": str(j % 3)}}
+    reqs = {"cpu": rng.choice([100, 300, 700]), "memory": GI}
+    if cls == "selector":
+        kw["node_selector"] = {"disk": "ssd"}
+    elif cls == "tolerate":
+        kw["tolerations"] = (Toleration(key="ded", value="x",
+                                        effect=NO_SCHEDULE),)
+    elif cls == "anti":
+        kw["affinity"] = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=(PodAffinityTerm(
+                label_selector=LabelSelector(
+                    match_labels=(("app", "anti"),)),
+                topology_key=LABEL_HOSTNAME),)))
+    elif cls == "port":
+        kw["containers"] = (Container.make(
+            name="c", requests=dict(reqs),
+            ports=(ContainerPort(host_port=8000 + j % 7,
+                                 container_port=80),)),)
+    elif cls == "prio":
+        kw["priority"] = rng.randint(1, 5)
+    elif cls == "scalar":
+        reqs["example.com/gpu"] = rng.randint(1, 3)
+    elif cls == "init":
+        kw["init_containers"] = (Container.make(
+            name="i", requests={"cpu": 2000}),)
+    if "containers" not in kw:
+        kw["containers"] = (Container.make(name="c", requests=reqs),)
+    p = Pod(name=f"f{j}", **kw)
+    p.resource_version = rng.randint(1, 1000)
+    return p
+
+
+class TestRowBitIdentity:
+    def test_cached_row_equals_fresh_encode_fuzz(self):
+        """THE contract: for a fuzzed pod population, every cached row is
+        field-for-field identical to a fresh encode_row — including after
+        update-in-place re-encodes."""
+        rng = random.Random(7)
+        rc = PodRowCache()
+        pods = [fuzz_pod(rng, j) for j in range(120)]
+        rc.insert_many(pods)
+        # random updates: bump rv + mutate spec, re-deliver
+        for p in rng.sample(pods, 40):
+            p.resource_version += 1
+            p.priority += 10
+            p.labels["upd"] = "y"
+            rc.insert(p)
+        for p in pods:
+            cached = rc.lookup_row(p)
+            fresh = encode_row(p)
+            # interned signature must EQUAL the canonical tuple
+            assert cached.pop("signature") == fresh.pop("signature"), p
+            assert cached == fresh, (p.name, cached, fresh)
+
+    def test_signatures_interned_and_identical(self):
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        rng = random.Random(3)
+        rc = PodRowCache()
+        pods = [fuzz_pod(rng, j) for j in range(60)]
+        rc.insert_many(pods)
+        sigs = rc.signatures(pods)
+        ref = TPUScheduler.class_signatures(pods)
+        assert sigs == ref
+        # equal sigs are the SAME object (interning)
+        by_val = {}
+        for s in sigs:
+            assert by_val.setdefault(s, s) is s
+
+    def test_gather_matches_predicates(self):
+        from kubernetes_tpu.api.types import (get_container_ports,
+                                              has_pod_affinity_terms)
+        rng = random.Random(11)
+        rc = PodRowCache()
+        pods = [fuzz_pod(rng, j) for j in range(50)]
+        rc.insert_many(pods)
+        g = rc.gather(pods, ("has_aff_terms", "has_ports", "has_volumes"))
+        assert g is not None
+        for i, p in enumerate(pods):
+            assert bool(g["has_aff_terms"][i]) == has_pod_affinity_terms(p)
+            assert bool(g["has_ports"][i]) == bool(get_container_ports(p))
+            assert bool(g["has_volumes"][i]) == bool(p.volumes)
+
+    def test_gather_returns_none_on_any_miss(self):
+        rc = PodRowCache()
+        a, b = mkpod("a", rv=1), mkpod("b", rv=1)
+        rc.insert(a)
+        assert rc.gather([a, b]) is None          # b never delivered
+        rc.insert(b)
+        assert rc.gather([a, b]) is not None
+        b.resource_version = 2                     # stale
+        assert rc.gather([a, b]) is None
+
+
+class TestInvalidation:
+    def test_update_in_place_same_uid_new_rv(self):
+        rc = PodRowCache()
+        p = mkpod("p", cpu=100, rv=1)
+        rc.insert(p)
+        assert rc.lookup_row(p)["req_cpu"] == 100
+        # spec change lands as a new rv on the SAME uid
+        p2 = p.clone()
+        p2.resource_version = 2
+        p2.containers = (Container.make(name="c", requests={"cpu": 900}),)
+        assert p2.uid == p.uid
+        rc.insert(p2)
+        assert rc.lookup_row(p2)["req_cpu"] == 900
+        # the OLD rv is now stale: lookup falls back to a fresh encode of
+        # the old object (still correct — contract, not cache)
+        assert rc.lookup_row(p)["req_cpu"] == 100
+        assert len(rc) == 1
+
+    def test_delete_then_recreate_same_name(self):
+        rc = PodRowCache()
+        p = mkpod("same", cpu=100, rv=1)
+        rc.insert(p)
+        rc.invalidate(p)
+        assert len(rc) == 0
+        # recreate under the same NAME: a fresh Pod object gets a fresh
+        # uid, so the old row can never serve the new pod
+        p2 = mkpod("same", cpu=700, rv=9)
+        assert p2.uid != p.uid
+        rc.insert(p2)
+        assert rc.lookup_row(p2)["req_cpu"] == 700
+        assert rc.lookup_row(p)["req_cpu"] == 100   # fresh-encode fallback
+        assert len(rc) == 1
+
+    def test_capacity_bound_evicts_oldest(self):
+        rc = PodRowCache(capacity=8)
+        pods = [mkpod(f"p{i}", cpu=100 + i, rv=1) for i in range(12)]
+        for p in pods:
+            rc.insert(p)
+        assert len(rc) == 8
+        # evicted pods decay to the miss path, with correct values
+        for p in pods[:4]:
+            assert rc.lookup_row(p)["req_cpu"] == \
+                encode_row(p)["req_cpu"]
+
+    def test_slot_reuse_after_invalidate(self):
+        rc = PodRowCache()
+        pods = [mkpod(f"p{i}", rv=1) for i in range(20)]
+        rc.insert_many(pods)
+        for p in pods[::2]:
+            rc.invalidate(p)
+        fresh = [mkpod(f"q{i}", cpu=333, rv=1) for i in range(10)]
+        rc.insert_many(fresh)
+        for p in fresh:
+            assert rc.lookup_row(p)["req_cpu"] == 333
+        for p in pods[1::2]:
+            assert rc.lookup_row(p)["req_cpu"] == 100
+
+
+class TestSchedulerWiring:
+    """The shell fills/invalidates the cache at informer delivery and the
+    burst prologue gathers from it — end to end on a live scheduler."""
+
+    def _world(self, n_nodes=4):
+        from kubernetes_tpu.scheduler import Scheduler
+        store = Store(watch_log_size=1 << 16)
+        for i in range(n_nodes):
+            store.create(NODES, Node(
+                name=f"n{i}", labels={LABEL_HOSTNAME: f"n{i}"},
+                allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100)
+        sched.sync()
+        return store, sched
+
+    def test_rows_filled_at_delivery_and_invalidated_on_bind(self):
+        store, sched = self._world()
+        store.create_many(PODS, [mkpod(f"p{j}") for j in range(6)])
+        sched.pump()
+        assert len(sched.pod_rows) == 6
+        bound = sched.schedule_burst(max_pods=64)
+        assert bound == 6
+        sched.pump()   # deliver the bind MODIFIEDs -> rows invalidate
+        assert len(sched.pod_rows) == 0
+
+    def test_row_cache_rows_deleted_pod(self):
+        store, sched = self._world()
+        store.create(PODS, mkpod("gone"))
+        sched.pump()
+        assert len(sched.pod_rows) == 1
+        store.delete(PODS, "default/gone")
+        sched.pump()
+        assert len(sched.pod_rows) == 0
+
+    def test_update_reencodes_row(self):
+        store, sched = self._world()
+        store.create(PODS, mkpod("u", cpu=100))
+        sched.pump()
+        cur = store.get(PODS, "default/u")
+        cur.containers = (Container.make(name="c",
+                                         requests={"cpu": 800}),)
+        store.update(PODS, cur)
+        sched.pump()
+        got = sched.pod_rows.lookup_row(store.get(PODS, "default/u"))
+        assert got["req_cpu"] == 800
+
+
+class TestBatchedIngest:
+    def test_queue_add_many_matches_serial_adds(self):
+        from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
+        rng = random.Random(5)
+        pods = []
+        for j in range(40):
+            p = mkpod(f"p{j}", rv=1)
+            p.priority = rng.randint(0, 3)
+            pods.append(p)
+        q1, q2 = PriorityQueue(), PriorityQueue()
+        for p in pods:
+            q1.add(p)
+        q2.add_many(list(pods))
+        order1 = [q1.pop(timeout=0).key for _ in range(len(pods))]
+        order2 = [q2.pop(timeout=0).key for _ in range(len(pods))]
+        assert order1 == order2
+
+    def test_informer_add_run_delivered_as_batch(self):
+        store = Store(watch_log_size=1 << 16)
+        from kubernetes_tpu.store.informer import SharedInformer
+        inf = SharedInformer(store, PODS)
+        batches, singles, updates = [], [], []
+        inf.add_event_handler(
+            on_add=lambda o: singles.append(o.key),
+            on_add_many=lambda objs: batches.append([o.key for o in objs]),
+            on_update=lambda o, n: updates.append(n.key))
+        inf.sync()
+        for j in range(5):
+            store.create(PODS, mkpod(f"a{j}"))
+        inf.pump()
+        assert batches == [[f"default/a{j}" for j in range(5)]]
+        assert singles == []
+        # a MODIFIED breaks the run; the two adds around it batch/loop
+        store.create(PODS, mkpod("b0"))
+        store.update(PODS, store.get(PODS, "default/a0"))
+        store.create(PODS, mkpod("b1"))
+        inf.pump()
+        assert singles == ["default/b0", "default/b1"]
+        assert updates == ["default/a0"]
+
+    def test_heap_push_many_matches_serial(self):
+        from kubernetes_tpu.utils.heap import NumericKeyedHeap
+        rng = random.Random(9)
+        items = [(f"k{i}", (rng.random(), rng.random(), float(i)))
+                 for i in range(64)]
+        h1 = NumericKeyedHeap(key_fn=lambda e: e[0],
+                              triple_fn=lambda e: e[1])
+        h2 = NumericKeyedHeap(key_fn=lambda e: e[0],
+                              triple_fn=lambda e: e[1])
+        for it in items:
+            h1.add(it)
+        h2.add_many(items)
+        # replacement semantics ride the batch too
+        h1.add(("k3", (0.0, 0.0, 0.0)))
+        h2.add_many([("k3", (0.0, 0.0, 0.0))])
+        assert [e[0] for e in h1.pop_many(100)] \
+            == [e[0] for e in h2.pop_many(100)]
+
+    def test_gated_create_many_sheds_tail_with_accepted(self):
+        from kubernetes_tpu.serve.backpressure import BackpressureGate
+        store = Store(watch_log_size=1 << 16)
+        depth = {"v": 0}
+        store.admission_gate = BackpressureGate(
+            lambda: depth["v"], max_depth=5, retry_after_base=0.1)
+        pods = [mkpod(f"p{j}") for j in range(8)]
+        with pytest.raises(BackpressureError) as ei:
+            store.create_many(PODS, pods)
+        assert ei.value.accepted == 5
+        assert ei.value.retry_after > 0
+        stored = {p.key for p in store.list(PODS)[0]}
+        assert stored == {f"default/p{j}" for j in range(5)}
+        # nodes are never gated, and non-shed batches return the prefix
+        out = store.create_many(NODES, [Node(name="n0")])
+        assert len(out) == 1
+
+    def test_gated_create_many_stamps_admission_batch(self):
+        from kubernetes_tpu.obs import ledger as L
+        from kubernetes_tpu.serve.backpressure import BackpressureGate
+        L.LEDGER.reset()
+        try:
+            store = Store(watch_log_size=1 << 16)
+            store.admission_gate = BackpressureGate(lambda: 0,
+                                                    max_depth=100)
+            store.create_many(PODS, [mkpod(f"p{j}") for j in range(4)])
+            assert L.LEDGER.debug_state()["in_flight"] == 4
+        finally:
+            L.LEDGER.reset()
+
+
+class TestObserveBatchEdges:
+    """Satellite pin: observe_batch on empty and single-element arrays —
+    the batched ledger stamps hit the empty case every quiet flush."""
+
+    def _family(self, name):
+        from kubernetes_tpu.obs.registry import Histogram
+        return Histogram(name, "t", buckets=(0.001, 0.01, 0.1, 1.0))
+
+    def test_empty_batch_is_noop(self):
+        h = self._family("t_empty")
+        h.observe_batch([])
+        h.observe_batch(np.asarray([], dtype=np.float64))
+        c = h.labels()
+        assert c.count == 0 and c.sum == 0.0 and all(b == 0
+                                                     for b in c.buckets)
+
+    def test_single_element_equals_observe(self):
+        for v in (0.0005, 0.001, 0.0500001, 2.0, 100.0):
+            ha, hb = self._family("t_a"), self._family("t_b")
+            ha.observe(v)
+            hb.observe_batch([v])
+            a, b = ha.labels(), hb.labels()
+            assert (a.count, a.sum, a.buckets) == (b.count, b.sum,
+                                                   b.buckets), v
+
+    def test_batch_equals_observe_loop(self):
+        rng = random.Random(2)
+        vals = [rng.random() * 10 ** rng.randint(-4, 1)
+                for _ in range(500)]
+        ha, hb = self._family("t_c"), self._family("t_d")
+        for v in vals:
+            ha.observe(v)
+        hb.observe_batch(vals)
+        a, b = ha.labels(), hb.labels()
+        assert a.count == b.count and a.buckets == b.buckets
+        assert a.sum == pytest.approx(b.sum)
+
+
+class TestLedgerFinalizeOnDelete:
+    """Satellite pin: the completion-reaper leak — pods deleted while
+    holding in-flight ledger slots are finalized, so a minutes-scale soak
+    holds a BOUNDED in-flight/awaiting map."""
+
+    def test_delete_finalizes_pending_and_awaiting(self):
+        from kubernetes_tpu.obs import ledger as L
+        L.LEDGER.reset()
+        try:
+            store = Store(watch_log_size=1 << 16)
+            # pending record (admission-stamped, never bound)
+            store.admission_gate = type(
+                "G", (), {"admit": lambda self, p: None})()
+            store.create(PODS, mkpod("pend"))
+            assert L.LEDGER.debug_state()["in_flight"] == 1
+            store.delete(PODS, "default/pend")
+            assert L.LEDGER.debug_state()["in_flight"] == 0
+            # bound + awaiting copy-out (commit stamped, no watcher ever
+            # polls): the reaper-shaped delete must clear it
+            store.admission_gate = None
+            store.create(PODS, mkpod("bnd"))
+            store.create(NODES, Node(name="n0"))
+            L.LEDGER.stamp_enqueue("default/bnd")
+            store.bind_pod("default/bnd", "n0")
+            assert L.LEDGER.debug_state()["awaiting_fanout"] == 1
+            store.delete(PODS, "default/bnd")
+            assert L.LEDGER.debug_state()["awaiting_fanout"] == 0
+            assert L.LEDGER_FINALIZED.value >= 2
+        finally:
+            L.LEDGER.reset()
+
+    def test_reaper_shaped_soak_bounded(self):
+        """Soak shape: create -> bind -> reap (delete) in waves with NO
+        watcher draining bind events; steady-state in-flight + awaiting
+        stay bounded by the live set, not by total throughput."""
+        from kubernetes_tpu.obs import ledger as L
+        L.LEDGER.reset()
+        try:
+            store = Store(watch_log_size=1 << 16)
+            store.create(NODES, Node(name="n0"))
+            for wave in range(30):
+                keys = []
+                for j in range(16):
+                    p = mkpod(f"w{wave}-{j}")
+                    store.create(PODS, p)
+                    L.LEDGER.stamp_admission(p.key)
+                    L.LEDGER.stamp_enqueue(p.key)
+                    keys.append(p.key)
+                store.bind_pods([(k, "n0") for k in keys])
+                for k in keys:
+                    store.delete(PODS, k)   # the reaper
+                dbg = L.LEDGER.debug_state()
+                assert dbg["in_flight"] == 0, (wave, dbg)
+                assert dbg["awaiting_fanout"] == 0, (wave, dbg)
+        finally:
+            L.LEDGER.reset()

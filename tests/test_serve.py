@@ -195,12 +195,10 @@ class TestRemoteServing:
 
     def test_remote_arrivals_shed_and_converge(self):
         from kubernetes_tpu.apiserver.server import APIServer
-        from kubernetes_tpu.store.remote import (REQUEST_RETRIES,
-                                                 RemoteStore)
+        from kubernetes_tpu.store.remote import RemoteStore
         store, sched = build_world(n_nodes=4)
         loop = ServeLoop(sched, window_size=8, depth=2)
         loop.attach_gate(max_depth=6, retry_after_base=0.005)
-        before = REQUEST_RETRIES.labels("backpressure").value
         with APIServer(store) as srv:
             remote = RemoteStore(srv.url)
             gen = ArrivalGenerator(remote, rate=10 ** 6, total=40, seed=5)
@@ -213,12 +211,31 @@ class TestRemoteServing:
             loop.drain(timeout=10.0)
         g = gen.stats()
         assert loop.gate.rejected > 0          # sheds crossed the wire
-        # the remote client's own 429 retry loop fired (Retry-After
-        # honored inside RemoteStore.create, before the generator's)
-        assert REQUEST_RETRIES.labels("backpressure").value > before
+        # the batched wire contract: the shed tail was accounted and
+        # re-admitted off the server's Retry-After (round 17: arrivals
+        # ride ONE collection POST per flush; the partial 429 carries
+        # `accepted`, so nothing is lost OR double-created)
+        assert g["rejected_429"] > 0
         bound = sum(1 for p in store.list(PODS)[0] if p.node_name)
         assert bound == g["created"] == 40
         assert g["attempted"] == 40 and g["gave_up"] == 0
+
+    def test_remote_batch_create_partial_shed_accepted_count(self):
+        """The collection POST's 429 surfaces `accepted` exactly: the
+        prefix landed server-side, the tail did not."""
+        from kubernetes_tpu.apiserver.server import APIServer
+        from kubernetes_tpu.store.remote import RemoteStore
+        store, sched = build_world(n_nodes=4)
+        loop = ServeLoop(sched, window_size=8, depth=2)
+        loop.attach_gate(max_depth=3)
+        with APIServer(store) as srv:
+            remote = RemoteStore(srv.url)
+            with pytest.raises(BackpressureError) as ei:
+                remote.create_many(PODS, [mkpod(f"b{j}") for j in range(8)])
+        assert ei.value.accepted == 3
+        assert ei.value.retry_after > 0
+        stored = {p.name for p in store.list(PODS)[0]}
+        assert stored == {"b0", "b1", "b2"}
 
 
 class TestServeWindowParity:
@@ -285,7 +302,7 @@ class TestServeWindowParity:
     @pytest.mark.parametrize("seed", [7, 19, 43])
     def test_serve_stream_identical(self, seed, flight_replay,
                                     chaos=False, death=False, mesh=None,
-                                    shed_rate=0.0):
+                                    shed_rate=0.0, update_rate=0.0):
         rng = random.Random(seed)
         n_nodes = rng.randint(8, 24)
         zones = rng.choice([1, 2, 3])
@@ -333,6 +350,20 @@ class TestServeWindowParity:
                         store.create(PODS, pod.clone())
                     except BackpressureError:
                         carry.append(pod)   # readmit next round, in order
+                if update_rate:
+                    # mid-window pod updates (round-17 row-cache variant):
+                    # both worlds mutate the same pending pods — same rng
+                    # stream over the same unbound set (identical under
+                    # parity-so-far) — so update-in-place invalidation is
+                    # exercised without breaking the differential harness
+                    unbound = sorted(p.key for p in store.list(PODS)[0]
+                                     if not p.node_name)
+                    for key in unbound:
+                        if rng.random() < update_rate:
+                            cur = store.get(PODS, key)
+                            cur.priority += 1
+                            cur.labels["upd"] = str(r)
+                            store.update(PODS, cur)
                 if kill is not None and r == kill_round:
                     live = sorted(
                         n.name for n in store.list(NODES)[0])
@@ -341,6 +372,14 @@ class TestServeWindowParity:
                 loop.step()
                 if flush is not None:
                     flush()
+                if use_tpu and sched.pod_rows is not None:
+                    # row-by-row bit-identity: every pending pod's cached
+                    # row must equal a fresh encode (the contract that
+                    # keeps gathered windows oracle-parity)
+                    from kubernetes_tpu.ops.pod_rows import encode_row
+                    for p in sched.queue.pending_pods()["active"]:
+                        assert sched.pod_rows.lookup_row(p) \
+                            == encode_row(p), p.key
             # shed leftovers readmit, then the backlog drains
             for pod in carry:
                 try:
@@ -384,3 +423,13 @@ class TestServeWindowParity:
         same serve.shed schedule, shed arrivals readmit at the next
         window boundary, and the streams stay bit-identical."""
         self.test_serve_stream_identical(7, flight_replay, shed_rate=0.3)
+
+    def test_serve_stream_identical_with_mid_window_updates(
+            self, flight_replay):
+        """Round-17 row-cache variant: pending pods mutate (priority +
+        labels, new resourceVersions) BETWEEN windows in both worlds —
+        update-in-place invalidation must re-encode rows at delivery, the
+        cached-row/fresh-encode bit-identity holds row by row, and the
+        binding streams stay identical."""
+        self.test_serve_stream_identical(19, flight_replay,
+                                         update_rate=0.4)
