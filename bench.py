@@ -601,6 +601,61 @@ def run_serve_bench(n_nodes: int, arrival_rate: float, duration: float,
     }
 
 
+def run_fleet_bench(n_nodes: int, instances: int, arrival_rate: float,
+                    duration: float, window: int = 2048,
+                    depth: int = 3) -> dict:
+    """`--mode fleet` (round 18): the active-active fleet lane — measure
+    the SOLO serve baseline first (one scheduler, same store shape, same
+    arrival rate, same duration), then `instances` partitioned fleet
+    members on their own threads against one shared store at the same
+    rate, and report aggregate pods/s with the ratio. The acceptance
+    gate is `vs_solo_serve >= 1.0` WITH the in-bench zero-double-bind
+    audit: an aggregate number bought by a double-bind is not a number.
+    On a tunneled real chip the fleet hides N dispatch RTTs behind each
+    other, which is the 'no single host process could reach' headline;
+    on the CPU box the claim is parity-at-rate plus the robustness
+    audits. One JSON line."""
+    from kubernetes_tpu.perf.harness import run_fleet_cell, run_serve_cell
+    solo = run_serve_cell(n_nodes, arrival_rate, duration,
+                          window=window, depth=depth)
+    fleet = run_fleet_cell(n_nodes, instances=instances,
+                           arrival_rate=arrival_rate, duration=duration,
+                           window=window, depth=depth)
+    solo_rate = solo["sustained_pods_per_s"]
+    agg = fleet["aggregate_pods_per_s"]
+    return {
+        "metric": (f"fleet_aggregate_{instances}x_{n_nodes}n_"
+                   f"{int(arrival_rate)}rps_{int(duration)}s"),
+        "value": agg,
+        "unit": "pods/s",
+        "baseline_note": "aggregate fleet pods/s vs the solo serve "
+                         "baseline measured in the SAME run (same store "
+                         "shape, arrival rate, and duration)",
+        "instances": fleet["instances"],
+        "shards": fleet["shards"],
+        "arrival_rate": arrival_rate,
+        "duration_s": fleet["duration"],
+        "solo_serve_pods_per_s": solo_rate,
+        "vs_solo_serve": round(agg / solo_rate, 3) if solo_rate else None,
+        "per_instance_pods_bound": fleet["per_instance_pods_bound"],
+        "startup_p99": fleet["startup_p99"],
+        "startup_slo_5s": fleet["startup_slo_ok"],
+        # the robustness audits that gate the number
+        "double_binds": fleet["double_binds"],
+        "audit_no_double_bind": fleet["audit_no_double_bind"],
+        "audit_all_admitted_or_429": fleet["audit_all_admitted_or_429"],
+        "partition_disjoint": fleet["partition_disjoint"],
+        "fenced_waves": fleet["fenced_waves"],
+        "bind_conflicts_requeued": fleet["bind_conflicts_requeued"],
+        "bind_conflicts_fenced": fleet["bind_conflicts_fenced"],
+        "admission_admitted": fleet["admission"]["admitted"],
+        "admission_rejected": fleet["admission"]["rejected"],
+        "arrivals": fleet["arrivals"],
+        "solo_startup_p99": solo["startup_p99"],
+        "solo_parity_violations": solo["parity_violations"],
+    }
+
+
 def run_commit_bench(n_pods: int = 4096, waves: int = 8,
                      watchers: int = 8) -> dict:
     """`--mode commit`: the round-11 commit-core lane — the store-write +
@@ -758,8 +813,15 @@ def main():
     ap.add_argument("--pods", type=int, default=None)
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
-                             "gang", "commit", "chaos", "churn", "serve"],
+                             "gang", "commit", "chaos", "churn", "serve",
+                             "fleet"],
                     default="burst")
+    # `--mode fleet` (round 18): N partitioned scheduler instances on
+    # their own threads against one shared store, vs the solo serve
+    # baseline measured in the same run (lease claims, fenced writes,
+    # zero-double-bind audit)
+    ap.add_argument("--instances", type=int, default=2,
+                    help="fleet mode: scheduler instances (2-8)")
     # `--mode serve` (round 16): arrival-driven serving — pods arrive at
     # --arrival-rate for --duration seconds (minutes-scale soaks: raise
     # --duration) while the ServeLoop cuts --serve-window-sized launch
@@ -893,7 +955,7 @@ def main():
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     n_nodes = args.nodes if args.nodes is not None \
-        else (1000 if args.mode in ("preempt", "chaos", "serve")
+        else (1000 if args.mode in ("preempt", "chaos", "serve", "fleet")
               else (300 if args.mode == "churn" else 15000))
     n_pods = args.pods if args.pods is not None \
         else (5000 if args.mode == "chaos"
@@ -904,6 +966,12 @@ def main():
             n_nodes, args.arrival_rate, args.duration,
             window=args.serve_window, depth=args.serve_depth,
             max_depth=args.max_queue_depth, mesh=mesh))
+        finish(result)
+        return
+    if args.mode == "fleet":
+        result = retry_transient(lambda: run_fleet_bench(
+            n_nodes, args.instances, args.arrival_rate, args.duration,
+            window=args.serve_window, depth=args.serve_depth))
         finish(result)
         return
     if args.mode == "preempt":

@@ -36,7 +36,8 @@ from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
 from kubernetes_tpu.store.store import (
     Store, PODS, PODGROUPS, AlreadyExistsError, BackpressureError,
-    ConflictError, DisruptionBudgetError, NotFoundError, ExpiredError,
+    ConflictError, DisruptionBudgetError, FencedError, NotFoundError,
+    ExpiredError,
 )
 
 API_PREFIX = "/api/v1"
@@ -335,21 +336,58 @@ def make_handler(store: Store, admission: AdmissionChain,
                 key = f"{parts[3]}/{parts[4]}"
                 if not self._authorized(user, "create", PODS, key):
                     return
-                node = self._body().get("node", "")
+                body = self._body()
+                node = body.get("node", "")
+                # optional fleet fencing token(s): [[scope, token], ...]
+                fence = [(str(s), int(t)) for s, t in body.get("fence") or []]
                 try:
                     current = store.get(PODS, key)
                     # the binding subresource runs admission too
                     # (NodeRestriction: node identities never bind)
                     admission.admit_binding(current, node, store,
                                             user=self._user_name(user))
-                    store.bind_pod(key, node)
+                    if fence:
+                        store.bind_pod(key, node, fence=fence)
+                    else:
+                        store.bind_pod(key, node)
                 except AdmissionError as e:
                     self._error(422, "Invalid", str(e))
+                    return
+                except FencedError as e:
+                    # superseded partition-lease token: the whole write
+                    # was rejected atomically (reason distinguishes it
+                    # from the rv-CAS loss on the wire)
+                    self._error(409, "Fenced", str(e))
+                    return
+                except ConflictError as e:
+                    # rv-CAS bind loss: the pod is already bound — the
+                    # racing loser re-queues, never overwrites
+                    self._error(409, "Conflict", str(e))
                     return
                 except NotFoundError:
                     self._error(404, "NotFound", key)
                     return
                 self._send(201, {"kind": "Status", "status": "Success"})
+                return
+            # fence-advance verb: POST /api/v1/fences/{scope} {"token": N}
+            # — the claim protocol's handoff write (a new partition-lease
+            # holder fences out the superseded one BEFORE replaying)
+            if len(parts) == 4 and parts[2] == "fences":
+                scope = parts[3]
+                if not self._authorized(user, "update", "fences", scope):
+                    return
+                try:
+                    token = int(self._body().get("token"))
+                except (TypeError, ValueError) as e:
+                    self._error(400, "BadRequest", f"token: {e}")
+                    return
+                if not store.advance_fence(scope, token):
+                    self._error(409, "Fenced",
+                                f"fence {scope!r}: token {token} is "
+                                f"already superseded")
+                    return
+                self._send(200, {"kind": "Status", "status": "Success",
+                                 "scope": scope, "token": token})
                 return
             # eviction subresource: POST /api/v1/pods/{ns}/{name}/eviction
             # — PDB-guarded delete (reference: registry/core/pod/rest/

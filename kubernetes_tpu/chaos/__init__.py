@@ -53,6 +53,13 @@ at the exact point the real failure would surface):
   changes which pods enter the cluster, so a blanket ``all=`` rate must
   not seed it (the serve referee drives the SAME shed schedule through
   both worlds).
+- ``fleet.lease-loss`` — a fleet scheduler instance PAUSES its partition
+  claim maintenance for a few steps (the GC-pause / network-partition
+  stand-in) while still scheduling: its shard leases expire, a peer
+  claims them and advances the fence, and the zombie's next wave must be
+  rejected WHOLE by the store's fencing-token check (zero double-binds).
+  Opt-in: it needs the fleet claim plumbing, and it legitimately moves
+  partition ownership, so a blanket ``all=`` rate must not seed it.
 
 Configuration:
 - programmatic: ``chaos.plan(seed=42, rates={"device.fetch": 0.1})`` or
@@ -92,12 +99,14 @@ SEAMS = (
     "sched.crash",
     "node.dead",
     "serve.shed",
+    "fleet.lease-loss",
 )
 
 #: seams a blanket `all=<rate>` never seeds: they need explicit opt-in
 #: plumbing (a wrapped clock, a crash-driving harness, a node-kill hook,
 #: an attached serving backpressure gate)
-OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead", "serve.shed")
+OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead", "serve.shed",
+                "fleet.lease-loss")
 
 INJECTIONS = obs.counter(
     "chaos_injections_total",
@@ -169,6 +178,7 @@ _FAULT_FOR = {
     "sched.crash": SchedulerCrash,
     "node.dead": InjectedFault,
     "serve.shed": InjectedFault,
+    "fleet.lease-loss": InjectedFault,
 }
 
 

@@ -117,6 +117,10 @@ struct CommitCore {
     std::unordered_map<std::string, KindLog>* logs;
     std::unordered_map<long long, Watcher>* watchers;
     std::unordered_map<std::string, std::vector<long long>>* by_kind;
+    // fencing-token table (round 18): scope -> highest lease token
+    // validated. Guarded by the CALLER's store lock like the rv counter
+    // (GIL held, no mutex) — never touched from consumer threads.
+    std::unordered_map<std::string, long long>* fences;
     std::mutex* mu;
     std::condition_variable* cv;
     PyObject* fanout_sink;   // owned, may be null (observability hook)
@@ -293,6 +297,51 @@ int create_batch_body(CommitCore* self, PyObject* bucket, const char* kind,
     }
     Py_DECREF(seq);
     return 0;
+}
+
+// -- fencing tokens (round 18; caller holds the store lock) ------------------
+// Twin: PyCommitCore.fence_ok / advance_fence / fence_token / fence_table —
+// identical semantics (a token below the recorded maximum is superseded).
+PyObject* core_fence_ok(CommitCore* self, PyObject* args) {
+    const char* scope;
+    long long token;
+    if (!PyArg_ParseTuple(args, "sL", &scope, &token)) return nullptr;
+    auto it = self->fences->find(scope);
+    bool ok = it == self->fences->end() || token >= it->second;
+    if (ok) Py_RETURN_TRUE;
+    Py_RETURN_FALSE;
+}
+
+PyObject* core_advance_fence(CommitCore* self, PyObject* args) {
+    const char* scope;
+    long long token;
+    if (!PyArg_ParseTuple(args, "sL", &scope, &token)) return nullptr;
+    auto it = self->fences->find(scope);
+    if (it != self->fences->end() && token < it->second) Py_RETURN_FALSE;
+    (*self->fences)[scope] = token;
+    Py_RETURN_TRUE;
+}
+
+PyObject* core_fence_token(CommitCore* self, PyObject* arg) {
+    const char* scope = PyUnicode_AsUTF8(arg);
+    if (!scope) return nullptr;
+    auto it = self->fences->find(scope);
+    return PyLong_FromLongLong(it == self->fences->end() ? 0 : it->second);
+}
+
+PyObject* core_fence_table(CommitCore* self, PyObject*) {
+    PyObject* out = PyDict_New();
+    if (!out) return nullptr;
+    for (auto& kv : *self->fences) {
+        PyObject* v = PyLong_FromLongLong(kv.second);
+        if (!v || PyDict_SetItemString(out, kv.first.c_str(), v) < 0) {
+            Py_XDECREF(v);
+            Py_DECREF(out);
+            return nullptr;
+        }
+        Py_DECREF(v);
+    }
+    return out;
 }
 
 // -- methods ----------------------------------------------------------------
@@ -880,6 +929,7 @@ PyObject* core_new(PyTypeObject* type, PyObject* args, PyObject*) {
     self->watchers = new std::unordered_map<long long, Watcher>();
     self->by_kind =
         new std::unordered_map<std::string, std::vector<long long>>();
+    self->fences = new std::unordered_map<std::string, long long>();
     self->mu = new std::mutex();
     self->cv = new std::condition_variable();
     self->fanout_sink = nullptr;
@@ -907,6 +957,7 @@ void core_dealloc(CommitCore* self) {
         }
         delete self->logs;
         delete self->by_kind;
+        delete self->fences;
         if (!waiters) {
             // a watcher that was never detached may still be blocked in
             // poll (a daemon thread at teardown): destroying a mutex/cv
@@ -957,6 +1008,15 @@ PyMethodDef core_methods[] = {
      "copy-out with (kind, events, lags)"},
     {"log_window", (PyCFunction)core_log_window, METH_O,
      "(first rv retained, last rv) of a kind's log ring"},
+    {"fence_ok", (PyCFunction)core_fence_ok, METH_VARARGS,
+     "fence_ok(scope, token) -> bool: token not superseded for scope"},
+    {"advance_fence", (PyCFunction)core_advance_fence, METH_VARARGS,
+     "advance_fence(scope, token) -> bool: record the new maximum "
+     "(False when token is already superseded)"},
+    {"fence_token", (PyCFunction)core_fence_token, METH_O,
+     "current fencing token recorded for a scope (0 when none)"},
+    {"fence_table", (PyCFunction)core_fence_table, METH_NOARGS,
+     "scope -> token snapshot (demotion carryover / debug)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

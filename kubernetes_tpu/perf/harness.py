@@ -630,6 +630,236 @@ def run_serve_cell(n_nodes: int = 1000, arrival_rate: float = 2000.0,
     }
 
 
+def run_fleet_cell(n_nodes: int = 1000, instances: int = 2,
+                   arrival_rate: float = 4000.0, duration: float = 20.0,
+                   window: int = 2048, depth: int = 3,
+                   n_shards: Optional[int] = None,
+                   use_tpu: bool = True, seed: int = 0,
+                   max_resident: Optional[int] = None) -> dict:
+    """Active-active fleet cell (`bench.py --mode fleet`, round 18):
+    `instances` FleetInstances — each a full scheduler with its own
+    informers, activeQ, and launch queue — run on their OWN THREADS
+    against ONE shared store, partitioned by namespace-hash Lease claims
+    with fenced writes, while an ArrivalGenerator feeds namespace-spread
+    pods at `arrival_rate`/s for `duration` seconds through one
+    fleet-wide backpressure gate. Scores AGGREGATE sustained pods/s.
+
+    Three in-cell audits gate the number:
+    - zero-double-bind: a BindAuditor folds the shared pod watch for the
+      whole run; any nodeName transition non-empty -> different
+      non-empty fails the cell (the fleet_double_binds_total tripwire);
+    - all-admitted-or-429'd: every generated arrival either landed AND
+      bound, or was shed and accounted — same contract as the serve cell;
+    - partition sanity: live claim sets stay disjoint at every probe.
+
+    A completion reaper (serve-cell pattern) keeps the resident set in
+    steady state so minutes-scale fleet soaks don't fill the cluster."""
+    import threading as _th
+    import time as _t
+    from collections import deque
+    from kubernetes_tpu.api.types import Node, Pod, Container
+    from kubernetes_tpu.fleet import FleetInstance, BindAuditor, shard_of
+    from kubernetes_tpu.obs.ledger import LEDGER
+    from kubernetes_tpu.serve import ArrivalGenerator
+    from kubernetes_tpu.serve.backpressure import fleet_gate
+    from kubernetes_tpu.store.store import MODIFIED, NODES, ExpiredError
+    GI = 1024 ** 3
+    MI = 1024 ** 2
+    n_shards = int(n_shards) if n_shards else max(8, 4 * instances)
+    store = Store(watch_log_size=1 << 17)
+    for i in range(n_nodes):
+        store.create(NODES, Node(
+            name=f"node-{i}",
+            labels={"failure-domain.beta.kubernetes.io/zone":
+                    f"zone-{i % 3}",
+                    "kubernetes.io/hostname": f"node-{i}"},
+            allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110}))
+    idents = [f"sched-{i}" for i in range(int(instances))]
+    fleet = [FleetInstance(store, ident, idents, use_tpu=use_tpu,
+                           window=window, depth=depth, n_shards=n_shards,
+                           lease_duration=5.0, renew_deadline=3.0,
+                           percentage_of_nodes_to_score=100)
+             for ident in idents]
+    for inst in fleet:
+        inst.sync()
+    # claims settle + jit warmup BEFORE the gate attaches and the clock
+    # starts: feed a handful of ungated pods and drain them
+    n_prefix = "fl-"
+    import zlib as _zlib
+
+    def mkpod(name: str) -> Pod:
+        # namespace spread drives the shard partition (crc32 of the
+        # namespace): 4*shards namespaces cover every shard
+        ns = f"ns-{_zlib.crc32(name.encode()) % (4 * n_shards)}"
+        return Pod(name=name, namespace=ns, labels={"app": "fleet"},
+                   containers=(Container.make(
+                       name="c", requests={"cpu": 100,
+                                           "memory": 500 * MI}),))
+
+    warm = ArrivalGenerator(store, rate=10 ** 9, total=32 * instances,
+                            pod_fn=mkpod, name_prefix="flwarm-", seed=seed)
+    for _ in range(3):
+        warm.tick()
+        for inst in fleet:
+            inst.step()
+    def fleet_idle() -> bool:
+        """Nothing pending anywhere: queues empty AND every instance's
+        pod-informer backlog drained — the queue alone lags creates by
+        one pump, so checking it in isolation races the last arrivals
+        into a stopped thread's undelivered backlog."""
+        for inst in fleet:
+            if inst.sched.queue.num_pending() > 0:
+                return False
+            if inst.sched.informers.informer(PODS).backlog() > 0:
+                return False
+        return True
+
+    deadline_warm = _t.perf_counter() + 60.0
+    while _t.perf_counter() < deadline_warm:
+        if sum(inst.step() for inst in fleet) == 0 and fleet_idle():
+            break
+    auditor = BindAuditor(store)
+    gate = fleet_gate([inst.loop for inst in fleet],
+                      max_depth=max(4 * window, int(2 * arrival_rate)))
+    store.admission_gate = gate
+    LEDGER.reset()
+    gen = ArrivalGenerator(store, rate=arrival_rate, pod_fn=mkpod,
+                           name_prefix=n_prefix, seed=seed)
+    # completion reaper (serve-cell pattern): oldest bound arrivals are
+    # deleted past the resident target so the cell reaches steady state
+    cap = n_nodes * min(110, 4000 // 100)
+    resident_target = (int(max_resident) if max_resident is not None
+                       else max(4 * window, cap // 2))
+    reap_watch = store.watch(PODS)
+    bound_fifo: deque = deque()
+    seen_bound: set = set()
+    reaped = 0
+
+    def reap() -> None:
+        nonlocal reaped
+        try:
+            events = reap_watch.drain()
+        except ExpiredError:
+            events = []
+            bound_fifo.clear()
+            seen_bound.clear()
+            for p in store.list(PODS)[0]:
+                if p.node_name and p.name.startswith(n_prefix):
+                    bound_fifo.append(p.key)
+                    seen_bound.add(p.key)
+        for ev in events:
+            if ev.type == MODIFIED and ev.obj.node_name \
+                    and ev.obj.name.startswith(n_prefix) \
+                    and ev.obj.key not in seen_bound:
+                bound_fifo.append(ev.obj.key)
+                seen_bound.add(ev.obj.key)
+        if len(bound_fifo) > resident_target:
+            batch = []
+            while len(bound_fifo) > resident_target:
+                batch.append(bound_fifo.popleft())
+            reaped += len(store.delete_many(PODS, batch))
+
+    stop = _th.Event()
+
+    def drive(inst: FleetInstance) -> None:
+        while not stop.is_set():
+            if inst.step() == 0:
+                _t.sleep(0.001)
+
+    threads = [_th.Thread(target=drive, args=(inst,), daemon=True,
+                          name=f"fleet-{inst.identity}")
+               for inst in fleet]
+    bound0 = sum(inst.loop.pods_bound for inst in fleet)
+    partition_overlap = False
+    t0 = _t.perf_counter()
+    for th in threads:
+        th.start()
+    t_end = t0 + duration
+    while _t.perf_counter() < t_end:
+        reap()
+        gen.tick()
+        auditor.scan()
+        # partition sanity probe: live claim sets stay disjoint
+        seen: set = set()
+        for inst in fleet:
+            owned = inst.claims.owned()
+            if owned & seen:
+                partition_overlap = True
+            seen |= owned
+        _t.sleep(0.002)
+    elapsed = _t.perf_counter() - t0
+    aggregate = (sum(inst.loop.pods_bound for inst in fleet) - bound0) \
+        / elapsed if elapsed else 0.0
+    # settle: arrivals stop; shed retries, informer backlogs, and the
+    # queues drain. The idle condition must hold over CONSECUTIVE polls:
+    # the drive threads are still stepping, and a single snapshot can
+    # catch a window mid-flight (popped pods make a queue read empty)
+    settle_deadline = _t.perf_counter() + 90.0
+    idle_polls = 0
+    while _t.perf_counter() < settle_deadline:
+        gen.flush_retries(timeout=0.2)
+        reap()
+        auditor.scan()
+        if gen.stats()["pending_retry"] == 0 and fleet_idle():
+            idle_polls += 1
+            if idle_polls >= 3:
+                break
+        else:
+            idle_polls = 0
+        _t.sleep(0.05)
+    stop.set()
+    for th in threads:
+        th.join(timeout=5.0)
+    # post-stop cooperative drain: a step that completed right at the
+    # stop boundary may have re-queued a pod (failed decision) or left
+    # undelivered informer events — finish them sequentially, bounded
+    drain_deadline = _t.perf_counter() + 30.0
+    while not fleet_idle() and _t.perf_counter() < drain_deadline:
+        reap()
+        for inst in fleet:
+            inst.step()
+    auditor.scan()
+    reap_watch.stop()
+    auditor.stop()
+    g = gen.stats()
+    measured = [p for p in store.list(PODS)[0]
+                if p.name.startswith(n_prefix)]
+    unbound = sum(1 for p in measured if not p.node_name)
+    assert len(measured) + reaped == g["created"], \
+        (f"fleet accounting leak: {len(measured)} in store + {reaped} "
+         f"reaped != {g['created']} created")
+    assert unbound == 0, f"{unbound} admitted arrivals never bound"
+    assert not auditor.violations, \
+        f"DOUBLE BINDS observed: {auditor.violations[:5]}"
+    assert not partition_overlap, "live shard claims overlapped"
+    led = LEDGER.snapshot()
+    from kubernetes_tpu.fleet import BIND_CONFLICTS
+    return {
+        "nodes": n_nodes,
+        "instances": int(instances),
+        "shards": n_shards,
+        "arrival_rate": arrival_rate,
+        "duration": round(elapsed, 2),
+        "aggregate_pods_per_s": round(aggregate, 1),
+        "per_instance_pods_bound": {
+            inst.identity: inst.loop.pods_bound for inst in fleet},
+        "fenced_waves": sum(inst.sched.fenced_waves for inst in fleet),
+        "bind_conflicts_requeued":
+            BIND_CONFLICTS.labels("requeued").value,
+        "bind_conflicts_fenced": BIND_CONFLICTS.labels("fenced").value,
+        "double_binds": len(auditor.violations),
+        "partition_disjoint": not partition_overlap,
+        "startup_p50": led["startup_p50"],
+        "startup_p99": led["startup_p99"],
+        "startup_slo_ok": led["startup_slo_ok"],
+        "workload_reaped": reaped,
+        "arrivals": g,
+        "admission": gate.debug_state(),
+        "audit_all_admitted_or_429": True,   # the asserts above gate it
+        "audit_no_double_bind": True,
+    }
+
+
 # the benchmark matrices (scheduler_bench_test.go:40-118)
 BENCHMARK_MATRIX = {
     "plain": [(100, 0), (100, 1000), (1000, 0), (1000, 1000), (5000, 1000)],
@@ -659,6 +889,12 @@ BENCHMARK_MATRIX = {
     # the 5000rps cell probes the shed regime.
     "serve": [(1000, 2000, 30), (1000, 4000, 30), (1000, 5000, 30),
               (5000, 2000, 30)],
+    # active-active fleet cells: (nodes, instances, arrivals/s, seconds)
+    # — run via run_fleet_cell. The 2-instance cell is the round-18
+    # acceptance gate (aggregate >= the solo serve baseline with the
+    # zero-double-bind audit); the 4-instance cell probes claim churn
+    # at higher membership.
+    "fleet": [(1000, 2, 4000, 20), (1000, 4, 4000, 20)],
 }
 
 
@@ -738,23 +974,29 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
     caller can referee native vs twin bit-for-bit."""
     from kubernetes_tpu.api.types import Container, Pod
     from kubernetes_tpu.store.record import EventRecorder
-    store = Store(watch_log_size=max(1 << 17, 4 * n_pods * waves),
+    store = Store(watch_log_size=max(1 << 17, 8 * n_pods * waves),
                   commit_core=impl)
     recorder = EventRecorder(store)
     MI = 1024 ** 2
-    for j in range(n_pods):
-        store.create(PODS, Pod(
-            name=f"p{j}", labels={"app": "commit"},
-            containers=(Container.make(
-                name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
+    # one fresh pod set PER WAVE: the round-18 rv-CAS bind refuses
+    # re-binding an already-bound pod (the fleet's double-bind guard), so
+    # the steady-state commit path is exercised with distinct unbound
+    # pods each wave — the per-binding work (clone, setattr, rv, log
+    # append) is identical to the old rebind loop
+    for wv in range(waves):
+        for j in range(n_pods):
+            store.create(PODS, Pod(
+                name=f"p{wv}-{j}", labels={"app": "commit"},
+                containers=(Container.make(
+                    name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
     pods_by_key = {p.key: p for p in store.list(PODS)[0]}
-    keys = [f"default/p{j}" for j in range(n_pods)]
+    wave_keys = [[f"default/p{wv}-{j}" for j in range(n_pods)]
+                 for wv in range(waves)]
     watches = [store.watch(PODS) for _ in range(n_watchers)]
     writes = 0
     t0 = time.perf_counter()
     for wv in range(waves):
-        # the binding subresource is unconditional, so re-binding the same
-        # pods wave after wave exercises the steady-state commit path
+        keys = wave_keys[wv]
         bindings = [(k, f"n{wv}") for k in keys]
         recs = recorder.make_pod_records([
             (pods_by_key[k], "Normal", "Scheduled",
@@ -785,11 +1027,21 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
     # quota/throttle this box is under right now (absolute writes/s here
     # swing 3-4x run to run with cgroup credits)
     ref_n = min(n_pods, 1024)
+    # fresh unbound pods for the serial reference (the rv-CAS bind would
+    # refuse re-binding the wave pods); created OUTSIDE the timed loop
+    for j in range(ref_n):
+        store.create(PODS, Pod(
+            name=f"ref-{j}", labels={"app": "commit"},
+            containers=(Container.make(
+                name="c", requests={"cpu": 100, "memory": 500 * MI}),)))
+    ref_pods = {p.key: p for p in store.list(PODS)[0]
+                if p.name.startswith("ref-")}
     t2 = time.perf_counter()
-    for k in keys[:ref_n]:
+    for j in range(ref_n):
+        k = f"default/ref-{j}"
         store.bind_pod(k, "ref")
         rec = recorder.make_pod_records([
-            (pods_by_key[k], "Normal", "Scheduled",
+            (ref_pods[k], "Normal", "Scheduled",
              f"Successfully assigned {k} to ref")])[0]
         store.create(EVENTS, rec, move=True)
     t_ref = time.perf_counter() - t2

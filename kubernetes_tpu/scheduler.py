@@ -36,7 +36,7 @@ from kubernetes_tpu.oracle.generic_scheduler import (
 from kubernetes_tpu.queue.scheduling_queue import PriorityQueue
 from kubernetes_tpu.store.store import (
     Store, PODS, NODES, PODGROUPS, SERVICES, REPLICASETS, PDBS, PVS, PVCS,
-    NotFoundError,
+    ConflictError, FencedError, NotFoundError,
 )
 from kubernetes_tpu.oracle.volumes import VolumeListers, VolumeBinder
 from kubernetes_tpu.store.informer import InformerFactory
@@ -47,6 +47,13 @@ from kubernetes_tpu.utils.clock import Clock, RealClock
 from kubernetes_tpu.utils.tracing import Trace, SLOW_CYCLE_THRESHOLD
 
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+#: per-process scheduler instance sequence: wave dedupe tokens must be
+#: unique PER INSTANCE, not per scheduler name — an active-active fleet
+#: runs several instances under one profile name against one store, and
+#: name-keyed tokens would alias their waves in the dedupe map (instance
+#: B's wave 1 answered with instance A's recorded result)
+_INSTANCE_SEQ = itertools.count(1)
 
 # gang (PodGroup) scheduling observability — the obs catalogue additions:
 # attempts by outcome, and how long a gang waited from group creation (or
@@ -210,8 +217,18 @@ class Scheduler:
         self._stop = threading.Event()
         self._bind_threads: list[threading.Thread] = []
         # idempotent commit retry: one fresh token per wave (REUSED across
-        # that wave's retries) keys the store's dedupe map
+        # that wave's retries) keys the store's dedupe map; the prefix is
+        # instance-unique (see _INSTANCE_SEQ) so fleet peers sharing a
+        # profile name can never dedupe-alias each other's waves
         self._wave_seq = itertools.count(1)
+        self._token_prefix = f"{scheduler_name}#{next(_INSTANCE_SEQ)}"
+        # fleet mode (round 18): when set, every wave/bind write carries
+        # the instance's live partition-lease fencing tokens — a write
+        # from a superseded claim is rejected whole by the store
+        # (FencedError) and its pods are dropped to the claim's new
+        # holder instead of re-queued
+        self.fence_provider: Optional[Callable[[], Optional[list]]] = None
+        self.fenced_waves = 0
         # crash-restart recovery context: while a burst's windows commit,
         # this tracks the exact walk-counter/rotation boundary of the
         # committed prefix plus the window in flight — recover() reads it
@@ -729,7 +746,7 @@ class Scheduler:
                 # extender owns the write only for pods it manages)
                 self._extender_binder.bind(assumed, host)
             else:
-                self.store.bind_pod(assumed.key, host)
+                self._store_bind_pod(assumed.key, host)
             chaos.check("sched.crash")
             self.cache.finish_binding(assumed)
             self.metrics.binding_count += 1
@@ -743,9 +760,50 @@ class Scheduler:
             return True
         except chaos.SchedulerCrash:
             raise   # process-death stand-in: recovery, not re-queue
+        except FencedError:
+            # superseded partition claim: the write was rejected whole.
+            # Forget silently and DROP the pod — it belongs to the
+            # claim's new holder now; a zombie writing failure events
+            # for it would be exactly the write fencing forbids.
+            from kubernetes_tpu.fleet import BIND_CONFLICTS
+            BIND_CONFLICTS.labels("fenced").inc()
+            self.fenced_waves += 1
+            self.cache.forget_pod(assumed)
+            if self.pod_rows is not None:
+                self.pod_rows.invalidate(assumed)
+            return False
+        except ConflictError as err:
+            # rv-CAS bind loss (already bound by another scheduler): the
+            # winner's binding stands; the loser re-queues with backoff —
+            # _record_failure drops the requeue once the store shows the
+            # pod bound, which is the usual case
+            from kubernetes_tpu.fleet import BIND_CONFLICTS
+            BIND_CONFLICTS.labels("requeued").inc()
+            fail(False, f"rv-CAS bind conflict: {err}")
+            return False
         except Exception as err:
             fail(False, str(err))
             return False
+
+    def _store_bind_pod(self, pod_key: str, host: str):
+        """The serial bind write, carrying the instance's partition-lease
+        fencing tokens when fleet mode is on and the store's verb takes
+        them (probed per call only on the fleet path — the solo hot path
+        is the plain verb unchanged)."""
+        if self.fence_provider is None:
+            return self.store.bind_pod(pod_key, host)
+        fence = self.fence_provider()
+        if not fence:
+            return self.store.bind_pod(pod_key, host)
+        import inspect
+        try:
+            takes = "fence" in inspect.signature(
+                self.store.bind_pod).parameters
+        except (TypeError, ValueError):
+            takes = False
+        if takes:
+            return self.store.bind_pod(pod_key, host, fence=fence)
+        return self.store.bind_pod(pod_key, host)
 
     def _record_failure(self, pod: Pod, cycle: int,
                         reason: str = REASON_SCHEDULER_ERROR,
@@ -1809,14 +1867,16 @@ class Scheduler:
         bindings = [(a.key, h) for a, h in zip(assumed_list, hosts)]
         commit_wave = getattr(self.store, "commit_wave", None)
         emit_batch = commit_wave is None
+        conflicted: list = []
         try:
             # crash seam, pre-write side: the wave has been assumed in the
             # cache but NOTHING reached the store — recovery must re-queue
             # every pod of this window
             chaos.check("sched.crash")
             if commit_wave is not None:
-                missing = set(self._commit_wave_retrying(
-                    commit_wave, bindings))
+                missing_list, conflicted = self._commit_wave_retrying(
+                    commit_wave, bindings)
+                missing = set(missing_list)
             else:
                 missing = set(self.store.bind_pods(bindings))
             # crash seam, post-write side: the wave LANDED but the cache
@@ -1828,6 +1888,22 @@ class Scheduler:
             # graceful per-pod resolution below: it propagates to the test
             # harness, which then drives Scheduler.recover()
             raise
+        except FencedError:
+            # the partition lease this wave wrote under was superseded
+            # mid-flight: the store rejected the WHOLE wave atomically
+            # (nothing landed, no events). Forget the assumes and DROP
+            # the pods — they belong to the claim's new holder, which
+            # re-lists them from the store; a zombie must not keep
+            # writing failure events/conditions for pods it lost.
+            # (the finally below still runs the fan-out call)
+            from kubernetes_tpu.fleet import BIND_CONFLICTS
+            BIND_CONFLICTS.labels("fenced").inc(len(assumed_list))
+            self.fenced_waves += 1
+            for assumed in assumed_list:
+                self.cache.forget_pod(assumed)
+                if self.pod_rows is not None:
+                    self.pod_rows.invalidate(assumed)
+            return 0
         except Exception:
             # a mid-batch store failure may have partially committed:
             # resolve each pod by what actually landed — bound pods finish,
@@ -1853,9 +1929,27 @@ class Scheduler:
             fanout = getattr(self.store, "fanout_wave", None)
             if fanout is not None:
                 fanout()
+        confl_set = set(conflicted)
         bound = []
         for assumed, pod, host, cycle in zip(assumed_list, pods, hosts,
                                              cycles):
+            if assumed.key in confl_set:
+                # rv-CAS bind loss: another scheduler bound this pod
+                # between decision and commit (claim handoff window /
+                # nominated race). The existing binding stands; the loser
+                # forgets its assume and re-queues with backoff in
+                # creation order — _record_failure reads the store and
+                # drops the requeue when the pod is (as usual) already
+                # bound by the winner.
+                from kubernetes_tpu.fleet import BIND_CONFLICTS
+                BIND_CONFLICTS.labels("requeued").inc()
+                self.cache.forget_pod(assumed)
+                self.metrics.observe("error")
+                self._record_failure(
+                    pod, cycle, REASON_SCHEDULER_ERROR,
+                    f"{PODS}/{assumed.key} (rv-CAS bind conflict: bound "
+                    f"by another scheduler)")
+                continue
             if assumed.key in missing:
                 # vanished between decision and commit: same handling as a
                 # failed bind write (_bind's fail path)
@@ -1882,7 +1976,8 @@ class Scheduler:
                  f"Successfully assigned {a.key} to {h}") for a, h in bound])
         return k
 
-    def _commit_wave_retrying(self, commit_wave, bindings: list) -> list:
+    def _commit_wave_retrying(self, commit_wave,
+                              bindings: list) -> tuple[list, list]:
         """Idempotent commit_wave: bounded exponential backoff with jitter
         on transient store failures, under ONE dedupe token for the wave.
         A pre-land failure (nothing written) simply re-runs the wave; an
@@ -1890,12 +1985,17 @@ class Scheduler:
         answered by the store's token map on retry — the wave can neither
         double-land nor double-emit its events. Exhausted retries fall
         back to the caller's per-pod crash resolution, which is also safe
-        (it reads back what actually landed).
+        (it reads back what actually landed). Returns (missing keys,
+        rv-CAS conflicted keys) — conflicted pods were bound by another
+        scheduler between decision and commit and are NEVER overwritten.
 
         Stores whose commit_wave takes `event_spec` (round 17) build the
         wave's Scheduled records INSIDE the commit core — no per-pod
         record construction on this thread; older/alternate stores get
-        host-built records (identical fields)."""
+        host-built records (identical fields). Stores taking `fence`
+        carry the instance's partition-lease tokens (fleet mode); a
+        FencedError is DEFINITIVE (ConflictError is never a transient) —
+        it propagates for the caller's whole-wave drop, never retried."""
         import inspect
         try:
             # probed per wave, not cached: tests (and alternate stores)
@@ -1903,11 +2003,18 @@ class Scheduler:
             params = inspect.signature(commit_wave).parameters
             takes_token = "token" in params
             takes_spec = "event_spec" in params
+            takes_fence = "fence" in params
+            takes_conflicts = "conflicts" in params
         except (TypeError, ValueError):
             takes_token = takes_spec = False
+            takes_fence = takes_conflicts = False
         kwargs = {}
         if takes_token:
-            kwargs["token"] = f"{self.name}:w{next(self._wave_seq)}"
+            kwargs["token"] = f"{self._token_prefix}:w{next(self._wave_seq)}"
+        if takes_fence and self.fence_provider is not None:
+            fence = self.fence_provider()
+            if fence:
+                kwargs["fence"] = fence
         if takes_spec:
             recs = None
             kwargs["event_spec"] = {"component": self.recorder.component}
@@ -1921,11 +2028,16 @@ class Scheduler:
         delay = 0.005
         attempts = 4
         for attempt in range(attempts):
+            confl: list = []
+            if takes_conflicts:
+                # a FRESH list per attempt: a dedupe-answered retry
+                # extends it from the recorded wave result
+                kwargs["conflicts"] = confl
             try:
                 out = commit_wave(bindings, recs, **kwargs)
                 if attempt:
                     COMMIT_RETRIES.labels("recovered").inc()
-                return out
+                return out, confl
             except Exception as e:   # noqa: BLE001 — filtered below
                 if attempt + 1 >= attempts \
                         or not _retryable_store_error(e):

@@ -173,3 +173,32 @@ class BackpressureGate:
             "admitted": self.admitted,
             "rejected": self.rejected,
         }
+
+
+def fleet_gate(loops, max_depth: int,
+               retry_after_base: float = 0.25,
+               retry_after_max: float = 2.0) -> BackpressureGate:
+    """One admission gate for an active-active fleet sharing a store
+    (round 18): the store has a single `admission_gate` hook, but N
+    serve loops each own a queue. The gate keys on the LEAST-loaded
+    instance's depth (a create is shed only when every member is over
+    the watermark — the pod's namespace-hash owner may well be the idle
+    one) and the SUM of in-flight launch windows (device pressure is a
+    fleet-wide resource). Attach the returned gate to
+    `store.admission_gate` yourself — the fleet bench owns that wiring."""
+    from kubernetes_tpu.store.store import PODS as _PODS
+    informers = [loop.sched.informers.informer(_PODS) for loop in loops]
+
+    def depth() -> int:
+        depths = [loop.sched.queue.active_depth() + inf.backlog()
+                  for loop, inf in zip(loops, informers)]
+        return min(depths) if depths else 0
+
+    def inflight() -> int:
+        return sum(loop.inflight_windows() for loop in loops)
+
+    return BackpressureGate(
+        depth, max_depth=max_depth, inflight_fn=inflight,
+        max_inflight=4 * sum(max(1, loop.depth) for loop in loops),
+        retry_after_base=retry_after_base,
+        retry_after_max=retry_after_max)

@@ -102,6 +102,11 @@ class PyCommitCore:
         self._next_wid = 0
         self._cond = threading.Condition(threading.Lock())
         self._fanout_sink = None
+        # fencing-token table (round 18, active-active fleet): scope ->
+        # the highest lease fencing token validated so far. Guarded by
+        # the STORE's lock like the rv counter (every writer holds it);
+        # never touched from consumer threads.
+        self._fences: dict[str, int] = {}
 
     def set_fanout_sink(self, sink) -> None:
         """Observability hook (identical on the native core): called at
@@ -110,6 +115,38 @@ class PyCommitCore:
         it to the watch_fanout_lag_seconds histogram and the pod-lifecycle
         ledger's copy-out stamp. Never part of parity-observable state."""
         self._fanout_sink = sink
+
+    # -- fencing tokens (round 18; caller holds the store lock) --------------
+    # A scope names one partition lease (e.g. "fleet-default-scheduler-s3");
+    # tokens are the lease's resourceVersion at acquisition, so a later
+    # claimant's token is strictly greater. `fence_ok` is the read-only
+    # validation (a write carrying a token below the recorded maximum is
+    # superseded and must be rejected WHOLE before anything lands);
+    # `advance_fence` records the new maximum. The native core implements
+    # the identical pair (commitcore.cpp), and the parity tests drive both
+    # through the store's random-program harness.
+    def fence_ok(self, scope: str, token: int) -> bool:
+        return int(token) >= self._fences.get(scope, 0)
+
+    def advance_fence(self, scope: str, token: int) -> bool:
+        token = int(token)
+        if token < self._fences.get(scope, 0):
+            return False
+        self._fences[scope] = token
+        return True
+
+    def fence_token(self, scope: str) -> int:
+        return self._fences.get(scope, 0)
+
+    def fence_table(self) -> dict:
+        return dict(self._fences)
+
+    def adopt_fences(self, table: dict) -> None:
+        """Carry a demoted core's fence table over: the twin must keep
+        rejecting superseded writers with no gap."""
+        for scope, token in table.items():
+            if int(token) > self._fences.get(scope, 0):
+                self._fences[scope] = int(token)
 
     # -- rv ------------------------------------------------------------------
     def rv(self) -> int:

@@ -34,9 +34,9 @@ from typing import Any, Callable, Optional
 from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.store.store import (
-    Event, PODS, AlreadyExistsError, BackpressureError, ConflictError,
-    DisruptionBudgetError, ExpiredError, NotFoundError,
-    nominated_node_mutator, pod_condition_mutator,
+    Event, LEASES, PODS, AlreadyExistsError, BackpressureError,
+    ConflictError, DisruptionBudgetError, ExpiredError, FencedError,
+    NotFoundError, nominated_node_mutator, pod_condition_mutator,
 )
 
 # client-runtime metrics (rest_client_requests_total /
@@ -83,6 +83,12 @@ def _raise_for(code: int, reason: str, message: str,
     if code == 409:
         if reason == "AlreadyExists":
             raise AlreadyExistsError(message)
+        if reason == "Fenced":
+            # superseded partition-lease fencing token: the write was
+            # rejected WHOLE — a definitive answer for a superseded
+            # claim holder, never auto-retried (FencedError subclasses
+            # ConflictError, so every conflict path already stops)
+            raise FencedError(message)
         raise ConflictError(message)
     if code == 410:
         raise ExpiredError(message)
@@ -272,6 +278,15 @@ class RemoteStore:
         "bind": (4, 0.02),     # binding POST: read-your-write dedupe below
         "status": (3, 0.02),   # status subresource PUT (idempotent mutator)
         "write": (1, 0.0),     # create/delete: NOT idempotent — no retry
+        # Lease CAS writes (leader-election acquire/renew/claim): exactly
+        # ONE attempt, never ridden through transport retries. A retried
+        # renew whose first attempt landed answers 409, which the elector
+        # must read as a DEFINITIVE lost lease (step down before the
+        # fencing window), not something a client-side loop may paper
+        # over — a lease retried into "still holding" while another
+        # candidate acquired is precisely the split-brain fencing exists
+        # to kill. tests/test_remote.TestRetryPolicyTable pins this row.
+        "lease": (1, 0.0),
     }
 
     def __init__(self, base_url: str, timeout: float = 30.0,
@@ -397,9 +412,14 @@ class RemoteStore:
         # the server uses the object's resourceVersion as the CAS
         # precondition; expect_rv overrides it (None = unconditional)
         d["resource_version"] = expect_rv if expect_rv is not None else 0
+        if kind == LEASES and expect_rv is not None:
+            # lease acquire/renew CAS: one attempt, fail fast to the
+            # elector (see the RETRY_POLICY "lease" row)
+            verb = "lease"
+        else:
+            verb = "cas" if expect_rv is not None else "write"
         return serde.from_dict(kind, self._request(
-            "PUT", f"/api/v1/{kind}/{obj.key}", d,
-            verb_class="cas" if expect_rv is not None else "write"))
+            "PUT", f"/api/v1/{kind}/{obj.key}", d, verb_class=verb))
 
     def delete(self, kind: str, key: str) -> Any:
         return serde.from_dict(kind, self._request(
@@ -425,15 +445,23 @@ class RemoteStore:
             "POST", f"/api/v1/{PODS}/{pod_key}/eviction", {},
             verb_class="write"))
 
-    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+    def bind_pod(self, pod_key: str, node_name: str, fence=None) -> Any:
         """POST pods/{ns}/{name}/binding (factory.go:710), idempotent
         under retry: a transient failure after the POST went out is
         AMBIGUOUS (the write may have landed, only the response was lost),
         so before re-POSTing the client reads the pod back — a binding
         that already landed is success, never re-POSTed, and therefore
-        never double-bumps the rv or double-emits the MODIFIED event."""
+        never double-bumps the rv or double-emits the MODIFIED event.
+
+        `fence` rides the body as [[scope, token], ...]; the server's 409
+        reason=Fenced maps to FencedError (definitive, no retry), and the
+        rv-CAS already-bound refusal maps to ConflictError."""
         attempts, base = self.RETRY_POLICY["bind"]
         path = f"/api/v1/{PODS}/{pod_key}/binding"
+        body: dict = {"node": node_name}
+        if fence:
+            pairs = [fence] if isinstance(fence, tuple) else list(fence)
+            body["fence"] = [[s, int(t)] for s, t in pairs]
         last: Optional[BaseException] = None
         for attempt in range(attempts):
             if attempt:
@@ -449,31 +477,46 @@ class RemoteStore:
                 REQUEST_RETRIES.labels("bind").inc()
                 self._sleep(self._backoff(attempt - 1, base))
             try:
-                return self._request_once("POST", path, {"node": node_name})
+                return self._request_once("POST", path, body)
             except Exception as e:   # noqa: BLE001 — filtered below
                 if not self._is_transient(e):
                     raise
                 last = e
         raise last
 
-    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
+    def bind_pods(self, bindings: list[tuple[str, str]],
+                  fence=None, conflicts: Optional[list] = None) -> list[str]:
         """Batch contract of Store.bind_pods over the wire: one POST per
         binding (the REST surface has no batch verb, matching the
-        reference), missing pods reported back instead of raised."""
+        reference), missing pods reported back instead of raised. rv-CAS
+        losers (409 Conflict) go to `conflicts` when a list is passed,
+        else ride the missing return — either way the caller requeues
+        them. A FencedError STOPS the batch immediately and propagates:
+        a superseded claim holder must not keep writing its tail."""
         missing = []
         for pod_key, node_name in bindings:
             try:
-                self.bind_pod(pod_key, node_name)
+                self.bind_pod(pod_key, node_name, fence=fence)
             except NotFoundError:
                 missing.append(pod_key)
+            except FencedError:
+                raise
+            except ConflictError:
+                if conflicts is not None:
+                    conflicts.append(pod_key)
+                else:
+                    missing.append(pod_key)
         return missing
 
     def commit_wave(self, bindings: list[tuple[str, str]],
                     events: Optional[list] = None,
-                    token: Optional[str] = None) -> list[str]:
+                    token: Optional[str] = None,
+                    fence=None,
+                    conflicts: Optional[list] = None) -> list[str]:
         """Wave contract of Store.commit_wave over the wire: binds via the
         binding subresource (404 -> missing, mapped exactly like
-        bind_pods), then the audit records of the binds that landed via
+        bind_pods; 409 Conflict -> rv-CAS loser; 409 Fenced aborts the
+        wave), then the audit records of the binds that landed via
         per-record POSTs — each isolated and fire-and-forget like the
         recorder's remote path (a rejected or undeliverable event write
         never fails the commit).
@@ -485,10 +528,11 @@ class RemoteStore:
         dropped (record keys are deterministic per event). `token` is
         accepted for surface parity with the embedded store."""
         del token   # per-verb dedupe makes the wave token redundant here
-        missing = self.bind_pods(bindings)
+        confl: list = []
+        missing = self.bind_pods(bindings, fence=fence, conflicts=confl)
         if events:
             from kubernetes_tpu.store.store import EVENTS
-            gone = set(missing)
+            gone = set(missing) | set(confl)
             drop = (APIStatusError, AlreadyExistsError, ConflictError,
                     OSError, urllib.error.URLError)
             for (pod_key, _n), rec in zip(bindings, events):
@@ -498,7 +542,22 @@ class RemoteStore:
                     self.create(EVENTS, rec, move=True)
                 except drop:
                     continue
-        return missing
+        if conflicts is not None:
+            conflicts.extend(confl)
+            return missing
+        return missing + confl
+
+    def advance_fence(self, scope: str, token: int) -> bool:
+        """POST /api/v1/fences/{scope} — the claim handoff's fence
+        advance over the wire. Idempotent (the server records a maximum),
+        so it rides the cas retry class; a 409 Fenced answer means the
+        caller's token is itself already superseded -> False."""
+        try:
+            self._request("POST", f"/api/v1/fences/{scope}",
+                          {"token": int(token)}, verb_class="cas")
+            return True
+        except FencedError:
+            return False
 
     def fanout_wave(self) -> None:
         """Watch fan-out happens server-side (the embedded store's commit

@@ -122,9 +122,36 @@ EVENTS_TRIMMED = obs.counter(
     "Audit EventRecords evicted oldest-first past the store's retention "
     "cap (the reference's event TTL analog; each eviction emits DELETED).")
 
+FENCED_WRITES = obs.counter(
+    "store_fenced_writes_total",
+    "Writes rejected whole because they carried an expired or superseded "
+    "partition-lease fencing token, by verb (commit_wave / bind / "
+    "advance). A fenced write lands NOTHING: no binds, no events, no rv.",
+    ("verb",))
+BIND_CAS_CONFLICTS = obs.counter(
+    "store_bind_conflicts_total",
+    "Bind writes refused by the rv-CAS already-bound check (the pod was "
+    "bound by another writer between decision and commit). The pod's "
+    "existing binding is never overwritten — this counter plus the "
+    "fleet's zero-double-bind tripwire are the two sides of the same "
+    "invariant.")
+
 
 class ConflictError(Exception):
     """resourceVersion precondition failed (optimistic-concurrency loss)."""
+
+
+class FencedError(ConflictError):
+    """A write carried an expired or superseded partition-lease fencing
+    token (round 18, active-active fleet): the claim it wrote under has a
+    newer holder, so the WHOLE write is rejected atomically — no partial
+    wave lands, no events emit, no rv burns. Subclasses ConflictError so
+    every existing never-auto-retry path treats it as a definitive answer;
+    the HTTP surface maps it to 409 reason=Fenced."""
+
+    def __init__(self, message: str, scope: str = ""):
+        super().__init__(message)
+        self.scope = scope
 
 
 class DisruptionBudgetError(Exception):
@@ -313,6 +340,10 @@ class Store:
         # chaos store.fanout seam: a deferred wave delivery is flushed by
         # the next fan-out call or the next consumer poll (never lost)
         self._fanout_deferred = False
+        # fencing-token fallback table: used ONLY when the loaded commit
+        # core predates the fence verbs (a stale prebuilt .so) — the
+        # fresh builds of both cores own the table themselves
+        self._py_fences: dict[str, int] = {}
         # serving admission gate (serve.backpressure.BackpressureGate):
         # when attached, pod creates are checked against the activeQ-depth
         # / in-flight-window watermarks and shed with BackpressureError
@@ -366,6 +397,16 @@ class Store:
         twin = PyCommitCore(self._log_size, self._queue_size,
                             Event, ExpiredError, AlreadyExistsError)
         twin.set_rv(self._core.rv())
+        # the fence table must survive demotion with no gap: a superseded
+        # writer rejected by the native core must stay rejected by the twin
+        old_table = getattr(self._core, "fence_table", None)
+        if old_table is not None:
+            try:
+                twin.adopt_fences(old_table())
+            except Exception:
+                twin.adopt_fences(dict(self._py_fences))
+        else:
+            twin.adopt_fences(dict(self._py_fences))
         for wid, kind in self._watch_ids.items():
             twin.adopt_watcher(wid, kind, resync=True)
         self._core = twin
@@ -482,6 +523,86 @@ class Store:
         tie-breaking, so the launch must be refused either way)."""
         with self._lock:
             return len(self._objs.get(kind, {}))
+
+    # -- fencing tokens (round 18, active-active fleet) ----------------------
+    # A scope names one partition lease; tokens are the lease's
+    # resourceVersion at acquisition (strictly greater for every later
+    # claimant). Validation/advance live in the commit core (native AND
+    # twin — identical fence_ok/advance_fence pair); the store-side dict
+    # is only the stale-prebuilt-.so fallback.
+    def _fence_ok_locked(self, scope: str, token: int) -> bool:
+        fn = getattr(self._core, "fence_ok", None)
+        if fn is not None:
+            return bool(fn(scope, int(token)))
+        return int(token) >= self._py_fences.get(scope, 0)
+
+    def _fence_advance_locked(self, scope: str, token: int) -> bool:
+        fn = getattr(self._core, "advance_fence", None)
+        if fn is not None:
+            return bool(fn(scope, int(token)))
+        if int(token) < self._py_fences.get(scope, 0):
+            return False
+        self._py_fences[scope] = int(token)
+        return True
+
+    @staticmethod
+    def _fence_pairs(fence) -> list:
+        """Normalize a fence argument: one (scope, token) pair or a list
+        of pairs (a wave may span several claimed shards)."""
+        if not fence:
+            return []
+        if isinstance(fence, tuple) and len(fence) == 2 \
+                and isinstance(fence[0], str):
+            return [fence]
+        return list(fence)
+
+    def _check_fences_locked(self, fence, verb: str) -> None:
+        """Validate EVERY fence pair read-only first, then advance — so a
+        rejection is atomic (no scope advanced, nothing written) and a
+        mixed wave can never partially move the table. Raises FencedError
+        naming the superseded scope."""
+        pairs = self._fence_pairs(fence)
+        for scope, token in pairs:
+            if not self._fence_ok_locked(scope, token):
+                FENCED_WRITES.labels(verb).inc()
+                raise FencedError(
+                    f"{verb}: fencing token {token} for {scope!r} is "
+                    f"superseded (current "
+                    f"{self.fence_token_locked(scope)})", scope=scope)
+        for scope, token in pairs:
+            self._fence_advance_locked(scope, token)
+
+    def fence_token_locked(self, scope: str) -> int:
+        fn = getattr(self._core, "fence_token", None)
+        if fn is not None:
+            return int(fn(scope))
+        return self._py_fences.get(scope, 0)
+
+    def advance_fence(self, scope: str, token: int) -> bool:
+        """The claim protocol's handoff verb: a new partition-lease holder
+        advances the fence BEFORE replaying its partition, so any late
+        write from the superseded holder is rejected even if the usurper
+        has not written yet. Returns False (no state change) when `token`
+        is itself already superseded — the caller lost a newer race and
+        must drop its claim."""
+        with self._lock:
+            ok = self._fence_advance_locked(scope, int(token))
+        if not ok:
+            FENCED_WRITES.labels("advance").inc()
+        return ok
+
+    def fence_token(self, scope: str) -> int:
+        with self._lock:
+            return self.fence_token_locked(scope)
+
+    def fence_table(self) -> dict:
+        """scope -> token snapshot (the fleet replay harness re-applies it
+        at the recorded points; /debug material otherwise)."""
+        with self._lock:
+            fn = getattr(self._core, "fence_table", None)
+            if fn is not None:
+                return dict(fn())
+            return dict(self._py_fences)
 
     # -- writes -------------------------------------------------------------
     # Every verb's per-object body lives in the commit core (shared by the
@@ -635,58 +756,108 @@ class Store:
         return gone
 
     # -- pod conveniences (the scheduler's write surface) --------------------
-    def bind_pod(self, pod_key: str, node_name: str) -> Any:
+    def bind_pod(self, pod_key: str, node_name: str,
+                 fence=None) -> Any:
         """POST pods/<p>/binding analog (reference: factory.go:710).
 
-        Single-lock fast path of guaranteed_update(set nodeName): the
-        binding subresource replaces one spec field unconditionally (the
-        reference's Bind POST carries no resourceVersion precondition), so
-        no CAS retry loop — one clone, one lock, one event. The per-binding
-        body is the commit core's bind_batch (identical to the burst wave)."""
+        Single-lock fast path of guaranteed_update(set nodeName): one
+        clone, one lock, one event. Round 18 makes the verb an rv-CAS
+        bind (the reference rejects a Binding for a pod whose nodeName is
+        already set): a pod already bound to a DIFFERENT node raises
+        ConflictError and its binding is never overwritten — two racing
+        schedulers see exactly one success and one 409 — while a re-bind
+        to the SAME node is an idempotent no-op (a retried bind whose
+        first attempt landed must look like success). `fence` optionally
+        carries the writer's partition-lease fencing token(s); a
+        superseded token raises FencedError before anything lands."""
         with self._lock:
+            if fence is not None:
+                self._check_fences_locked(fence, "bind")
             self._core_guard()
             bucket = self._objs.setdefault(PODS, {})
-            if self._bind_batch_locked(bucket, [(pod_key, node_name)]):
-                self._flush()
+            current = bucket.get(pod_key)
+            if current is None:
                 raise NotFoundError(f"{PODS}/{pod_key}")
+            # the alias tripwire runs BEFORE the CAS read: a consumer
+            # mutation through an aliased reference must fail loudly as
+            # corruption, not masquerade as an already-bound conflict
+            self._check_entry(PODS, pod_key, current)
+            if current.node_name:
+                if current.node_name == node_name:
+                    return current   # idempotent re-bind: already landed
+                BIND_CAS_CONFLICTS.inc()
+                raise ConflictError(
+                    f"{PODS}/{pod_key}: already bound to "
+                    f"{current.node_name} (rv-CAS refused bind to "
+                    f"{node_name})")
+            self._bind_batch_locked(bucket, [(pod_key, node_name)], [])
             self._flush()
             from kubernetes_tpu.obs.ledger import LEDGER
             LEDGER.commit_many((pod_key,))
             return bucket[pod_key]
 
-    def _bind_batch_locked(self, bucket,
-                           bindings: list[tuple[str, str]]) -> list[str]:
+    def _bind_batch_locked(self, bucket, bindings: list[tuple[str, str]],
+                           conflicts: list) -> list[str]:
         """Batched binding body shared by bind_pod/bind_pods/commit_wave;
-        caller holds the lock and flushes. Returns the missing keys. The
-        integrity tripwire brackets the core call (debug mode only)."""
+        caller holds the lock and flushes. Returns the missing keys and
+        appends rv-CAS losers to `conflicts`: a pod already bound to a
+        different node is NEVER overwritten (the fleet's double-bind
+        impossibility rests on this one scan), and a same-node re-bind is
+        a silent no-op (neither missing nor conflicted — the binding
+        already landed). The integrity tripwire brackets the core call
+        (debug mode only)."""
         if self._integrity is not None:
+            # alias tripwire BEFORE the CAS scan: a mutated aliased pod
+            # must surface as corruption, not as an already-bound loser
             for pod_key, _n in bindings:
                 current = bucket.get(pod_key)
                 if current is not None:
                     self._check_entry(PODS, pod_key, current)
-        missing = self._core.bind_batch(bucket, PODS, bindings)
+        live = []
+        for pod_key, node_name in bindings:
+            current = bucket.get(pod_key)
+            if current is not None and current.node_name:
+                if current.node_name != node_name:
+                    BIND_CAS_CONFLICTS.inc()
+                    conflicts.append(pod_key)
+                continue
+            live.append((pod_key, node_name))
+        if not live:
+            return []
+        missing = self._core.bind_batch(bucket, PODS, live)
         if self._integrity is not None:
             gone = set(missing)
-            for pod_key, _n in bindings:
+            for pod_key, _n in live:
                 if pod_key not in gone:
                     self._record_entry(PODS, pod_key, bucket[pod_key])
         return missing
 
-    def bind_pods(self, bindings: list[tuple[str, str]]) -> list[str]:
+    def bind_pods(self, bindings: list[tuple[str, str]],
+                  fence=None, conflicts: Optional[list] = None) -> list[str]:
         """Batch form of bind_pod for the burst prefix commit: ONE lock
         acquisition and ONE core call for the whole burst instead of one
-        per pod (per-binding semantics identical to bind_pod). Returns the
-        keys that were missing (deleted between decision and commit); the
-        caller handles those like failed binds."""
+        per pod (per-binding semantics identical to bind_pod, including
+        the rv-CAS already-bound check). Returns the keys that were
+        missing (deleted between decision and commit); rv-CAS losers go
+        to `conflicts` when the caller passes a list — else they ride the
+        missing return (either way the caller requeues them, never
+        overwrites). `fence` validates the writer's partition-lease
+        tokens atomically before anything lands."""
+        confl: list = []
         with self._lock:
+            if fence is not None:
+                self._check_fences_locked(fence, "bind")
             self._core_guard()
             bucket = self._objs.setdefault(PODS, {})
-            missing = self._bind_batch_locked(bucket, bindings)
+            missing = self._bind_batch_locked(bucket, bindings, confl)
         self._flush()
         from kubernetes_tpu.obs.ledger import LEDGER
-        gone = set(missing)
+        gone = set(missing) | set(confl)
         LEDGER.commit_many([k for k, _n in bindings if k not in gone])
-        return missing
+        if conflicts is not None:
+            conflicts.extend(confl)
+            return missing
+        return missing + confl
 
     def create_many(self, kind: str, objs: list,
                     move: bool = False) -> list:
@@ -753,7 +924,9 @@ class Store:
     def commit_wave(self, bindings: list[tuple[str, str]],
                     events: Optional[list] = None,
                     token: Optional[str] = None,
-                    event_spec: Optional[dict] = None) -> list[str]:
+                    event_spec: Optional[dict] = None,
+                    fence=None,
+                    conflicts: Optional[list] = None) -> list[str]:
         """One burst wave's whole store-write tail as ONE core call: the
         batched bind (bind_pods semantics) plus the audit-record creates
         for the bindings that landed (`events[i]` rides `bindings[i]`;
@@ -768,6 +941,16 @@ class Store:
         without touching the core — a retried bind after an AMBIGUOUS
         failure (the wave landed but the caller saw an exception) can
         neither double-land nor double-emit its events.
+
+        `fence` (round 18) carries the writing scheduler's partition-lease
+        fencing token(s): an expired or superseded token rejects the WHOLE
+        wave atomically (FencedError; no bind, no event, no rv — on the
+        native core and the twin alike, since validation precedes every
+        core write). Bindings whose pod is ALREADY bound to a different
+        node are rv-CAS conflicts: skipped (never overwritten), reported
+        via `conflicts` when a list is passed, else merged into the
+        missing return; their audit records are skipped exactly like a
+        vanished pod's. Same-node re-binds are idempotent no-ops.
 
         `event_spec` (round 17, mutually exclusive with `events`) asks
         the commit core to BUILD the Scheduled audit payloads itself:
@@ -791,7 +974,16 @@ class Store:
                 hit = self._wave_tokens.get(token)
                 if hit is not None:
                     WAVE_DEDUP.inc()
-                    return list(hit)
+                    missing, confl = list(hit[0]), list(hit[1])
+                    if conflicts is not None:
+                        conflicts.extend(confl)
+                        return missing
+                    return missing + confl
+            # fence validation FIRST (before the chaos seam and every
+            # core write): a superseded claim's retry must stay rejected
+            # whole, never half-retried into the core
+            if fence is not None:
+                self._check_fences_locked(fence, "commit_wave")
             # injected pre-land failure: nothing written yet — the caller
             # retries the whole wave under the same token
             chaos.check("store.commit_wave")
@@ -799,10 +991,26 @@ class Store:
             pods = self._objs.setdefault(PODS, {})
             evs = self._objs.setdefault(EVENTS, {})
             if self._integrity is not None:
+                # alias tripwire BEFORE the CAS scan (see bind_pod)
                 for pod_key, _n in bindings:
                     current = pods.get(pod_key)
                     if current is not None:
                         self._check_entry(PODS, pod_key, current)
+            # rv-CAS pre-scan (round 18): already-bound pods never reach
+            # the core — a different-node decision is a conflict, a
+            # same-node one an idempotent no-op; `live` keeps wave order
+            confl = []
+            live = []
+            live_idx = []
+            for i, (pod_key, node_name) in enumerate(bindings):
+                current = pods.get(pod_key)
+                if current is not None and current.node_name:
+                    if current.node_name != node_name:
+                        BIND_CAS_CONFLICTS.inc()
+                        confl.append(pod_key)
+                    continue
+                live.append((pod_key, node_name))
+                live_idx.append(i)
             t_core = _time.perf_counter()
             if event_spec is not None:
                 cwb = getattr(self._core, "commit_wave_binds", None)
@@ -810,23 +1018,28 @@ class Store:
                     # ONE core call builds the Scheduled payloads AND
                     # lands binds + events (native: zero per-pod Python
                     # on the commit thread)
-                    missing = cwb(pods, PODS, bindings, evs, EVENTS,
+                    missing = cwb(pods, PODS, live, evs, EVENTS,
                                   EventRecord, component, seq0)
                 else:
                     # stale prebuilt .so without the verb: build the
                     # records host-side (identical fields) and ride the
                     # classic wave call
                     recs = build_scheduled_records(
-                        EventRecord, bindings, component, seq0)
+                        EventRecord, live, component, seq0)
                     missing = self._core.commit_wave(
-                        pods, PODS, bindings, evs, EVENTS, recs)
+                        pods, PODS, live, evs, EVENTS, recs)
             else:
-                missing = self._core.commit_wave(pods, PODS, bindings,
-                                                 evs, EVENTS, events or [])
+                recs = events or []
+                if recs and len(live) != len(bindings):
+                    # events[i] rides bindings[i]: conflicted / no-op
+                    # bindings drop their records like vanished pods
+                    recs = [recs[i] for i in live_idx]
+                missing = self._core.commit_wave(pods, PODS, live,
+                                                 evs, EVENTS, recs)
             self._trim_events_locked()   # audit retention (event TTL)
             t_landed = _time.perf_counter()
             if token is not None:
-                self._wave_tokens[token] = list(missing)
+                self._wave_tokens[token] = (list(missing), list(confl))
                 while len(self._wave_tokens) > WAVE_TOKEN_CAP:
                     self._wave_tokens.popitem(last=False)
             # injected AMBIGUOUS failure: the wave LANDED (core write done,
@@ -837,7 +1050,7 @@ class Store:
                 t_landed - t_core)
             if self._integrity is not None:
                 gone = set(missing)
-                for pod_key, _n in bindings:
+                for pod_key, _n in live:
                     if pod_key not in gone:
                         self._record_entry(PODS, pod_key, pods[pod_key])
                 for rec in events or []:
@@ -846,14 +1059,17 @@ class Store:
                         self._record_entry(EVENTS, rec.key, stored)
         # ledger: the commit_wave landing IS the per-pod commit stamp
         from kubernetes_tpu.obs.ledger import LEDGER
-        gone = set(missing)
+        gone = set(missing) | set(confl)
         LEDGER.commit_many([k for k, _n in bindings if k not in gone],
                            t=t_landed)
         if ambiguous:
             raise chaos.StoreFault(
                 "store.commit_wave.ambiguous",
                 "chaos: commit_wave response lost after the wave landed")
-        return missing
+        if conflicts is not None:
+            conflicts.extend(confl)
+            return missing
+        return missing + confl
 
     def fanout_wave(self) -> None:
         """Deliver a committed wave's pending watch events: ONE core call

@@ -1,0 +1,67 @@
+"""Fleet robustness seed sweep (the round-18 42-trial run).
+
+Not collected by pytest (no test_ prefix): run by hand after any fleet,
+fencing, claim, bind-CAS, or commit-core change —
+
+    JAX_PLATFORMS=cpu python tests/sweep_fleet_seeds.py [trials] [base_seed]
+
+Each trial re-runs the fleet differential (tests/test_fleet:
+run_fleet_trial + replay_all_live) with a fresh seed: a random instance
+count (2-8) of partitioned schedulers round-robin against ONE shared
+store, with the trial mix rotating through the plain run, a clean
+mid-run instance kill (lease-expiry failover), kill-then-restart
+(rejoin through the claim protocol), the fleet.lease-loss zombie seam
+(claims pause while scheduling continues — the fence must reject every
+stale wave whole), a mid-burst sched.crash kill (a partial wave lands
+and the survivor replays the shard from the store), and a TPU-burst-path
+variant. Every trial asserts: zero double-binds EVER (the BindAuditor
+tripwire), live claim sets disjoint at every round, every admitted pod
+bound, and each non-crashed instance's recorded decision stream
+BIT-IDENTICAL under solo replay — the reclaimed partition's
+post-failover stream equal to a solo scheduler that observed the same
+pod subset.
+"""
+import random
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    from kubernetes_tpu import chaos as chaos_mod
+    from tests.test_fleet import replay_all_live, run_fleet_trial
+    rng = random.Random(base_seed)
+    variants = [
+        ("plain", {}),
+        ("kill", {"kill": True}),
+        ("restart", {"kill": True, "restart": True}),
+        ("zombie", {"zombie": True}),
+        ("crash", {"crash": True}),
+        ("tpu", {"use_tpu": True, "n_instances": 2, "rounds": 4}),
+    ]
+    for trial in range(trials):
+        name, kw = variants[trial % len(variants)]
+        seed = rng.randint(1, 10_000)
+        n_instances = kw.get("n_instances", rng.randint(2, 8))
+        try:
+            mgr, _store, idents = run_fleet_trial(
+                seed, n_instances=n_instances, **{
+                    k: v for k, v in kw.items() if k != "n_instances"})
+            replay_all_live(mgr, idents,
+                            use_tpu=kw.get("use_tpu", False))
+        except Exception:
+            print(f"FAIL variant={name} seed={seed} "
+                  f"instances={n_instances}")
+            raise
+        finally:
+            chaos_mod.disable()
+        print(f"ok {trial + 1}/{trials} {name} seed={seed} "
+              f"x{n_instances}")
+    print(f"fleet sweep green: {trials} trials")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

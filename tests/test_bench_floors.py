@@ -349,6 +349,44 @@ def test_serve_mode_soak():
 
 
 @pytest.mark.slow
+def test_fleet_mode_floor():
+    """`bench.py --mode fleet` (the round-18 active-active lane) at the
+    acceptance cell — 2 instances, 1000 nodes, 2000 arrivals/s for 20 s
+    against ONE shared store, with the solo serve baseline measured in
+    the same run. The gates: the zero-double-bind audit (the tripwire
+    counter the whole fleet design exists to pin at zero), every arrival
+    admitted-and-bound or 429'd-and-accounted, live claim sets disjoint,
+    and aggregate pods/s >= 0.95x the solo baseline (both runs are
+    arrival-bound when the box keeps up, so the ratio sits at ~1.0 on
+    CPU — the >1x headline needs the tunneled chip, where N instances
+    hide N dispatch RTTs behind each other; 0.95 absorbs run variance
+    without letting a real regression through)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "fleet", "--instances", "2",
+         "--nodes", "1000", "--arrival-rate", "2000", "--duration", "20"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["unit"] == "pods/s"
+    assert out["instances"] == 2
+    # the three robustness audits gate the number
+    assert out["double_binds"] == 0, out
+    assert out["audit_no_double_bind"] is True
+    assert out["audit_all_admitted_or_429"] is True
+    assert out["partition_disjoint"] is True
+    # aggregate throughput floor vs the same-run solo baseline
+    assert out["vs_solo_serve"] is not None
+    assert out["vs_solo_serve"] >= 0.95, out
+    assert out["value"] >= 0.9 * 2000, out
+    assert out["startup_p99"] <= 5.0, out
+    # every instance did real work (the partition actually spread)
+    shares = list(out["per_instance_pods_bound"].values())
+    assert len(shares) == 2 and all(s > 0 for s in shares), out
+
+
+@pytest.mark.slow
 def test_sharded_lane_floor():
     """Round-15 sharded lane: `bench.py --devices` must (a) report the
     multi-chip fields — devices > 1, per_device_node_rows, a non-zero

@@ -65,7 +65,7 @@ class TestPlanMechanics:
             "store.commit_wave", "store.commit_wave.ambiguous",
             "store.fanout", "native.commitcore", "native.heapcore",
             "remote.http", "watch.drop", "clock.jump", "sched.crash",
-            "node.dead", "serve.shed",
+            "node.dead", "serve.shed", "fleet.lease-loss",
         }
         assert set(chaos._FAULT_FOR) == set(chaos.SEAMS)
         assert set(chaos.OPT_IN_SEAMS) <= set(chaos.SEAMS)

@@ -129,6 +129,25 @@ class _Recorderless:
         self.store.fanout_wave()
         return missing
 
+    def op_advance_fence(self, scope, token):
+        # the claim-handoff verb: monotonic max per scope, False when the
+        # caller's token is already superseded (round 18)
+        return self.store.advance_fence(f"fleet-par-s{scope}", token)
+
+    def op_fenced_wave(self, names, node, scope, token):
+        # a wave carrying a fencing token: a superseded token raises
+        # FencedError (caught as a ConflictError subclass by op()) with
+        # NOTHING landed — rv streams, bucket state, and watch sequences
+        # must stay bit-identical across cores either way; rv-CAS
+        # conflicts of re-bound pods ride the conflicts list
+        confl: list = []
+        missing = self.store.commit_wave(
+            [(f"default/{n}", node) for n in names],
+            event_spec={"component": "parity-sched"},
+            fence=(f"fleet-par-s{scope}", token), conflicts=confl)
+        self.store.fanout_wave()
+        return (missing, confl)
+
     def op_watch(self, wid, since_rv):
         self.watches[wid] = self.store.watch(PODS, since_rv=since_rv)
         return None
@@ -172,10 +191,21 @@ def _random_program(seed: int, n_ops: int = 120):
             prog.append(("commit_wave_binds",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.86:
+        elif r < 0.83:
+            # fenced-writer ops (round 18): fence advances interleave
+            # with fenced waves so both STALE rejections (atomic, no rv)
+            # and valid advances land in the compared stream
+            prog.append(("advance_fence", rng.randint(0, 2),
+                         rng.randint(1, 30)))
+        elif r < 0.88:
+            prog.append(("fenced_wave",
+                         tuple(rng.sample(names, rng.randint(1, 4))),
+                         f"n{rng.randint(0, 3)}",
+                         rng.randint(0, 2), rng.randint(1, 30)))
+        elif r < 0.92:
             prog.append(("watch", rng.randint(0, 3),
                          rng.randint(0, 40) if rng.random() < 0.5 else None))
-        elif r < 0.96:
+        elif r < 0.98:
             prog.append(("drain", rng.randint(0, 3)))
         else:
             prog.append(("rv",))
@@ -198,11 +228,14 @@ class TestNativeTwinParity:
             for op in prog:
                 h.op(*op)
             runs[impl] = (h.log, h.snapshot_pods(),
-                          h.store.resource_version())
+                          h.store.resource_version(),
+                          h.store.fence_table())
         # EventRecord uids/names were normalized; everything else must match
         assert runs["native"][1] == runs["twin"][1]
         assert runs["native"][2] == runs["twin"][2]
         assert runs["native"][0] == runs["twin"][0]
+        # the round-18 fence tables advanced identically too
+        assert runs["native"][3] == runs["twin"][3]
 
     def test_update_conflict_and_duplicate_create(self):
         for impl in ("native", "twin"):

@@ -579,3 +579,76 @@ class TestBackpressure429:
             remote.evict_pod("default/guarded")
         assert sleeps == []          # no auto-retry on budget refusals
         assert store.get(PODS, "default/guarded").name == "guarded"
+
+
+class TestRetryPolicyTable:
+    """Round-18 satellite pin, the client-side sibling of the
+    TRANSIENT_ERROR_MARKERS table test (tests/test_chaos_plane.py): the
+    per-verb-class retry budget is a correctness surface, not a tuning
+    knob. In particular: a 409 (ConflictError, FencedError included) is
+    a DEFINITIVE answer on every class, and Lease CAS writes (leader
+    election acquire/renew/claim) get exactly ONE attempt even for
+    transient transport failures — a renew ridden through retries can
+    land, answer 409 to its own replay, and leave the elector believing
+    a lie in either direction; the lost lease must surface to the
+    elector, which steps down before the fencing window, not be retried
+    into a fencing violation."""
+
+    def _attempts(self, verb_class, exc_factory):
+        import urllib.error   # noqa: F401 — factories close over it
+        rs = RemoteStore("http://127.0.0.1:1")
+        rs._sleep = lambda _s: None
+        calls = {"n": 0}
+
+        def boom(method, path, body=None):
+            calls["n"] += 1
+            raise exc_factory()
+        rs._request_once = boom
+        with pytest.raises(Exception):
+            rs._request("PUT", "/api/v1/x", verb_class=verb_class)
+        return calls["n"]
+
+    def test_policy_table_pinned(self):
+        assert RemoteStore.RETRY_POLICY == {
+            "read": (4, 0.02),
+            "cas": (3, 0.02),
+            "bind": (4, 0.02),
+            "status": (3, 0.02),
+            "write": (1, 0.0),
+            "lease": (1, 0.0),
+        }
+
+    def test_conflicts_never_auto_retried_on_any_class(self):
+        from kubernetes_tpu.store.store import FencedError
+        for verb in ("read", "cas", "bind", "status", "write", "lease"):
+            assert self._attempts(verb, lambda: ConflictError("cas")) == 1
+            assert self._attempts(verb, lambda: FencedError("stale")) == 1
+
+    def test_transient_budget_per_class(self):
+        import urllib.error
+        expected = {"read": 4, "cas": 3, "status": 3, "write": 1,
+                    "lease": 1}
+        for verb, n in expected.items():
+            got = self._attempts(
+                verb, lambda: urllib.error.URLError("connection reset"))
+            assert got == n, (verb, got, n)
+
+    def test_lease_cas_update_routes_to_lease_class(self):
+        """update(LEASES, ..., expect_rv=...) rides the one-attempt lease
+        class; every other kind's CAS keeps the cas class."""
+        from kubernetes_tpu.api.types import Lease
+        from kubernetes_tpu.api import serde
+        from kubernetes_tpu.store.store import LEASES
+        rs = RemoteStore("http://127.0.0.1:1")
+        seen = []
+
+        def fake_request(method, path, body=None, verb_class="read"):
+            seen.append(verb_class)
+            if "leases" in path:
+                return serde.to_dict(Lease(name="lock"))
+            return serde.to_dict(mkpod("p"))
+        rs._request = fake_request
+        rs.update(LEASES, Lease(name="lock"), expect_rv=3)
+        rs.update(PODS, mkpod("p"), expect_rv=3)
+        rs.update(LEASES, Lease(name="lock"))   # unconditional: write
+        assert seen == ["lease", "cas", "write"]
