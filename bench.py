@@ -479,16 +479,31 @@ def run_preempt_bench(n_nodes: int, n_victims: int,
 
 
 def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
-                   gang_sizes: tuple = (8, 64, 512), mesh=None) -> dict:
+                   gang_sizes: tuple = (8, 64, 512), mesh=None,
+                   profiles: bool = False) -> dict:
     """`--mode gang`: all-or-nothing PodGroup throughput over the same
     cell as the headline bench. Gangs of 8/64/512 spec-identical members
     (the SPMD-rank shape) split `pods_budget` three ways; every group must
     land whole — the run FAILS if any group is partially bound (the gang
-    atomicity contract, driver-checked). Prints the same one-line JSON."""
+    atomicity contract, driver-checked). Prints the same one-line JSON,
+    which always carries `gang_locality` — the fraction of bound gangs
+    whose members all landed in ONE zone.
+
+    `--profiles` (round 19) runs TWO lanes in one invocation on identical
+    workloads: a placement-blind PROFILE (default weight vector — its
+    decisions are bit-identical to the no-profile scheduler by the
+    per-profile parity contract) and a rank-aware profile (gang
+    set-scoring). Both lanes ride the [profiles x priorities] tensor
+    machinery, so their ratio isolates exactly what the knob costs — the
+    set-scoring objective — not the tensor plumbing both share (that
+    delta is visible against the plain `--mode gang` lane). The JSON
+    reports per-lane locality + throughput; the test_bench_floors pin is
+    rank-aware locality >= blind locality at >= 0.9x blind throughput."""
     from kubernetes_tpu.api.types import Pod, Container
     from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
-    from kubernetes_tpu.store.store import Store, PODS, PODGROUPS
+    from kubernetes_tpu.store.store import Store, NODES, PODS, PODGROUPS
     from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.api.types import get_zone_key
     MI = 1024 ** 2
     per_size = max(pods_budget // len(gang_sizes), max(gang_sizes))
     plan = []   # (group name, size)
@@ -496,63 +511,115 @@ def run_gang_bench(n_nodes: int, pods_budget: int = 10000,
         for g in range(max(1, per_size // size)):
             plan.append((f"gang-{size}-{g}", size))
     n_pods = sum(size for _, size in plan)
-    store = Store(watch_log_size=max(65536, 4 * (n_nodes + n_pods)))
-    build_cluster(store, n_nodes)
-    sched = Scheduler(store, use_tpu=True, percentage_of_nodes_to_score=100,
-                      mesh=mesh)
-    sched.sync()
 
-    def create_gangs(tag: str, the_plan) -> int:
-        total = 0
-        for gname, size in the_plan:
-            name = f"{tag}{gname}"
-            store.create(PODGROUPS, PodGroup(name=name, min_member=size))
-            for r in range(size):
-                store.create(PODS, Pod(
-                    name=f"{name}-r{r}",
-                    labels={LABEL_POD_GROUP: name, "app": "gang"},
-                    containers=(Container.make(
-                        name="c",
-                        requests={"cpu": 100, "memory": 500 * MI}),)))
-            total += size
-        return total
+    def run_lane(pset, sched_name: str) -> dict:
+        store = Store(watch_log_size=max(65536, 4 * (n_nodes + n_pods)))
+        build_cluster(store, n_nodes)
+        sched = Scheduler(store, use_tpu=True,
+                          percentage_of_nodes_to_score=100,
+                          mesh=mesh, profiles=pset)
+        sched.sync()
 
-    # warmup: one small gang per size compiles every wave bucket
-    create_gangs("warm-", [(f"w{s}", s) for s in gang_sizes])
-    sched.pump()
-    while sched.schedule_burst(max_pods=10000):
-        pass
-    sched.pump()
+        def create_gangs(tag: str, the_plan) -> int:
+            total = 0
+            for gname, size in the_plan:
+                name = f"{tag}{gname}"
+                store.create(PODGROUPS, PodGroup(name=name,
+                                                 min_member=size))
+                for r in range(size):
+                    store.create(PODS, Pod(
+                        name=f"{name}-r{r}",
+                        scheduler_name=sched_name,
+                        labels={LABEL_POD_GROUP: name, "app": "gang"},
+                        containers=(Container.make(
+                            name="c",
+                            requests={"cpu": 100, "memory": 500 * MI}),)))
+                total += size
+            return total
 
-    create_gangs("", plan)
-    sched.pump()
-    bound = 0
-    t0 = time.perf_counter()
-    while True:
-        n = sched.schedule_burst(max_pods=10000)
-        if n == 0:
-            break
-        bound += n
-    elapsed = time.perf_counter() - t0
-    sched.pump()
-    # atomicity audit: every group is bound whole or not at all
-    by_group: dict[str, list] = {}
-    for p in store.list(PODS)[0]:
-        g = p.labels.get(LABEL_POD_GROUP)
-        if g:
-            by_group.setdefault(g, []).append(bool(p.node_name))
-    partial = sorted(g for g, flags in by_group.items()
-                     if any(flags) and not all(flags))
-    assert not partial, f"partially bound gangs: {partial[:5]}"
-    throughput = bound / elapsed if elapsed > 0 else 0.0
+        # warmup: a FULL-SIZE plan drains untimed first, so every wave
+        # bucket the measured drain will hit — including the drain-window
+        # bucket itself — is compiled outside the timed region (the
+        # profile-tensor program compiles slower than the plain one, and
+        # an in-window compile would charge that delta to the lane)
+        create_gangs("warm-", [(f"w{g}", s) for g, s in plan])
+        sched.pump()
+        while sched.schedule_burst(max_pods=10000):
+            pass
+        sched.pump()
+
+        create_gangs("", plan)
+        sched.pump()
+        bound = 0
+        t0 = time.perf_counter()
+        while True:
+            n = sched.schedule_burst(max_pods=10000)
+            if n == 0:
+                break
+            bound += n
+        elapsed = time.perf_counter() - t0
+        sched.pump()
+        # atomicity audit: every group is bound whole or not at all —
+        # plus the per-gang zone census for the locality score
+        zone_of = {node.name: get_zone_key(node)
+                   for node in store.list(NODES)[0]}
+        by_group: dict[str, list] = {}
+        zones_by_group: dict[str, set] = {}
+        for p in store.list(PODS)[0]:
+            g = p.labels.get(LABEL_POD_GROUP)
+            if g:
+                by_group.setdefault(g, []).append(bool(p.node_name))
+                if p.node_name and not g.startswith("warm-"):
+                    zones_by_group.setdefault(g, set()).add(
+                        zone_of.get(p.node_name))
+        partial = sorted(g for g, flags in by_group.items()
+                         if any(flags) and not all(flags))
+        assert not partial, f"partially bound gangs: {partial[:5]}"
+        locality = (sum(1 for z in zones_by_group.values() if len(z) == 1)
+                    / max(len(zones_by_group), 1))
+        return {
+            "throughput": round(bound / elapsed if elapsed > 0 else 0.0, 1),
+            "locality": round(locality, 4),
+            "bound": bound,
+        }
+
+    if profiles:
+        from kubernetes_tpu.profiles import ProfileSet, SchedulingProfile
+        blind = run_lane(ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("tenant-blind"),
+        ]), "tenant-blind")
+        rank = run_lane(ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("tenant-rank", rank_aware=True,
+                              gang_weight=3),
+        ]), "tenant-rank")
+        return {
+            "metric": f"gang_profiles_{n_nodes}n_{n_pods}p",
+            "value": rank["throughput"],
+            "unit": "pods/s",
+            "vs_baseline": round(rank["throughput"]
+                                 / max(blind["throughput"], 1e-9), 3),
+            "gangs": {str(s): sum(1 for _g, sz in plan if sz == s)
+                      for s in gang_sizes},
+            "gang_locality": {"blind": blind["locality"],
+                              "rank_aware": rank["locality"]},
+            "throughput": {"blind": blind["throughput"],
+                           "rank_aware": rank["throughput"]},
+            "pods_bound": rank["bound"],
+            "all_or_nothing": True,
+            "profiles": True,
+        }
+    lane = run_lane(None, "default-scheduler")
     return {
         "metric": f"gang_throughput_{n_nodes}n_{n_pods}p",
-        "value": round(throughput, 1),
+        "value": lane["throughput"],
         "unit": "pods/s",
-        "vs_baseline": round(throughput / 100.0, 2),
+        "vs_baseline": round(lane["throughput"] / 100.0, 2),
         "gangs": {str(s): sum(1 for _g, sz in plan if sz == s)
                   for s in gang_sizes},
-        "pods_bound": bound,
+        "gang_locality": lane["locality"],
+        "pods_bound": lane["bound"],
         "all_or_nothing": True,
     }
 
@@ -822,6 +889,14 @@ def main():
     # zero-double-bind audit)
     ap.add_argument("--instances", type=int, default=2,
                     help="fleet mode: scheduler instances (2-8)")
+    # `--mode gang --profiles` (round 19): placement-blind vs rank-aware
+    # scheduling-profile lanes in one invocation, JSON reports per-lane
+    # gang locality (fraction of gangs landing single-zone) + throughput
+    ap.add_argument("--profiles", action="store_true",
+                    help="gang mode: run blind + rank-aware profile lanes")
+    ap.add_argument("--gang-sizes", default=None,
+                    help="gang mode: comma-separated gang sizes "
+                         "(default 8,64,512)")
     # `--mode serve` (round 16): arrival-driven serving — pods arrive at
     # --arrival-rate for --duration seconds (minutes-scale soaks: raise
     # --duration) while the ServeLoop cuts --serve-window-sized launch
@@ -981,8 +1056,12 @@ def main():
         finish(result)
         return
     if args.mode == "gang":
+        sizes = (8, 64, 512) if not args.gang_sizes else tuple(
+            int(s) for s in args.gang_sizes.split(","))
         result = retry_transient(
-            lambda: run_gang_bench(n_nodes, pods_budget=n_pods, mesh=mesh))
+            lambda: run_gang_bench(n_nodes, pods_budget=n_pods, mesh=mesh,
+                                   gang_sizes=sizes,
+                                   profiles=args.profiles))
         finish(result)
         return
     if args.mode == "commit":

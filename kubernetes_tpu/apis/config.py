@@ -53,6 +53,12 @@ class SchedulerConfiguration:
     # the device kernels
     feature_gates: dict = field(default_factory=lambda: {"TPUScoring": True})
     plugins_enabled: Optional[list] = None
+    # scheduling profiles (round 19 — KubeSchedulerConfiguration.profiles):
+    # raw profile dicts ({"schedulerName": ..., "priorities": ...,
+    # "rankAwareGang": ..., "gangWeight": ...}); build_profiles() resolves
+    # them into a validated profiles.ProfileSet. None = single-profile
+    # (scheduler_name + algorithm_source), exactly the pre-profile config.
+    profiles: Optional[list] = None
 
     # -- round trip ----------------------------------------------------------
     def to_dict(self) -> dict:
@@ -71,12 +77,25 @@ class SchedulerConfiguration:
             k: le[k] for k in LeaderElectionConfig.__dataclass_fields__ if k in le})
         for k in ("scheduler_name", "hard_pod_affinity_symmetric_weight",
                   "disable_preemption", "percentage_of_nodes_to_score",
-                  "bind_timeout_seconds", "plugins_enabled"):
+                  "bind_timeout_seconds", "plugins_enabled", "profiles"):
             if k in d:
                 setattr(cfg, k, d[k])
         if "feature_gates" in d:
             cfg.feature_gates = dict(cfg.feature_gates, **d["feature_gates"])
         return cfg
+
+    def build_profiles(self):
+        """Resolve `profiles` into a validated profiles.ProfileSet, or
+        None when the config is single-profile. Validation errors
+        (duplicate names, unknown priorities, weight bounds) surface as
+        ValidationError, matching the rest of this module."""
+        if not self.profiles:
+            return None
+        from kubernetes_tpu.profiles import ProfileSet
+        try:
+            return ProfileSet.from_dict({"profiles": self.profiles})
+        except ValueError as e:
+            raise ValidationError(str(e)) from e
 
     @staticmethod
     def from_file(path: str) -> "SchedulerConfiguration":
@@ -109,5 +128,12 @@ def validate(cfg: SchedulerConfiguration) -> None:
     # policy is ambiguous (the reference requires exactly one source)
     if has_policy and src.provider not in (None, "DefaultProvider"):
         errs.append("provider and policy are mutually exclusive")
+    if cfg.profiles:
+        if has_policy:
+            errs.append("profiles and policy are mutually exclusive")
+        try:
+            cfg.build_profiles()
+        except ValidationError as e:
+            errs.append(str(e))
     if errs:
         raise ValidationError("; ".join(errs))

@@ -188,6 +188,19 @@ class TPUScheduler:
         self.weights = None           # None -> kernels.DEFAULT_WEIGHTS
         self.enabled_predicates = None  # None -> all
         self.priority_name_weights = None  # provider/policy priorities by name
+        # scheduling profiles (round 19): when a ProfileSet with real
+        # multi-profile content attaches (set_profiles), scoring runs the
+        # [profiles x priorities] weight-tensor path — per-pod rows
+        # gathered on device by profile_id, one launch scoring every
+        # profile; None / a degenerate default set keeps the exact
+        # pre-profile kernel programs
+        self.profiles = None
+        self._ptab = None             # host [P, K] tensor (tensor mode)
+        self._wtab_dev = None         # device-resident copy, lazy
+        self._union_weights = None    # static cross-profile gate dict
+        self._profile_static = None   # per-profile static kernel rows
+        self._gang_score = False      # any profile rank-aware
+        self._oracle_cfgs_prof = None  # per-profile host-twin configs
         # NominatedPodMap handle; when preemption has nominated pods, cycles
         # fall back to the oracle's two-pass fitting (podFitsOnNode :627) —
         # the device kernel doesn't model ghost pods yet
@@ -311,6 +324,62 @@ class TPUScheduler:
             return
         ICI_ALLGATHER.labels(op).inc(
             int(n_cycles) * int(n_pad) * ICI_BYTES_PER_ROW * (d - 1) // d)
+
+    # -- scheduling profiles (round 19) --------------------------------------
+    def set_profiles(self, profiles) -> None:
+        """Attach a profiles.ProfileSet. In tensor mode (multiple
+        profiles, non-default vectors, or any rank-aware profile) every
+        scoring path switches to the resident [profiles x priorities]
+        weight tensor: windows gather each pod's row by profile_id, the
+        static `weights` dicts become the cross-profile union gate, and
+        the fused segment kernel compiles the gang set-scoring carry in
+        when any profile is rank-aware. A degenerate default set keeps
+        the pre-profile programs — decisions trivially bit-identical."""
+        self.profiles = profiles
+        self._ptab = None
+        self._wtab_dev = None
+        self._union_weights = None
+        self._profile_static = None
+        self._gang_score = False
+        self._oracle_cfgs_prof = None
+        self._oracle_cfgs = None   # rebuilt per profile on next fallback
+        if profiles is not None and profiles.tensor_mode():
+            self._ptab = profiles.weight_table()
+            self._union_weights = profiles.union_kernel_weights()
+            self._profile_static = [profiles.kernel_row(i)
+                                    for i in range(len(profiles))]
+            self._gang_score = any(p.rank_aware for p in profiles)
+
+    def _profile_id(self, pod: Pod) -> int:
+        if self.profiles is None:
+            return 0
+        pid = self.profiles.index_of(pod.scheduler_name)
+        return 0 if pid is None else pid
+
+    def _profile_ids(self, pods: list):
+        """Per-pod profile-id vector for a window (None off the tensor
+        path). Gathered columnar from the encode-at-admission row cache
+        when every row is live; the per-pod fallback is bit-identical by
+        the row contract."""
+        if self._ptab is None:
+            return None
+        rc = self.pod_rows
+        if rc is not None:
+            g = rc.gather(pods, ("profile_id",))
+            if g is not None:
+                return g["profile_id"].astype(np.int64)
+        return np.asarray([self._profile_id(p) for p in pods], np.int64)
+
+    def _wtab(self):
+        """The device-resident weight tensor (uploaded once; tiny, so it
+        replicates across the mesh)."""
+        if self._wtab_dev is None:
+            tab = jnp.asarray(self._ptab, jnp.int64)
+            if self.mesh is not None:
+                from kubernetes_tpu.parallel import sharding as S
+                tab = jax.device_put(tab, S.replicated(self.mesh))
+            self._wtab_dev = tab
+        return self._wtab_dev
 
     # -- device input assembly ----------------------------------------------
     _NODE_FIELDS = ("valid", "alloc_cpu", "alloc_mem", "alloc_eph",
@@ -486,7 +555,17 @@ class TPUScheduler:
                 percentage_of_nodes_to_score=self.percentage_of_nodes_to_score,
                 hard_pod_affinity_weight=self.hard_pod_affinity_weight,
                 nominated_pods_fn=self.nominated.pods_for_node)
-            if self.priority_name_weights is not None:
+            if self.profiles is not None:
+                # per-profile twin configs: the serial referee scores with
+                # the SAME weight vector the tensor row carries
+                self._oracle_cfgs_prof = [
+                    self.profiles.oracle_configs(
+                        i, services_fn=self.services_fn,
+                        replicasets_fn=self.replicasets_fn,
+                        hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+                    for i in range(len(self.profiles))]
+                self._oracle_cfgs = self._oracle_cfgs_prof[0]
+            elif self.priority_name_weights is not None:
                 from kubernetes_tpu.factory import build_priority_configs
                 self._oracle_cfgs = build_priority_configs(
                     self.priority_name_weights,
@@ -513,7 +592,8 @@ class TPUScheduler:
     _REPROBE_EVERY = 1024
 
     def _schedule_host_twin(self, pod: Pod, node_infos: dict[str, NodeInfo],
-                            all_node_names: list[str]) -> ScheduleResult:
+                            all_node_names: list[str],
+                            extra_configs=None) -> ScheduleResult:
         o = self._oracle_fallback()
         o.last_index, o.last_node_index = self.last_index, self.last_node_index
         from kubernetes_tpu.factory import (
@@ -524,10 +604,15 @@ class TPUScheduler:
             node_infos, volume_listers=self.volume_listers,
             volume_binder=self.volume_binder,
             services_fn=self.services_fn)
+        cfgs = self._oracle_cfgs
+        if self._oracle_cfgs_prof is not None:
+            cfgs = self._oracle_cfgs_prof[self._profile_id(pod)]
+        if extra_configs:
+            cfgs = list(cfgs) + list(extra_configs)
         try:
             return o.schedule(pod, node_infos, all_node_names,
                               predicate_funcs=funcs,
-                              priority_configs=self._oracle_cfgs)
+                              priority_configs=cfgs)
         finally:
             self.last_index = o.last_index
             self.last_node_index = o.last_node_index
@@ -553,11 +638,18 @@ class TPUScheduler:
         return seam
 
     def schedule(self, pod: Pod, node_infos: dict[str, NodeInfo],
-                 all_node_names: list[str]) -> ScheduleResult:
+                 all_node_names: list[str],
+                 extra_configs=None) -> ScheduleResult:
         if not all_node_names:
             raise FitError(pod, 0, {})
         self._serial_cycles += 1
-        if self.nominated is not None and self.nominated.has_any():
+        if extra_configs:
+            # trial-scoped extra priorities (the rank-aware gang serial
+            # referee's GangLocalityPriority, bound to live trial state):
+            # the host twin IS the reference for that objective
+            use_twin = True
+            reason = "gang-locality-serial"
+        elif self.nominated is not None and self.nominated.has_any():
             use_twin = True     # two-pass ghost-pod fitting lives on the twin
             reason = "nominated-ghosts"
         elif not self.breaker.allow_device():
@@ -575,7 +667,9 @@ class TPUScheduler:
         t0 = _time.perf_counter()
         try:
             if use_twin:
-                return self._schedule_host_twin(pod, node_infos, all_node_names)
+                return self._schedule_host_twin(pod, node_infos,
+                                                all_node_names,
+                                                extra_configs=extra_configs)
             try:
                 return self._schedule_device(pod, node_infos, all_node_names)
             except _DEVICE_FAULTS as e:
@@ -607,6 +701,14 @@ class TPUScheduler:
                          state_encoder=self.encoder)
         feats = enc.encode(pod)
         pod_in = self._pod_arrays(feats, b.n_pad)
+        wtab = None
+        weights = self.weights
+        if self._ptab is not None:
+            # tensor mode: the pod's profile row is gathered on device —
+            # one compiled cycle program scores every profile
+            pod_in["profile_id"] = np.int64(self._profile_id(pod))
+            wtab = self._wtab()
+            weights = self._union_weights
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(n, self.percentage_of_nodes_to_score)
         z_pad = _pad_pow2(len(b.zone_names), 4)
@@ -615,18 +717,27 @@ class TPUScheduler:
             # node axis split over the chips; collectives ride ICI and the
             # select epilogue replicates (parallel/sharding.py)
             from kubernetes_tpu.parallel import sharding as S
-            if self._sharded_cycle is None or self._sharded_cycle[0] != z_pad:
-                self._sharded_cycle = (z_pad, S.sharded_cycle_fn(
-                    self.mesh, z_pad=z_pad, weights=self.weights))
+            ckey = (z_pad, wtab is not None)
+            if self._sharded_cycle is None or self._sharded_cycle[0] != ckey:
+                self._sharded_cycle = (ckey, S.sharded_cycle_fn(
+                    self.mesh, z_pad=z_pad, weights=weights,
+                    use_wtab=wtab is not None))
             pod_sharded = S.shard_pod_arrays(self.mesh, pod_in)
-            out = self._sharded_cycle[1](
-                nodes, pod_sharded,
-                K._i64(self.last_index), K._i64(self.last_node_index),
-                K._i64(num_to_find), K._i64(n))
+            if wtab is not None:
+                out = self._sharded_cycle[1](
+                    nodes, pod_sharded,
+                    K._i64(self.last_index), K._i64(self.last_node_index),
+                    K._i64(num_to_find), K._i64(n), wtab)
+            else:
+                out = self._sharded_cycle[1](
+                    nodes, pod_sharded,
+                    K._i64(self.last_index), K._i64(self.last_node_index),
+                    K._i64(num_to_find), K._i64(n))
         else:
             out = K.schedule_cycle(nodes, pod_in, self.last_index,
                                    self.last_node_index,
-                                   num_to_find, n, z_pad, weights=self.weights)
+                                   num_to_find, n, z_pad, weights=weights,
+                                   wtab=wtab)
         # ONE device->host fetch for everything the decision needs: each
         # separate readback pays a full dispatch round trip (ruinous over a
         # tunneled device), so the scalars and per-node vectors come back
@@ -1176,7 +1287,14 @@ class TPUScheduler:
         sigs = self._signatures(pods)
         s0 = sigs[0]
         uniform_spec = all(s is s0 or s == s0 for s in sigs)
-        if num_to_find >= n and self.last_index == 0:
+        # tensor mode: per-pod profile ids (row-cache gather); a uniform
+        # window must be single-PROFILE too — different weight rows change
+        # the tie structure the K-batch modes rely on, so mixed-profile
+        # windows ride the generic scan (which gathers rows per pod)
+        pids = self._profile_ids(pods)
+        pid0 = 0 if pids is None else int(pids[0])
+        uniform_profile = pids is None or int(pids.min()) == int(pids.max())
+        if num_to_find >= n and self.last_index == 0 and uniform_profile:
             # spec-identical pods produce identical encoder output against a
             # fixed snapshot, so the uniform path encodes ONE pod — per-pod
             # feature encoding (IPA topology counting in particular) is the
@@ -1196,7 +1314,8 @@ class TPUScheduler:
                                            all_node_names, node_infos)
             _t = _obs("encode", _t0)
             sel = self._uniform_waves(pods, b, cls, extra_ok, ban, rotation,
-                                      n, commit, _obs, _t, bucket, fl=fl)
+                                      n, commit, _obs, _t, bucket, fl=fl,
+                                      pid=pid0)
             if sel is None:
                 # device fault during a commit-less trial: whole-burst
                 # refusal (nothing committed, counters rewound)
@@ -1287,6 +1406,12 @@ class TPUScheduler:
         # spread counts, and the single-dispatch/single-fetch contract all
         # run sharded — the old burst-sharded-rotation / burst-sharded-
         # spread oracle fallbacks are deleted, not dodged.
+        if pids is not None:
+            # per-pod weight-row selection: shallow per-pod dicts so the
+            # varying profile_id stacks while every other field keeps its
+            # identity-broadcast (equal sigs still share field objects)
+            per_pod = [dict(pp, profile_id=np.int64(pids[i]))
+                       for i, pp in enumerate(per_pod)]
         fl = obs_flight.RECORDER.begin("scan", self, [(pods, False)],
                                        all_node_names, node_infos)
         _t = _obs("encode", _t0)
@@ -1297,7 +1422,7 @@ class TPUScheduler:
     def _uniform_waves(self, pods: list[Pod], b: NodeBatch, cls, extra_ok,
                        ban: bool, rotation, n: int, commit, _obs,
                        _t: float, bucket: int,
-                       fl=None) -> Optional[list]:
+                       fl=None, pid: int = 0) -> Optional[list]:
         """Single-launch driver for the uniform kernel: the ENTIRE burst
         (up to B_CAP; larger bursts chunk, with chunk k's fetch+commit
         overlapping chunk k+1's device execution) is ONE dispatch and ONE
@@ -1343,10 +1468,13 @@ class TPUScheduler:
                 rot = (rotation[0], win)
             t_d = obs_trace.now()
             chaos.check("device.dispatch")
+            tensor = self._ptab is not None
             rows, packed, lni_out = K.schedule_batch_uniform(
                 self._dev_nodes, dict(cls), chunk, lni_dev, n,
-                self.check_resources, weights=self.weights, rotation=rot,
-                extra_ok=extra_ok, ban=ban, mesh=self.mesh, cap=cap)
+                self.check_resources,
+                weights=self._union_weights if tensor else self.weights,
+                rotation=rot, extra_ok=extra_ok, ban=ban, mesh=self.mesh,
+                cap=cap, wtab=self._wtab() if tensor else None, pid=pid)
             self._note_ici("burst_uniform", chunk, b.n_pad)
             lni_dev = lni_out
             self._dev_nodes = {**self._dev_nodes, **rows}
@@ -1522,11 +1650,13 @@ class TPUScheduler:
         t_d = obs_trace.now()
         try:
             chaos.check("device.dispatch")
+            tensor = self._ptab is not None
             state, _li_out, _lni_out, _spread, outs = K.schedule_batch(
                 self._dev_nodes, stacked, self.last_index,
                 self.last_node_index, num_to_find, n, z_pad,
-                weights=self.weights, rotation=rot,
-                spread0=spread0, rotation_pos=rotp, mesh=self.mesh)
+                weights=self._union_weights if tensor else self.weights,
+                rotation=rot, spread0=spread0, rotation_pos=rotp,
+                mesh=self.mesh, wtab=self._wtab() if tensor else None)
             self._note_ici("burst_scan", n_pods, b.n_pad)
             DEVICE_DISPATCH.labels("burst_scan").inc()
             _t = _obs("kernel", _t)
@@ -1733,6 +1863,12 @@ class TPUScheduler:
                 pp = arr_by_sig[sig] = self._pod_arrays(
                     f, b.n_pad, upd_fields=True, pod=p)
             per_pod.append(pp)
+        pids = self._profile_ids(flat)
+        if pids is not None:
+            # tensor mode: each pod selects its weight row in-kernel; the
+            # shallow dict keeps every other field identity-broadcastable
+            per_pod = [dict(pp, profile_id=np.int64(pids[i]))
+                       for i, pp in enumerate(per_pod)]
         n = b.n_real
         num_to_find = num_feasible_nodes_to_find(
             n, self.percentage_of_nodes_to_score)
@@ -1771,11 +1907,14 @@ class TPUScheduler:
         t_d = obs_trace.now()
         try:
             chaos.check("device.dispatch")
+            tensor = self._ptab is not None
             state, _li, _lni, _spread, packed = K.schedule_batch_segments(
                 nodes, stacked, seg_start, gang, n_total, self.last_index,
                 self.last_node_index, num_to_find, n, z_pad,
-                weights=self.weights, rotation=rotation,
-                rotation_pos=rotation_pos, mesh=self.mesh)
+                weights=self._union_weights if tensor else self.weights,
+                rotation=rotation, rotation_pos=rotation_pos,
+                mesh=self.mesh, wtab=self._wtab() if tensor else None,
+                gang_score=self._gang_score)
             self._note_ici("burst_fused", n_total, b.n_pad)
             DEVICE_DISPATCH.labels("burst_fused").inc()
             _t = _obs("kernel", _t)
@@ -2207,6 +2346,17 @@ class TPUScheduler:
                 # the pressure scan doesn't carry spread counts
                 PRESSURE_GATES.labels("spread-selectors").inc()
                 return None
+        press_weights = self.weights
+        if self._ptab is not None:
+            # tensor mode: the pressure kernel scores with ONE static
+            # per-profile row (its ghost/victim machinery has no per-pod
+            # row gather); a mixed-profile tail degrades to the serial
+            # loop, whose per-pod twin configs are exact
+            pids = self._profile_ids(pods)
+            if int(pids.min()) != int(pids.max()):
+                PRESSURE_GATES.labels("profile-mixed").inc()
+                return None
+            press_weights = self._profile_static[int(pids[0])]
         vic, slots, gate = self._victim_inputs(node_infos, b, all_node_names,
                                                prios[0], pdbs)
         if vic is None:
@@ -2257,7 +2407,7 @@ class TPUScheduler:
                 stacked = self._stack_pods(chunk)
                 mut0, ghost0, li, lni, outs = K.pressure_batch(
                     nodes, mut0, ghost0, stacked, vic, li, lni, num_to_find,
-                    n, z_pad, weights=self.weights, mesh=self.mesh)
+                    n, z_pad, weights=press_weights, mesh=self.mesh)
                 self._note_ici("pressure_batch", len(chunk), b.n_pad)
                 DEVICE_DISPATCH.labels("pressure_batch").inc()
                 outs_chunks.append(outs)

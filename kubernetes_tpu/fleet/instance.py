@@ -53,7 +53,13 @@ class FleetScheduler(Scheduler):
     FleetScheduler is just a Scheduler); FleetInstance swaps in the
     claim check. The filter is consulted at informer delivery time
     through `_responsible_for`, so claim changes take effect at the next
-    pump without re-registering handlers."""
+    pump without re-registering handlers.
+
+    With a round-19 ProfileSet attached, responsibility stays pinned to
+    the instance's CLAIMED profile (self.name) — the set only supplies
+    scoring: the claimed profile's weight-tensor row scores every owned
+    pod, so fleet tenants get real per-tenant scheduler classes while
+    partitioning semantics are untouched."""
 
     _partition_filter = staticmethod(lambda pod: True)
 
@@ -74,13 +80,17 @@ class FleetInstance:
                  lease_duration: float = 6.0,
                  renew_deadline: float = 4.0,
                  claims=None,
+                 profiles=None,
                  **sched_kw):
         self.identity = identity
         self.profile = profile
         self.n_shards = int(n_shards)
+        if profiles is not None and profiles.index_of(profile) is None:
+            raise ValueError(
+                f"claimed profile {profile!r} is not in the ProfileSet")
         self.sched = FleetScheduler(
             store, scheduler_name=profile, use_tpu=use_tpu, clock=clock,
-            **sched_kw)
+            profiles=profiles, **sched_kw)
         self.claims = claims if claims is not None else ShardClaimSet(
             store, profile, identity, peers, n_shards=n_shards,
             clock=self.sched.clock, lease_duration=lease_duration,

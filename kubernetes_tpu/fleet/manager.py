@@ -85,11 +85,17 @@ class FleetManager:
 
     def __init__(self, store: Store, identities: list,
                  make_instance: Callable[[str], object],
-                 clock=None, record: bool = False):
+                 clock=None, record: bool = False, profiles=None):
         self.store = store
         self.clock = clock
         self.identities = list(identities)
         self.make_instance = make_instance
+        # round-19 scheduling profiles: when the fleet serves a
+        # ProfileSet, create_pods REPORTS arrivals whose schedulerName no
+        # fleet profile claims (scheduler_profile_unknown_total + event)
+        # — such a pod would otherwise sit unowned forever, silently
+        self.profiles = profiles
+        self._recorder = None
         self.instances = {}
         for ident in self.identities:
             inst = make_instance(ident)
@@ -109,12 +115,21 @@ class FleetManager:
     # -- recorded world inputs ----------------------------------------------
     def create_pods(self, pods: list) -> None:
         """Arrival batch: written to the store AND recorded (clones), so
-        the replay feeds the identical sequence."""
+        the replay feeds the identical sequence. Pods whose schedulerName
+        no fleet profile claims are reported (never default-scored; they
+        stay pending until a profile claims them)."""
         if self.timeline is not None:
             self.timeline.append(
                 {"op": "create", "pods": [p.clone() for p in pods]})
         for pod in pods:
             self.store.create(PODS, pod)
+            if self.profiles is not None \
+                    and self.profiles.index_of(pod.scheduler_name) is None:
+                if self._recorder is None:
+                    from kubernetes_tpu.store.record import EventRecorder
+                    self._recorder = EventRecorder(
+                        self.store, component="fleet-manager")
+                self.profiles.report_unknown(pod, recorder=self._recorder)
 
     def advance_clock(self, dt: float) -> None:
         if self.clock is None:

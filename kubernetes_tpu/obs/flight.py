@@ -115,6 +115,11 @@ class FlightRecorder:
                 "enabled": (None if algo.enabled_predicates is None
                             else set(algo.enabled_predicates)),
                 "weights": algo.priority_name_weights,
+                # round-19 scheduling profiles: the set is decision
+                # INPUT (per-pod weight rows + the rank-aware gang
+                # objective), so replay must select configs per pod the
+                # same way (the set is immutable once validated)
+                "profiles": getattr(algo, "profiles", None),
             }
         rec = BurstRecord(
             kind, [(list(seg), bool(g)) for seg, g in segments],
@@ -232,16 +237,29 @@ class FlightRecorder:
             hard_pod_affinity_weight=hpaw,
             nominated_pods_fn=lambda _n: [])
         oracle.last_index, oracle.last_node_index = rec.li, rec.lni
-        if cap["weights"] is not None:
+        profiles = cap.get("profiles")
+        if profiles is not None:
+            prof_cfgs = [profiles.oracle_configs(
+                i, services_fn=lambda: services,
+                replicasets_fn=lambda: replicasets,
+                hard_pod_affinity_weight=hpaw)
+                for i in range(len(profiles))]
+
+            def cfgs_for(pod):
+                pid = profiles.index_of(pod.scheduler_name)
+                return prof_cfgs[0 if pid is None else pid]
+        elif cap["weights"] is not None:
             cfgs = build_priority_configs(
                 cap["weights"], services_fn=lambda: services,
                 replicasets_fn=lambda: replicasets,
                 hard_pod_affinity_weight=hpaw)
+            cfgs_for = lambda _pod: cfgs
         else:
             cfgs = default_priority_configs(
                 services_fn=lambda: services,
                 replicasets_fn=lambda: replicasets,
                 hard_pod_affinity_weight=hpaw)
+            cfgs_for = lambda _pod: cfgs
         pred_names = (sorted(cap["enabled"]) if cap["enabled"]
                       else DEFAULT_PREDICATE_NAMES)
         t_consumed = 0   # enumerations consumed (the kernel's carried t)
@@ -257,13 +275,27 @@ class FlightRecorder:
             t_consumed += 1
             return ns
 
-        def run_pod(pod) -> Optional[str]:
+        def run_pod(pod, gang_zones=None) -> Optional[str]:
             funcs = build_predicate_set(
                 pred_names, infos, services_fn=lambda: services)
+            pod_cfgs = cfgs_for(pod)
+            gw = (profiles.gang_weight_for(pod.scheduler_name)
+                  if profiles is not None and gang_zones is not None else 0)
+            if gw:
+                # rank-aware gang set-scoring: the replay's twin of the
+                # kernel's per-segment zone-count carry
+                from kubernetes_tpu.oracle import priorities as prios
+                from kubernetes_tpu.oracle.generic_scheduler import (
+                    PriorityConfig)
+                pod_cfgs = list(pod_cfgs) + [PriorityConfig(
+                    "GangLocalityPriority", gw,
+                    function=lambda _p, nis, nodes: [
+                        prios.gang_locality_map(gang_zones, nis[n.name])
+                        for n in nodes])]
             try:
                 r = oracle.schedule(pod, infos, take_names(),
                                     predicate_funcs=funcs,
-                                    priority_configs=cfgs)
+                                    priority_configs=pod_cfgs)
             except FitError:
                 return None
             host = r.suggested_host
@@ -272,6 +304,12 @@ class FlightRecorder:
             ni = infos[host].clone()
             ni.add_pod(assumed)
             infos[host] = ni
+            if gang_zones is not None:
+                from kubernetes_tpu.api.types import get_zone_key
+                node = infos[host].node
+                z = get_zone_key(node) if node is not None else ""
+                if z:
+                    gang_zones[z] = gang_zones.get(z, 0) + 1
             return host
 
         # normalize: uniform/scan records are one non-gang segment
@@ -292,8 +330,9 @@ class FlightRecorder:
                        None if tree is None else tree.checkpoint())
                 hosts: list = []
                 fail_at = None
+                gang_zones: dict = {}   # per-segment zone-count tracker
                 for i, p in enumerate(seg_pods):
-                    h = run_pod(p)
+                    h = run_pod(p, gang_zones=gang_zones)
                     if h is None:
                         fail_at = i
                         break   # the kernel skips the rest of the segment

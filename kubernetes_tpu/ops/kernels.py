@@ -70,6 +70,30 @@ DEFAULT_WEIGHTS = {
     "image_locality": 1,
 }
 
+# profile scoring tensor (round 19): column order of the
+# [profiles x priorities] int64 weight table the profile-aware kernels
+# gather per-pod rows from (`wtab[pod["profile_id"]]`). The last column,
+# "gang_locality", is the rank-aware gang set-scoring objective — zero
+# for placement-blind profiles, so the default row reproduces today's
+# scoring exactly. profiles.ProfileSet.weight_table() builds tables in
+# THIS order; changing it is a wire-format change for resident tensors.
+PRIORITY_AXIS = ("selector_spread", "interpod", "least_requested",
+                 "most_requested", "rtcr", "balanced", "prefer_avoid",
+                 "node_affinity", "taint_toleration", "image_locality",
+                 "gang_locality")
+_AXIS_INDEX = {n: i for i, n in enumerate(PRIORITY_AXIS)}
+
+
+def _wsel(weights, wrow, name):
+    """Effective weight of one priority family: the static python int
+    (single-profile path — folds at trace time, today's programs) or the
+    pod's gathered tensor-row lane (tensor mode — the STATIC `weights`
+    dict then only gates which families compile in: a family any profile
+    weights is computed once and scaled per pod, including to zero)."""
+    if wrow is None:
+        return weights[name]
+    return wrow[_AXIS_INDEX[name]]
+
 
 def _i64(x):
     return jnp.asarray(x, dtype=jnp.int64)
@@ -83,26 +107,29 @@ def _inert(arr) -> bool:
     return arr.ndim >= 1 and arr.shape[-1] == 1
 
 
-def _local_total(weights, req_cpu, req_mem, alloc_cpu, alloc_mem):
+def _local_total(weights, req_cpu, req_mem, alloc_cpu, alloc_mem,
+                 wrow=None):
     """The four row-local resource priorities (least/most/RTCR/balanced),
     exact integer/float formulas. `req_*` is pod-nonzero + node-nonzero.
     Works elementwise on [N] vectors and on single-row scalars — both the
     full-cycle kernel and the uniform-burst incremental rescore call this,
-    so the two paths cannot drift."""
+    so the two paths cannot drift. `wrow` (optional) is one pod's gathered
+    [K] weight-tensor row: families gate on the STATIC `weights` union and
+    scale by the traced lane (_wsel)."""
     total = jnp.zeros_like(alloc_cpu)
 
     if weights["least_requested"]:
         def least(req, cap):
             ok = (cap > 0) & (req <= cap)
             return jnp.where(ok, (cap - req) * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
-        total = total + weights["least_requested"] * (
+        total = total + _wsel(weights, wrow, "least_requested") * (
             (least(req_cpu, alloc_cpu) + least(req_mem, alloc_mem)) // 2)
 
     if weights["most_requested"]:
         def most(req, cap):
             ok = (cap > 0) & (req <= cap)
             return jnp.where(ok, req * MAX_PRIORITY // jnp.maximum(cap, 1), 0)
-        total = total + weights["most_requested"] * (
+        total = total + _wsel(weights, wrow, "most_requested") * (
             (most(req_cpu, alloc_cpu) + most(req_mem, alloc_mem)) // 2)
 
     if weights["rtcr"]:
@@ -113,7 +140,7 @@ def _local_total(weights, req_cpu, req_mem, alloc_cpu, alloc_mem):
             p = jnp.where((cap == 0) | (req > cap), 100,
                           100 - (cap - req) * 100 // jnp.maximum(cap, 1))
             return 10 - (10 * p) // 100
-        total = total + weights["rtcr"] * (
+        total = total + _wsel(weights, wrow, "rtcr") * (
             (rtcr_res(req_cpu, alloc_cpu) + rtcr_res(req_mem, alloc_mem)) // 2)
 
     if weights["balanced"]:
@@ -122,26 +149,48 @@ def _local_total(weights, req_cpu, req_mem, alloc_cpu, alloc_mem):
         balanced = jnp.where(
             (cpu_f >= 1.0) | (mem_f >= 1.0), 0,
             ((1.0 - jnp.abs(cpu_f - mem_f)) * float(MAX_PRIORITY)).astype(jnp.int64))
-        total = total + weights["balanced"] * balanced
+        total = total + _wsel(weights, wrow, "balanced") * balanced
 
     return total
 
 
-def _fit_scores(nodes, pod, kept, weights, z_pad):
+def _fit_scores(nodes, pod, kept, weights, z_pad, wrow=None, gang=None):
     """Enabled priorities, masked-normalized over `kept`. Returns total[N] i64.
 
     Zero-weight priorities and inert (default-valued, shape-[1]) pod fields
     are skipped at trace time: a plain-pod burst compiles down to
     LeastRequested + BalancedAllocation + integer constants — int64 division
     and f64 emulation on the MXU-less VPU path are the cost drivers, so ops
-    that provably contribute a constant are folded into one scalar."""
+    that provably contribute a constant are folded into one scalar.
+
+    `wrow` (tensor mode) is this pod's [K] weight row — the STATIC
+    `weights` dict becomes the cross-profile union gate and every family
+    scales by its lane. `gang` = (gz[z_pad], member) is the rank-aware
+    gang set-scoring input: gz counts THIS segment's already-placed
+    members per zone, and nodes score min(count, 10) * gang weight — the
+    group objective that prefers packing a gang into few zones, via the
+    same one-hot zone reduction the spread family uses."""
     alloc_cpu, alloc_mem = nodes["alloc_cpu"], nodes["alloc_mem"]
     req_cpu = pod["nz_cpu"] + nodes["nz_cpu"]
     req_mem = pod["nz_mem"] + nodes["nz_mem"]
 
     const = 0   # python-int accumulator for provably-constant scores
     total = jnp.zeros(nodes["valid"].shape, dtype=jnp.int64) + _local_total(
-        weights, req_cpu, req_mem, alloc_cpu, alloc_mem)
+        weights, req_cpu, req_mem, alloc_cpu, alloc_mem, wrow=wrow)
+
+    if gang is not None and weights.get("gang_locality"):
+        # gang-locality (rank-aware set-scoring): zone member counts of the
+        # current gang segment, gathered per node through a dense one-hot
+        # [N, Z] reduction (no scatter/gather serialization), clipped at
+        # MAX_PRIORITY like every integer priority. Zone 0 = "no zone"
+        # scores 0; non-members contribute and read nothing.
+        gz, gmember = gang
+        zone_id = nodes["zone_id"]
+        gw = _wsel(weights, wrow, "gang_locality")
+        zh = zone_id[:, None] == jnp.arange(z_pad, dtype=zone_id.dtype)[None, :]
+        glc = jnp.sum(jnp.where(zh, gz[None, :], 0), axis=1)
+        gl = jnp.minimum(glc, MAX_PRIORITY)
+        total = total + jnp.where(gmember & (zone_id > 0), gw * gl, 0)
 
     if weights["node_affinity"]:
         na = pod["node_aff_counts"]
@@ -150,17 +199,18 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
         else:
             # NodeAffinity: NormalizeReduce(10, reverse=False) over kept
             na_max = jnp.max(jnp.where(kept, na, 0))
-            total = total + weights["node_affinity"] * jnp.where(
+            total = total + _wsel(weights, wrow, "node_affinity") * jnp.where(
                 na_max == 0, na, MAX_PRIORITY * na // jnp.maximum(na_max, 1))
 
     if weights["taint_toleration"]:
         tt = pod["taint_counts"]
         if _inert(tt):
-            const += weights["taint_toleration"] * MAX_PRIORITY
+            const = const + _wsel(weights, wrow, "taint_toleration") \
+                * MAX_PRIORITY
         else:
             # TaintToleration: NormalizeReduce(10, reverse=True) over kept
             tt_max = jnp.max(jnp.where(kept, tt, 0))
-            total = total + weights["taint_toleration"] * jnp.where(
+            total = total + _wsel(weights, wrow, "taint_toleration") * jnp.where(
                 tt_max == 0, MAX_PRIORITY,
                 MAX_PRIORITY - MAX_PRIORITY * tt // jnp.maximum(tt_max, 1))
 
@@ -168,7 +218,8 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
         sc = pod["spread_counts"]
         if _inert(sc):
             # all counts 0 -> node and zone fractions are both max -> 10
-            const += weights["selector_spread"] * MAX_PRIORITY
+            const = const + _wsel(weights, wrow, "selector_spread") \
+                * MAX_PRIORITY
         else:
             # SelectorSpread: node + zone blend (selector_spreading.go:99).
             # Zone aggregation runs as dense one-hot [N, Z] reductions —
@@ -199,7 +250,8 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
                            float(MAX_PRIORITY))
             f = jnp.where(have_zones & (zone_id > 0),
                           f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zs, f)
-            total = total + weights["selector_spread"] * f.astype(jnp.int64)
+            total = total + _wsel(weights, wrow, "selector_spread") \
+                * f.astype(jnp.int64)
 
     if weights["interpod"]:
         ic = pod["interpod_counts"]
@@ -214,7 +266,7 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
             ic_min = jnp.minimum(
                 jnp.min(jnp.where(sel, ic, jnp.iinfo(jnp.int64).max)), 0)
             diff = ic_max - ic_min
-            total = total + weights["interpod"] * jnp.where(
+            total = total + _wsel(weights, wrow, "interpod") * jnp.where(
                 (diff > 0) & tracked,
                 (float(MAX_PRIORITY) * ((ic - ic_min)
                                         / jnp.maximum(diff, 1))).astype(jnp.int64),
@@ -227,15 +279,16 @@ def _fit_scores(nodes, pod, kept, weights, z_pad):
         else:
             # ImageLocality (image_locality.go:42)
             sc = jnp.clip(s, IMAGE_MIN, IMAGE_MAX)
-            total = total + weights["image_locality"] * (
+            total = total + _wsel(weights, wrow, "image_locality") * (
                 MAX_PRIORITY * (sc - IMAGE_MIN) // (IMAGE_MAX - IMAGE_MIN))
 
     if weights["prefer_avoid"]:
         pa = pod["prefer_avoid"]
         if _inert(pa):
-            const += weights["prefer_avoid"] * MAX_PRIORITY
+            const = const + _wsel(weights, wrow, "prefer_avoid") \
+                * MAX_PRIORITY
         else:
-            total = total + weights["prefer_avoid"] * pa
+            total = total + _wsel(weights, wrow, "prefer_avoid") * pa
 
     return total + const
 
@@ -305,7 +358,7 @@ def _feasibility(nodes, pod):
 
 def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
                 weights, z_pad, perm=None, inv_perm=None, pos=None,
-                ghost=None):
+                ghost=None, wtab=None, gang=None):
     """One fused cycle. The reference's sequential walk from last_index
     (generic_scheduler.go:486,519) is emulated WITHOUT materializing the
     rotation permutation: for natural index j, its 1-based rank in rotation
@@ -327,7 +380,13 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
     kept == feasible and evaluated == n, so the only order-dependent step
     is selectHost's k-th-tie pick — resolved by one [N] sort of tie
     positions instead of the three [N] gathers of the perm path, which
-    serialize badly on TPU (30x per-cycle cost at 1k nodes)."""
+    serialize badly on TPU (30x per-cycle cost at 1k nodes).
+
+    `wtab` (tensor mode) is the resident [profiles x priorities] weight
+    table; this pod's row is gathered by `pod["profile_id"]` and every
+    score family scales by its lane (the static `weights` dict gates
+    which families compile in — the cross-profile union). `gang` threads
+    the rank-aware gang set-scoring input into _fit_scores."""
     n_pad = nodes["valid"].shape[0]
     i32 = jnp.int32
     i = jnp.arange(n_pad, dtype=i32)
@@ -382,7 +441,9 @@ def _cycle_core(nodes, pod, last_index, last_node_index, num_to_find, n_real,
         # a skip (bucket-padding) pod consumes no rotation state
         evaluated = jnp.where(pod["skip"], 0, evaluated).astype(jnp.int64)
 
-    total = _fit_scores(nodes, pod, kept, weights, z_pad)
+    wrow = None if wtab is None else wtab[pod["profile_id"]]
+    total = _fit_scores(nodes, pod, kept, weights, z_pad, wrow=wrow,
+                        gang=gang)
 
     tmask = jnp.where(kept, total, jnp.iinfo(jnp.int64).min)
     max_score = jnp.max(tmask)
@@ -438,12 +499,27 @@ def _schedule_cycle_jit(nodes, pod, last_index, last_node_index, num_to_find,
                        n_real, weights, z_pad)
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple"))
+def _schedule_cycle_wtab_jit(nodes, pod, wtab, last_index, last_node_index,
+                             num_to_find, n_real, z_pad, weights_tuple):
+    return _cycle_core(nodes, pod, last_index, last_node_index, num_to_find,
+                       n_real, dict(weights_tuple), z_pad, wtab=wtab)
+
+
 def schedule_cycle(nodes, pod, last_index, last_node_index, num_to_find, n_real,
-                   z_pad, weights=None):
+                   z_pad, weights=None, wtab=None):
     """One scheduling cycle. `nodes`/`pod` are dicts of device arrays.
     (Nominated-ghost cycles run only inside the pressure batch —
-    _pressure_batch_jit — which calls _cycle_core with its carried ghost.)"""
+    _pressure_batch_jit — which calls _cycle_core with its carried ghost.)
+
+    `wtab` (tensor mode) is the resident [P, K] profile weight table;
+    `pod` must then carry `profile_id` and `weights` is the static union
+    gate dict — ONE compiled program scores every profile."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    if wtab is not None:
+        return _schedule_cycle_wtab_jit(
+            nodes, pod, wtab, _i64(last_index), _i64(last_node_index),
+            _i64(num_to_find), _i64(n_real), z_pad, weights_tuple)
     return _schedule_cycle_jit(
         nodes, pod, _i64(last_index), _i64(last_node_index), _i64(num_to_find),
         _i64(n_real), z_pad, weights_tuple)
@@ -493,13 +569,16 @@ def _fold_state(state, pod, sel, hit):
 def _batch_core(nodes, mut0, pods, last_index, last_node_index,
                 num_to_find, n_real, perms, inv_perms, oid_seq,
                 spread0, z_pad, weights, rotate, carry_spread,
-                rotate_pos=False, constrain=None):
+                rotate_pos=False, constrain=None, wtab=None):
     """Body of the generic lax.scan burst kernel. `constrain` (optional)
     pins the node-axis carry — the mutable state rows and the carried
     spread vector — to a mesh sharding every iteration, so the O(N) sweep
     stays split across chips while the scalar select epilogue replicates
     (parallel/sharding.py wraps this for mesh mode; None = single-chip
-    identity, the exact program the jit wrapper below compiles)."""
+    identity, the exact program the jit wrapper below compiles). `wtab`
+    (tensor mode) makes the scan profile-aware: `pods["profile_id"]` [B]
+    rides the xs, and each step's cycle gathers that pod's weight row —
+    a window MIXING tenants scores in the one launch."""
     if constrain is None:
         constrain = lambda v: v
     static = {k: v for k, v in nodes.items() if k not in _MUTABLE}
@@ -526,7 +605,8 @@ def _batch_core(nodes, mut0, pods, last_index, last_node_index,
             pod = {**pod, "spread_counts": spread}
         full = {**static, **state}
         out = _cycle_core(full, pod, li, lni, num_to_find, n_real, weights,
-                          z_pad, perm=perm, inv_perm=inv_perm, pos=pos)
+                          z_pad, perm=perm, inv_perm=inv_perm, pos=pos,
+                          wtab=wtab)
         sel = out["selected"]
         hit = out["found"] > 0
         new_state = constrain(_fold_state(state, pod, sel, hit))
@@ -572,9 +652,22 @@ def _schedule_batch_jit(nodes, mut0, pods, last_index, last_node_index,
                        carry_spread, rotate_pos=rotate_pos)
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rotate",
+                                   "carry_spread", "rotate_pos"))
+def _schedule_batch_wtab_jit(nodes, mut0, pods, wtab, last_index,
+                             last_node_index, num_to_find, n_real, perms,
+                             inv_perms, oid_seq, spread0, z_pad,
+                             weights_tuple, rotate, carry_spread,
+                             rotate_pos=False):
+    return _batch_core(nodes, mut0, pods, last_index, last_node_index,
+                       num_to_find, n_real, perms, inv_perms, oid_seq,
+                       spread0, z_pad, dict(weights_tuple), rotate,
+                       carry_spread, rotate_pos=rotate_pos, wtab=wtab)
+
+
 def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real,
                    z_pad, weights=None, rotation=None, spread0=None,
-                   rotation_pos=None, carry_in=None, mesh=None):
+                   rotation_pos=None, carry_in=None, mesh=None, wtab=None):
     """Schedule a burst of pods against one snapshot, decisions serially
     equivalent to per-pod cycles. `pods` is a dict of [B, ...] arrays.
 
@@ -604,7 +697,11 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
     epilogue's tiny per-node vectors riding an ICI all-gather — sharded vs
     single-device is one code path parameterized by the sharding spec, so
     decisions are bit-identical by construction (pinned by
-    tests/test_sharding.py + the sharded fuzz variants)."""
+    tests/test_sharding.py + the sharded fuzz variants).
+
+    `wtab` (tensor mode) is the [P, K] profile weight table (PRIORITY_AXIS
+    columns); `pods` must then carry a `profile_id` [B] column and
+    `weights` the static cross-profile union gate dict."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -628,14 +725,28 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
         mut0 = {k: nodes[k] for k in _MUTABLE}
         s0 = jnp.asarray(spread0, jnp.int64) if spread0 is not None \
             else jnp.zeros((), jnp.int64)
+    if wtab is not None:
+        wtab = jnp.asarray(wtab, jnp.int64)
     if mesh is not None:
         from kubernetes_tpu.parallel import sharding as S
         fn = S.sharded_scan_fn(mesh, z_pad, weights_tuple,
                                rotation is not None, carry_spread,
-                               rotation_pos is not None)
+                               rotation_pos is not None,
+                               use_wtab=wtab is not None)
+        if wtab is not None:
+            return fn(nodes, mut0, pods, wtab, _i64(last_index),
+                      _i64(last_node_index), _i64(num_to_find),
+                      _i64(n_real), perms, inv_perms, oid_seq, s0)
         return fn(nodes, mut0, pods, _i64(last_index),
                   _i64(last_node_index), _i64(num_to_find), _i64(n_real),
                   perms, inv_perms, oid_seq, s0)
+    if wtab is not None:
+        return _schedule_batch_wtab_jit(
+            nodes, mut0, pods, wtab, _i64(last_index),
+            _i64(last_node_index), _i64(num_to_find), _i64(n_real), perms,
+            inv_perms, oid_seq, s0, z_pad, weights_tuple,
+            rotation is not None, carry_spread,
+            rotate_pos=rotation_pos is not None)
     return _schedule_batch_jit(
         nodes, mut0, pods, _i64(last_index), _i64(last_node_index),
         _i64(num_to_find), _i64(n_real), perms, inv_perms, oid_seq, s0,
@@ -674,7 +785,8 @@ def schedule_batch(nodes, pods, last_index, last_node_index, num_to_find, n_real
 def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
                    last_index, last_node_index, num_to_find, n_real,
                    perms, inv_perms, oid_seq, spread0, z_pad,
-                   weights, rot_mode, carry_spread, constrain=None):
+                   weights, rot_mode, carry_spread, constrain=None,
+                   wtab=None, gang_score=False):
     """rot_mode: 0 = stable axis order, 1 = perm/inv-perm gathers,
     2 = gather-free positions (full-scan regime).
 
@@ -690,7 +802,17 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
     mode; None = single-chip identity). The checkpoint/rewind pick() is a
     per-element where over identically-sharded operands, so a gang rewind
     stays shard-local — no collective beyond the select epilogue's
-    all-gather."""
+    all-gather.
+
+    `wtab`/`gang_score` (round 19): profile weight-tensor gathering per
+    pod, plus the rank-aware gang set-scoring carry — a tiny [z_pad]
+    zone-count vector `gz` rides the live carry (and therefore the gang
+    checkpoint/rewind machinery for free): it RESETS at every segment
+    start, each placed GANG member one-hot-folds its node's zone, and
+    later members of the same segment score nodes by
+    min(members_in_zone, 10) * the member's profile gang weight
+    (_fit_scores). A rewound gang restores gz with the rest of the
+    carry; singleton segments never read it."""
     if constrain is None:
         constrain = lambda v: v
     i32 = jnp.int32
@@ -707,13 +829,24 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
                for k, v in pods.items()}
         sflag = seg_start[i]
         gflag = gang[i]
+        if gang_score:
+            # the gang zone-count vector resets at every segment start
+            # BEFORE the checkpoint pick, so a rewind restores the reset
+            # (zero) counts — exactly the serial trial's fresh tracker
+            st_g, li_g, lni_g, sp_g, gz_g = cur
+            gz_g = jnp.where(sflag, jnp.zeros_like(gz_g), gz_g)
+            cur = (st_g, li_g, lni_g, sp_g, gz_g)
         # segment boundary: re-checkpoint the whole live carry (device
         # arrays are immutable, so this pins the pre-segment rows the same
         # way gang_carry_checkpoint does host-side — zero-copy)
         chk = pick(sflag, cur, chk)
         chk_t = jnp.where(sflag, t, chk_t)
         failed = jnp.where(sflag, False, failed)
-        state, li, lni, spread = cur
+        if gang_score:
+            state, li, lni, spread, gz = cur
+        else:
+            state, li, lni, spread = cur
+            gz = None
         # a member behind its segment's first failure consumes nothing:
         # the serial trial's post-failure decisions are discarded anyway
         eskip = pod["skip"] | (gflag & failed)
@@ -729,7 +862,8 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
         full = {**static, **state}
         out_c = _cycle_core(full, pod, li, lni, num_to_find, n_real,
                             weights, z_pad, perm=perm, inv_perm=inv_perm,
-                            pos=pos)
+                            pos=pos, wtab=wtab,
+                            gang=(gz, gflag) if gang_score else None)
         sel = out_c["selected"]
         hit = out_c["found"] > 0
         new_state = constrain(_fold_state(state, pod, sel, hit))
@@ -737,8 +871,18 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
         if carry_spread:
             new_spread = constrain(spread.at[jnp.maximum(sel, 0)].add(
                 jnp.where(hit & ~eskip, 1, 0)))
-        new_cur = (new_state, out_c["next_last_index"],
-                   out_c["next_last_node_index"], new_spread)
+        if gang_score:
+            # a placed gang member one-hot-folds its node's zone into the
+            # segment's count vector (zone 0 = "no zone" never counts)
+            selz = static["zone_id"][jnp.maximum(sel, 0)]
+            gadd = hit & ~eskip & gflag & (selz > 0)
+            new_gz = gz + ((jnp.arange(z_pad, dtype=selz.dtype) == selz)
+                           & gadd).astype(gz.dtype)
+            new_cur = (new_state, out_c["next_last_index"],
+                       out_c["next_last_node_index"], new_spread, new_gz)
+        else:
+            new_cur = (new_state, out_c["next_last_index"],
+                       out_c["next_last_node_index"], new_spread)
         new_t = t + jnp.where(eskip, 0, jnp.int32(1))
         # gang member found no node: rewind the live carry to the segment
         # checkpoint — the in-scan gang_rewind
@@ -746,7 +890,7 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
         cur2 = pick(fail_now, chk, new_cur)
         t2 = jnp.where(fail_now, chk_t, new_t)
         failed = failed | fail_now
-        _s2, li2, lni2, _sp2 = cur2
+        li2, lni2 = cur2[1], cur2[2]
         col = jnp.stack([
             jnp.where(hit & ~eskip, sel, jnp.int64(-1)).astype(i32),
             li2.astype(i32),
@@ -754,15 +898,19 @@ def _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
             t2])
         return (cur2, chk, t2, chk_t, failed, i + 1, out.at[:, i].set(col))
 
-    init_cur = (constrain(mut0), last_index, last_node_index,
-                constrain(spread0))
+    if gang_score:
+        init_cur = (constrain(mut0), last_index, last_node_index,
+                    constrain(spread0), jnp.zeros(z_pad, jnp.int64))
+    else:
+        init_cur = (constrain(mut0), last_index, last_node_index,
+                    constrain(spread0))
     out0 = jnp.full((4, B), -1, i32)
     init = (init_cur, init_cur, jnp.int32(0), jnp.int32(0),
             jnp.zeros((), bool), jnp.int32(0), out0)
     Bn = jnp.asarray(n_pods, i32)
     (cur, _chk, _t, _ct, _f, _i, out) = jax.lax.while_loop(
         lambda c: c[5] < Bn, body, init)
-    state, li, lni, spread = cur
+    state, li, lni, spread = cur[0], cur[1], cur[2], cur[3]
     # ONE packed fetch block [4B] i32: selections (−1 = miss / rewound gang
     # member / padding), then the post-pod walk counters and the consumed-
     # enumeration count — every boundary the host commit needs (decided
@@ -783,10 +931,26 @@ def _schedule_batch_seg_jit(nodes, mut0, pods, seg_start, gang, n_pods,
                           dict(weights_tuple), rot_mode, carry_spread)
 
 
+@partial(jax.jit, static_argnames=("z_pad", "weights_tuple", "rot_mode",
+                                   "carry_spread", "gang_score", "use_wtab"))
+def _schedule_batch_seg_prof_jit(nodes, mut0, pods, seg_start, gang, n_pods,
+                                 last_index, last_node_index, num_to_find,
+                                 n_real, perms, inv_perms, oid_seq, spread0,
+                                 wtab, z_pad, weights_tuple, rot_mode,
+                                 carry_spread, gang_score, use_wtab):
+    return _segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
+                          last_index, last_node_index, num_to_find, n_real,
+                          perms, inv_perms, oid_seq, spread0, z_pad,
+                          dict(weights_tuple), rot_mode, carry_spread,
+                          wtab=wtab if use_wtab else None,
+                          gang_score=gang_score)
+
+
 def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
                             last_index, last_node_index, num_to_find,
                             n_real, z_pad, weights=None, rotation=None,
-                            rotation_pos=None, spread0=None, mesh=None):
+                            rotation_pos=None, spread0=None, mesh=None,
+                            wtab=None, gang_score=False):
     """Schedule a segmented drain window — singleton runs and all-or-nothing
     gang segments — in ONE launch with ONE packed fetch (see block comment).
 
@@ -806,7 +970,12 @@ def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
     live carry AND the gang checkpoint sharded across the mesh
     (parallel/sharding.py) — in-scan gang rewinds, rotation by consumed
     count t, and spread carries all run sharded, decisions bit-identical
-    to the single-device kernel."""
+    to the single-device kernel.
+
+    `wtab` (tensor mode) is the [P, K] profile weight table (pods carry
+    `profile_id` [B]); `gang_score=True` compiles the rank-aware gang
+    set-scoring carry in (see _segments_core) — both off reproduce the
+    pre-profile program exactly."""
     weights_tuple = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     z = jnp.zeros((1, 1), jnp.int32)
     if rotation_pos is not None:
@@ -827,14 +996,36 @@ def schedule_batch_segments(nodes, pods, seg_start, gang, n_pods,
     carry_spread = spread0 is not None
     s0 = jnp.asarray(spread0, jnp.int64) if carry_spread \
         else jnp.zeros((), jnp.int64)
+    profile_mode = wtab is not None or gang_score
+    if wtab is not None:
+        wtab = jnp.asarray(wtab, jnp.int64)
     if mesh is not None:
         from kubernetes_tpu.parallel import sharding as S
         fn = S.sharded_segments_fn(mesh, z_pad, weights_tuple, rot_mode,
-                                   carry_spread)
+                                   carry_spread,
+                                   use_wtab=wtab is not None,
+                                   gang_score=bool(gang_score))
+        if profile_mode:
+            w = wtab if wtab is not None else jnp.zeros(
+                (1, len(PRIORITY_AXIS)), jnp.int64)
+            return fn(nodes, mut0, pods, jnp.asarray(seg_start, bool),
+                      jnp.asarray(gang, bool), _i64(n_pods),
+                      _i64(last_index), _i64(last_node_index),
+                      _i64(num_to_find), _i64(n_real), perms, inv_perms,
+                      oid_seq, s0, w)
         return fn(nodes, mut0, pods, jnp.asarray(seg_start, bool),
                   jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
                   _i64(last_node_index), _i64(num_to_find), _i64(n_real),
                   perms, inv_perms, oid_seq, s0)
+    if profile_mode:
+        w = wtab if wtab is not None else jnp.zeros(
+            (1, len(PRIORITY_AXIS)), jnp.int64)
+        return _schedule_batch_seg_prof_jit(
+            nodes, mut0, pods, jnp.asarray(seg_start, bool),
+            jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
+            _i64(last_node_index), _i64(num_to_find), _i64(n_real), perms,
+            inv_perms, oid_seq, s0, w, z_pad, weights_tuple, rot_mode,
+            carry_spread, bool(gang_score), wtab is not None)
     return _schedule_batch_seg_jit(
         nodes, mut0, pods, jnp.asarray(seg_start, bool),
         jnp.asarray(gang, bool), _i64(n_pods), _i64(last_index),
@@ -905,14 +1096,23 @@ _PERM_DEV_CACHE: dict = {}
 
 def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
                   perm, oid_seq, extra_ok, weights, flags,
-                  b_cap, k_batch, rotate, ban, has_extra, constrain=None):
+                  b_cap, k_batch, rotate, ban, has_extra, constrain=None,
+                  wtab=None, pid=None):
     """Body of the uniform-class burst kernel. `constrain` (optional) pins
     node-axis arrays — the carried [R, N1]/[N1] state and the static alloc
     vectors — to a mesh sharding so the O(N) sweep splits across chips while
     the scalar tie-walk epilogue replicates (parallel/sharding.py wraps this
-    for the north-star multi-chip config; None = single-chip identity)."""
+    for the north-star multi-chip config; None = single-chip identity).
+
+    `wtab`/`pid` (tensor mode): the window's shared weight row is gathered
+    ONCE from the resident [P, K] table by the class's profile id — a
+    uniform window is single-profile by construction (the profile id is
+    part of the window's uniformity contract: different rows change the
+    tie structure the K-batch modes rely on), so one compiled program
+    serves every profile and the row is just data."""
     if constrain is None:
         constrain = lambda v: v
+    wrow = None if wtab is None else wtab[pid]
     check_res, has_req, carry_eph, static_eph, carried_s, static_s = flags
     i32 = jnp.int32
     n_pad = nodes["valid"].shape[0]
@@ -961,7 +1161,7 @@ def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
 
     tot0 = constrain(_local_total(
         weights, cls["nz_cpu"] + st0[2], cls["nz_mem"] + st0[3],
-        alloc_cpu, alloc_mem).astype(i32))
+        alloc_cpu, alloc_mem, wrow=wrow).astype(i32))
     jlane = jnp.arange(k_batch, dtype=i32)
     B = jnp.asarray(n_pods, i32)
 
@@ -991,7 +1191,7 @@ def _uniform_core(nodes, cls, n_pods, last_node_index, n_real,
         lane-0 probe and the batch validation."""
         nt = _local_total(
             weights, cls["nz_cpu"] + rowvals[2], cls["nz_mem"] + rowvals[3],
-            alloc_cpu[idx], alloc_mem[idx]).astype(i32)
+            alloc_cpu[idx], alloc_mem[idx], wrow=wrow).astype(i32)
         return nt, resource_fit(rowvals, idx)
 
     def body(carry):
@@ -1150,9 +1350,21 @@ def _schedule_batch_uniform_jit(nodes, cls, n_pods, last_node_index, n_real,
                          k_batch, rotate, ban, has_extra)
 
 
+@partial(jax.jit, static_argnames=("weights_tuple", "flags", "b_cap",
+                                   "k_batch", "rotate", "ban", "has_extra"))
+def _schedule_batch_uniform_prof_jit(nodes, cls, n_pods, last_node_index,
+                                     n_real, perm, oid_seq, extra_ok, wtab,
+                                     pid, weights_tuple, flags, b_cap,
+                                     k_batch, rotate, ban, has_extra):
+    return _uniform_core(nodes, cls, n_pods, last_node_index, n_real, perm,
+                         oid_seq, extra_ok, dict(weights_tuple), flags, b_cap,
+                         k_batch, rotate, ban, has_extra, wtab=wtab, pid=pid)
+
+
 def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
                            check_resources, weights=None, rotation=None,
-                           extra_ok=None, ban=False, mesh=None, cap=None):
+                           extra_ok=None, ban=False, mesh=None, cap=None,
+                           wtab=None, pid=0):
     """Uniform-class burst (see block comment above). `cls` holds the shared
     per-pod scalars: req_cpu/req_mem/req_eph, req_scalar[S], nz_cpu/nz_mem,
     upd_cpu/upd_mem/upd_eph, upd_scalar[S], has_request. Returns
@@ -1229,14 +1441,25 @@ def schedule_batch_uniform(nodes, cls, n_pods, last_node_index, n_real,
     has_extra = extra_ok is not None
     extra = jnp.asarray(extra_ok, bool) if has_extra \
         else jnp.zeros(1, dtype=bool)
+    if wtab is not None:
+        wtab = jnp.asarray(wtab, jnp.int64)
     if mesh is not None:
         # north-star multi-chip config: node-axis state sharded over the
         # mesh, tie-walk epilogue replicated (parallel/sharding.py)
         from kubernetes_tpu.parallel import sharding as S
         fn = S.sharded_uniform_fn(mesh, weights_tuple, flags, cap, K_BATCH,
-                                  rotation is not None, bool(ban), has_extra)
+                                  rotation is not None, bool(ban), has_extra,
+                                  use_wtab=wtab is not None)
+        if wtab is not None:
+            return fn(nodes, cls, _i64(n_pods), _i64(last_node_index),
+                      _i64(n_real), perm, oid_seq, extra, wtab, _i64(pid))
         return fn(nodes, cls, _i64(n_pods), _i64(last_node_index),
                   _i64(n_real), perm, oid_seq, extra)
+    if wtab is not None:
+        return _schedule_batch_uniform_prof_jit(
+            nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
+            perm, oid_seq, extra, wtab, _i64(pid), weights_tuple, flags,
+            cap, K_BATCH, rotation is not None, bool(ban), has_extra)
     return _schedule_batch_uniform_jit(
         nodes, cls, _i64(n_pods), _i64(last_node_index), _i64(n_real),
         perm, oid_seq, extra, weights_tuple, flags, cap, K_BATCH,

@@ -56,15 +56,18 @@ def pod_class_signature(pod: Pod) -> tuple:
             pod.init_containers)
 
 
-#: columnar int64 fields, in row order (gather() does one np.take each)
+#: columnar int64 fields, in row order (gather() does one np.take each);
+#: profile_id (round 19) is the pod's scheduling-profile index — filled at
+#: admission like every other flag, gathered per window so mixed-tenant
+#: windows select their weight-tensor rows without touching the pod specs
 _I64_FIELDS = ("req_cpu", "req_mem", "req_eph", "nz_cpu", "nz_mem",
-               "upd_cpu", "upd_mem", "upd_eph", "priority")
+               "upd_cpu", "upd_mem", "upd_eph", "priority", "profile_id")
 #: columnar bool fields
 _BOOL_FIELDS = ("has_request", "has_scalar", "has_aff_terms", "has_ports",
                 "has_volumes")
 
 
-def encode_row(pod: Pod) -> dict:
+def encode_row(pod: Pod, profile_fn=None) -> dict:
     """THE per-pod feature row: every spec-derived scalar the window
     prologue reads, in one place — insert() stores exactly this, the
     lookup fallback recomputes exactly this, and the bit-identity fuzz
@@ -72,11 +75,14 @@ def encode_row(pod: Pod) -> dict:
     sorted name->quantity items, NOT vocab-aligned arrays: the scalar
     vocab belongs to the node snapshot, so alignment happens at the
     window (cheap — scalar pods are rare) while the row stays
-    snapshot-independent."""
+    snapshot-independent. `profile_fn(scheduler_name) -> Optional[int]`
+    maps the pod to its scheduling-profile index (profiles.ProfileSet
+    .index_of); None/unset resolves to 0 — the default profile row."""
     from kubernetes_tpu.cache.node_info import calculate_resource
     req = get_resource_request(pod)
     upd = calculate_resource(pod)
     nz_cpu, nz_mem = get_pod_nonzero_requests(pod)
+    pid = profile_fn(pod.scheduler_name) if profile_fn is not None else 0
     return {
         "req_cpu": req.milli_cpu, "req_mem": req.memory,
         "req_eph": req.ephemeral_storage,
@@ -84,6 +90,7 @@ def encode_row(pod: Pod) -> dict:
         "upd_cpu": upd.milli_cpu, "upd_mem": upd.memory,
         "upd_eph": upd.ephemeral_storage,
         "priority": pod.priority,
+        "profile_id": 0 if pid is None else int(pid),
         "has_request": bool(req.milli_cpu or req.memory
                             or req.ephemeral_storage or req.scalar),
         "has_scalar": bool(req.scalar or upd.scalar),
@@ -109,8 +116,12 @@ class PodRowCache:
     evicted (the window falls back to fresh encodes for it — the same
     degradation as a miss)."""
 
-    def __init__(self, capacity: int = 1 << 17):
+    def __init__(self, capacity: int = 1 << 17, profile_fn=None):
         self.capacity = int(capacity)
+        #: scheduling-profile resolver (profiles.ProfileSet.index_of);
+        #: applied at insert AND at the lookup fallback so the
+        #: bit-identity contract holds column-for-column
+        self.profile_fn = profile_fn
         cap0 = 1024
         self._cap = cap0
         for f in _I64_FIELDS:
@@ -171,7 +182,7 @@ class PodRowCache:
             if not self._free:
                 self._grow()
             slot = self._free.pop()
-        self._write(slot, encode_row(pod))
+        self._write(slot, encode_row(pod, self.profile_fn))
         # (re-)append so eviction order stays oldest-write-first
         self._slot_of[uid] = (slot, pod.resource_version)
 
@@ -237,7 +248,7 @@ class PodRowCache:
         `encode_row` (identical values; the fallback is the contract)."""
         slot = self._slot(pod)
         if slot < 0:
-            return encode_row(pod)
+            return encode_row(pod, self.profile_fn)
         row = {f: getattr(self, "_" + f)[slot].item()
                for f in _I64_FIELDS}
         for f in _BOOL_FIELDS:

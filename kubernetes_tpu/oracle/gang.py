@@ -38,6 +38,7 @@ class GangTrial:
 
     def run(self, pods: list, schedule_fn: Callable,
             refresh_snapshot_fn: Callable[[], None],
+            on_placed: Optional[Callable[[str], None]] = None,
             ) -> Optional[list[str]]:
         """Trial-place `pods` serially. Returns the per-member host list
         with every member's assume left IN the cache (the caller commits
@@ -45,7 +46,12 @@ class GangTrial:
 
         `schedule_fn(pod, names)` is the shell's algorithm dispatch;
         `refresh_snapshot_fn()` refreshes the shell's snapshot so member
-        k sees members 0..k-1 as assumed load."""
+        k sees members 0..k-1 as assumed load. `on_placed(host)`
+        (optional) fires after each member's assume — the rank-aware gang
+        set-scoring hook: the shell folds the placed member's zone into
+        the trial's zone-count tracker so member k+1's GangLocalityPriority
+        sees members 0..k, exactly like the fused kernel's per-segment
+        carry (a rollback discards the whole tracker with the trial)."""
         tree = self.cache.node_tree
         tree_chk = tree.checkpoint()
         li = self.algorithm.last_index
@@ -67,6 +73,8 @@ class GangTrial:
                 self.cache.assume_pod(trial)
                 assumed.append(trial)
                 hosts.append(result.suggested_host)
+                if on_placed is not None:
+                    on_placed(result.suggested_host)
         except FitError:
             self.rollback(assumed, tree_chk, li, lni)
             return None

@@ -373,3 +373,22 @@ def interpod_affinity_priority(pod: Pod, node_infos: dict[str, NodeInfo],
 
 def equal_priority_map(pod: Pod, ni: NodeInfo) -> int:
     return 1
+
+
+# ---------------------------------------------------------------------------
+# Gang locality (round 19 — rank-aware gang set-scoring, the serial half
+# of the device kernels' per-segment zone-count carry)
+# ---------------------------------------------------------------------------
+def gang_locality_map(zone_counts: dict, ni: NodeInfo) -> int:
+    """Score a candidate node by how many members of the CURRENT gang
+    trial already landed in its zone, clipped at MAX_PRIORITY — the group
+    objective that prefers packing a tightly-coupled gang into few
+    zones/ICI domains. `zone_counts` is the trial's live {zone_key:
+    members placed} map (reset per gang, updated after every member's
+    assume); nodes without a zone score 0. Must stay bit-identical to the
+    kernel's gang term in ops.kernels._fit_scores: min(count, 10),
+    weighted by the member profile's gang weight at the caller."""
+    zone = get_zone_key(ni.node) if ni.node is not None else ""
+    if not zone:
+        return 0
+    return min(int(zone_counts.get(zone, 0)), MAX_PRIORITY)

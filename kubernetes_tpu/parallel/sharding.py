@@ -112,7 +112,8 @@ def _constrain_nodes(mesh: Mesh, nodes: dict) -> dict:
     return out
 
 
-def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
+def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None,
+                     use_wtab: bool = False):
     """A jitted scheduling cycle with the node axis sharded across the mesh.
 
     The per-node phases (feasibility, scores) are constrained to the node
@@ -120,14 +121,26 @@ def sharded_cycle_fn(mesh: Mesh, z_pad: int, weights=None):
     (the feasibility cumsum and score reductions become all-gathers/psums
     over ICI) and the tiny scalar selection epilogue replicates. Decisions
     are bit-identical to the single-device kernel (tests/test_sharding.py).
-    Returns fn(nodes, pod, last_index, last_node_index, num_to_find, n_real).
+    Returns fn(nodes, pod, last_index, last_node_index, num_to_find, n_real)
+    — with `use_wtab`, fn takes a trailing replicated [P, K] profile
+    weight table and `pod` carries `profile_id`.
     """
     weights_tuple = tuple(sorted((weights or K.DEFAULT_WEIGHTS).items()))
 
-    def fn(nodes, pod, last_index, last_node_index, num_to_find, n_real):
-        nodes = _constrain_nodes(mesh, nodes)
-        return K._cycle_core(nodes, pod, last_index, last_node_index,
-                             num_to_find, n_real, dict(weights_tuple), z_pad)
+    if use_wtab:
+        def fn(nodes, pod, last_index, last_node_index, num_to_find,
+               n_real, wtab):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._cycle_core(nodes, pod, last_index, last_node_index,
+                                 num_to_find, n_real, dict(weights_tuple),
+                                 z_pad, wtab=wtab)
+    else:
+        def fn(nodes, pod, last_index, last_node_index, num_to_find,
+               n_real):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._cycle_core(nodes, pod, last_index, last_node_index,
+                                 num_to_find, n_real, dict(weights_tuple),
+                                 z_pad)
 
     return jax.jit(fn)
 
@@ -136,7 +149,7 @@ _UNIFORM_CACHE: dict = {}
 
 
 def sharded_uniform_fn(mesh: Mesh, weights_tuple, flags, b_cap, k_batch,
-                       rotate, ban, has_extra):
+                       rotate, ban, has_extra, use_wtab: bool = False):
     """The uniform K-pods-per-pass burst kernel (kernels._uniform_core) with
     its node-axis state sharded over the mesh — the north-star multi-chip
     configuration (BASELINE.json configs[4]; the 16-way fan-out it replaces
@@ -152,7 +165,7 @@ def sharded_uniform_fn(mesh: Mesh, weights_tuple, flags, b_cap, k_batch,
     # Mesh is hashable/eq-comparable: content-equal meshes share the entry
     # (keying on id() would recompile per Mesh object and pin dead meshes)
     key = (mesh, weights_tuple, flags, b_cap, k_batch, rotate, ban,
-           has_extra)
+           has_extra, use_wtab)
     fn = _UNIFORM_CACHE.get(key)
     if fn is not None:
         return fn
@@ -164,12 +177,24 @@ def sharded_uniform_fn(mesh: Mesh, weights_tuple, flags, b_cap, k_batch,
         return jax.lax.with_sharding_constraint(
             v, shard2 if v.ndim == 2 else shard1)
 
-    def f(nodes, cls, n_pods, lni, n_real, perm, oid_seq, extra_ok):
-        nodes = _constrain_nodes(mesh, nodes)
-        return K._uniform_core(nodes, cls, n_pods, lni, n_real, perm,
-                               oid_seq, extra_ok, dict(weights_tuple), flags,
-                               b_cap, k_batch, rotate, ban, has_extra,
-                               constrain=constrain)
+    if use_wtab:
+        # profile tensor mode: the tiny [P, K] weight table replicates and
+        # the class's row is gathered once by the scalar profile id
+        def f(nodes, cls, n_pods, lni, n_real, perm, oid_seq, extra_ok,
+              wtab, pid):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._uniform_core(nodes, cls, n_pods, lni, n_real, perm,
+                                   oid_seq, extra_ok, dict(weights_tuple),
+                                   flags, b_cap, k_batch, rotate, ban,
+                                   has_extra, constrain=constrain,
+                                   wtab=wtab, pid=pid)
+    else:
+        def f(nodes, cls, n_pods, lni, n_real, perm, oid_seq, extra_ok):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._uniform_core(nodes, cls, n_pods, lni, n_real, perm,
+                                   oid_seq, extra_ok, dict(weights_tuple),
+                                   flags, b_cap, k_batch, rotate, ban,
+                                   has_extra, constrain=constrain)
 
     fn = _UNIFORM_CACHE[key] = jax.jit(f)
     return fn
@@ -206,7 +231,8 @@ _PREEMPT_CACHE: dict = {}
 
 
 def sharded_scan_fn(mesh: Mesh, z_pad: int, weights_tuple, rotate: bool,
-                    carry_spread: bool, rotate_pos: bool):
+                    carry_spread: bool, rotate_pos: bool,
+                    use_wtab: bool = False):
     """The generic lax.scan burst kernel (kernels._batch_core) with the
     node axis sharded over the mesh — the SAME program single-device runs,
     parameterized by the sharding spec: each chip folds the selected pod's
@@ -217,27 +243,42 @@ def sharded_scan_fn(mesh: Mesh, z_pad: int, weights_tuple, rotate: bool,
     into the replicated select epilogue. Decisions are bit-identical to
     the single-device scan (tests/test_sharding.py + the sharded fuzz
     variants). Compiled once per (mesh, statics) and cached."""
-    key = (mesh, z_pad, weights_tuple, rotate, carry_spread, rotate_pos)
+    key = (mesh, z_pad, weights_tuple, rotate, carry_spread, rotate_pos,
+           use_wtab)
     fn = _SCAN_CACHE.get(key)
     if fn is not None:
         return fn
     c = node_constrainer(mesh)
 
-    def f(nodes, mut0, pods, last_index, last_node_index, num_to_find,
-          n_real, perms, inv_perms, oid_seq, spread0):
-        nodes = _constrain_nodes(mesh, nodes)
-        return K._batch_core(nodes, mut0, pods, last_index, last_node_index,
-                             num_to_find, n_real, perms, inv_perms, oid_seq,
-                             spread0, z_pad, dict(weights_tuple), rotate,
-                             carry_spread, rotate_pos=rotate_pos,
-                             constrain=c)
+    if use_wtab:
+        # profile tensor mode: the replicated [P, K] weight table rides the
+        # operands and each step gathers its pod's row (profile_id in pods)
+        def f(nodes, mut0, pods, wtab, last_index, last_node_index,
+              num_to_find, n_real, perms, inv_perms, oid_seq, spread0):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._batch_core(nodes, mut0, pods, last_index,
+                                 last_node_index, num_to_find, n_real,
+                                 perms, inv_perms, oid_seq, spread0, z_pad,
+                                 dict(weights_tuple), rotate, carry_spread,
+                                 rotate_pos=rotate_pos, constrain=c,
+                                 wtab=wtab)
+    else:
+        def f(nodes, mut0, pods, last_index, last_node_index, num_to_find,
+              n_real, perms, inv_perms, oid_seq, spread0):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._batch_core(nodes, mut0, pods, last_index,
+                                 last_node_index, num_to_find, n_real,
+                                 perms, inv_perms, oid_seq, spread0, z_pad,
+                                 dict(weights_tuple), rotate, carry_spread,
+                                 rotate_pos=rotate_pos, constrain=c)
 
     fn = _SCAN_CACHE[key] = jax.jit(f)
     return fn
 
 
 def sharded_segments_fn(mesh: Mesh, z_pad: int, weights_tuple,
-                        rot_mode: int, carry_spread: bool):
+                        rot_mode: int, carry_spread: bool,
+                        use_wtab: bool = False, gang_score: bool = False):
     """The fused segmented drain-window kernel (kernels._segments_core)
     sharded over the mesh: the whole while_loop carry — live mutable rows,
     spread, AND the in-scan gang checkpoint — stays under
@@ -246,21 +287,41 @@ def sharded_segments_fn(mesh: Mesh, z_pad: int, weights_tuple,
     stays indexed by the consumed-count t with the perm tables replicated,
     and the single [4B] packed output replicates (per-pod, tiny).
     Decisions bit-identical to the single-device fused kernel."""
-    key = (mesh, z_pad, weights_tuple, rot_mode, carry_spread)
+    key = (mesh, z_pad, weights_tuple, rot_mode, carry_spread, use_wtab,
+           gang_score)
     fn = _SEG_CACHE.get(key)
     if fn is not None:
         return fn
     c = node_constrainer(mesh)
 
-    def f(nodes, mut0, pods, seg_start, gang, n_pods, last_index,
-          last_node_index, num_to_find, n_real, perms, inv_perms, oid_seq,
-          spread0):
-        nodes = _constrain_nodes(mesh, nodes)
-        return K._segments_core(nodes, mut0, pods, seg_start, gang, n_pods,
-                                last_index, last_node_index, num_to_find,
-                                n_real, perms, inv_perms, oid_seq, spread0,
-                                z_pad, dict(weights_tuple), rot_mode,
-                                carry_spread, constrain=c)
+    if use_wtab or gang_score:
+        # profile tensor mode / rank-aware gang set-scoring: the weight
+        # table replicates (a dummy rides when only gang_score is on) and
+        # the tiny [z_pad] gang zone-count carry replicates with the
+        # scalar walk counters
+        def f(nodes, mut0, pods, seg_start, gang, n_pods, last_index,
+              last_node_index, num_to_find, n_real, perms, inv_perms,
+              oid_seq, spread0, wtab):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._segments_core(nodes, mut0, pods, seg_start, gang,
+                                    n_pods, last_index, last_node_index,
+                                    num_to_find, n_real, perms, inv_perms,
+                                    oid_seq, spread0, z_pad,
+                                    dict(weights_tuple), rot_mode,
+                                    carry_spread, constrain=c,
+                                    wtab=wtab if use_wtab else None,
+                                    gang_score=gang_score)
+    else:
+        def f(nodes, mut0, pods, seg_start, gang, n_pods, last_index,
+              last_node_index, num_to_find, n_real, perms, inv_perms,
+              oid_seq, spread0):
+            nodes = _constrain_nodes(mesh, nodes)
+            return K._segments_core(nodes, mut0, pods, seg_start, gang,
+                                    n_pods, last_index, last_node_index,
+                                    num_to_find, n_real, perms, inv_perms,
+                                    oid_seq, spread0, z_pad,
+                                    dict(weights_tuple), rot_mode,
+                                    carry_spread, constrain=c)
 
     fn = _SEG_CACHE[key] = jax.jit(f)
     return fn

@@ -1,0 +1,314 @@
+"""Scheduling profiles — a multi-profile `KubeSchedulerConfiguration`
+(round 19; ROADMAP item 4's per-tenant scoring lanes).
+
+One scheduler process serves several named profiles: a pod picks its
+profile by `spec.schedulerName` (the reference's multi-profile contract,
+kube-scheduler KubeSchedulerConfiguration.profiles), and each profile
+carries its OWN priority-weight vector — Gavel-style per-tenant
+throughput-aware weights (PAPERS.md 2008.09213) without per-tenant
+scheduler processes. On device the vectors stack into ONE dense
+`[profiles x priorities]` int64 tensor (column order =
+`ops.kernels.PRIORITY_AXIS`); every kernel core gathers each pod's weight
+row by its `profile_id` (a PodRowCache column filled at admission), so a
+single launch scores a window that mixes tenants — the tensor rides the
+upload once and stays resident.
+
+The last tensor column is `gang_locality`: the rank-aware gang
+set-scoring objective (PAPERS.md 2603.22691 — MPI ranks want zone/ICI
+locality). A profile with `rank_aware=True` gives its gangs a
+device-scored preference for packing the group into few zones: inside
+the fused segment scan, each placed member one-hot-folds its node's zone
+into a per-segment count vector, and later members of the SAME gang
+score every node by `min(members_already_in_zone, 10) * gang_weight` —
+candidate node SETS, not just nodes, via the same one-hot zone
+reductions the spread kernel uses. The serial referee
+(oracle.gang.GangTrial + oracle.priorities.gang_locality_map) computes
+the identical objective, so per-profile decisions stay oracle-parity.
+The default profile ships with `rank_aware=False` and the provider
+weight vector — bit-identical to the pre-profile scheduler.
+
+Validation rides the existing `apis/policy` bounds: every weight
+positive and < MAX_WEIGHT (weight * MaxPriority must fit int32),
+duplicate profile names and unknown priority names are errors.
+
+A pod whose `spec.schedulerName` no profile claims is REPORTED
+(`scheduler_profile_unknown_total` + a FailedScheduling-style event),
+never silently scored by the default profile.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu import obs
+from kubernetes_tpu.apis.policy import (
+    MAX_WEIGHT, Policy, PolicyValidationError, PriorityPolicy,
+    validate_policy,
+)
+
+DEFAULT_PROFILE_NAME = "default-scheduler"
+
+PROFILE_UNKNOWN = obs.counter(
+    "scheduler_profile_unknown_total",
+    "Pods whose spec.schedulerName matched no configured scheduling "
+    "profile — reported (counter + event), never silently scored by the "
+    "default profile.")
+PROFILE_SCHEDULED = obs.counter(
+    "scheduler_profile_scheduled_total",
+    "Pods successfully scheduled, by the profile that scored them.",
+    ("profile",))
+
+
+def _kernel_priority_names() -> dict:
+    """K8s priority name -> kernel weight key (the device-supported set —
+    a profile's weights must all be kernel-expressible so the tensor can
+    score every profile in one launch)."""
+    from kubernetes_tpu.factory import TPU_WEIGHT_KEYS
+    return TPU_WEIGHT_KEYS
+
+
+@dataclass(frozen=True)
+class SchedulingProfile:
+    """One named profile: a priority-weight vector + the rank-aware knob.
+
+    `weights` maps reference priority names (e.g. "LeastRequestedPriority")
+    to integer weights; an empty mapping means the DefaultProvider vector
+    (factory.DEFAULT_PRIORITY_WEIGHTS) — exactly today's scoring.
+    `rank_aware` switches on gang set-scoring for this profile's
+    PodGroups, weighted by `gang_weight`."""
+    name: str
+    weights: tuple = ()          # ((priority name, weight), ...)
+    rank_aware: bool = False
+    gang_weight: int = 1
+
+    def name_weights(self) -> dict:
+        if self.weights:
+            return dict(self.weights)
+        from kubernetes_tpu.factory import DEFAULT_PRIORITY_WEIGHTS
+        return dict(DEFAULT_PRIORITY_WEIGHTS)
+
+    @staticmethod
+    def from_dict(d: dict) -> "SchedulingProfile":
+        """Accepts the KubeSchedulerConfiguration-flavored shape:
+        {"schedulerName": ..., "priorities": {name: weight} | [{"name":
+        ..., "weight": ...}], "rankAwareGang": bool, "gangWeight": int}
+        (snake_case twins accepted)."""
+        name = d.get("schedulerName") or d.get("scheduler_name") \
+            or d.get("name") or DEFAULT_PROFILE_NAME
+        prios = d.get("priorities") or ()
+        if isinstance(prios, dict):
+            weights = tuple(sorted(prios.items()))
+        else:
+            weights = tuple(sorted(
+                (p["name"], p.get("weight", 1)) for p in prios))
+        return SchedulingProfile(
+            name=name, weights=weights,
+            rank_aware=bool(d.get("rankAwareGang",
+                                  d.get("rank_aware", False))),
+            gang_weight=int(d.get("gangWeight", d.get("gang_weight", 1))))
+
+
+class ProfileValidationError(PolicyValidationError):
+    pass
+
+
+class ProfileSet:
+    """An ordered, validated set of scheduling profiles.
+
+    Profile 0 is the DEFAULT profile (index 0 in the weight tensor); a
+    single default-vector, non-rank-aware profile degenerates to the
+    pre-profile scheduler (`tensor_mode()` False — callers keep the
+    exact old kernel programs)."""
+
+    def __init__(self, profiles: Optional[list] = None,
+                 validate: bool = True):
+        if not profiles:
+            profiles = [SchedulingProfile(DEFAULT_PROFILE_NAME)]
+        self.profiles: list[SchedulingProfile] = list(profiles)
+        self._index = {p.name: i for i, p in enumerate(self.profiles)}
+        #: uids already reported unknown (bounds event/counter noise)
+        self._unknown_seen: set = set()
+        self.unknown_names: dict[str, int] = {}
+        #: per-profile scheduled counts (the /debug/sched section's copy;
+        #: the obs counter is the wire-visible one)
+        self.scheduled_counts = [0] * len(self.profiles)
+        if validate:
+            self.validate()
+
+    # -- construction --------------------------------------------------------
+    @staticmethod
+    def from_dict(d: dict) -> "ProfileSet":
+        return ProfileSet([SchedulingProfile.from_dict(p)
+                           for p in d.get("profiles", ())])
+
+    @staticmethod
+    def from_json(text: str) -> "ProfileSet":
+        return ProfileSet.from_dict(json.loads(text))
+
+    @staticmethod
+    def from_file(path: str) -> "ProfileSet":
+        with open(path) as f:
+            return ProfileSet.from_dict(json.load(f))
+
+    # -- validation (apis/policy bounds) -------------------------------------
+    def validate(self) -> None:
+        """Duplicate profile names and unknown priority names are errors;
+        every weight (including rank-aware gang weights) rides the
+        existing positive/MAX_WEIGHT policy bounds."""
+        errs = []
+        seen: set = set()
+        known = _kernel_priority_names()
+        for p in self.profiles:
+            if p.name in seen:
+                errs.append(f"duplicate profile name {p.name!r}")
+            seen.add(p.name)
+            if not p.name:
+                errs.append("profile name must not be empty")
+            nw = p.name_weights()
+            for prio_name in nw:
+                if prio_name not in known:
+                    errs.append(f"profile {p.name}: unknown priority "
+                                f"{prio_name!r}")
+            pol = Policy(priorities=[
+                PriorityPolicy(name=n, weight=w) for n, w in
+                sorted(nw.items())])
+            if p.rank_aware:
+                pol.priorities.append(PriorityPolicy(
+                    name=f"{p.name}/GangLocalityPriority",
+                    weight=p.gang_weight))
+            try:
+                validate_policy(pol)
+            except PolicyValidationError as e:
+                errs.append(f"profile {p.name}: {e}")
+        if errs:
+            raise ProfileValidationError("; ".join(errs))
+
+    # -- lookups -------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self.profiles)
+
+    def __iter__(self):
+        return iter(self.profiles)
+
+    @property
+    def default(self) -> SchedulingProfile:
+        return self.profiles[0]
+
+    def index_of(self, scheduler_name: str) -> Optional[int]:
+        """Profile index for a pod's spec.schedulerName, or None when no
+        profile claims it (the caller must REPORT, not default-score)."""
+        return self._index.get(scheduler_name)
+
+    def profile_for(self, scheduler_name: str) -> Optional[SchedulingProfile]:
+        i = self.index_of(scheduler_name)
+        return None if i is None else self.profiles[i]
+
+    def gang_weight_for(self, scheduler_name: str) -> int:
+        p = self.profile_for(scheduler_name)
+        return p.gang_weight if (p is not None and p.rank_aware) else 0
+
+    def tensor_mode(self) -> bool:
+        """True when the kernels must run the weight-tensor program: more
+        than one profile, any non-default weight vector, or any
+        rank-aware profile. False = the pre-profile fast path (exact old
+        kernel programs; decisions trivially bit-identical)."""
+        from kubernetes_tpu.factory import DEFAULT_PRIORITY_WEIGHTS
+        if len(self.profiles) > 1:
+            return True
+        p = self.profiles[0]
+        return p.rank_aware or (
+            p.weights and dict(p.weights) != DEFAULT_PRIORITY_WEIGHTS)
+
+    # -- device tensor -------------------------------------------------------
+    def kernel_row(self, i: int) -> dict:
+        """Kernel-keyed weight dict for profile `i` (gang_locality
+        included — 0 unless rank-aware)."""
+        from kubernetes_tpu.factory import tpu_kernel_weights
+        p = self.profiles[i]
+        row = tpu_kernel_weights(p.name_weights())
+        if row is None:   # unreachable after validate(); stay safe
+            raise ProfileValidationError(
+                f"profile {p.name}: priorities not kernel-expressible")
+        row["gang_locality"] = p.gang_weight if p.rank_aware else 0
+        return row
+
+    def union_kernel_weights(self) -> dict:
+        """Static trace-time gate dict: a priority family is compiled in
+        iff ANY profile weights it (per-pod rows then scale it, including
+        to zero). This is the `weights` argument of every tensor-mode
+        kernel call."""
+        from kubernetes_tpu.ops.kernels import PRIORITY_AXIS
+        union = {k: 0 for k in PRIORITY_AXIS}
+        for i in range(len(self.profiles)):
+            for k, w in self.kernel_row(i).items():
+                union[k] = max(union[k], int(w))
+        return union
+
+    def weight_table(self) -> np.ndarray:
+        """The [profiles x priorities] scoring tensor, column order =
+        ops.kernels.PRIORITY_AXIS. Uploaded once, resident; kernels
+        gather row `profile_id` per pod."""
+        from kubernetes_tpu.ops.kernels import PRIORITY_AXIS
+        tab = np.zeros((len(self.profiles), len(PRIORITY_AXIS)),
+                       dtype=np.int64)
+        for i in range(len(self.profiles)):
+            row = self.kernel_row(i)
+            for j, key in enumerate(PRIORITY_AXIS):
+                tab[i, j] = int(row.get(key, 0))
+        return tab
+
+    # -- oracle side ---------------------------------------------------------
+    def oracle_configs(self, i: int, services_fn=lambda: [],
+                       replicasets_fn=lambda: [],
+                       hard_pod_affinity_weight: int = 1) -> list:
+        """Per-profile PriorityConfig list for the serial referee — the
+        SAME weight vector the tensor row carries, so per-profile parity
+        is pinnable (the gang-locality objective is injected per trial by
+        the shell, not here: it needs the trial's live zone counts)."""
+        from kubernetes_tpu.factory import build_priority_configs
+        return build_priority_configs(
+            self.profiles[i].name_weights(), services_fn=services_fn,
+            replicasets_fn=replicasets_fn,
+            hard_pod_affinity_weight=hard_pod_affinity_weight)
+
+    # -- unknown-profile reporting -------------------------------------------
+    def report_unknown(self, pod, recorder=None) -> None:
+        """Book a pod no profile claims: counter + (once per uid) a
+        FailedScheduling event. NEVER default-scores."""
+        self.unknown_names[pod.scheduler_name] = \
+            self.unknown_names.get(pod.scheduler_name, 0) + 1
+        if pod.uid in self._unknown_seen:
+            return
+        self._unknown_seen.add(pod.uid)
+        if len(self._unknown_seen) > 65536:
+            self._unknown_seen.clear()
+        PROFILE_UNKNOWN.inc()
+        if recorder is not None:
+            from kubernetes_tpu.store.record import WARNING
+            recorder.pod_event(
+                pod, WARNING, "FailedScheduling",
+                f"no scheduling profile claims "
+                f"schedulerName={pod.scheduler_name!r}")
+
+    def note_scheduled(self, i: int, count: int = 1) -> None:
+        PROFILE_SCHEDULED.labels(self.profiles[i].name).inc(count)
+        self.scheduled_counts[i] += count
+
+    # -- /debug/sched --------------------------------------------------------
+    def debug_state(self) -> dict:
+        from kubernetes_tpu.ops.kernels import PRIORITY_AXIS
+        tab = self.weight_table()
+        return {
+            "priority_axis": list(PRIORITY_AXIS),
+            "profiles": [{
+                "name": p.name,
+                "rank_aware": p.rank_aware,
+                "weights": tab[i].tolist(),
+                "scheduled": self.scheduled_counts[i],
+            } for i, p in enumerate(self.profiles)],
+            "tensor_mode": self.tensor_mode(),
+            "unknown_scheduler_names": dict(self.unknown_names),
+        }

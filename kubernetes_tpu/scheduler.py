@@ -203,9 +203,24 @@ class Scheduler:
                  predicate_names: Optional[list] = None,
                  priority_weights: Optional[dict] = None,
                  extenders: Optional[list] = None,
-                 mesh=None):
+                 mesh=None,
+                 profiles=None):
         self.store = store
         self.name = scheduler_name
+        # scheduling profiles (round 19): a profiles.ProfileSet makes THIS
+        # process serve every named profile — responsibility is membership
+        # in the set (unknown schedulerNames are REPORTED, never
+        # default-scored), per-pod scoring selects the profile's weight
+        # row ([profiles x priorities] tensor on the TPU path, per-profile
+        # PriorityConfig lists on the oracle path), and rank-aware
+        # profiles turn on gang set-scoring. Mutually exclusive with the
+        # single-vector priority_weights.
+        if profiles is not None:
+            if priority_weights is not None:
+                raise ValueError(
+                    "profiles and priority_weights are mutually exclusive")
+            profiles.validate()
+        self.profiles = profiles
         self.recorder = EventRecorder(store, component=scheduler_name)
         self.clock = clock or RealClock()
         self.cache = SchedulerCache(clock=self.clock)
@@ -302,8 +317,12 @@ class Scheduler:
                 collect_host_priority=False)
             self.algorithm.metrics = self.metrics   # encode/kernel/fetch phases
             from kubernetes_tpu.ops.pod_rows import PodRowCache
-            self.pod_rows = PodRowCache()
+            self.pod_rows = PodRowCache(
+                profile_fn=(profiles.index_of if profiles is not None
+                            else None))
             self.algorithm.pod_rows = self.pod_rows
+            if profiles is not None:
+                self.algorithm.set_profiles(profiles)
             if hasattr(store, "contains"):
                 # mid-burst node-death detection: the wave drivers scan
                 # each launch's decisions against the store after the
@@ -325,13 +344,25 @@ class Scheduler:
                 hard_pod_affinity_weight=hard_pod_affinity_weight,
                 nominated_pods_fn=self.queue.nominated.pods_for_node)
             self.algorithm.extenders = self.extenders
-        if priority_weights is not None:
+        if profiles is not None:
+            # per-profile PriorityConfig lists (the oracle/serial scoring
+            # side of the tensor rows — same vectors, pinnable parity)
+            self._profile_configs = [
+                profiles.oracle_configs(
+                    i, services_fn=self._services_fn,
+                    replicasets_fn=self._replicasets_fn,
+                    hard_pod_affinity_weight=hard_pod_affinity_weight)
+                for i in range(len(profiles))]
+            self._priority_configs = self._profile_configs[0]
+        elif priority_weights is not None:
             from kubernetes_tpu.factory import build_priority_configs
+            self._profile_configs = None
             self._priority_configs = build_priority_configs(
                 priority_weights, services_fn=self._services_fn,
                 replicasets_fn=self._replicasets_fn,
                 hard_pod_affinity_weight=hard_pod_affinity_weight)
         else:
+            self._profile_configs = None
             self._priority_configs = default_priority_configs(
                 services_fn=self._services_fn, replicasets_fn=self._replicasets_fn,
                 hard_pod_affinity_weight=hard_pod_affinity_weight)
@@ -343,6 +374,16 @@ class Scheduler:
             store=store, enabled=plugins_enabled)
         self._add_all_event_handlers()
         self._register_debug()
+
+    def _note_profile_scheduled(self, pods: list) -> None:
+        """Book successful bindings on the per-profile scheduled counter
+        (scheduler_profile_scheduled_total + the /debug/sched section)."""
+        if self.profiles is None:
+            return
+        for p in pods:
+            pid = self.profiles.index_of(p.scheduler_name)
+            if pid is not None:
+                self.profiles.note_scheduled(pid)
 
     def _register_debug(self) -> None:
         """Publish this scheduler's /debug/sched sections (queue depths,
@@ -359,6 +400,14 @@ class Scheduler:
                 return None
             return s.debug_state()
         obs.register_debug("scheduler", snap)
+        if self.profiles is not None:
+            # loaded profiles, weight rows, per-profile scheduled counts
+            pref = weakref.ref(self.profiles)
+
+            def psnap():
+                ps = pref()
+                return None if ps is None else ps.debug_state()
+            obs.register_debug("profiles", psnap)
 
     def debug_state(self) -> dict:
         from kubernetes_tpu.obs.ledger import LEDGER
@@ -377,6 +426,15 @@ class Scheduler:
 
     # -- event handlers (reference: eventhandlers.go:319) --------------------
     def _responsible_for(self, pod: Pod) -> bool:
+        if self.profiles is not None:
+            # multi-profile responsibility: any profile in the set claims
+            # the pod; an unknown schedulerName is REPORTED (counter +
+            # event, once per uid) and refused — never silently scored by
+            # the default profile
+            if self.profiles.index_of(pod.scheduler_name) is None:
+                self.profiles.report_unknown(pod, recorder=self.recorder)
+                return False
+            return True
         return pod.scheduler_name == self.name
 
     def _add_all_event_handlers(self) -> None:
@@ -674,7 +732,16 @@ class Scheduler:
             t.join(timeout)
         self._bind_threads = [t for t in self._bind_threads if t.is_alive()]
 
-    def _schedule(self, pod: Pod, names: list[str]) -> ScheduleResult:
+    def _pod_priority_configs(self, pod: Pod) -> list:
+        """The oracle-path PriorityConfig list for one pod: its profile's
+        vector when profiles are configured, else the single set."""
+        if self._profile_configs is not None:
+            pid = self.profiles.index_of(pod.scheduler_name)
+            return self._profile_configs[0 if pid is None else pid]
+        return self._priority_configs
+
+    def _schedule(self, pod: Pod, names: list[str],
+                  extra_configs=None) -> ScheduleResult:
         if isinstance(self.algorithm, GenericScheduler):
             from kubernetes_tpu.factory import (
                 build_predicate_set, DEFAULT_PREDICATE_NAMES)
@@ -684,11 +751,44 @@ class Scheduler:
                 volume_listers=self.volume_listers,
                 volume_binder=self.volume_binder,
                 services_fn=self._services_fn)
+            cfgs = self._pod_priority_configs(pod)
+            if extra_configs:
+                cfgs = list(cfgs) + list(extra_configs)
             return self.algorithm.schedule(
                 pod, self._snapshot.node_infos, names,
                 predicate_funcs=funcs,
-                priority_configs=self._priority_configs)
+                priority_configs=cfgs)
+        if extra_configs:
+            # trial-scoped extra priorities (gang locality): the TPU
+            # algorithm routes these through its host twin
+            return self.algorithm.schedule(
+                pod, self._snapshot.node_infos, names,
+                extra_configs=extra_configs)
         return self.algorithm.schedule(pod, self._snapshot.node_infos, names)
+
+    def _gang_schedule_fn(self, tracker: dict):
+        """Member dispatch for a serial gang trial: rank-aware profiles
+        append a GangLocalityPriority bound to the trial's LIVE zone
+        counts (`tracker["zones"]`), weighted by the member's profile
+        gang weight — the serial half of the fused kernel's per-segment
+        zone-count carry. Placement-blind members dispatch unchanged."""
+        if self.profiles is None:
+            return self._schedule
+        from kubernetes_tpu.oracle.generic_scheduler import PriorityConfig
+        from kubernetes_tpu.oracle import priorities as prios
+
+        def fn(pod: Pod, names: list[str]) -> ScheduleResult:
+            gw = self.profiles.gang_weight_for(pod.scheduler_name)
+            if not gw:
+                return self._schedule(pod, names)
+            cfg = PriorityConfig(
+                "GangLocalityPriority", gw,
+                function=lambda _p, nis, nodes: [
+                    prios.gang_locality_map(tracker["zones"], nis[n.name])
+                    for n in nodes])
+            return self._schedule(pod, names, extra_configs=[cfg])
+
+        return fn
 
     def _bind(self, assumed: Pod, host: str, orig: Pod, cycle: int,
               ctx: Optional[PluginContext] = None) -> bool:
@@ -753,6 +853,7 @@ class Scheduler:
             self.metrics.binding_duration.observe(self.clock.now() - t_bind)
             self.metrics.observe_phase("binding", self.clock.now() - t_bind)
             self.metrics.observe("scheduled")
+            self._note_profile_scheduled([assumed])
             # user-visible audit record (scheduler.go:433)
             self.recorder.pod_event(
                 assumed, NORMAL, "Scheduled",
@@ -1174,8 +1275,16 @@ class Scheduler:
         tree = self.cache.node_tree
         hosts = None
         committed = 0
+        # rank-aware gangs need the per-segment zone-count carry, which
+        # only the fused segments kernel and the serial referee model —
+        # the plain burst trial would score placement-blind, so it is
+        # ineligible for them (the fused window path upstream is the
+        # device home for rank-aware gangs)
+        rank_aware = self.profiles is not None and any(
+            self.profiles.gang_weight_for(p.scheduler_name) for p in pods)
         can_trial_burst = (hasattr(self.algorithm, "schedule_burst")
                            and not self.queue.nominated.has_any()
+                           and not rank_aware
                            and all(self._pod_is_burstable(p) for p in pods))
         if can_trial_burst:
             has_gchk = hasattr(self.algorithm, "gang_checkpoint")
@@ -1263,7 +1372,27 @@ class Scheduler:
 
             def refresh():
                 self._snapshot = self.cache.update_snapshot(self._snapshot)
-            hosts = trial.run(pods, self._schedule, refresh)
+
+            on_placed = None
+            schedule_fn = self._schedule
+            if rank_aware:
+                # trial-scoped zone-count tracker: the serial half of the
+                # fused kernel's gang set-scoring carry (a rollback
+                # discards it with the trial)
+                from kubernetes_tpu.api.types import get_zone_key
+                tracker = {"zones": {}}
+                schedule_fn = self._gang_schedule_fn(tracker)
+
+                def on_placed(host: str) -> None:
+                    ni = self._snapshot.node_infos.get(host)
+                    if ni is not None and ni.node is not None:
+                        z = get_zone_key(ni.node)
+                        if z:
+                            tracker["zones"][z] = \
+                                tracker["zones"].get(z, 0) + 1
+
+            hosts = trial.run(pods, schedule_fn, refresh,
+                              on_placed=on_placed)
             if hosts is None:
                 self._reject_gang(group, pods, 0)
                 return 0
@@ -1968,6 +2097,7 @@ class Scheduler:
         self.metrics.binding_duration.observe_many(dt / k, k)
         self.metrics.observe_phase("binding", dt / k, count=k)
         self.metrics.observe("scheduled", count=k)
+        self._note_profile_scheduled([a for a, _h in bound])
         if emit_batch:
             # stores without the wave verb (and the crash-resolution path)
             # land audit records in one batched write (scheduler.go:433)

@@ -1,0 +1,71 @@
+"""Profile-seed parity sweep (round 19 — the sixth 42-trial sweep).
+
+Not collected by pytest (no test_ prefix): run by hand after any change
+to the profile subsystem — the [profiles x priorities] weight-tensor
+kernels, per-pod profile-id plumbing, the rank-aware gang set-scoring
+carry, or the per-profile oracle configs —
+
+    JAX_PLATFORMS=cpu python tests/sweep_profile_seeds.py [trials] [base_seed]
+
+Each trial re-runs the long-range differential fuzzes with MULTI-PROFILE
+draws (2-3 profiles, distinct weight vectors, one rank-aware, assigned
+per pod/gang): the mixed-workload shell fuzz (every burst path gathers
+per-pod weight rows; the flight recorder replays each burst through the
+per-profile oracle referee) and the gang burst fuzz (the fused segment
+kernel's gang zone-count carry vs the serial GangLocalityPriority
+referee), with the wave/segment-boundary variants. Any divergence prints
+the failing (class, seed, wave_size).
+"""
+import random
+import sys
+from contextlib import contextmanager
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+@contextmanager
+def _flight_recorder():
+    from kubernetes_tpu.obs import flight
+    flight.RECORDER.configure(mode="replay", capacity=64)
+    flight.RECORDER.clear()
+    try:
+        yield flight.RECORDER
+    finally:
+        flight.RECORDER.configure(mode="digest")
+        flight.RECORDER.clear()
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    from tests.test_tpu_parity import TestMixedWorkloadShellFuzz
+    from tests.test_coscheduling import TestGangBurstParity
+
+    def mixed(t, s, w):
+        with _flight_recorder() as rec:
+            t.test_bindings_identical(s, w, rec, profiles=True)
+
+    def gang(t, s, w):
+        t.test_gang_parity(s, w, profiles=True)
+
+    classes = [
+        ("mixed-profiles", TestMixedWorkloadShellFuzz(), mixed),
+        ("gang-profiles", TestGangBurstParity(), gang),
+    ]
+    rng = random.Random(base_seed)
+    for trial in range(trials):
+        name, inst, fn = classes[trial % len(classes)]
+        seed = rng.randint(1, 10_000)
+        wave = rng.choice([None, 3, 4])
+        try:
+            fn(inst, seed, wave)
+        except Exception:
+            print(f"FAIL class={name} seed={seed} wave_size={wave}")
+            raise
+        print(f"ok {trial + 1}/{trials} {name} seed={seed} wave={wave}")
+    print(f"sweep green: {trials} trials")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

@@ -591,7 +591,8 @@ class TestGangBurstParity:
 
     @pytest.mark.parametrize("wave_size", [None, 3, 4])
     @pytest.mark.parametrize("seed", [2, 13, 29, 41])
-    def test_gang_parity(self, seed, wave_size, chaos=False, mesh=None):
+    def test_gang_parity(self, seed, wave_size, chaos=False, mesh=None,
+                         profiles=False):
         from kubernetes_tpu.api.types import (
             Affinity, ContainerPort, PodAntiAffinity, PodAffinityTerm,
             LabelSelector)
@@ -599,6 +600,23 @@ class TestGangBurstParity:
         n_nodes = rng.randint(5, 12)
         zones = rng.choice([1, 2, 3])
         cap = rng.choice([2000, 4000])
+        # multi-profile draws (round 19): three profiles with distinct
+        # weight vectors, one rank-aware — both worlds get the same
+        # ProfileSet and the same per-pod schedulerName assignments, so
+        # the fused tensor path must match the per-profile serial referee
+        prof_names = ["default-scheduler", "tenant-most", "tenant-rank"]
+
+        def make_profiles():
+            from kubernetes_tpu.profiles import (ProfileSet,
+                                                 SchedulingProfile)
+            return ProfileSet([
+                SchedulingProfile("default-scheduler"),
+                SchedulingProfile("tenant-most", weights=(
+                    ("MostRequestedPriority", 2),
+                    ("BalancedResourceAllocation", 1))),
+                SchedulingProfile("tenant-rank", rank_aware=True,
+                                  gang_weight=3),
+            ])
 
         def build():
             s = Store(watch_log_size=65536)
@@ -613,9 +631,12 @@ class TestGangBurstParity:
                 size = rng.randint(2, 5)
                 kind = rng.choice(["plain", "plain", "big", "hetero",
                                    "anti", "port"])
+                gprof = rng.choice(prof_names) if profiles else None
                 s.create(PODGROUPS, PodGroup(name=f"g{g}", min_member=size))
                 for r in range(size):
                     kw = {}
+                    if gprof is not None:
+                        kw["scheduler_name"] = gprof
                     cpu = rng.choice([100, 300, 500])
                     if kind == "big":
                         cpu = cap    # only one per node; size may exceed nodes
@@ -637,9 +658,12 @@ class TestGangBurstParity:
                     s.create(PODS, member(f"g{g}r{r}", f"g{g}", cpu=cpu,
                                           **kw))
             for j in range(rng.randint(5, 15)):
+                kw = {}
+                if profiles:
+                    kw["scheduler_name"] = rng.choice(prof_names)
                 s.create(PODS, singleton(
                     f"s{j}", cpu=rng.choice([200, 400, 800]),
-                    priority=rng.choice([0, 0, 0, 5, 9])))
+                    priority=rng.choice([0, 0, 0, 5, 9]), **kw))
 
         from tests.test_tpu_parity import set_world_chaos
         rng_state = rng.getstate()
@@ -651,7 +675,9 @@ class TestGangBurstParity:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu, clock=clock,
                               percentage_of_nodes_to_score=100,
-                              mesh=mesh if use_tpu else None)
+                              mesh=mesh if use_tpu else None,
+                              profiles=make_profiles() if profiles
+                              else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
                 # also force small SCAN SEGMENTS inside fused windows, so
@@ -678,6 +704,15 @@ class TestGangBurstParity:
         assert outs[0] == outs[1], (
             f"seed={seed} wave={wave_size}: gang decisions diverged: "
             f"{[a for a, b in zip(*outs) if a != b][:6]}")
+
+    # round-19: multi-profile draws — 2-3 profiles with distinct weight
+    # vectors, one rank-aware, mixed across gangs AND singletons; the
+    # fused weight-tensor path (per-pod rows, gang zone-count carry) must
+    # stay bit-identical to the per-profile serial referee
+    @pytest.mark.parametrize("wave_size", [None, 3])
+    @pytest.mark.parametrize("seed", [2, 13, 29, 41])
+    def test_gang_parity_profiles(self, seed, wave_size):
+        self.test_gang_parity(seed, wave_size, profiles=True)
 
     def test_gang_parity_under_injection(self):
         """Round-13 acceptance: gang atomicity + parity hold with the

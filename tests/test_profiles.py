@@ -1,0 +1,594 @@
+"""Scheduling profiles (round 19): the [profiles x priorities] scoring
+tensor + rank-aware gang set-scoring, end to end.
+
+- ProfileSet validation rides the apis/policy bounds (positive weights,
+  MAX_WEIGHT, duplicate names, unknown priorities) — table tests.
+- The weight tensor's layout is pinned to ops.kernels.PRIORITY_AXIS and
+  row 0 (default profile) reproduces DEFAULT_WEIGHTS exactly.
+- PodRowCache gains the profile_id column (encode-at-admission, the
+  bit-identity contract extends to it).
+- Unknown spec.schedulerName is REPORTED (counter + event), never
+  silently default-scored — solo shell and fleet manager both.
+- Per-profile parity: multi-profile workloads (distinct weight vectors,
+  one rank-aware) scheduled by the TPU burst path vs the pure-oracle
+  shell must bind identically; rank-aware gangs must actually pack
+  fewer zones than placement-blind ones.
+- /debug/sched gains the profiles section.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import Container, Node, Pod
+from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+from kubernetes_tpu.profiles import (
+    DEFAULT_PROFILE_NAME, PROFILE_UNKNOWN, ProfileSet,
+    ProfileValidationError, SchedulingProfile,
+)
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.store.store import EVENTS, NODES, PODGROUPS, PODS, Store
+from kubernetes_tpu.utils.clock import FakeClock
+
+GI = 1024 ** 3
+LABEL_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+def mknode(name, cpu=4000, zone=None, mem=32 * GI):
+    labels = {LABEL_HOSTNAME: name}
+    if zone is not None:
+        labels[LABEL_ZONE] = zone
+    return Node(name=name, labels=labels,
+                allocatable={"cpu": cpu, "memory": mem, "pods": 110})
+
+
+def mkpod(name, cpu=100, sched=DEFAULT_PROFILE_NAME, **kw):
+    containers = kw.pop("containers", (Container.make(
+        name="c", requests={"cpu": cpu, "memory": GI}),))
+    return Pod(name=name, scheduler_name=sched, containers=containers, **kw)
+
+
+def drain(sched, rounds=30, max_pods=16):
+    for _ in range(rounds):
+        sched.pump()
+        n = sched.schedule_burst(max_pods=max_pods)
+        sched.pump()
+        if n == 0:
+            break
+
+
+# ---------------------------------------------------------------------------
+# validation (apis/policy bounds) — table tests
+# ---------------------------------------------------------------------------
+class TestProfileValidation:
+    def test_good_set_validates(self):
+        ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("t", weights=(
+                ("MostRequestedPriority", 2),
+                ("BalancedResourceAllocation", 1))),
+            SchedulingProfile("r", rank_aware=True, gang_weight=3),
+        ])
+
+    @pytest.mark.parametrize("profiles,frag", [
+        # duplicate profile names are errors
+        ([SchedulingProfile("a"), SchedulingProfile("a")], "duplicate"),
+        # unknown priority names are errors
+        ([SchedulingProfile("a", weights=(("NoSuchPriority", 1),))],
+         "unknown priority"),
+        # positive-weight bound (api/validation)
+        ([SchedulingProfile("a", weights=(("LeastRequestedPriority", 0),))],
+         "positive"),
+        ([SchedulingProfile("a", weights=(("LeastRequestedPriority", -3),))],
+         "positive"),
+        # MAX_WEIGHT bound: weight * MaxPriority must fit int32
+        ([SchedulingProfile("a", weights=(
+            ("LeastRequestedPriority", 1 << 31),))], "too large"),
+        # the rank-aware gang weight rides the same bounds
+        ([SchedulingProfile("a", rank_aware=True, gang_weight=0)],
+         "positive"),
+        ([SchedulingProfile("a", rank_aware=True, gang_weight=1 << 31)],
+         "too large"),
+        # empty profile name
+        ([SchedulingProfile("")], "empty"),
+    ])
+    def test_bad_sets_refused(self, profiles, frag):
+        with pytest.raises(ProfileValidationError) as ei:
+            ProfileSet(profiles)
+        assert frag in str(ei.value)
+
+    def test_gang_weight_unchecked_when_not_rank_aware(self):
+        # the knob is inert off — no bound applies
+        ProfileSet([SchedulingProfile("a", gang_weight=0)])
+
+    def test_from_dict_shapes(self):
+        ps = ProfileSet.from_dict({"profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "t",
+             "priorities": {"MostRequestedPriority": 2}},
+            {"schedulerName": "r",
+             "priorities": [{"name": "LeastRequestedPriority",
+                             "weight": 4}],
+             "rankAwareGang": True, "gangWeight": 5},
+        ]})
+        assert [p.name for p in ps] == ["default-scheduler", "t", "r"]
+        assert ps.profiles[1].name_weights()["MostRequestedPriority"] == 2
+        assert ps.profiles[2].rank_aware and ps.profiles[2].gang_weight == 5
+        assert ps.gang_weight_for("r") == 5
+        assert ps.gang_weight_for("t") == 0
+        assert ps.index_of("nobody") is None
+
+
+# ---------------------------------------------------------------------------
+# tensor layout
+# ---------------------------------------------------------------------------
+class TestWeightTensor:
+    def test_axis_layout_and_default_row(self):
+        from kubernetes_tpu.ops.kernels import (
+            DEFAULT_WEIGHTS, PRIORITY_AXIS, _AXIS_INDEX)
+        ps = ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("t", weights=(("MostRequestedPriority", 7),),
+                              rank_aware=True, gang_weight=9),
+        ])
+        tab = ps.weight_table()
+        assert tab.shape == (2, len(PRIORITY_AXIS))
+        assert tab.dtype == np.int64
+        # row 0 IS the provider default vector — bit-identical scoring
+        for k, w in DEFAULT_WEIGHTS.items():
+            assert tab[0, _AXIS_INDEX[k]] == w
+        assert tab[0, _AXIS_INDEX["gang_locality"]] == 0
+        # row 1: only the named priorities + the gang knob
+        assert tab[1, _AXIS_INDEX["most_requested"]] == 7
+        assert tab[1, _AXIS_INDEX["least_requested"]] == 0
+        assert tab[1, _AXIS_INDEX["gang_locality"]] == 9
+
+    def test_tensor_mode_degenerate_default_off(self):
+        assert not ProfileSet([SchedulingProfile(
+            DEFAULT_PROFILE_NAME)]).tensor_mode()
+        assert not ProfileSet().tensor_mode()
+        assert ProfileSet([SchedulingProfile("a"),
+                           SchedulingProfile("b")]).tensor_mode()
+        assert ProfileSet([SchedulingProfile(
+            "a", rank_aware=True)]).tensor_mode()
+        assert ProfileSet([SchedulingProfile(
+            "a", weights=(("LeastRequestedPriority", 5),))]).tensor_mode()
+
+    def test_union_gates_every_profiled_family(self):
+        ps = ProfileSet([
+            SchedulingProfile("a", weights=(("LeastRequestedPriority", 1),)),
+            SchedulingProfile("b", weights=(("MostRequestedPriority", 3),)),
+        ])
+        u = ps.union_kernel_weights()
+        assert u["least_requested"] == 1 and u["most_requested"] == 3
+        assert u["balanced"] == 0 and u["gang_locality"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pod-row cache profile_id column
+# ---------------------------------------------------------------------------
+class TestPodRowProfileColumn:
+    def test_profile_id_encoded_at_admission_and_gathered(self):
+        from kubernetes_tpu.ops.pod_rows import PodRowCache, encode_row
+        ps = ProfileSet([SchedulingProfile("default-scheduler"),
+                         SchedulingProfile("tenant")])
+        rc = PodRowCache(profile_fn=ps.index_of)
+        pods = [mkpod("a"), mkpod("b", sched="tenant")]
+        for i, p in enumerate(pods):
+            p.uid = f"u{i}"
+            p.resource_version = 3
+            rc.insert(p)
+        g = rc.gather(pods, ("profile_id",))
+        assert g["profile_id"].tolist() == [0, 1]
+        # bit-identity contract extends to the new column: cached row ==
+        # fresh encode_row under the same resolver, field for field
+        for p in pods:
+            assert rc.lookup_row(p) == encode_row(p, ps.index_of)
+        # miss fallback uses the SAME resolver
+        stray = mkpod("x", sched="tenant")
+        stray.uid = "u9"
+        assert rc.lookup_row(stray)["profile_id"] == 1
+
+    def test_default_cache_stays_zero(self):
+        from kubernetes_tpu.ops.pod_rows import encode_row
+        assert encode_row(mkpod("a", sched="whatever"))["profile_id"] == 0
+
+
+# ---------------------------------------------------------------------------
+# unknown-profile reporting (satellite: counter + event, never scored)
+# ---------------------------------------------------------------------------
+class TestUnknownProfile:
+    def _profiles(self):
+        return ProfileSet([SchedulingProfile("default-scheduler"),
+                           SchedulingProfile("tenant")])
+
+    def test_shell_reports_and_refuses(self):
+        s = Store(watch_log_size=65536)
+        for i in range(4):
+            s.create(NODES, mknode(f"n{i}"))
+        sched = Scheduler(s, use_tpu=False, clock=FakeClock(10.0),
+                          profiles=self._profiles())
+        sched.sync()
+        before = PROFILE_UNKNOWN.value
+        s.create(PODS, mkpod("ok"))
+        s.create(PODS, mkpod("stray", sched="no-such-scheduler"))
+        drain(sched)
+        pods = {p.name: p for p in s.list(PODS)[0]}
+        assert pods["ok"].node_name            # claimed profile scheduled
+        assert not pods["stray"].node_name     # unknown: NOT default-scored
+        assert PROFILE_UNKNOWN.value == before + 1
+        msgs = [e.message for e in s.list(EVENTS)[0]
+                if "no scheduling profile" in e.message]
+        assert any("no-such-scheduler" in m for m in msgs)
+
+    def test_fleet_manager_reports(self):
+        from kubernetes_tpu.fleet.manager import FleetManager
+        from kubernetes_tpu.fleet.instance import FleetInstance
+        clock = FakeClock(50.0)
+        s = Store(watch_log_size=65536)
+        for i in range(4):
+            s.create(NODES, mknode(f"n{i}"))
+        ps = self._profiles()
+        mgr = FleetManager(
+            s, ["i0"],
+            lambda ident: FleetInstance(
+                s, ident, ["i0"], profile="tenant", clock=clock,
+                profiles=self._profiles()),
+            clock=clock, profiles=ps)
+        before = PROFILE_UNKNOWN.value
+        mgr.create_pods([mkpod("good", sched="tenant"),
+                         mkpod("lost", sched="ghost-scheduler")])
+        for _ in range(6):
+            mgr.step_all()
+            clock.step(2.0)
+        pods = {p.name: p for p in s.list(PODS)[0]}
+        assert pods["good"].node_name
+        assert not pods["lost"].node_name
+        assert PROFILE_UNKNOWN.value == before + 1
+        assert ps.unknown_names.get("ghost-scheduler") == 1
+
+    def test_fleet_instance_rejects_unclaimed_profile(self):
+        from kubernetes_tpu.fleet.instance import FleetInstance
+        s = Store(watch_log_size=65536)
+        with pytest.raises(ValueError):
+            FleetInstance(s, "i0", ["i0"], profile="ghost",
+                          profiles=self._profiles())
+
+
+# ---------------------------------------------------------------------------
+# per-profile parity: device tensor vs oracle configs
+# ---------------------------------------------------------------------------
+def _parity_profiles():
+    return ProfileSet([
+        SchedulingProfile("default-scheduler"),
+        SchedulingProfile("tenant-most", weights=(
+            ("MostRequestedPriority", 2),
+            ("BalancedResourceAllocation", 1))),
+        SchedulingProfile("tenant-rank", rank_aware=True, gang_weight=3),
+    ])
+
+
+class TestProfileParity:
+    @pytest.mark.parametrize("seed", [7, 19, 53])
+    def test_mixed_profile_bursts_identical(self, seed):
+        """Mixed-tenant windows (three profiles, distinct weight vectors)
+        through the TPU tensor path vs the per-profile oracle configs —
+        bindings must be identical."""
+        outs = []
+        for use_tpu in (True, False):
+            rng = random.Random(seed)
+            s = Store(watch_log_size=65536)
+            n_nodes = rng.randint(6, 12)
+            for i in range(n_nodes):
+                s.create(NODES, mknode(f"n{i}", zone=f"z{i % 3}"))
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100,
+                              profiles=_parity_profiles())
+            sched.sync()
+            names = ["default-scheduler", "tenant-most", "tenant-rank"]
+            for j in range(rng.randint(20, 40)):
+                s.create(PODS, mkpod(
+                    f"p{j}", cpu=rng.choice([100, 300, 700]),
+                    sched=rng.choice(names)))
+            drain(sched)
+            outs.append(sorted((p.key, p.node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1], \
+            [a for a, b in zip(*outs) if a != b][:6]
+
+    def test_profiles_actually_change_decisions(self):
+        """MostRequested (packing) vs LeastRequested (spreading) must
+        place identical pods differently — the tensor rows are live, not
+        decorative."""
+        def run(sched_name):
+            s = Store(watch_log_size=65536)
+            for i in range(4):
+                s.create(NODES, mknode(f"n{i}"))
+            sched = Scheduler(s, use_tpu=True,
+                              percentage_of_nodes_to_score=100,
+                              profiles=_parity_profiles())
+            sched.sync()
+            # pre-load n0 so pack-vs-spread diverges
+            s.create(PODS, mkpod("seed", cpu=800, sched=sched_name))
+            drain(sched)
+            for j in range(3):
+                s.create(PODS, mkpod(f"p{j}", cpu=400, sched=sched_name))
+            drain(sched)
+            return sorted(p.node_name for p in s.list(PODS)[0]
+                          if p.name != "seed" and p.node_name)
+        spread = run("default-scheduler")
+        packed = run("tenant-most")
+        assert spread != packed
+        # MostRequested keeps stacking the seeded node
+        assert len(set(packed)) < len(set(spread))
+
+    @pytest.mark.parametrize("seed", [3, 11])
+    def test_rank_aware_gang_parity_and_locality(self, seed):
+        """Rank-aware gangs: the fused kernel's per-segment zone-count
+        carry vs the serial referee's GangLocalityPriority — identical
+        bindings, and rank-aware gangs must land in no more zones than
+        the same-size placement-blind gangs."""
+        outs = []
+        for use_tpu in (True, False):
+            rng = random.Random(seed)
+            s = Store(watch_log_size=65536)
+            for i in range(9):
+                s.create(NODES, mknode(f"n{i}", zone=f"z{i % 3}"))
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100,
+                              profiles=_parity_profiles())
+            sched.sync()
+            for g in range(4):
+                prof = "tenant-rank" if g % 2 == 0 else "default-scheduler"
+                size = rng.randint(2, 4)
+                s.create(PODGROUPS, PodGroup(name=f"g{g}",
+                                             min_member=size))
+                for r in range(size):
+                    s.create(PODS, mkpod(
+                        f"g{g}r{r}", cpu=rng.choice([100, 300]),
+                        sched=prof,
+                        labels={LABEL_POD_GROUP: f"g{g}"}))
+            for j in range(6):
+                s.create(PODS, mkpod(f"s{j}", cpu=200))
+            drain(sched, max_pods=8)
+            outs.append(sorted((p.key, p.node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1], \
+            [a for a, b in zip(*outs) if a != b][:6]
+        # locality: rank-aware (even g) gangs pack into ONE zone here
+        zones: dict[str, set] = {}
+        for k, n in outs[0]:
+            name = k.split("/")[-1]
+            if n and name.startswith("g"):
+                zones.setdefault(name.split("r")[0], set()).add(
+                    int(n[1:]) % 3)
+        for g, zs in zones.items():
+            if int(g[1:]) % 2 == 0 and len(zs) > 1:
+                pytest.fail(f"rank-aware gang {g} spread over {zs}")
+
+    def test_single_pod_cycles_match_serial_referee(self):
+        """The tensor-mode device CYCLE (one pod per launch) must agree
+        with the per-profile host twin — run the same stream through
+        serial_path='device' and 'host'."""
+        results = {}
+        for path in ("device", "host"):
+            s = Store(watch_log_size=65536)
+            for i in range(5):
+                s.create(NODES, mknode(f"n{i}", zone=f"z{i % 2}"))
+            sched = Scheduler(s, use_tpu=True,
+                              percentage_of_nodes_to_score=100,
+                              profiles=_parity_profiles())
+            sched.algorithm.serial_path = path
+            sched.sync()
+            for j in range(8):
+                s.create(PODS, mkpod(
+                    f"p{j}", cpu=[100, 400, 700][j % 3],
+                    sched=["default-scheduler", "tenant-most"][j % 2]))
+            sched.pump()
+            # serial loop only (no bursts): one cycle per pod
+            for _ in range(20):
+                if not sched.schedule_one(timeout=0):
+                    break
+            sched.pump()
+            results[path] = sorted((p.key, p.node_name)
+                                   for p in s.list(PODS)[0])
+        assert results["device"] == results["host"]
+
+
+# ---------------------------------------------------------------------------
+# /debug/sched profiles section
+# ---------------------------------------------------------------------------
+class TestProfilesDebug:
+    def test_debug_section_lists_rows_and_counts(self):
+        from kubernetes_tpu import obs
+        s = Store(watch_log_size=65536)
+        for i in range(3):
+            s.create(NODES, mknode(f"n{i}"))
+        ps = _parity_profiles()
+        sched = Scheduler(s, use_tpu=False, profiles=ps)
+        sched.sync()
+        s.create(PODS, mkpod("a"))
+        s.create(PODS, mkpod("b", sched="tenant-most"))
+        drain(sched)
+        snap = obs.debug_snapshot()
+        sec = snap["profiles"]
+        assert sec["tensor_mode"] is True
+        names = [p["name"] for p in sec["profiles"]]
+        assert names == ["default-scheduler", "tenant-most", "tenant-rank"]
+        from kubernetes_tpu.ops.kernels import PRIORITY_AXIS
+        assert sec["priority_axis"] == list(PRIORITY_AXIS)
+        assert all(len(p["weights"]) == len(PRIORITY_AXIS)
+                   for p in sec["profiles"])
+        by = {p["name"]: p["scheduled"] for p in sec["profiles"]}
+        assert by["default-scheduler"] >= 1
+        assert by["tenant-most"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# KubeSchedulerConfiguration carrier (apis/config) + serve-loop windows
+# ---------------------------------------------------------------------------
+class TestConfigCarrier:
+    def test_config_round_trips_and_builds_profiles(self):
+        from kubernetes_tpu.apis.config import (
+            SchedulerConfiguration, ValidationError, validate)
+        cfg = SchedulerConfiguration.from_dict({"profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "t",
+             "priorities": {"MostRequestedPriority": 2},
+             "rankAwareGang": True, "gangWeight": 4},
+        ]})
+        validate(cfg)
+        ps = cfg.build_profiles()
+        assert [p.name for p in ps] == ["default-scheduler", "t"]
+        assert ps.gang_weight_for("t") == 4
+        # round trip through the dict serialization
+        ps2 = SchedulerConfiguration.from_dict(cfg.to_dict()) \
+            .build_profiles()
+        assert ps2.weight_table().tolist() == ps.weight_table().tolist()
+        # invalid profile content surfaces as config ValidationError
+        bad = SchedulerConfiguration.from_dict({"profiles": [
+            {"schedulerName": "a",
+             "priorities": {"NoSuchPriority": 1}}]})
+        with pytest.raises(ValidationError):
+            validate(bad)
+        # no-profiles config stays single-profile
+        assert SchedulerConfiguration().build_profiles() is None
+
+
+class TestServeMixedProfiles:
+    def test_serve_windows_mix_tenants_with_parity(self):
+        """Mixed-profile arrival batches through ServeLoop windows: the
+        TPU world's windows gather per-pod weight rows mid-stream; the
+        oracle world schedules the same arrivals serially — bindings
+        must be identical (windows fully drain between batches, so the
+        streams are serial-equivalent)."""
+        from kubernetes_tpu.serve.loop import ServeLoop
+        names = ["default-scheduler", "tenant-most", "tenant-rank"]
+        outs = []
+        for use_tpu in (True, False):
+            rng = random.Random(5)
+            s = Store(watch_log_size=65536)
+            for i in range(8):
+                s.create(NODES, mknode(f"n{i}", zone=f"z{i % 2}"))
+            sched = Scheduler(s, use_tpu=use_tpu,
+                              percentage_of_nodes_to_score=100,
+                              profiles=_parity_profiles())
+            loop = ServeLoop(sched, window_size=8, depth=2)
+            sched.sync()
+            for batch in range(5):
+                for j in range(rng.randint(4, 10)):
+                    s.create(PODS, mkpod(
+                        f"b{batch}p{j}",
+                        cpu=rng.choice([100, 300, 700]),
+                        sched=rng.choice(names)))
+                for _ in range(4):
+                    loop.step()
+            for _ in range(10):
+                loop.step()
+            outs.append(sorted((p.key, p.node_name)
+                               for p in s.list(PODS)[0]))
+        assert outs[0] == outs[1], \
+            [a for a, b in zip(*outs) if a != b][:6]
+
+
+class TestPressureProfileGate:
+    def _world(self, n_nodes=4):
+        from kubernetes_tpu.cache.node_info import NodeInfo
+        infos, names = {}, []
+        for i in range(n_nodes):
+            node = Node(name=f"n{i}",
+                        allocatable={"cpu": 1000, "memory": 8 * GI,
+                                     "pods": 110})
+            ni = NodeInfo(node)
+            victim = Pod(name=f"v{i}", priority=0, node_name=node.name,
+                         containers=(Container.make(
+                             name="c", requests={"cpu": 800}),))
+            ni.add_pod(victim)
+            infos[node.name] = ni
+            names.append(node.name)
+        return infos, names
+
+    def _preemptors(self, sched_names):
+        return [Pod(name=f"hi{k}", priority=10, scheduler_name=sn,
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 600}),))
+                for k, sn in enumerate(sched_names)]
+
+    def test_mixed_profile_tail_refuses(self):
+        from kubernetes_tpu.core.tpu_scheduler import (PRESSURE_GATES,
+                                                       TPUScheduler)
+        infos, names = self._world()
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        tpu.set_profiles(_parity_profiles())
+        g0 = PRESSURE_GATES.labels("profile-mixed").value
+        out = tpu.preempt_pressure_burst(
+            self._preemptors(["default-scheduler", "tenant-most"]),
+            infos, names, [])
+        assert out is None   # refused whole: the serial loop re-derives
+        assert PRESSURE_GATES.labels("profile-mixed").value - g0 == 1
+
+    def test_single_profile_tail_scores_with_its_row(self):
+        """A tenant-most pressure tail must produce the SAME outcomes as
+        a scheduler configured with that vector the pre-profile way
+        (priority_name_weights) — the per-profile static row is the same
+        weights, different plumbing."""
+        from kubernetes_tpu.core.tpu_scheduler import TPUScheduler
+        from kubernetes_tpu.factory import tpu_kernel_weights
+        vec = {"MostRequestedPriority": 2, "BalancedResourceAllocation": 1}
+        outs = []
+        for mode in ("profiles", "weights"):
+            infos, names = self._world()
+            tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+            if mode == "profiles":
+                tpu.set_profiles(_parity_profiles())
+                pods = self._preemptors(["tenant-most"] * 4)
+            else:
+                tpu.weights = tpu_kernel_weights(vec)
+                tpu.priority_name_weights = vec
+                pods = self._preemptors(["default-scheduler"] * 4)
+            out = tpu.preempt_pressure_burst(pods, infos, names, [])
+            assert out is not None
+            outs.append([(oc[0], oc[1] if len(oc) > 1 else None)
+                         for oc in out])
+        assert outs[0] == outs[1]
+
+
+class TestProfileParitySharded:
+    """Round-15 one-code-path rule: the tensor-mode kernels must run
+    sharded through the same constrain hooks with no new fallback labels
+    — mixed-profile windows and rank-aware gangs over the conftest
+    8-device mesh, bindings identical to the pure-oracle world."""
+
+    def _run_world(self, seed, use_tpu, mesh):
+        rng = random.Random(seed)
+        s = Store(watch_log_size=65536)
+        n_nodes = 8   # splits evenly over the 8-device mesh
+        for i in range(n_nodes):
+            s.create(NODES, mknode(f"n{i}", zone=f"z{i % 3}"))
+        sched = Scheduler(s, use_tpu=use_tpu,
+                          percentage_of_nodes_to_score=100,
+                          mesh=mesh if use_tpu else None,
+                          profiles=_parity_profiles())
+        sched.sync()
+        names = ["default-scheduler", "tenant-most", "tenant-rank"]
+        for g in range(2):
+            size = rng.randint(2, 3)
+            s.create(PODGROUPS, PodGroup(name=f"g{g}", min_member=size))
+            gprof = rng.choice(names)
+            for r in range(size):
+                s.create(PODS, mkpod(f"g{g}r{r}", cpu=rng.choice(
+                    [100, 300]), sched=gprof,
+                    labels={LABEL_POD_GROUP: f"g{g}"}))
+        for j in range(12):
+            s.create(PODS, mkpod(f"p{j}", cpu=rng.choice([100, 300, 700]),
+                                 sched=rng.choice(names)))
+        drain(sched, max_pods=8)
+        return sorted((p.key, p.node_name) for p in s.list(PODS)[0])
+
+    @pytest.mark.parametrize("seed", [7, 29])
+    def test_sharded_tensor_parity(self, seed):
+        from kubernetes_tpu.parallel import sharding as S
+        got = self._run_world(seed, True, S.make_mesh(8))
+        want = self._run_world(seed, False, None)
+        assert got == want, [a for a, b in zip(got, want) if a != b][:6]

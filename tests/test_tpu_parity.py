@@ -705,7 +705,7 @@ class TestMixedWorkloadShellFuzz:
     @pytest.mark.parametrize("wave_size", [None, 4])
     @pytest.mark.parametrize("seed", [11, 23, 47, 5, 31, 61])
     def test_bindings_identical(self, seed, wave_size, flight_replay,
-                                chaos=False, mesh=None):
+                                chaos=False, mesh=None, profiles=False):
         import random
         from kubernetes_tpu.store.store import Store, PODS, NODES
         from kubernetes_tpu.scheduler import Scheduler
@@ -767,7 +767,26 @@ class TestMixedWorkloadShellFuzz:
                 kw["containers"] = (Container.make(
                     name="c", requests={"cpu": rng.choice([100, 300, 700]),
                                         "memory": GI}),)
+            if profiles:
+                kw["scheduler_name"] = rng.choice(
+                    ["default-scheduler", "tenant-most", "tenant-rank"])
             return Pod(name=f"p{j}", **kw)
+
+        def make_profiles():
+            # round-19 multi-profile draws: three distinct weight rows,
+            # one rank-aware — both worlds get the same set, so mixed-
+            # tenant windows pin the weight-tensor gather against the
+            # per-profile serial configs
+            from kubernetes_tpu.profiles import (ProfileSet,
+                                                 SchedulingProfile)
+            return ProfileSet([
+                SchedulingProfile("default-scheduler"),
+                SchedulingProfile("tenant-most", weights=(
+                    ("MostRequestedPriority", 2),
+                    ("BalancedResourceAllocation", 1))),
+                SchedulingProfile("tenant-rank", rank_aware=True,
+                                  gang_weight=3),
+            ])
 
         # one pod stream, two worlds
         rng_state = rng.getstate()
@@ -778,7 +797,9 @@ class TestMixedWorkloadShellFuzz:
             s = build()
             sched = Scheduler(s, use_tpu=use_tpu,
                               percentage_of_nodes_to_score=100,
-                              mesh=mesh if use_tpu else None)
+                              mesh=mesh if use_tpu else None,
+                              profiles=make_profiles() if profiles
+                              else None)
             if use_tpu and wave_size:
                 sched.algorithm.wave_size = wave_size
             sched.sync()
@@ -799,6 +820,17 @@ class TestMixedWorkloadShellFuzz:
         finish_with_flight(
             flight_replay, f"mixed-{seed}-{wave_size}", not diff,
             f"seed={seed}: {len(diff)} diverged: {sorted(diff.items())[:6]}")
+
+    # round-19: the same differential fuzz with multi-profile draws —
+    # every pod draws a scheduling profile (distinct weight vectors, one
+    # rank-aware) so mixed-tenant windows exercise the per-pod weight-row
+    # gather on every burst path vs the per-profile oracle configs
+    @pytest.mark.parametrize("wave_size", [None, 4])
+    @pytest.mark.parametrize("seed", [11, 47, 31])
+    def test_bindings_identical_profiles(self, seed, wave_size,
+                                         flight_replay):
+        self.test_bindings_identical(seed, wave_size, flight_replay,
+                                     profiles=True)
 
     def test_bindings_identical_under_injection(self, flight_replay):
         """Round-13 acceptance: the same differential fuzz stays
@@ -1663,6 +1695,72 @@ class TestDeviceFetchContract:
         assert all(h is None for h in hosts[4:])   # undecided from failure
         assert DEVICE_DISPATCH.labels("burst_scan").value - d0 == 1
         assert DEVICE_FETCHES.labels("burst_scan").value - f0 == 1
+
+    def test_mixed_profile_scan_burst_one_fetch(self):
+        """Round 19: a window MIXING scheduling profiles rides the
+        weight-tensor generic scan as ONE dispatch + ONE packed fetch —
+        the per-pod weight-row gather happens in-kernel, never as extra
+        device traffic."""
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        from kubernetes_tpu.profiles import ProfileSet, SchedulingProfile
+        infos, names = self._uniform_world()
+        pods = []
+        for k in range(12):
+            pods.append(Pod(
+                name=f"p{k}",
+                scheduler_name=["default-scheduler", "tenant-most"][k % 2],
+                labels={"sz": str(k % 3)},
+                containers=(Container.make(
+                    name="c", requests={"cpu": [100, 300, 500][k % 3]}),)))
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        tpu.set_profiles(ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("tenant-most", weights=(
+                ("MostRequestedPriority", 2),
+                ("BalancedResourceAllocation", 1))),
+        ]))
+        d0 = DEVICE_DISPATCH.labels("burst_scan").value
+        f0 = DEVICE_FETCHES.labels("burst_scan").value
+        hosts = tpu.schedule_burst(pods, infos, names)
+        assert hosts is not None and all(h is not None for h in hosts)
+        assert DEVICE_DISPATCH.labels("burst_scan").value - d0 == 1
+        assert DEVICE_FETCHES.labels("burst_scan").value - f0 == 1
+
+    def test_mixed_profile_fused_window_one_fetch(self):
+        """Round 19: a fused drain window mixing profiles ACROSS
+        segments (a rank-aware gang + default singletons) stays ONE
+        dispatch + ONE packed fetch — the gang zone-count carry and the
+        tensor rows ride the launch."""
+        from kubernetes_tpu.core.tpu_scheduler import (DEVICE_DISPATCH,
+                                                       DEVICE_FETCHES)
+        from kubernetes_tpu.profiles import ProfileSet, SchedulingProfile
+        infos, names = self._uniform_world(6)
+        gang = [Pod(name=f"g{k}", scheduler_name="tenant-rank",
+                    labels={"g": "1"},
+                    containers=(Container.make(
+                        name="c", requests={"cpu": 100}),))
+                for k in range(3)]
+        singles = [Pod(name=f"s{k}",
+                       containers=(Container.make(
+                           name="c", requests={"cpu": 200}),))
+                   for k in range(4)]
+        tpu = TPUScheduler(percentage_of_nodes_to_score=100)
+        tpu.set_profiles(ProfileSet([
+            SchedulingProfile("default-scheduler"),
+            SchedulingProfile("tenant-rank", rank_aware=True,
+                              gang_weight=3),
+        ]))
+        d0 = DEVICE_DISPATCH.labels("burst_fused").value
+        f0 = DEVICE_FETCHES.labels("burst_fused").value
+        res = tpu.schedule_burst_fused(
+            [(singles[:2], False), (gang, True), (singles[2:], False)],
+            infos, names)
+        assert res is not None
+        assert [seg["status"] for seg in res["segments"]] \
+            == ["decided", "decided", "decided"]
+        assert DEVICE_DISPATCH.labels("burst_fused").value - d0 == 1
+        assert DEVICE_FETCHES.labels("burst_fused").value - f0 == 1
 
     def test_launch_queue_depth3_one_fetch_per_window(self):
         """Round 16: the N-deep launch queue at depth 3 with window-sized
