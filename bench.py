@@ -724,7 +724,7 @@ def run_fleet_bench(n_nodes: int, instances: int, arrival_rate: float,
 
 
 def run_commit_bench(n_pods: int = 4096, waves: int = 8,
-                     watchers: int = 8) -> dict:
+                     watchers: int = 8, watch_classes: int = 1) -> dict:
     """`--mode commit`: the round-11 commit-core lane — the store-write +
     watch-fan-out tail of a burst wave in isolation (ONE commit_wave +
     ONE fanout_wave call per wave; perf.harness.run_commit_cell). Runs
@@ -732,20 +732,30 @@ def run_commit_bench(n_pods: int = 4096, waves: int = 8,
     wave sequence and asserts the observable streams bit-identical
     (per-wave missing keys + resourceVersions, and the full first-watcher
     event stream) before reporting — the same in-bench referee posture as
-    the gang lane's atomicity audit. One JSON line."""
+    the gang lane's atomicity audit. One JSON line.
+
+    Round 20: `--watchers N` scales the fan-out plane (N watchers split
+    across `--watch-classes` shared subscription classes; default 1 —
+    everyone shares one materialize-once/encode-once class). At >= 1000
+    watchers the lane also measures the DEGENERATE class-per-watcher
+    mode at min(1000, N) watchers in the same run: its copy-out rate is
+    watcher-count-independent (every copy-out pays a materialization),
+    so it IS the per-watcher-extrapolated cost the scaling floor divides
+    by — `vs_per_watcher` >= 5 at 10k watchers is the sublinearity gate."""
     from kubernetes_tpu.perf.harness import run_commit_cell
     audit: list = []
-    r = run_commit_cell(n_pods, waves, watchers, audit=audit)
+    r = run_commit_cell(n_pods, waves, watchers, audit=audit,
+                        watch_classes=watch_classes)
     twin_audit: list = []
     t = run_commit_cell(n_pods, waves, watchers, impl="twin",
-                        audit=twin_audit)
+                        audit=twin_audit, watch_classes=watch_classes)
     # referee: rv assignment, missing detection, and the watch sequence
     # must be bit-identical between the native core and the twin (both
     # runs replay the same op sequence from rv 0)
     assert audit[:-1] == twin_audit[:-1], "commit core rv/missing drift"
     assert audit[-1] == twin_audit[-1], "commit core watch-stream drift"
     serial = r["serial_writes_per_s"]
-    return {
+    out = {
         "metric": f"commit_core_{n_pods}p_{waves}w",
         "value": r["writes_per_s"],
         "unit": "writes/s",
@@ -753,6 +763,11 @@ def run_commit_bench(n_pods: int = 4096, waves: int = 8,
         "events_per_s": r["events_per_s"],
         "events_delivered": r["events_delivered"],
         "watchers": watchers,
+        "subscription_classes": r["subscription_classes"],
+        "copyout_events_per_sec": r["copyout_events_per_sec"],
+        "copyout_bytes_per_sec": r["copyout_bytes_per_sec"],
+        "copyout_materializations": r["copyout_materializations"],
+        "copyout_shared_hits": r["copyout_shared_hits"],
         "impl": r["impl"],
         # the round-10 per-pod shape measured in the SAME run — the
         # throttle-proof normalizer the floor test divides by
@@ -761,6 +776,20 @@ def run_commit_bench(n_pods: int = 4096, waves: int = 8,
         "twin_writes_per_s": t["writes_per_s"],
         "twin_parity": "ok",
     }
+    if watchers >= 1000:
+        # degenerate (pre-round-20 per-watcher) reference lane: same cell
+        # shape, capped at 1000 watchers — per-event copy-out cost in this
+        # mode does not depend on watcher count, so extrapolating it to
+        # `watchers` is just using its rate as-is
+        d = run_commit_cell(n_pods, waves, min(1000, watchers),
+                            watch_classes=watch_classes,
+                            shared_classes=False)
+        deg = d["copyout_events_per_sec"]
+        out["degenerate_watchers"] = d["watchers"]
+        out["degenerate_events_per_s"] = deg
+        out["vs_per_watcher"] = (round(r["copyout_events_per_sec"] / deg, 2)
+                                 if deg else None)
+    return out
 
 
 # the non-plain lanes of the benchmark matrix at the reference's 1000-node /
@@ -927,6 +956,17 @@ def main():
     # the wave exactly like the scheduling lanes' 10k-pod bursts — at 16
     # the tunnel RTT alone caps the lane at ~160 scans/s
     ap.add_argument("--preemptors", type=int, default=128)
+    # `--mode commit` fan-out scaling (round 20): N watchers split across
+    # --watch-classes shared (kind, selector) subscription classes; at
+    # >= 1000 watchers the degenerate per-watcher reference lane runs in
+    # the same invocation and the JSON gains vs_per_watcher (the
+    # sublinear-scaling floor's ratio)
+    ap.add_argument("--watchers", type=int, default=8,
+                    help="commit mode: live pod watchers during the "
+                         "timed waves")
+    ap.add_argument("--watch-classes", type=int, default=1,
+                    help="commit mode: distinct (kind, selector) "
+                         "subscription classes the watchers split across")
     # `--mode chaos`: the fault plane's bench lane — the headline burst
     # workload with deterministic injection at every non-opt-in seam. The
     # JSON line carries injection counts per seam, breaker state, and the
@@ -1066,9 +1106,20 @@ def main():
         return
     if args.mode == "commit":
         # host-only lane (no device dispatch -> no transient tunnel risk):
-        # --pods is the per-wave width, the default one full scheduler wave
+        # --pods is the per-wave width; the default is one full scheduler
+        # wave, shrunk at high watcher counts so the cell measures
+        # fan-out, not writes (the matrix's watcher-scaling cell shapes)
+        if args.pods is not None:
+            commit_pods, commit_waves = args.pods, 8
+        elif args.watchers >= 100_000:
+            commit_pods, commit_waves = 64, 2
+        elif args.watchers >= 1000:
+            commit_pods, commit_waves = 256, 4
+        else:
+            commit_pods, commit_waves = 4096, 8
         finish(run_commit_bench(
-            n_pods=args.pods if args.pods is not None else 4096))
+            n_pods=commit_pods, waves=commit_waves,
+            watchers=args.watchers, watch_classes=args.watch_classes))
         return
     if args.mode == "matrix":
         # just the matrix lanes + ratio-to-plain, one JSON line (transient

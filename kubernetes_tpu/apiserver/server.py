@@ -42,6 +42,16 @@ from kubernetes_tpu.store.store import (
 
 API_PREFIX = "/api/v1"
 
+
+def wire_line(etype: str, obj, rv: int) -> bytes:
+    """The watch stream's wire encoding of one event — THE byte-ring
+    contract: installed into the store as the serialize-once encoder
+    (each event is encoded once per subscription class and every
+    classmate's HTTP stream serves the identical bytes). One JSON object
+    per line, newline-terminated; chunked framing rides on top."""
+    return json.dumps({"type": etype, "resourceVersion": rv,
+                       "object": serde.to_dict(obj)}).encode() + b"\n"
+
 # request metrics (apiserver_request_total / ..._duration_seconds /
 # ..._longrunning analogs, staging/src/k8s.io/apiserver metrics.go) —
 # registered at import so /metrics always exposes the families
@@ -63,6 +73,12 @@ ACTIVE_WATCHES = obs.gauge(
 
 def make_handler(store: Store, admission: AdmissionChain,
                  authenticator=None, authorizer=None):
+    # serialize-once byte ring: the store's commit core encodes each watch
+    # event ONCE per subscription class with this server's wire encoder;
+    # _watch then streams the shared bytes (zero per-watcher encoding)
+    if hasattr(store, "set_wire_encoder"):
+        store.set_wire_encoder(wire_line)
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -278,12 +294,21 @@ def make_handler(store: Store, admission: AdmissionChain,
 
         def _watch(self, kind: str, q) -> None:
             since = q.get("resourceVersion", [None])[0]
+            # opaque subscription-class key: watchers passing the same
+            # (kind, selector) share one materialize-once / encode-once
+            # class in the commit core (NOT a server-side event filter)
+            selector = q.get("selector", [None])[0]
             try:
                 w = store.watch(kind,
-                                int(since) if since is not None else None)
+                                int(since) if since is not None else None,
+                                selector=selector)
             except ExpiredError as e:
                 self._error(410, "Expired", str(e))
                 return
+            # pre-encoded wire bytes straight from the class ring when the
+            # core has the byte-ring verbs (a stale prebuilt .so degrades
+            # to per-stream encoding)
+            use_bytes = hasattr(getattr(store, "_core", None), "poll_bytes")
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Transfer-Encoding", "chunked")
@@ -301,22 +326,24 @@ def make_handler(store: Store, admission: AdmissionChain,
             try:
                 while True:
                     try:
-                        ev = w.next(timeout=0.5)
+                        if use_bytes:
+                            line = w.next_bytes(timeout=0.5)
+                        else:
+                            ev = w.next(timeout=0.5)
+                            line = None if ev is None else wire_line(
+                                ev.type, ev.obj, ev.resource_version)
                     except ExpiredError:
                         # this consumer fell behind the fan-out ring and
                         # was dropped-with-resync: end the stream — the
                         # client reconnects from its last seen rv and gets
                         # a replay, or a 410 -> re-list (reflector contract)
                         break
-                    if ev is None:
+                    if line is None:
                         # blank-line keep-alive (an empty chunk would be the
                         # stream terminator); readers skip empty lines
                         if not emit(b"\n"):
                             break
                         continue
-                    line = json.dumps({
-                        "type": ev.type, "resourceVersion": ev.resource_version,
-                        "object": serde.to_dict(ev.obj)}).encode() + b"\n"
                     if not emit(line):
                         break
             finally:

@@ -32,6 +32,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -40,6 +41,8 @@
 #include <string>
 #include <thread>
 #include <unordered_map>
+
+#include <cxxabi.h>   // abi::__forced_unwind (pthread_exit at finalization)
 #include <vector>
 
 namespace {
@@ -99,10 +102,29 @@ struct KindLog {
     long long end() const { return start + (long long)entries.size(); }
 };
 
+// One shared subscription class (round 20): every watcher with the same
+// (kind, selector) interest shares one materialize-once Event cache and
+// one serialize-once wire-line cache. `evs`/`lines` are slot deques
+// aligned to the kind log from absolute seq `cache_start` (realigned
+// lazily at poll when the ring evicts); slot refs are owned and only
+// touched under the mutex with refcount-only GIL re-acquisition (the
+// standard lock contract). The selector is an OPAQUE interest key —
+// class dedupe only, never an event filter.
+struct SubClass {
+    std::string kind;
+    std::string selector;
+    long long members = 0;
+    long long cache_start = 0;
+    std::deque<PyObject*> evs;     // owned Event or nullptr per log slot
+    std::deque<PyObject*> lines;   // owned wire bytes or nullptr per slot
+};
+
 struct Watcher {
     std::string kind;
     long long cursor;
     bool resync = false;
+    SubClass* cls = nullptr;   // shared class (stable node pointer), or
+                               // nullptr in old-shape degenerate mode
 };
 
 struct CommitCore {
@@ -117,6 +139,11 @@ struct CommitCore {
     std::unordered_map<std::string, KindLog>* logs;
     std::unordered_map<long long, Watcher>* watchers;
     std::unordered_map<std::string, std::vector<long long>>* by_kind;
+    // subscription classes keyed "kind\x1fselector" (node-based map:
+    // Watcher::cls pointers stay valid until the class is erased at
+    // zero members). Guarded by the mutex like the watcher cursors.
+    std::unordered_map<std::string, SubClass>* classes;
+    bool shared_classes;     // false = old-shape per-watcher degenerate
     // fencing-token table (round 18): scope -> highest lease token
     // validated. Guarded by the CALLER's store lock like the rv counter
     // (GIL held, no mutex) — never touched from consumer threads.
@@ -124,10 +151,74 @@ struct CommitCore {
     std::mutex* mu;
     std::condition_variable* cv;
     PyObject* fanout_sink;   // owned, may be null (observability hook)
+    PyObject* wire_encoder;  // owned, may be null ((etype, obj, rv)->bytes)
+    // watch-plane counters (guarded by the mutex; observability only)
+    long long stat_mat;      // Event materializations (cache misses)
+    long long stat_shared;   // deliveries served from a class cache
+    long long stat_enc;      // wire-line encodes (cache misses)
+    long long stat_bytes;    // wire bytes served (hits + misses)
 };
 
 KindLog& kind_log(CommitCore* self, const std::string& kind) {
     return (*self->logs)[kind];
+}
+
+// -- subscription-class plumbing (mutex held, GIL released) ------------------
+// Realign a class's slot deques to the log window [start, end). Evicted
+// slot refs go into `stale` for the caller to decref AFTER the mutex is
+// dropped (never a decref under the mutex without the GIL).
+void class_align(SubClass* c, KindLog& log, std::vector<PyObject*>& stale) {
+    while (c->cache_start < log.start && !c->evs.empty()) {
+        if (c->evs.front() != nullptr) stale.push_back(c->evs.front());
+        if (c->lines.front() != nullptr) stale.push_back(c->lines.front());
+        c->evs.pop_front();
+        c->lines.pop_front();
+        c->cache_start += 1;
+    }
+    if (c->cache_start < log.start) c->cache_start = log.start;
+    while (c->cache_start + (long long)c->evs.size() < log.end()) {
+        c->evs.push_back(nullptr);
+        c->lines.push_back(nullptr);
+    }
+}
+
+// Resolve (kind, selector) to its shared class, creating it on first
+// membership. A new class covers the full current log window so
+// replaying watchers (attach with since_rv) index valid slots. Returns
+// nullptr in degenerate mode.
+SubClass* join_class(CommitCore* self, const std::string& kind,
+                     const std::string& selector, KindLog& log) {
+    if (!self->shared_classes) return nullptr;
+    std::string key = kind;
+    key.push_back('\x1f');
+    key += selector;
+    auto it = self->classes->find(key);
+    if (it == self->classes->end()) {
+        SubClass c;
+        c.kind = kind;
+        c.selector = selector;
+        c.cache_start = log.start;
+        c.evs.assign(log.entries.size(), nullptr);
+        c.lines.assign(log.entries.size(), nullptr);
+        it = self->classes->emplace(std::move(key), std::move(c)).first;
+    }
+    it->second.members += 1;
+    return &it->second;
+}
+
+void leave_class(CommitCore* self, SubClass* c,
+                 std::vector<PyObject*>& stale) {
+    if (c == nullptr) return;
+    c->members -= 1;
+    if (c->members > 0) return;
+    for (PyObject* o : c->evs)
+        if (o != nullptr) stale.push_back(o);
+    for (PyObject* o : c->lines)
+        if (o != nullptr) stale.push_back(o);
+    std::string key = c->kind;
+    key.push_back('\x1f');
+    key += c->selector;
+    self->classes->erase(key);
 }
 
 // Release the GIL for the lifetime of this object (constructor) and take
@@ -148,8 +239,21 @@ struct GilRelease {
     bool finalizing() const { return _Py_IsFinalizing() != 0; }
     void block() {
         if (finalizing()) park();
-        PyEval_RestoreThread(ts);
-        ts = nullptr;
+        // If the interpreter starts finalizing while RestoreThread blocks
+        // on the GIL (the race the check above cannot close), CPython 3.10
+        // exits the thread via pthread_exit -> a forced unwind through
+        // these C++ frames, which std::terminate()s the whole process at
+        // the first noexcept frame. Catch the forced-unwind exception and
+        // park forever instead: this thread must never run Python again,
+        // and park() never returns, so the never-rethrown unwind is
+        // abandoned harmlessly until process exit.
+        PyThreadState* t = ts;
+        ts = nullptr;   // keep the (noexcept) destructor a no-op mid-unwind
+        try {
+            PyEval_RestoreThread(t);
+        } catch (abi::__forced_unwind&) {
+            park();
+        }
     }
     [[noreturn]] static void park() {
         for (;;)
@@ -665,12 +769,21 @@ PyObject* core_flush(CommitCore* self, PyObject*) {
 PyObject* core_attach(CommitCore* self, PyObject* args) {
     const char* kind;
     PyObject* since = Py_None;
-    if (!PyArg_ParseTuple(args, "s|O", &kind, &since)) return nullptr;
+    PyObject* selector_obj = Py_None;
+    if (!PyArg_ParseTuple(args, "s|OO", &kind, &since, &selector_obj))
+        return nullptr;
     long long since_rv = 0;
     bool has_since = since != Py_None;
     if (has_since) {
         since_rv = PyLong_AsLongLong(since);
         if (since_rv == -1 && PyErr_Occurred()) return nullptr;
+    }
+    // selector: opaque interest key; None joins the kind's default class
+    std::string selector;
+    if (selector_obj != Py_None) {
+        const char* s = PyUnicode_AsUTF8(selector_obj);
+        if (s == nullptr) return nullptr;
+        selector = s;
     }
     long long wid = -1;
     bool expired = false;
@@ -697,7 +810,9 @@ PyObject* core_attach(CommitCore* self, PyObject* args) {
         }
         if (!expired) {
             wid = self->next_wid++;
-            (*self->watchers)[wid] = Watcher{kind, cursor};
+            Watcher w{kind, cursor};
+            w.cls = join_class(self, kind, selector, log);
+            (*self->watchers)[wid] = w;
             (*self->by_kind)[kind].push_back(wid);
         }
     }
@@ -712,6 +827,7 @@ PyObject* core_attach(CommitCore* self, PyObject* args) {
 PyObject* core_detach(CommitCore* self, PyObject* arg) {
     long long wid = PyLong_AsLongLong(arg);
     if (wid == -1 && PyErr_Occurred()) return nullptr;
+    std::vector<PyObject*> stale;
     {
         GilRelease gil;
         std::lock_guard<std::mutex> lk(*self->mu);
@@ -721,102 +837,246 @@ PyObject* core_detach(CommitCore* self, PyObject* arg) {
             for (auto v = lst.begin(); v != lst.end(); ++v) {
                 if (*v == wid) { lst.erase(v); break; }
             }
+            // attach/detach move a refcount, never a backlog: the last
+            // member leaving frees the class and its caches
+            leave_class(self, it->second.cls, stale);
             self->watchers->erase(it);
         }
         self->cv->notify_all();
     }
+    for (PyObject* o : stale) Py_DECREF(o);
     Py_RETURN_NONE;
 }
 
-PyObject* core_poll(CommitCore* self, PyObject* args) {
-    long long wid;
-    PyObject* timeout_obj;
-    long long limit;
-    if (!PyArg_ParseTuple(args, "LOL", &wid, &timeout_obj, &limit))
-        return nullptr;
-    bool forever = timeout_obj == Py_None;
-    double timeout = 0.0;
-    if (!forever) {
-        timeout = PyFloat_AsDouble(timeout_obj);
-        if (timeout == -1.0 && PyErr_Occurred()) return nullptr;
-    }
-    std::vector<Entry> picked;
+// Shared wait-and-pick half of poll/poll_bytes. On return: `picked`
+// holds OWNED entry refs, `cached_ev`/`cached_ln` hold OWNED class-slot
+// refs (or nullptr) parallel to `picked`, and `stale` holds OWNED refs
+// of cache slots the log ring evicted — the caller releases all of them
+// with the GIL held. The shared-hit counter rides the pick (line hits in
+// bytes mode, Event hits otherwise), matching PyCommitCore._poll_pick.
+struct PickResult {
+    bool expired = false;
+    bool evicted_window = false;
     std::string kind;
-    bool expired = false, evicted_window = false;
-    {
-        GilRelease gil;
-        std::unique_lock<std::mutex> lk(*self->mu);
-        auto deadline = std::chrono::steady_clock::now() +
-            std::chrono::duration_cast<std::chrono::steady_clock::duration>(
-                std::chrono::duration<double>(timeout > 0 ? timeout : 0));
-        for (;;) {
-            auto it = self->watchers->find(wid);
-            if (it == self->watchers->end()) break;   // stopped -> []
-            Watcher& w = it->second;
-            kind = w.kind;
-            if (w.resync) { expired = true; break; }
-            KindLog& log = kind_log(self, kind);
-            if (w.cursor < log.start) {
-                // the ring evicted entries this watcher never consumed
-                w.resync = true;
-                expired = evicted_window = true;
-                break;
+    long long c0 = 0;
+    SubClass* cls = nullptr;
+    std::vector<Entry> picked;
+    std::vector<PyObject*> cached_ev;
+    std::vector<PyObject*> cached_ln;
+    std::vector<PyObject*> stale;
+};
+
+void poll_pick(CommitCore* self, long long wid, bool forever,
+               double timeout, long long limit, bool bytes_mode,
+               PickResult& r) {
+    GilRelease gil;
+    std::unique_lock<std::mutex> lk(*self->mu);
+    auto deadline = std::chrono::steady_clock::now() +
+        std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+            std::chrono::duration<double>(timeout > 0 ? timeout : 0));
+    for (;;) {
+        auto it = self->watchers->find(wid);
+        if (it == self->watchers->end()) break;   // stopped -> []
+        Watcher& w = it->second;
+        r.kind = w.kind;
+        if (w.resync) { r.expired = true; break; }
+        KindLog& log = kind_log(self, r.kind);
+        if (w.cursor < log.start) {
+            // the ring evicted entries this watcher never consumed
+            w.resync = true;
+            r.expired = r.evicted_window = true;
+            break;
+        }
+        long long avail = log.flushed - w.cursor;
+        if (avail > 0) {
+            long long n = avail < limit ? avail : limit;
+            size_t lo = (size_t)(w.cursor - log.start);
+            // take raw pointers under the mutex (eviction can't run
+            // while we hold it), incref below before releasing it
+            for (long long i = 0; i < n; ++i)
+                r.picked.push_back(log.entries[lo + (size_t)i]);
+            r.c0 = w.cursor;
+            w.cursor += n;
+            r.cls = w.cls;
+            if (r.cls == nullptr) {
+                // old-shape private watcher: every pick materializes
+                self->stat_mat += n;
+            } else {
+                class_align(r.cls, log, r.stale);
+                size_t base = (size_t)(r.c0 - r.cls->cache_start);
+                long long hits = 0;
+                for (long long i = 0; i < n; ++i) {
+                    PyObject* ce = r.cls->evs[base + (size_t)i];
+                    PyObject* cl = r.cls->lines[base + (size_t)i];
+                    r.cached_ev.push_back(ce);
+                    r.cached_ln.push_back(cl);
+                    if ((bytes_mode ? cl : ce) != nullptr) hits += 1;
+                }
+                self->stat_shared += hits;
             }
-            long long avail = log.flushed - w.cursor;
-            if (avail > 0) {
-                long long n = avail < limit ? avail : limit;
-                size_t lo = (size_t)(w.cursor - log.start);
-                // take raw pointers under the mutex (eviction can't run
-                // while we hold it), incref below before releasing it
-                for (long long i = 0; i < n; ++i)
-                    picked.push_back(log.entries[lo + (size_t)i]);
-                w.cursor += n;
-                break;
-            }
-            if (!forever && timeout <= 0) break;
-            if (forever) {
-                self->cv->wait(lk);
-            } else if (self->cv->wait_until(lk, deadline) ==
-                       std::cv_status::timeout) {
-                timeout = 0;   // one last non-blocking re-check
+            break;
+        }
+        if (!forever && timeout <= 0) break;
+        if (forever) {
+            self->cv->wait(lk);
+        } else if (self->cv->wait_until(lk, deadline) ==
+                   std::cv_status::timeout) {
+            timeout = 0;   // one last non-blocking re-check
+        }
+    }
+    if (!r.picked.empty()) {
+        // refcount-only work with the GIL re-acquired while STILL
+        // holding the mutex (no allocations here — see lock contract);
+        // at interpreter shutdown, release the mutex before parking
+        if (gil.finalizing()) lk.unlock();
+        gil.block();
+        for (Entry& e : r.picked) {
+            Py_INCREF(e.etype);
+            Py_INCREF(e.obj);
+        }
+        for (PyObject* o : r.cached_ev) Py_XINCREF(o);
+        for (PyObject* o : r.cached_ln) Py_XINCREF(o);
+    }
+}
+
+// First-writer-wins cache fill. `ins_ev[i]` / `ins_ln[i]` are BORROWED
+// candidates for absolute seq c0+i (nullptr = nothing to install); slots
+// already filled by a racing classmate keep the racer's value-identical
+// object. `installed_ev[i]` is set for events THIS call installed — the
+// fan-out sink fires for exactly those, so lag is observed once per
+// event per class. The counters ride the same mutex hold. GIL is
+// re-acquired under the mutex for refcount-only work (lock contract).
+void install_shared(CommitCore* self, SubClass* cls, long long c0,
+                    const std::vector<PyObject*>& ins_ev,
+                    const std::vector<PyObject*>& ins_ln,
+                    long long add_mat, long long add_enc,
+                    long long add_bytes,
+                    std::vector<unsigned char>* installed_ev) {
+    GilRelease gil;
+    std::unique_lock<std::mutex> lk(*self->mu);
+    if (gil.finalizing()) lk.unlock();
+    gil.block();
+    self->stat_mat += add_mat;
+    self->stat_enc += add_enc;
+    self->stat_bytes += add_bytes;
+    if (cls != nullptr) {
+        for (size_t i = 0; i < ins_ev.size(); ++i) {
+            if (ins_ev[i] == nullptr) continue;
+            long long ci = c0 + (long long)i - cls->cache_start;
+            if (ci >= 0 && ci < (long long)cls->evs.size()
+                && cls->evs[(size_t)ci] == nullptr) {
+                Py_INCREF(ins_ev[i]);
+                cls->evs[(size_t)ci] = ins_ev[i];
+                if (installed_ev != nullptr) (*installed_ev)[i] = 1;
             }
         }
-        if (!picked.empty()) {
-            // refcount-only work with the GIL re-acquired while STILL
-            // holding the mutex (no allocations here — see lock contract);
-            // at interpreter shutdown, release the mutex before parking
-            if (gil.finalizing()) lk.unlock();
-            gil.block();
-            for (Entry& e : picked) {
-                Py_INCREF(e.etype);
-                Py_INCREF(e.obj);
+        for (size_t i = 0; i < ins_ln.size(); ++i) {
+            if (ins_ln[i] == nullptr) continue;
+            long long ci = c0 + (long long)i - cls->cache_start;
+            if (ci >= 0 && ci < (long long)cls->lines.size()
+                && cls->lines[(size_t)ci] == nullptr) {
+                Py_INCREF(ins_ln[i]);
+                cls->lines[(size_t)ci] = ins_ln[i];
             }
         }
     }
-    if (expired) {
-        if (evicted_window)
-            PyErr_Format(self->expired_exc,
-                         "%s: rv window evicted before copy-out",
-                         kind.c_str());
-        else
-            PyErr_Format(self->expired_exc,
-                         "%s: watch dropped (resync required)", kind.c_str());
+    lk.unlock();
+}
+
+// fan-out sink: commit->copy-out lag per event, observed here on the
+// CONSUMER's thread (mirror of PyCommitCore._sink_fire). A sink failure
+// is unraisable, never a delivery failure. `evs` are borrowed.
+void fire_sink(CommitCore* self, PyObject* kind_str,
+               const std::vector<PyObject*>& evs,
+               const std::vector<double>& tss) {
+    if (self->fanout_sink == nullptr || evs.empty() || kind_str == nullptr)
+        return;
+    PyObject* ev_list = PyList_New((Py_ssize_t)evs.size());
+    PyObject* lags =
+        ev_list != nullptr ? PyList_New((Py_ssize_t)evs.size()) : nullptr;
+    bool ok = lags != nullptr;
+    double now = mono_now();
+    for (size_t i = 0; ok && i < evs.size(); ++i) {
+        Py_INCREF(evs[i]);
+        PyList_SET_ITEM(ev_list, (Py_ssize_t)i, evs[i]);
+        PyObject* lag = PyFloat_FromDouble(now - tss[i]);
+        if (lag == nullptr) ok = false;
+        else PyList_SET_ITEM(lags, (Py_ssize_t)i, lag);
+    }
+    if (ok) {
+        PyObject* res = PyObject_CallFunctionObjArgs(
+            self->fanout_sink, kind_str, ev_list, lags, nullptr);
+        if (res == nullptr) PyErr_WriteUnraisable(self->fanout_sink);
+        else Py_DECREF(res);
+    } else {
+        PyErr_Clear();
+    }
+    Py_XDECREF(ev_list);
+    Py_XDECREF(lags);
+}
+
+int parse_poll_args(PyObject* args, long long* wid, bool* forever,
+                    double* timeout, long long* limit) {
+    PyObject* timeout_obj;
+    if (!PyArg_ParseTuple(args, "LOL", wid, &timeout_obj, limit))
+        return -1;
+    *forever = timeout_obj == Py_None;
+    *timeout = 0.0;
+    if (!*forever) {
+        *timeout = PyFloat_AsDouble(timeout_obj);
+        if (*timeout == -1.0 && PyErr_Occurred()) return -1;
+    }
+    return 0;
+}
+
+int raise_expired(CommitCore* self, const PickResult& r) {
+    if (!r.expired) return 0;
+    if (r.evicted_window)
+        PyErr_Format(self->expired_exc,
+                     "%s: rv window evicted before copy-out",
+                     r.kind.c_str());
+    else
+        PyErr_Format(self->expired_exc,
+                     "%s: watch dropped (resync required)", r.kind.c_str());
+    return -1;
+}
+
+PyObject* core_poll(CommitCore* self, PyObject* args) {
+    long long wid, limit;
+    bool forever;
+    double timeout;
+    if (parse_poll_args(args, &wid, &forever, &timeout, &limit) < 0)
         return nullptr;
-    }
-    PyObject* out = PyList_New((Py_ssize_t)picked.size());
+    PickResult r;
+    poll_pick(self, wid, forever, timeout, limit, false, r);
+    for (PyObject* o : r.stale) Py_DECREF(o);
+    r.stale.clear();
+    if (raise_expired(self, r) < 0) return nullptr;
+    size_t n = r.picked.size();
+    PyObject* out = PyList_New((Py_ssize_t)n);
     PyObject* kind_str = nullptr;
-    if (out != nullptr && !picked.empty())
-        kind_str = PyUnicode_FromStringAndSize(kind.data(),
-                                               (Py_ssize_t)kind.size());
-    for (size_t i = 0; i < picked.size(); ++i) {
-        Entry& e = picked[i];
+    if (out != nullptr && n > 0)
+        kind_str = PyUnicode_FromStringAndSize(r.kind.data(),
+                                               (Py_ssize_t)r.kind.size());
+    std::vector<unsigned char> miss(n, 0);
+    size_t n_miss = 0;
+    for (size_t i = 0; i < n; ++i) {
+        Entry& e = r.picked[i];
         PyObject* ev = nullptr;
-        if (out != nullptr && (kind_str != nullptr || picked.empty())) {
-            PyObject* rvo = PyLong_FromLongLong(e.rv);
-            if (rvo != nullptr) {
-                ev = PyObject_CallFunctionObjArgs(
-                    self->event_cls, e.etype, kind_str, e.obj, rvo, nullptr);
-                Py_DECREF(rvo);
+        if (out != nullptr && kind_str != nullptr) {
+            if (r.cls != nullptr && r.cached_ev[i] != nullptr) {
+                // class cache hit: our owned ref transfers into the list
+                ev = r.cached_ev[i];
+                r.cached_ev[i] = nullptr;
+            } else {
+                PyObject* rvo = PyLong_FromLongLong(e.rv);
+                if (rvo != nullptr) {
+                    ev = PyObject_CallFunctionObjArgs(
+                        self->event_cls, e.etype, kind_str, e.obj, rvo,
+                        nullptr);
+                    Py_DECREF(rvo);
+                }
+                if (ev != nullptr) { miss[i] = 1; ++n_miss; }
             }
         }
         Py_DECREF(e.etype);
@@ -827,32 +1087,142 @@ PyObject* core_poll(CommitCore* self, PyObject* args) {
         }
         PyList_SET_ITEM(out, (Py_ssize_t)i, ev);
     }
-    // fan-out sink: commit->copy-out lag per event, observed here on the
-    // CONSUMER's thread (mirror of PyCommitCore.poll's hook). A sink
-    // failure is unraisable, never a delivery failure.
-    if (out != nullptr && self->fanout_sink != nullptr && !picked.empty()) {
-        double now = mono_now();
-        PyObject* lags = PyList_New((Py_ssize_t)picked.size());
-        if (lags != nullptr) {
-            bool ok = true;
-            for (size_t i = 0; i < picked.size() && ok; ++i) {
-                PyObject* lag = PyFloat_FromDouble(now - picked[i].ts);
-                if (lag == nullptr) ok = false;
-                else PyList_SET_ITEM(lags, (Py_ssize_t)i, lag);
+    // release cache refs not consumed (hits on the error path + lines)
+    for (PyObject* o : r.cached_ev) Py_XDECREF(o);
+    for (PyObject* o : r.cached_ln) Py_XDECREF(o);
+    if (out != nullptr && n > 0) {
+        if (r.cls != nullptr) {
+            if (n_miss > 0) {
+                std::vector<PyObject*> ins_ev(n, nullptr), no_ln;
+                for (size_t i = 0; i < n; ++i)
+                    if (miss[i]) ins_ev[i] = PyList_GET_ITEM(out, i);
+                std::vector<unsigned char> installed(n, 0);
+                install_shared(self, r.cls, r.c0, ins_ev, no_ln,
+                               (long long)n_miss, 0, 0, &installed);
+                std::vector<PyObject*> sink_evs;
+                std::vector<double> tss;
+                for (size_t i = 0; i < n; ++i) {
+                    if (installed[i]) {
+                        sink_evs.push_back(PyList_GET_ITEM(out, i));
+                        tss.push_back(r.picked[i].ts);
+                    }
+                }
+                fire_sink(self, kind_str, sink_evs, tss);
             }
-            if (ok) {
-                PyObject* r = PyObject_CallFunctionObjArgs(
-                    self->fanout_sink, kind_str, out, lags, nullptr);
-                if (r == nullptr) PyErr_WriteUnraisable(self->fanout_sink);
-                else Py_DECREF(r);
-            } else {
-                PyErr_WriteUnraisable(self->fanout_sink);
-            }
-            Py_DECREF(lags);
         } else {
-            PyErr_Clear();
+            std::vector<PyObject*> sink_evs;
+            std::vector<double> tss;
+            for (size_t i = 0; i < n; ++i) {
+                sink_evs.push_back(PyList_GET_ITEM(out, i));
+                tss.push_back(r.picked[i].ts);
+            }
+            fire_sink(self, kind_str, sink_evs, tss);
         }
     }
+    Py_XDECREF(kind_str);
+    return out;
+}
+
+PyObject* core_poll_bytes(CommitCore* self, PyObject* args) {
+    long long wid, limit;
+    bool forever;
+    double timeout;
+    if (parse_poll_args(args, &wid, &forever, &timeout, &limit) < 0)
+        return nullptr;
+    if (self->wire_encoder == nullptr) {
+        PyErr_SetString(PyExc_RuntimeError, "wire encoder not set");
+        return nullptr;
+    }
+    PickResult r;
+    poll_pick(self, wid, forever, timeout, limit, true, r);
+    for (PyObject* o : r.stale) Py_DECREF(o);
+    r.stale.clear();
+    if (raise_expired(self, r) < 0) return nullptr;
+    size_t n = r.picked.size();
+    PyObject* out = PyList_New((Py_ssize_t)n);
+    PyObject* kind_str = nullptr;
+    if (out != nullptr && n > 0)
+        kind_str = PyUnicode_FromStringAndSize(r.kind.data(),
+                                               (Py_ssize_t)r.kind.size());
+    // events materialized by this call (owned): degenerate mode makes one
+    // per entry for the sink; shared mode only where the class had none
+    std::vector<PyObject*> made_ev(n, nullptr);
+    std::vector<unsigned char> ln_miss(n, 0);
+    long long n_enc = 0, n_mat = 0, nbytes = 0;
+    for (size_t i = 0; i < n; ++i) {
+        Entry& e = r.picked[i];
+        PyObject* ln = nullptr;
+        if (out != nullptr && kind_str != nullptr) {
+            if (r.cls != nullptr && r.cached_ln[i] != nullptr) {
+                // serialize-once hit: the shared bytes object streams out
+                ln = r.cached_ln[i];
+                r.cached_ln[i] = nullptr;
+            } else {
+                PyObject* rvo = PyLong_FromLongLong(e.rv);
+                if (rvo != nullptr) {
+                    ln = PyObject_CallFunctionObjArgs(
+                        self->wire_encoder, e.etype, e.obj, rvo, nullptr);
+                    if (ln != nullptr &&
+                        (r.cls == nullptr || r.cached_ev[i] == nullptr)) {
+                        made_ev[i] = PyObject_CallFunctionObjArgs(
+                            self->event_cls, e.etype, kind_str, e.obj, rvo,
+                            nullptr);
+                        if (made_ev[i] == nullptr) Py_CLEAR(ln);
+                        else ++n_mat;
+                    }
+                    Py_DECREF(rvo);
+                }
+                if (ln != nullptr) { ln_miss[i] = 1; ++n_enc; }
+            }
+            if (ln != nullptr) {
+                Py_ssize_t sz = PyObject_Size(ln);
+                if (sz >= 0) nbytes += sz;
+                else PyErr_Clear();
+            }
+        }
+        Py_DECREF(e.etype);
+        Py_DECREF(e.obj);
+        if (ln == nullptr) {
+            Py_CLEAR(out);
+            continue;   // keep releasing the remaining picked refs
+        }
+        PyList_SET_ITEM(out, (Py_ssize_t)i, ln);
+    }
+    for (PyObject* o : r.cached_ev) Py_XDECREF(o);
+    for (PyObject* o : r.cached_ln) Py_XDECREF(o);
+    if (out != nullptr && n > 0) {
+        if (r.cls != nullptr) {
+            std::vector<PyObject*> ins_ln(n, nullptr);
+            for (size_t i = 0; i < n; ++i)
+                if (ln_miss[i]) ins_ln[i] = PyList_GET_ITEM(out, i);
+            std::vector<unsigned char> installed(n, 0);
+            install_shared(self, r.cls, r.c0, made_ev, ins_ln,
+                           n_mat, n_enc, nbytes, &installed);
+            std::vector<PyObject*> sink_evs;
+            std::vector<double> tss;
+            for (size_t i = 0; i < n; ++i) {
+                if (installed[i]) {
+                    sink_evs.push_back(made_ev[i]);
+                    tss.push_back(r.picked[i].ts);
+                }
+            }
+            fire_sink(self, kind_str, sink_evs, tss);
+        } else {
+            std::vector<PyObject*> none_ev, none_ln;
+            install_shared(self, nullptr, r.c0, none_ev, none_ln,
+                           0, n_enc, nbytes, nullptr);
+            std::vector<PyObject*> sink_evs;
+            std::vector<double> tss;
+            for (size_t i = 0; i < n; ++i) {
+                if (made_ev[i] != nullptr) {
+                    sink_evs.push_back(made_ev[i]);
+                    tss.push_back(r.picked[i].ts);
+                }
+            }
+            fire_sink(self, kind_str, sink_evs, tss);
+        }
+    }
+    for (PyObject* o : made_ev) Py_XDECREF(o);
     Py_XDECREF(kind_str);
     return out;
 }
@@ -867,6 +1237,85 @@ PyObject* core_set_fanout_sink(CommitCore* self, PyObject* arg) {
     }
     Py_XDECREF(old);
     Py_RETURN_NONE;
+}
+
+PyObject* core_set_wire_encoder(CommitCore* self, PyObject* arg) {
+    PyObject* old = self->wire_encoder;
+    if (arg == Py_None) {
+        self->wire_encoder = nullptr;
+    } else {
+        Py_INCREF(arg);
+        self->wire_encoder = arg;
+    }
+    Py_XDECREF(old);
+    Py_RETURN_NONE;
+}
+
+PyObject* core_set_shared_classes(CommitCore* self, PyObject* arg) {
+    int v = PyObject_IsTrue(arg);
+    if (v < 0) return nullptr;
+    self->shared_classes = v != 0;
+    Py_RETURN_NONE;
+}
+
+PyObject* core_fanout_stats(CommitCore* self, PyObject*) {
+    // snapshot under the mutex into plain C++ rows, build Python objects
+    // strictly outside it (allocations never run under the mutex)
+    struct Row {
+        std::string kind, selector;
+        long long members, cached_events, cached_lines, w0, w1;
+    };
+    std::vector<Row> rows;
+    long long mat, shared, enc, nbytes;
+    bool sc;
+    {
+        GilRelease gil;
+        std::lock_guard<std::mutex> lk(*self->mu);
+        mat = self->stat_mat;
+        shared = self->stat_shared;
+        enc = self->stat_enc;
+        nbytes = self->stat_bytes;
+        sc = self->shared_classes;
+        for (auto& kv : *self->classes) {
+            SubClass& c = kv.second;
+            Row row;
+            row.kind = c.kind;
+            row.selector = c.selector;
+            row.members = c.members;
+            row.cached_events = 0;
+            row.cached_lines = 0;
+            for (PyObject* o : c.evs)
+                if (o != nullptr) row.cached_events += 1;
+            for (PyObject* o : c.lines)
+                if (o != nullptr) row.cached_lines += 1;
+            row.w0 = c.cache_start;
+            row.w1 = c.cache_start + (long long)c.evs.size();
+            rows.push_back(std::move(row));
+        }
+    }
+    std::sort(rows.begin(), rows.end(), [](const Row& a, const Row& b) {
+        return a.kind != b.kind ? a.kind < b.kind : a.selector < b.selector;
+    });
+    PyObject* cls_list = PyList_New((Py_ssize_t)rows.size());
+    if (cls_list == nullptr) return nullptr;
+    for (size_t i = 0; i < rows.size(); ++i) {
+        Row& row = rows[i];
+        PyObject* d = Py_BuildValue(
+            "{s:s, s:s, s:L, s:L, s:L, s:[LL]}",
+            "kind", row.kind.c_str(), "selector", row.selector.c_str(),
+            "members", row.members, "cached_events", row.cached_events,
+            "cached_lines", row.cached_lines, "window", row.w0, row.w1);
+        if (d == nullptr) { Py_DECREF(cls_list); return nullptr; }
+        PyList_SET_ITEM(cls_list, (Py_ssize_t)i, d);
+    }
+    PyObject* out = Py_BuildValue(
+        "{s:O, s:L, s:L, s:L, s:L, s:O}",
+        "shared_classes", sc ? Py_True : Py_False,
+        "materializations", mat, "shared_hits", shared,
+        "line_encodes", enc, "bytes_served", nbytes,
+        "classes", cls_list);
+    Py_DECREF(cls_list);
+    return out;
 }
 
 PyObject* core_backlog(CommitCore* self, PyObject* arg) {
@@ -929,10 +1378,17 @@ PyObject* core_new(PyTypeObject* type, PyObject* args, PyObject*) {
     self->watchers = new std::unordered_map<long long, Watcher>();
     self->by_kind =
         new std::unordered_map<std::string, std::vector<long long>>();
+    self->classes = new std::unordered_map<std::string, SubClass>();
+    self->shared_classes = true;
     self->fences = new std::unordered_map<std::string, long long>();
     self->mu = new std::mutex();
     self->cv = new std::condition_variable();
     self->fanout_sink = nullptr;
+    self->wire_encoder = nullptr;
+    self->stat_mat = 0;
+    self->stat_shared = 0;
+    self->stat_enc = 0;
+    self->stat_bytes = 0;
     return (PyObject*)self;
 }
 
@@ -955,8 +1411,13 @@ void core_dealloc(CommitCore* self) {
                 Py_DECREF(e.obj);
             }
         }
+        for (auto& kv : *self->classes) {
+            for (PyObject* o : kv.second.evs) Py_XDECREF(o);
+            for (PyObject* o : kv.second.lines) Py_XDECREF(o);
+        }
         delete self->logs;
         delete self->by_kind;
+        delete self->classes;
         delete self->fences;
         if (!waiters) {
             // a watcher that was never detached may still be blocked in
@@ -972,6 +1433,7 @@ void core_dealloc(CommitCore* self) {
     Py_XDECREF(self->expired_exc);
     Py_XDECREF(self->already_exc);
     Py_XDECREF(self->fanout_sink);
+    Py_XDECREF(self->wire_encoder);
     Py_TYPE(self)->tp_free((PyObject*)self);
 }
 
@@ -996,11 +1458,25 @@ PyMethodDef core_methods[] = {
     {"flush", (PyCFunction)core_flush, METH_NOARGS,
      "publish pending entries to watchers -> events dropped"},
     {"attach", (PyCFunction)core_attach, METH_VARARGS,
-     "attach(kind, since_rv=None) -> watcher id (raises on expired rv)"},
+     "attach(kind, since_rv=None, selector=None) -> watcher id (raises "
+     "on expired rv); identical (kind, selector) watchers share one "
+     "subscription class"},
     {"detach", (PyCFunction)core_detach, METH_O, "remove a watcher"},
     {"poll", (PyCFunction)core_poll, METH_VARARGS,
      "poll(wid, timeout, limit) -> list[Event] (GIL released while "
-     "blocked; raises ExpiredError when dropped)"},
+     "blocked; raises ExpiredError when dropped); events materialize "
+     "once per subscription class"},
+    {"poll_bytes", (PyCFunction)core_poll_bytes, METH_VARARGS,
+     "poll_bytes(wid, timeout, limit) -> list[bytes] — pre-encoded wire "
+     "lines from the class's serialize-once byte ring"},
+    {"set_wire_encoder", (PyCFunction)core_set_wire_encoder, METH_O,
+     "set_wire_encoder(callable|None) — (etype, obj, rv) -> wire bytes "
+     "for the serialize-once byte ring"},
+    {"set_shared_classes", (PyCFunction)core_set_shared_classes, METH_O,
+     "set_shared_classes(bool) — False = old-shape per-watcher "
+     "degenerate mode for FUTURE attaches (differential tests)"},
+    {"fanout_stats", (PyCFunction)core_fanout_stats, METH_NOARGS,
+     "watch-plane snapshot: counters + one row per subscription class"},
     {"backlog", (PyCFunction)core_backlog, METH_O,
      "published-but-unconsumed events for a watcher"},
     {"set_fanout_sink", (PyCFunction)core_set_fanout_sink, METH_O,

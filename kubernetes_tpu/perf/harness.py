@@ -874,8 +874,13 @@ BENCHMARK_MATRIX = {
     "preempt": [(1000, 10000, 16), (1000, 10000, 128)],
     # commit-core cells: (pods-per-wave, waves, watchers) — run via
     # run_commit_cell (the round-11 store-write + fan-out tail; the
-    # 4096-pod cell is one full default scheduler wave)
-    "commit": [(1024, 8, 8), (4096, 8, 8)],
+    # 4096-pod cell is one full default scheduler wave). The round-20
+    # watcher-scaling cells shrink the wave so the cell measures fan-out,
+    # not writes: 1k/10k watchers sharing one subscription class, and the
+    # 100k-watcher north-star cell as the slow tier-2 gate.
+    "commit": [(1024, 8, 8), (4096, 8, 8),
+               (256, 4, 1000), (256, 4, 10000),
+               (64, 2, 100_000)],   # 100k cell: slow tier-2
     # mesh-sharded scale cells: (nodes, pods) — run via run_shard_cell
     # over every visible device. These node counts cannot fit one chip's
     # HBM once the resident planes + victim table are counted (PROFILE.md
@@ -954,7 +959,9 @@ def run_gang_cell(nodes: int = 1000, gang_size: int = 64,
 
 def run_commit_cell(n_pods: int = 4096, waves: int = 8,
                     n_watchers: int = 8, impl: Optional[str] = None,
-                    audit: Optional[list] = None) -> dict:
+                    audit: Optional[list] = None,
+                    watch_classes: int = 1,
+                    shared_classes: bool = True) -> dict:
     """Commit-core cell (`bench.py --mode commit`): the store-write +
     fan-out tail of a burst wave in isolation — `waves` waves of `n_pods`
     binds each, every wave ONE `commit_wave` call (batched bind + the
@@ -962,20 +969,33 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
     `n_watchers` live pod watchers copying events out on their own
     threads (the overlap the core's GIL-released poll buys).
 
+    Round 20: the watchers split across `watch_classes` distinct
+    (kind, selector) subscription classes (1 = everyone shares one
+    materialize-once/encode-once class — the north-star shape); half of
+    each class drains the Event lane, half the serialize-once byte ring
+    (the apiserver's wire encoding), so the copy-out phase pays both
+    representations once per class. `shared_classes=False` runs the
+    degenerate class-per-watcher mode — the pre-round-20 per-watcher
+    fan-out path, the scaling floor's extrapolation baseline.
+
     Reports writes/s (binds + event creates landed; the watchers are
     ATTACHED during the timed loop, so every fanout_wave pays its cursor
-    publishes) and events/s (events copied out through the watcher
-    fan-out, timed as its own phase — on a single-core box a concurrent
-    consumer just timeshares the GIL with the commit loop and turns both
-    numbers into scheduler noise; the threaded-overlap correctness is
-    pinned by tests/test_commit_core.py instead). `impl` pins the core
+    publishes) and copy-out events/s + bytes/s (the drain phase, timed
+    on its own — on a single-core box a concurrent consumer just
+    timeshares the GIL with the commit loop and turns both numbers into
+    scheduler noise; the threaded-overlap correctness is pinned by
+    tests/test_commit_core.py instead). `impl` pins the core
     ("native"/"twin"); when `audit` is a list, every wave's (missing,
     rv-after) and the full first-watcher event stream are appended so the
-    caller can referee native vs twin bit-for-bit."""
+    caller can referee native vs twin bit-for-bit. The serial per-pod
+    reference only runs at <= 1024 watchers (each serial verb's flush
+    walks every watcher — at 100k that measures the walk, not the verb)."""
     from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.apiserver.server import wire_line
     from kubernetes_tpu.store.record import EventRecorder
     store = Store(watch_log_size=max(1 << 17, 8 * n_pods * waves),
-                  commit_core=impl)
+                  commit_core=impl, shared_watch_classes=shared_classes)
+    store.set_wire_encoder(wire_line)
     recorder = EventRecorder(store)
     MI = 1024 ** 2
     # one fresh pod set PER WAVE: the round-18 rv-CAS bind refuses
@@ -992,7 +1012,9 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
     pods_by_key = {p.key: p for p in store.list(PODS)[0]}
     wave_keys = [[f"default/p{wv}-{j}" for j in range(n_pods)]
                  for wv in range(waves)]
-    watches = [store.watch(PODS) for _ in range(n_watchers)]
+    n_classes = max(1, min(watch_classes, n_watchers))
+    watches = [store.watch(PODS, selector=f"wc{i % n_classes}")
+               for i in range(n_watchers)]
     writes = 0
     t0 = time.perf_counter()
     for wv in range(waves):
@@ -1007,26 +1029,43 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
         if audit is not None:
             audit.append((list(missing), store.resource_version()))
     elapsed = time.perf_counter() - t0
-    # copy-out phase: drain every watcher (Event materialization happens
-    # here, on the consumer side — the cost fan-out moved OFF the commit
-    # thread above)
+    # copy-out phase: drain every watcher (Event materialization — once
+    # per class in shared mode — happens here, on the consumer side; the
+    # cost fan-out moved OFF the commit thread above). Odd watchers drain
+    # the serialize-once byte ring instead of the Event lane, so each
+    # class pays one materialization AND one wire encoding per event and
+    # every classmate after the first serves shared objects/bytes.
+    stats_before = store.watch_plane_state()
     delivered = 0
     audit_stream: list = []
     t1 = time.perf_counter()
     for i, w in enumerate(watches):
+        if i % 2 == 1:
+            delivered += len(w.drain_bytes())
+            continue
         evs = w.drain()
         delivered += len(evs)
         if audit is not None and i == 0:
             audit_stream = [(e.type, e.resource_version, e.obj.key,
                              e.obj.node_name) for e in evs]
     t_drain = time.perf_counter() - t1
+    # class-plane accounting over the drain window (cumulative core
+    # counters; the subtraction isolates this cell's copy-out phase)
+    stats_after = store.watch_plane_state()
+    n_live_classes = len(stats_after["classes"])
+    drain_bytes_served = (stats_after["bytes_served"]
+                          - stats_before["bytes_served"])
+    drain_materializations = (stats_after["materializations"]
+                              - stats_before["materializations"])
+    drain_shared_hits = (stats_after["shared_hits"]
+                         - stats_before["shared_hits"])
     # reference: the per-pod verb shape (serial bind_pod + its record
     # construction + per-record create, watchers still attached — the
     # same work per write the wave loop timed) measured IN THE SAME RUN,
     # so the floor check can normalize against whatever CPU
     # quota/throttle this box is under right now (absolute writes/s here
     # swing 3-4x run to run with cgroup credits)
-    ref_n = min(n_pods, 1024)
+    ref_n = min(n_pods, 1024) if n_watchers <= 1024 else 0
     # fresh unbound pods for the serial reference (the rv-CAS bind would
     # refuse re-binding the wave pods); created OUTSIDE the timed loop
     for j in range(ref_n):
@@ -1049,14 +1088,24 @@ def run_commit_cell(n_pods: int = 4096, waves: int = 8,
         w.stop()
     if audit is not None:
         audit.append(audit_stream)
+    copyout_rate = round(delivered / t_drain, 1) if t_drain else 0.0
     return {
         "writes_per_s": round(writes / elapsed, 1) if elapsed else 0.0,
-        "events_per_s": round(delivered / t_drain, 1) if t_drain else 0.0,
-        "serial_writes_per_s": round(2 * ref_n / t_ref, 1) if t_ref else 0.0,
+        "events_per_s": copyout_rate,
+        "serial_writes_per_s": (round(2 * ref_n / t_ref, 1)
+                                if ref_n and t_ref else None),
         "writes": writes,
         "events_delivered": delivered,
         "waves": waves,
         "watchers": n_watchers,
+        "subscription_classes": n_live_classes,
+        "copyout_events_per_sec": copyout_rate,
+        "copyout_bytes_per_sec": (round(drain_bytes_served / t_drain, 1)
+                                  if t_drain else 0.0),
+        "copyout_bytes": drain_bytes_served,
+        "copyout_materializations": drain_materializations,
+        "copyout_shared_hits": drain_shared_hits,
+        "shared_watch_classes": store.shared_watch_classes,
         "impl": store.core_impl,
     }
 

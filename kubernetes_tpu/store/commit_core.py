@@ -40,6 +40,7 @@ from __future__ import annotations
 import copy
 import os
 import threading
+import time as _time
 from bisect import bisect_right
 from typing import Any, Optional
 
@@ -73,14 +74,52 @@ class _KindLog:
         return self.start + len(self.entries)
 
 
-class _Watcher:
-    __slots__ = ("kind", "cursor", "resync", "stopped")
+class _SubClass:
+    """One shared subscription class: every watcher with the same
+    (kind, selector) interest indexes the same materialize-once caches.
+    `evs`/`lines` are parallel slot vectors aligned to the kind log from
+    absolute seq `cache_start` (realigned lazily at poll when the log
+    ring evicts) — slot i caches the Event object / pre-encoded wire
+    line for log entry `cache_start + i`, filled first-writer-wins by
+    whichever classmate copies the entry out first. The selector is an
+    OPAQUE interest key (class dedupe only, never an event filter)."""
 
-    def __init__(self, kind: str, cursor: int):
+    __slots__ = ("kind", "selector", "members", "cache_start",
+                 "evs", "lines")
+
+    def __init__(self, kind: str, selector: str, log: _KindLog):
+        self.kind = kind
+        self.selector = selector
+        self.members = 0
+        # cover the full current log window so replaying watchers
+        # (attach with since_rv) index valid slots
+        self.cache_start = log.start
+        self.evs: list = [None] * len(log.entries)
+        self.lines: list = [None] * len(log.entries)
+
+    def align(self, log: _KindLog) -> None:
+        """Realign the slot vectors to the log window [start, end)."""
+        if self.cache_start < log.start:
+            drop = min(len(self.evs), log.start - self.cache_start)
+            del self.evs[:drop]
+            del self.lines[:drop]
+            self.cache_start = log.start
+        need = log.end - self.cache_start - len(self.evs)
+        if need > 0:
+            self.evs.extend([None] * need)
+            self.lines.extend([None] * need)
+
+
+class _Watcher:
+    __slots__ = ("kind", "cursor", "resync", "stopped", "cls")
+
+    def __init__(self, kind: str, cursor: int,
+                 cls: Optional[_SubClass] = None):
         self.kind = kind
         self.cursor = cursor      # absolute seq of the next entry to read
         self.resync = False
         self.stopped = False
+        self.cls = cls            # shared subscription class (None = private)
 
 
 class PyCommitCore:
@@ -102,6 +141,18 @@ class PyCommitCore:
         self._next_wid = 0
         self._cond = threading.Condition(threading.Lock())
         self._fanout_sink = None
+        # shared subscription classes (round 20): watchers with the same
+        # (kind, selector) interest share one materialize-once Event cache
+        # and one serialize-once byte cache. `set_shared_classes(False)`
+        # is the old-shape degenerate mode (every watcher private) used by
+        # the differential parity tests.
+        self._shared_classes = True
+        self._classes: dict[tuple[str, str], _SubClass] = {}
+        self._wire_encoder = None      # (etype, obj, rv) -> bytes
+        self._stat_mat = 0             # Event materializations (cache miss)
+        self._stat_shared = 0          # deliveries served from a class cache
+        self._stat_enc = 0             # wire-line encodes (cache miss)
+        self._stat_bytes = 0           # wire bytes served (hits + misses)
         # fencing-token table (round 18, active-active fleet): scope ->
         # the highest lease fencing token validated so far. Guarded by
         # the STORE's lock like the rv counter (every writer holds it);
@@ -115,6 +166,21 @@ class PyCommitCore:
         it to the watch_fanout_lag_seconds histogram and the pod-lifecycle
         ledger's copy-out stamp. Never part of parity-observable state."""
         self._fanout_sink = sink
+
+    def set_wire_encoder(self, encoder) -> None:
+        """Serialize-once byte ring (round 20): `encoder(etype, obj, rv)`
+        must return the complete wire line (bytes) for one event. Encoded
+        lines are cached per subscription class, so the HTTP watch path
+        pays ONE serialization per event per class regardless of how many
+        watchers stream it. Observability/delivery-plane only."""
+        self._wire_encoder = encoder
+
+    def set_shared_classes(self, enabled: bool) -> None:
+        """Toggle class sharing for FUTURE attaches (old-shape degenerate
+        mode when False: every watcher materializes privately, exactly the
+        pre-round-20 copy-out path — the differential tests pin the two
+        modes bit-identical)."""
+        self._shared_classes = bool(enabled)
 
     # -- fencing tokens (round 18; caller holds the store lock) --------------
     # A scope names one partition lease (e.g. "fleet-default-scheduler-s3");
@@ -168,7 +234,6 @@ class PyCommitCore:
 
     def _append(self, log: _KindLog, etype: str, obj: Any, rv: int,
                 ts: Optional[float] = None) -> None:
-        import time as _time
         log.entries.append((etype, obj, rv,
                             ts if ts is not None else _time.perf_counter()))
         log.rvs.append(rv)
@@ -190,7 +255,6 @@ class PyCommitCore:
         """The store's batched bind body (_bind_locked semantics per
         binding): clone, set node_name, assign the next rv, replace the
         bucket entry, log MODIFIED. Returns the keys that were missing."""
-        import time as _time
         log = self._kind_log(kind)
         ts = _time.perf_counter()   # one commit stamp for the whole batch
         missing = []
@@ -212,7 +276,6 @@ class PyCommitCore:
         """The store's batched create body (_create_locked semantics per
         object): raise AlreadyExists on a duplicate key, snapshot unless
         `move`, assign the next rv, log ADDED. Returns the stored objects."""
-        import time as _time
         log = self._kind_log(kind)
         ts = _time.perf_counter()   # one commit stamp for the whole batch
         out = []
@@ -297,10 +360,28 @@ class PyCommitCore:
         return dropped
 
     # -- watch ---------------------------------------------------------------
-    def attach(self, kind: str, since_rv: Optional[int]) -> int:
+    def _join_class(self, kind: str, selector: Optional[str],
+                    log: _KindLog) -> Optional[_SubClass]:
+        """Resolve (kind, selector) to its shared class, creating it on
+        first membership (attach/detach move a refcount, never a backlog).
+        Returns None in degenerate mode. Caller holds `_cond`."""
+        if not self._shared_classes:
+            return None
+        key = (kind, selector or "")
+        cls = self._classes.get(key)
+        if cls is None:
+            cls = self._classes[key] = _SubClass(kind, key[1], log)
+        cls.members += 1
+        return cls
+
+    def attach(self, kind: str, since_rv: Optional[int],
+               selector: Optional[str] = None) -> int:
         """New watcher cursor. since_rv=None -> only events published after
         this point; else replay from the log, raising ExpiredError when the
-        resume point predates the log window (410 Gone)."""
+        resume point predates the log window (410 Gone). `selector` is the
+        watcher's interest key: identical (kind, selector) watchers dedupe
+        into one shared subscription class (None joins the kind's default
+        class); it never filters events."""
         log = self._kind_log(kind)
         with self._cond:
             if since_rv is None:
@@ -312,21 +393,25 @@ class PyCommitCore:
                 cursor = log.start + bisect_right(log.rvs, since_rv)
             wid = self._next_wid
             self._next_wid += 1
-            self._watchers[wid] = _Watcher(kind, cursor)
+            cls = self._join_class(kind, selector, log)
+            self._watchers[wid] = _Watcher(kind, cursor, cls)
             self._by_kind.setdefault(kind, []).append(wid)
             return wid
 
-    def adopt_watcher(self, wid: int, kind: str,
-                      resync: bool = True) -> None:
+    def adopt_watcher(self, wid: int, kind: str, resync: bool = True,
+                      selector: Optional[str] = None) -> None:
         """Take over a watcher id from a DEMOTED core (store fault plane):
         the Watch object keeps its wid, but its cursor state died with the
         old core, so the adopted watcher starts at the log head marked
         `resync` — the next poll raises ExpiredError and the consumer
-        re-lists (the standard drop-with-resync contract). Twin-only: the
-        native core is never the demotion TARGET."""
+        re-lists (the standard drop-with-resync contract). Class membership
+        RIDES the adoption (round 20): the adopted watcher re-joins its
+        (kind, selector) subscription class so classmates keep sharing the
+        materialize-once caches after failover. Twin-only: the native core
+        is never the demotion TARGET."""
         log = self._kind_log(kind)
         with self._cond:
-            w = _Watcher(kind, log.end)
+            w = _Watcher(kind, log.end, self._join_class(kind, selector, log))
             w.resync = bool(resync)
             self._watchers[wid] = w
             self._by_kind.setdefault(kind, []).append(wid)
@@ -341,23 +426,27 @@ class PyCommitCore:
                 lst = self._by_kind.get(w.kind, [])
                 if wid in lst:
                     lst.remove(wid)
+                cls = w.cls
+                if cls is not None:
+                    cls.members -= 1
+                    if cls.members <= 0:
+                        self._classes.pop((cls.kind, cls.selector), None)
             self._cond.notify_all()
 
-    def poll(self, wid: int, timeout: Optional[float],
-             limit: int) -> list:
-        """Copy out up to `limit` published events past the watcher's
-        cursor, blocking up to `timeout` seconds (None = forever) for the
-        first one. Returns [] on timeout or after stop; raises ExpiredError
-        when the watcher was dropped (slow consumer / log window)."""
+    def _poll_pick(self, wid: int, timeout: Optional[float], limit: int,
+                   bytes_mode: bool = False):
+        """The shared wait-and-pick half of poll/poll_bytes: block for the
+        first published entry, detect drop-with-resync, slice the picked
+        entries and advance the cursor, and snapshot the watcher's class
+        cache slots — all under `_cond`. Returns None on timeout/stop."""
         deadline = None
         if timeout and timeout > 0:
-            import time as _time
             deadline = _time.monotonic() + timeout
         with self._cond:
             while True:
                 w = self._watchers.get(wid)
                 if w is None:
-                    return []
+                    return None
                 if w.resync:
                     raise self._expired(
                         f"{w.kind}: watch dropped (resync required)")
@@ -370,32 +459,145 @@ class PyCommitCore:
                 if w.cursor < log.flushed:
                     break
                 if timeout == 0:
-                    return []
+                    return None
                 wait = None
                 if deadline is not None:
-                    import time as _time
                     wait = deadline - _time.monotonic()
                     if wait <= 0:
-                        return []
+                        return None
                 self._cond.wait(wait)   # None = wait forever
-            lo = w.cursor - log.start
-            n = min(limit, log.flushed - w.cursor)
+            c0 = w.cursor
+            lo = c0 - log.start
+            n = min(limit, log.flushed - c0)
             picked = log.entries[lo: lo + n]
             w.cursor += n
-        ev = self._event_cls
-        events = [ev(t, w.kind, o, rv) for t, o, rv, _ts in picked]
+            cls = w.cls
+            cached_evs = cached_lines = None
+            if cls is None:
+                # old-shape private watcher: every pick materializes
+                self._stat_mat += n
+            else:
+                cls.align(log)
+                base = c0 - cls.cache_start
+                cached_evs = cls.evs[base: base + n]
+                cached_lines = cls.lines[base: base + n]
+                hits = cached_lines if bytes_mode else cached_evs
+                self._stat_shared += sum(1 for h in hits if h is not None)
+        return w, picked, c0, cls, cached_evs, cached_lines
+
+    def _install_shared(self, cls: _SubClass, made_ev: list,
+                        made_ln: list, nbytes: int) -> list:
+        """First-writer-wins cache fill for events/lines this poll
+        materialized. Returns the (event, entry) pairs THIS call installed
+        — the fan-out sink fires for exactly those, so lag is observed
+        once per event per class, not once per watcher."""
+        installed = []
+        with self._cond:
+            self._stat_mat += len(made_ev)
+            self._stat_enc += len(made_ln)
+            self._stat_bytes += nbytes
+            for seq, e, entry in made_ev:
+                ci = seq - cls.cache_start
+                if 0 <= ci < len(cls.evs) and cls.evs[ci] is None:
+                    cls.evs[ci] = e
+                    installed.append((e, entry))
+            for seq, ln in made_ln:
+                ci = seq - cls.cache_start
+                if 0 <= ci < len(cls.lines) and cls.lines[ci] is None:
+                    cls.lines[ci] = ln
+        return installed
+
+    def _sink_fire(self, kind: str, events: list, entries: list) -> None:
         sink = self._fanout_sink
-        if sink is not None and events:
-            # copy-out stamp: commit->copy-out lag per event, observed on
-            # the CONSUMER's thread (the identical hook exists in
-            # commitcore.cpp's poll)
-            import time as _time
-            now = _time.perf_counter()
-            try:
-                sink(w.kind, events, [now - e[3] for e in picked])
-            except Exception:
-                pass   # observability must never break delivery
+        if sink is None or not events:
+            return
+        # copy-out stamp: commit->copy-out lag per event, observed on
+        # the CONSUMER's thread (the identical hook exists in
+        # commitcore.cpp's poll)
+        now = _time.perf_counter()
+        try:
+            sink(kind, events, [now - en[3] for en in entries])
+        except Exception:
+            pass   # observability must never break delivery
+
+    def poll(self, wid: int, timeout: Optional[float],
+             limit: int) -> list:
+        """Copy out up to `limit` published events past the watcher's
+        cursor, blocking up to `timeout` seconds (None = forever) for the
+        first one. Returns [] on timeout or after stop; raises ExpiredError
+        when the watcher was dropped (slow consumer / log window). With a
+        shared subscription class, each entry is materialized into an Event
+        ONCE per class (first classmate to copy it out) and every later
+        classmate is served the cached object — per-watcher event streams
+        stay value-identical to the private path."""
+        res = self._poll_pick(wid, timeout, limit)
+        if res is None:
+            return []
+        w, picked, c0, cls, cached_evs, _cached_lines = res
+        ev = self._event_cls
+        kind = w.kind
+        if cls is None:
+            events = [ev(t, kind, o, rv) for t, o, rv, _ts in picked]
+            self._sink_fire(kind, events, picked)
+            return events
+        events = []
+        made = []   # (abs seq, event, entry) materialized by this call
+        for i, entry in enumerate(picked):
+            e = cached_evs[i]
+            if e is None:
+                e = ev(entry[0], kind, entry[1], entry[2])
+                made.append((c0 + i, e, entry))
+            events.append(e)
+        if made:
+            installed = self._install_shared(cls, made, [], 0)
+            if installed:
+                self._sink_fire(kind, [e for e, _en in installed],
+                                [en for _e, en in installed])
         return events
+
+    def poll_bytes(self, wid: int, timeout: Optional[float],
+                   limit: int) -> list:
+        """poll(), but returns pre-encoded wire lines (bytes) from the
+        class's serialize-once byte ring: each entry is encoded ONCE per
+        class and every watcher streams the same bytes object — zero
+        per-watcher encoding on the delivery thread. Requires a wire
+        encoder (`set_wire_encoder`)."""
+        enc = self._wire_encoder
+        if enc is None:
+            raise RuntimeError("wire encoder not set")
+        res = self._poll_pick(wid, timeout, limit, bytes_mode=True)
+        if res is None:
+            return []
+        w, picked, c0, cls, cached_evs, cached_lines = res
+        ev = self._event_cls
+        kind = w.kind
+        if cls is None:
+            events = [ev(t, kind, o, rv) for t, o, rv, _ts in picked]
+            lines = [enc(t, o, rv) for t, o, rv, _ts in picked]
+            self._sink_fire(kind, events, picked)
+            with self._cond:
+                self._stat_enc += len(lines)
+                self._stat_bytes += sum(len(b) for b in lines)
+            return lines
+        lines = []
+        made_ev = []   # events materialized here (sink + classmate reuse)
+        made_ln = []
+        for i, entry in enumerate(picked):
+            ln = cached_lines[i]
+            if ln is None:
+                ln = enc(entry[0], entry[1], entry[2])
+                made_ln.append((c0 + i, ln))
+                if cached_evs[i] is None:
+                    made_ev.append((c0 + i,
+                                    ev(entry[0], kind, entry[1], entry[2]),
+                                    entry))
+            lines.append(ln)
+        installed = self._install_shared(cls, made_ev, made_ln,
+                                         sum(len(b) for b in lines))
+        if installed:
+            self._sink_fire(kind, [e for e, _en in installed],
+                            [en for _e, en in installed])
+        return lines
 
     # -- introspection (tests / bench) ---------------------------------------
     def backlog(self, wid: int) -> int:
@@ -412,6 +614,29 @@ class PyCommitCore:
         if not log.rvs:
             return (0, 0)
         return (log.rvs[0], log.rvs[-1])
+
+    def fanout_stats(self) -> dict:
+        """Watch-plane snapshot (identical shape on the native core):
+        cumulative materialization/shared-hit/encode/bytes counters plus
+        one row per live subscription class. Observability only."""
+        with self._cond:
+            classes = sorted(self._classes.values(),
+                             key=lambda c: (c.kind, c.selector))
+            rows = [{"kind": c.kind, "selector": c.selector,
+                     "members": c.members,
+                     "cached_events":
+                         sum(1 for e in c.evs if e is not None),
+                     "cached_lines":
+                         sum(1 for b in c.lines if b is not None),
+                     "window": [c.cache_start,
+                                c.cache_start + len(c.evs)]}
+                    for c in classes]
+            return {"shared_classes": self._shared_classes,
+                    "materializations": self._stat_mat,
+                    "shared_hits": self._stat_shared,
+                    "line_encodes": self._stat_enc,
+                    "bytes_served": self._stat_bytes,
+                    "classes": rows}
 
 
 def make_commit_core(log_size: int, ring_size: int, event_cls,

@@ -28,6 +28,7 @@ import random
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Optional
 
@@ -124,8 +125,10 @@ class RemoteWatch:
     _RECONNECT_DELAY = 0.05
 
     def __init__(self, base: str, kind: str, since_rv: Optional[int],
-                 timeout: float, token: Optional[str] = None):
+                 timeout: float, token: Optional[str] = None,
+                 selector: Optional[str] = None):
         self.kind = kind
+        self.selector = selector
         self._base = base
         self._timeout = timeout
         self._token = token
@@ -144,6 +147,11 @@ class RemoteWatch:
         url = f"{self._base}/api/v1/{self.kind}?watch=true"
         if since_rv is not None:
             url += f"&resourceVersion={since_rv}"
+        if self.selector is not None:
+            # subscription-class key: server-side, watchers sharing it
+            # serve from one serialize-once byte ring (reconnects carry
+            # it so a resumed stream rejoins its class)
+            url += "&selector=" + urllib.parse.quote(self.selector, safe="")
         headers = {}
         if self._token:
             headers["Authorization"] = f"Bearer {self._token}"
@@ -358,9 +366,10 @@ class RemoteStore:
         return ([serde.from_dict(kind, o) for o in d["items"]],
                 int(d["resourceVersion"]))
 
-    def watch(self, kind: str, since_rv: Optional[int] = None) -> RemoteWatch:
+    def watch(self, kind: str, since_rv: Optional[int] = None,
+              selector: Optional[str] = None) -> RemoteWatch:
         return RemoteWatch(self.base_url, kind, since_rv, self.timeout,
-                           token=self.token)
+                           token=self.token, selector=selector)
 
     #: (total attempts, cap seconds) for 429-Backpressure retries on
     #: create: the server's Retry-After is honored but capped (a server

@@ -21,6 +21,7 @@ from __future__ import annotations
 import copy
 import os
 import threading
+import time as _time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
@@ -96,6 +97,29 @@ WAVE_DEDUP = obs.counter(
     "wave whose first attempt had landed before the ambiguous failure — "
     "the retry returned the recorded result instead of double-landing "
     "binds or double-emitting events.")
+# watch-plane subscription classes (round 20): watchers sharing one
+# (kind, selector) interest dedupe into a class; each event is
+# materialized (and wire-encoded) ONCE per class, classmates after the
+# first serve the shared object/bytes from the class cache.
+WATCH_CLASSES_GAUGE = obs.gauge(
+    "watch_subscription_classes",
+    "Live shared subscription classes (distinct (kind, selector) watcher "
+    "interests) in the commit core's fan-out plane, by kind.", ("kind",))
+WATCH_COPYOUT_SHARED = obs.counter(
+    "watch_copyout_shared_total",
+    "Watch copy-out slots served from a subscription class's shared cache "
+    "(an Event or wire line a classmate already materialized) — the "
+    "fan-out work the class plane deduplicated away.")
+WATCH_COPYOUT_MAT = obs.counter(
+    "watch_copyout_materializations_total",
+    "Watch copy-out Event materializations actually performed (once per "
+    "event per class in shared mode; once per event per watcher in the "
+    "degenerate per-watcher mode).")
+
+#: watcher_lags() debug copy-out sample cap: the /debug/sched fan-out
+#: health view walks at most this many live watchers (at 100k watchers a
+#: full walk is itself a fan-out storm)
+WATCHER_LAG_SAMPLE = 1000
 
 EVICTIONS = obs.counter(
     "evictions_total",
@@ -213,13 +237,15 @@ class Watch:
     next()/try_next()/drain() raise ExpiredError and the caller re-lists,
     exactly like the reference reflector on 410 Gone."""
 
-    def __init__(self, store: "Store", kind: str, wid: int):
+    def __init__(self, store: "Store", kind: str, wid: int,
+                 selector: Optional[str] = None):
         self._store = store
         self.kind = kind
+        self.selector = selector
         self._wid = wid
         self._stopped = False
 
-    def _poll(self, timeout: Optional[float], limit: int) -> list[Event]:
+    def _pre_poll(self) -> None:
         if self._store._fanout_deferred:
             # a chaos-deferred wave fan-out: the consumer's poll is the
             # seam's delivery point — events are delayed, never lost
@@ -230,12 +256,28 @@ class Watch:
             WATCH_DROPPED.labels("injected").inc()
             raise ExpiredError(
                 f"{self.kind}: chaos-injected watch drop (resync required)")
+
+    def _poll(self, timeout: Optional[float], limit: int) -> list[Event]:
+        self._pre_poll()
         try:
             return self._store._core.poll(self._wid, timeout, limit)
         except ExpiredError as e:
             # fan-out-time drops were already counted (slow-consumer, by
             # event) in flush; an eviction the poll itself detects is the
             # log-window case (contract message shared with the native core)
+            if "evicted" in str(e):
+                WATCH_DROPPED.labels("log-window").inc()
+            raise
+
+    def _poll_bytes(self, timeout: Optional[float],
+                    limit: int) -> list[bytes]:
+        """Byte-ring poll: pre-encoded wire lines from the subscription
+        class's serialize-once cache (same chaos seams and drop contract
+        as the Event path)."""
+        self._pre_poll()
+        try:
+            return self._store._core.poll_bytes(self._wid, timeout, limit)
+        except ExpiredError as e:
             if "evicted" in str(e):
                 WATCH_DROPPED.labels("log-window").inc()
             raise
@@ -253,6 +295,16 @@ class Watch:
 
     def drain(self) -> list[Event]:
         return self._poll(0, 1 << 30)
+
+    def next_bytes(self, timeout: Optional[float] = None) -> Optional[bytes]:
+        """Next event as a pre-encoded wire line (requires a wire encoder
+        on the store; the apiserver installs one). Shares the watcher
+        cursor with next()/drain() — a stream consumes ONE representation."""
+        lines = self._poll_bytes(timeout, 1)
+        return lines[0] if lines else None
+
+    def drain_bytes(self) -> list[bytes]:
+        return self._poll_bytes(0, 1 << 30)
 
     def stop(self) -> None:
         self._stopped = True
@@ -318,7 +370,8 @@ class Store:
                  debug_integrity: Optional[bool] = None,
                  watch_queue_size: Optional[int] = None,
                  commit_core: Optional[str] = None,
-                 events_cap: Optional[int] = DEFAULT_EVENTS_CAP):
+                 events_cap: Optional[int] = DEFAULT_EVENTS_CAP,
+                 shared_watch_classes: Optional[bool] = None):
         from kubernetes_tpu.store.commit_core import make_commit_core
         self._lock = threading.RLock()
         self._objs: dict[str, dict[str, Any]] = {}
@@ -329,6 +382,27 @@ class Store:
             Event, ExpiredError, AlreadyExistsError, force=commit_core)
         self.core_impl = "native" if getattr(self._core, "is_native", False) \
             else "twin"
+        # shared subscription classes (round 20): watchers with the same
+        # (kind, selector) interest share one materialize-once event cache
+        # and one serialize-once byte ring. False is the degenerate
+        # class-per-watcher mode — the EXACT pre-class fan-out path, kept
+        # as the differential referee's old shape. KTPU_WATCH_CLASSES=0
+        # forces degenerate mode process-wide.
+        if shared_watch_classes is None:
+            shared_watch_classes = \
+                os.environ.get("KTPU_WATCH_CLASSES", "1") != "0"
+        self.shared_watch_classes = bool(shared_watch_classes)
+        if not self.shared_watch_classes \
+                and hasattr(self._core, "set_shared_classes"):
+            self._core.set_shared_classes(False)
+        # wire encoder for the byte ring ((etype, obj, rv) -> bytes; the
+        # apiserver installs its serde line encoder). Kept on the store so
+        # core demotion can re-install it on the twin.
+        self._wire_encoder = None
+        # last cumulative core fan-out stats synced into the obs counters
+        # (the core counts monotonically; obs counters get the deltas)
+        self._fanout_obs_synced = {"materializations": 0, "shared_hits": 0}
+        self._gauge_kinds: set = set()
         self._log_size = watch_log_size
         # audit-record retention (the event-TTL analog); None/0 = unbounded
         self._events_cap = events_cap
@@ -351,9 +425,10 @@ class Store:
         # lifecycle ledger's admission slot, opening the watch-to-enqueue
         # phase. None (the default) admits everything unstamped.
         self.admission_gate = None
-        # live watcher ids (wid -> kind) for the /debug/sched cursor-lag
-        # view; pruned on Watch.stop()
-        self._watch_ids: dict[int, str] = {}
+        # live watcher ids (wid -> (kind, selector)) for the /debug/sched
+        # cursor-lag view AND demotion adoption (class membership rides
+        # the adoption); pruned on Watch.stop()
+        self._watch_ids: dict[int, tuple] = {}
         # fan-out sink: the commit core calls this at poll copy-out (both
         # impls) with (kind, events, lags) — feeds the fan-out-lag
         # histogram and the pod ledger's copy-out stamp. hasattr-gated so a
@@ -407,8 +482,17 @@ class Store:
                 twin.adopt_fences(dict(self._py_fences))
         else:
             twin.adopt_fences(dict(self._py_fences))
-        for wid, kind in self._watch_ids.items():
-            twin.adopt_watcher(wid, kind, resync=True)
+        # fan-out plane posture FIRST (mode gates how adoptions join
+        # classes), then the adoptions themselves
+        if not self.shared_watch_classes:
+            twin.set_shared_classes(False)
+        if self._wire_encoder is not None:
+            twin.set_wire_encoder(self._wire_encoder)
+        for wid, (kind, selector) in self._watch_ids.items():
+            # class membership RIDES the adoption: the adopted watcher
+            # rejoins its (kind, selector) subscription class in the twin
+            # (resync still fires — the faulted core's cursors are gone)
+            twin.adopt_watcher(wid, kind, resync=True, selector=selector)
         self._core = twin
         self.core_impl = "twin"
         if hasattr(twin, "set_fanout_sink"):
@@ -431,20 +515,22 @@ class Store:
             # threads the GIL-released poll just freed
             lag_child.observe_batch(lags)
             if kind == PODS and LEDGER.has_awaiting():
-                import time as _time
                 now = _time.perf_counter()
                 for ev in events:
                     if ev.type == MODIFIED and ev.obj.node_name:
                         LEDGER.copyout(ev.obj.key, now)
         return sink
 
-    def watcher_lags(self) -> list[dict]:
+    def watcher_lags(self, sample: int = WATCHER_LAG_SAMPLE) -> list[dict]:
         """Per-watcher published-but-unconsumed cursor backlog (the
-        /debug/sched fan-out health view)."""
+        /debug/sched fan-out health view). SAMPLED: at 100k watchers a
+        full walk is itself a fan-out storm, so the debug copy-out stops
+        at `sample` watchers (class-level health lives in
+        watch_plane_state(), which is O(classes))."""
         out = []
         with self._lock:
             ids = list(self._watch_ids.items())
-        for wid, kind in ids:
+        for wid, (kind, _sel) in ids[:sample]:
             try:
                 out.append({"wid": wid, "kind": kind,
                             "backlog": int(self._core.backlog(wid))})
@@ -452,14 +538,55 @@ class Store:
                 continue
         return out
 
+    def set_wire_encoder(self, fn) -> None:
+        """Install the byte ring's wire encoder ((etype, obj, rv) ->
+        bytes; the apiserver passes its serde line encoder). Kept on the
+        store so core demotion re-installs it on the twin."""
+        self._wire_encoder = fn
+        if hasattr(self._core, "set_wire_encoder"):
+            self._core.set_wire_encoder(fn)
+
+    def watch_plane_state(self) -> dict:
+        """Subscription-class fan-out snapshot (classes, members, ring
+        occupancy, bytes served) from the commit core, and the obs
+        delta-sync point: the core counts materializations/shared hits
+        monotonically; this folds the deltas into the process counters
+        and refreshes the per-kind class gauge."""
+        fn = getattr(self._core, "fanout_stats", None)
+        if fn is None:    # a stale prebuilt .so without the class plane
+            return {"shared_classes": 0, "classes": []}
+        stats = fn()
+        with self._lock:
+            synced = self._fanout_obs_synced
+            d_mat = stats["materializations"] - synced["materializations"]
+            d_sh = stats["shared_hits"] - synced["shared_hits"]
+            synced["materializations"] = stats["materializations"]
+            synced["shared_hits"] = stats["shared_hits"]
+        if d_mat > 0:
+            WATCH_COPYOUT_MAT.inc(d_mat)
+        if d_sh > 0:
+            WATCH_COPYOUT_SHARED.inc(d_sh)
+        per_kind: dict[str, int] = {}
+        for row in stats["classes"]:
+            per_kind[row["kind"]] = per_kind.get(row["kind"], 0) + 1
+        for kind, n in per_kind.items():
+            WATCH_CLASSES_GAUGE.labels(kind).set(n)
+        for kind in self._gauge_kinds - set(per_kind):
+            WATCH_CLASSES_GAUGE.labels(kind).set(0)   # all classes gone
+        self._gauge_kinds = set(per_kind)
+        return stats
+
     def debug_state(self) -> dict:
         with self._lock:
             n_objs = {k: len(v) for k, v in self._objs.items()}
             rv = self._core.rv()
+            n_watchers = len(self._watch_ids)
         return {"resource_version": rv,
                 "commit_core": self.core_impl,
                 "objects": n_objs,
-                "watchers": self.watcher_lags()}
+                "watchers_total": n_watchers,
+                "watchers": self.watcher_lags(),
+                "watch_plane": self.watch_plane_state()}
 
     # -- alias tripwire ------------------------------------------------------
     @staticmethod
@@ -1154,8 +1281,15 @@ class Store:
                                       allow_skip=True)
 
     # -- watch --------------------------------------------------------------
-    def watch(self, kind: str, since_rv: Optional[int] = None) -> Watch:
+    def watch(self, kind: str, since_rv: Optional[int] = None,
+              selector: Optional[str] = None) -> Watch:
         """Stream events for `kind` after `since_rv` (None → only new events).
+
+        `selector` is an OPAQUE interest key, not a filter: watchers that
+        pass the same (kind, selector) dedupe into one subscription class
+        and share materialize-once Event objects and serialize-once wire
+        bytes; every watcher still sees the kind's FULL event stream.
+        None joins the kind's default class.
 
         Raises ExpiredError when since_rv has fallen out of the event log —
         callers re-list, exactly like the reference's Reflector on 410 Gone.
@@ -1163,9 +1297,13 @@ class Store:
         be the first after since_rv.)
         """
         with self._lock:
-            wid = self._core.attach(kind, since_rv)
-            self._watch_ids[wid] = kind
-            return Watch(self, kind, wid)
+            try:
+                wid = self._core.attach(kind, since_rv, selector)
+            except TypeError:
+                # stale prebuilt .so predating subscription classes
+                wid = self._core.attach(kind, since_rv)
+            self._watch_ids[wid] = (kind, selector)
+            return Watch(self, kind, wid, selector=selector)
 
     # -- bulk load (benchmark harness) --------------------------------------
     def load(self, kind: str, objs: Iterable[Any]) -> None:

@@ -146,6 +146,45 @@ class TestRESTSurface:
         assert [e["type"] for e in got] == ["ADDED", "DELETED"]
         assert got[0]["object"]["name"] == "w0"
 
+    def test_watch_byte_ring_shared_class(self, server):
+        """Round 20: two HTTP watchers on the same ?selector ride ONE
+        subscription class server-side — the watch route streams
+        pre-encoded lines out of the shared byte ring (wire shape
+        unchanged from the per-watcher encode path), and the store books
+        the second stream's lines as shared-ring hits, not re-encodes."""
+        store, url = server
+        got1, got2 = [], []
+        done1, done2 = threading.Event(), threading.Event()
+
+        def watcher(got, done):
+            with urllib.request.urlopen(
+                    f"{url}/api/v1/pods?watch=true&selector=app%3Da") as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if line:
+                        got.append(json.loads(line))
+                        if len(got) >= 2:
+                            done.set()
+                            return
+
+        t1 = threading.Thread(target=watcher, args=(got1, done1), daemon=True)
+        t2 = threading.Thread(target=watcher, args=(got2, done2), daemon=True)
+        t1.start()
+        t2.start()
+        import time
+        time.sleep(0.3)
+        store.create(PODS, Pod(name="b0"))
+        store.delete(PODS, "default/b0")
+        assert done1.wait(5) and done2.wait(5), (got1, got2)
+        assert got1 == got2
+        assert [e["type"] for e in got1] == ["ADDED", "DELETED"]
+        assert got1[0]["object"]["name"] == "b0"
+        assert got1[0]["resourceVersion"] > 0
+        st = store.watch_plane_state()
+        # one classmate's lines were serialize-once cache hits
+        assert st["shared_hits"] >= 2, st
+        assert st["line_encodes"] >= 2, st
+
     def test_priority_admission(self, server):
         store, url = server
         req(f"{url}/api/v1/priorityclasses", "POST",

@@ -62,9 +62,13 @@ class _Recorderless:
     """Apply one deterministic op stream to a store, recording every
     observable: results, raises, watch streams, bucket state."""
 
-    def __init__(self, impl: str, seed: int):
+    def __init__(self, impl: str, seed: int, shared: bool = True):
         self.store = Store(watch_log_size=64, watch_queue_size=32,
-                           commit_core=impl)
+                           commit_core=impl, shared_watch_classes=shared)
+        # deterministic wire encoder for the byte-ring ops: both cores
+        # (and both class modes) must stream identical bytes
+        self.store.set_wire_encoder(
+            lambda t, o, rv: f"{t}|{o.key}|{o.node_name}|{rv}".encode())
         self.rng = random.Random(seed)
         self.log = []
         self.watches = {}
@@ -148,8 +152,9 @@ class _Recorderless:
         self.store.fanout_wave()
         return (missing, confl)
 
-    def op_watch(self, wid, since_rv):
-        self.watches[wid] = self.store.watch(PODS, since_rv=since_rv)
+    def op_watch(self, wid, since_rv, selector=None):
+        self.watches[wid] = self.store.watch(PODS, since_rv=since_rv,
+                                             selector=selector)
         return None
 
     def op_drain(self, wid):
@@ -158,6 +163,32 @@ class _Recorderless:
             return None
         return [(e.type, e.resource_version, e.obj.key, e.obj.node_name)
                 for e in w.drain()]
+
+    def op_drain_bytes(self, wid):
+        # the serialize-once byte ring: wire lines instead of Events,
+        # same cursor, same drop contract (round 20)
+        w = self.watches.get(wid)
+        if w is None:
+            return None
+        return w.drain_bytes()
+
+    def op_stop_watch(self, wid):
+        # detach moves a class refcount (round 20): classmates keep their
+        # shared caches, the last member tears the class down
+        w = self.watches.pop(wid, None)
+        if w is not None:
+            w.stop()
+        return None
+
+    def op_demote(self):
+        # mid-program core demotion: watchers are adopted dropped-with-
+        # resync and KEEP their (kind, selector) class membership (round
+        # 20). On a twin-core store this is a twin->twin swap — the
+        # observable contract (fresh log, resync raises, fences carried)
+        # is identical, so the parity referee stays meaningful.
+        with self.store._lock:
+            self.store._demote_core()
+        return None
 
     def op_rv(self):
         return self.store.resource_version()
@@ -179,36 +210,50 @@ def _random_program(seed: int, n_ops: int = 120):
             prog.append(("delete", rng.choice(names)))
         elif r < 0.52:
             prog.append(("bind", rng.choice(names), f"n{rng.randint(0, 3)}"))
-        elif r < 0.66:
+        elif r < 0.64:
             prog.append(("bind_many",
                          tuple(rng.sample(names, rng.randint(1, 5))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.74:
+        elif r < 0.72:
             prog.append(("commit_wave",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.80:
+        elif r < 0.78:
             prog.append(("commit_wave_binds",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.83:
+        elif r < 0.81:
             # fenced-writer ops (round 18): fence advances interleave
             # with fenced waves so both STALE rejections (atomic, no rv)
             # and valid advances land in the compared stream
             prog.append(("advance_fence", rng.randint(0, 2),
                          rng.randint(1, 30)))
-        elif r < 0.88:
+        elif r < 0.86:
             prog.append(("fenced_wave",
                          tuple(rng.sample(names, rng.randint(1, 4))),
                          f"n{rng.randint(0, 3)}",
                          rng.randint(0, 2), rng.randint(1, 30)))
-        elif r < 0.92:
+        elif r < 0.90:
+            # round 20: watches land in shared (kind, selector) classes —
+            # repeated selectors make classmates, None joins the default
+            # class, and resumes-from-rv must replay from the class cache
             prog.append(("watch", rng.randint(0, 3),
-                         rng.randint(0, 40) if rng.random() < 0.5 else None))
-        elif r < 0.98:
+                         rng.randint(0, 40) if rng.random() < 0.5 else None,
+                         rng.choice([None, "s0", "s0", "s1"])))
+        elif r < 0.935:
             prog.append(("drain", rng.randint(0, 3)))
-        else:
+        elif r < 0.96:
+            # byte-ring drains interleave with Event drains on the SAME
+            # cursors (a stream serves either representation)
+            prog.append(("drain_bytes", rng.randint(0, 3)))
+        elif r < 0.975:
+            prog.append(("stop_watch", rng.randint(0, 3)))
+        elif r < 0.99:
             prog.append(("rv",))
+        else:
+            # mid-program core demotion: adoption must carry class
+            # membership and the resync contract on both stores
+            prog.append(("demote",))
     prog.append(("drain", 0))
     return prog
 
@@ -403,6 +448,166 @@ class TestWatchFanoutRobustness:
         w.stop()
         t.join(timeout=2)
         assert not t.is_alive() and out == [None]
+
+
+# ---------------------------------------------------------------------------
+# shared subscription classes + serialize-once byte ring (round 20)
+# ---------------------------------------------------------------------------
+class TestSharedSubscriptionClasses:
+    """Watchers with identical (kind, selector) dedupe into one class:
+    events materialize (and wire-encode) ONCE per class, classmates serve
+    the shared objects/bytes, and the per-watcher drop-with-resync
+    contract is untouched. The degenerate mode (shared_watch_classes=
+    False) is the EXACT pre-round-20 per-watcher path — the differential
+    referee below proves the refactor changed no observable."""
+
+    def _skip_if_missing(self, impl):
+        if impl == "native" and not have_native():
+            pytest.skip("commitcore did not build")
+
+    @pytest.mark.parametrize("impl", ["native", "twin"])
+    def test_differential_shared_vs_degenerate(self, impl):
+        """The old-vs-new differential: the same random op programs (now
+        mixing selector attaches, byte drains, detaches, and mid-program
+        demotions) through shared-class fan-out and the degenerate
+        class-per-watcher mode — every observable (results, raises, Event
+        streams, wire-byte streams, bucket state, rv) bit-identical."""
+        self._skip_if_missing(impl)
+        for seed in range(3):
+            prog = _random_program(seed)
+            runs = {}
+            for shared in (True, False):
+                h = _Recorderless(impl, seed, shared=shared)
+                for op in prog:
+                    h.op(*op)
+                runs[shared] = (h.log, h.snapshot_pods(),
+                                h.store.resource_version())
+            assert runs[True] == runs[False], f"seed {seed} diverged"
+
+    @pytest.mark.parametrize("impl", ["native", "twin"])
+    def test_classmates_share_objects_and_bytes(self, impl):
+        """Materialize-once is literal: classmates receive the SAME Event
+        objects and the SAME wire-bytes objects (refcounted shares out of
+        the class cache, not copies), and the core's fan-out stats book
+        one materialization + one encode per event per class."""
+        self._skip_if_missing(impl)
+        store = Store(commit_core=impl)
+        store.set_wire_encoder(
+            lambda t, o, rv: f"{t}|{o.key}|{rv}".encode())
+        a1 = store.watch(PODS, selector="app=a")
+        a2 = store.watch(PODS, selector="app=a")
+        b1 = store.watch(PODS, selector="app=a")
+        b2 = store.watch(PODS, selector="app=a")
+        store.create(PODS, mkpod("x"))
+        store.create(PODS, mkpod("y"))
+        e1, e2 = a1.drain(), a2.drain()
+        assert [(e.type, e.obj.key) for e in e1] == \
+            [("ADDED", "default/x"), ("ADDED", "default/y")]
+        assert all(x is y for x, y in zip(e1, e2))   # shared, not equal
+        l1, l2 = b1.drain_bytes(), b2.drain_bytes()
+        assert l1 == [b"ADDED|default/x|1", b"ADDED|default/y|2"]
+        assert all(x is y for x, y in zip(l1, l2))
+        st = store.watch_plane_state()
+        assert len(st["classes"]) == 1
+        assert st["classes"][0]["members"] == 4
+        assert st["materializations"] == 2    # once per event per class
+        assert st["line_encodes"] == 2
+        assert st["shared_hits"] == 4         # a2's 2 events + b2's 2 lines
+        assert st["bytes_served"] == sum(len(x) for x in l1) * 2
+
+    @pytest.mark.parametrize("impl", ["native", "twin"])
+    def test_slow_classmate_dropped_fast_unaffected_threaded(self, impl):
+        """The threaded copy-out stress: two classmates drain at wildly
+        different rates while the writer commits — the slow one is
+        dropped-with-resync at the ring bound, the fast one sees every
+        event in order and keeps streaming afterwards."""
+        self._skip_if_missing(impl)
+        store = Store(watch_log_size=4096, watch_queue_size=64,
+                      commit_core=impl)
+        fast = store.watch(PODS, selector="cls")
+        slow = store.watch(PODS, selector="cls")
+        got: list = []
+
+        def drainer():
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                got.extend(fast.drain())
+                if len(got) >= 200:
+                    return
+                time.sleep(0.0005)
+
+        t = threading.Thread(target=drainer)
+        t.start()
+        for i in range(200):
+            store.create(PODS, mkpod(f"p{i}"))
+            if i % 16 == 15:
+                time.sleep(0.002)   # let the fast classmate catch up
+        t.join(timeout=12)
+        assert not t.is_alive()
+        assert len(got) == 200
+        assert [e.obj.key for e in got] == \
+            [f"default/p{i}" for i in range(200)]
+        # the slow classmate fell past the ring bound and was dropped —
+        # WITHOUT disturbing its classmate's stream above
+        with pytest.raises(ExpiredError):
+            slow.drain()
+        # the fast classmate is still live after the classmate's drop
+        store.create(PODS, mkpod("after"))
+        assert [e.obj.key for e in fast.drain()] == ["default/after"]
+
+    @pytest.mark.parametrize("impl", ["native", "twin"])
+    def test_adoption_carries_class_membership(self, impl):
+        """Core demotion: adopted watchers keep their (kind, selector)
+        class membership (members/refcounts intact in the twin), every
+        adopted watcher still raises ExpiredError once (the resync
+        contract), and detach after adoption unwinds the right class."""
+        self._skip_if_missing(impl)
+        store = Store(commit_core=impl)
+        w1 = store.watch(PODS, selector="a")
+        w2 = store.watch(PODS, selector="a")
+        w3 = store.watch(PODS)
+        store.create(PODS, mkpod("x"))
+        with store._lock:
+            store._demote_core()
+        assert store.core_impl == "twin"
+        st = store.watch_plane_state()
+        members = {r["selector"]: r["members"] for r in st["classes"]}
+        assert members == {"a": 2, "": 1}
+        for w in (w1, w2, w3):
+            with pytest.raises(ExpiredError):
+                w.drain()
+        # detach decrements the ADOPTED class; the last member tears the
+        # class down
+        w1.stop()
+        w2.stop()
+        st = store.watch_plane_state()
+        assert {r["selector"] for r in st["classes"]} == {""}
+        # a re-listed consumer joins fresh and streams normally
+        w4 = store.watch(PODS, selector="a")
+        store.create(PODS, mkpod("y"))
+        assert [e.obj.key for e in w4.drain()] == ["default/y"]
+
+    @pytest.mark.parametrize("impl", ["native", "twin"])
+    def test_lag_observed_once_per_class(self, impl):
+        """The ledger/lag contract after the refactor: the fan-out sink
+        fires for MATERIALIZATIONS (once per event per class), so the lag
+        histogram books each event once per class — not once per
+        classmate (the old per-watcher arithmetic)."""
+        self._skip_if_missing(impl)
+        from kubernetes_tpu.store.store import WATCH_FANOUT_LAG
+        store = Store(commit_core=impl)
+        child = WATCH_FANOUT_LAG.labels(store.core_impl)
+        ws = [store.watch(PODS, selector="app=a") for _ in range(3)]
+        before = child.count
+        store.create(PODS, mkpod("x"))
+        store.create(PODS, mkpod("y"))
+        for w in ws:
+            assert len(w.drain()) == 2
+        # 2 events, ONE class: the first classmate's drain materialized
+        # (and stamped) both; the other drains were shared hits
+        assert child.count == before + 2
+        for w in ws:
+            w.stop()
 
 
 # ---------------------------------------------------------------------------
