@@ -723,6 +723,38 @@ def run_fleet_bench(n_nodes: int, instances: int, arrival_rate: float,
     }
 
 
+def run_soak_bench(n_nodes: int, instances: int, arrival_rate: float,
+                   duration: float, watchers: int, watch_classes: int,
+                   window: int = 2048, depth: int = 3, seed: int = 0,
+                   soak_out: str = None) -> dict:
+    """`--mode soak` (round 21): the soak scoreboard — fleet mode x
+    mixed profiles x serve arrivals x steady-state churn (rolling
+    updates, zone-paced node drains, gang arrivals, HPA oscillation,
+    low-rate chaos) with 10k-100k shared-class watchers attached, the
+    in-process time-series scraper sampling the whole registry
+    throughout, and the verdict engine reading the trajectories
+    (perf.soak.run_soak_cell). One JSON line carries the summary +
+    every verdict; `--soak-out` writes the full SOAK artifact
+    (config + trajectories + verdicts + audits)."""
+    from kubernetes_tpu.perf.soak import run_soak_cell
+    r = run_soak_cell(n_nodes=n_nodes, duration=duration,
+                      arrival_rate=arrival_rate, instances=instances,
+                      watchers=watchers, watch_classes=watch_classes,
+                      window=window, depth=depth, seed=seed,
+                      soak_out=soak_out)
+    out = {
+        "metric": (f"soak_{instances}x_{n_nodes}n_{int(arrival_rate)}rps"
+                   f"_{int(duration)}s_{watchers}w"),
+        "value": r["aggregate_pods_per_s"],
+        "unit": "pods/s",
+        "baseline_note": "sustained aggregate pods/s under the full "
+                         "churn+chaos+watcher composition; the verdicts "
+                         "say what (if anything) fell over first",
+    }
+    out.update(r)
+    return out
+
+
 def run_commit_bench(n_pods: int = 4096, waves: int = 8,
                      watchers: int = 8, watch_classes: int = 1) -> dict:
     """`--mode commit`: the round-11 commit-core lane — the store-write +
@@ -910,7 +942,7 @@ def main():
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
                              "gang", "commit", "chaos", "churn", "serve",
-                             "fleet"],
+                             "fleet", "soak"],
                     default="burst")
     # `--mode fleet` (round 18): N partitioned scheduler instances on
     # their own threads against one shared store, vs the solo serve
@@ -993,6 +1025,14 @@ def main():
     ap.add_argument("--devices", type=int, nargs="?", const=0, default=None,
                     help="shard the node axis over a mesh of N devices "
                          "(bare flag or 0 = all visible)")
+    # `--mode soak` (round 21): the soak scoreboard — fleet x profiles x
+    # serve arrivals x churn x chaos with the watcher plane attached and
+    # the time-series scraper + verdict engine reading the whole run.
+    # Reuses --nodes/--instances/--arrival-rate/--duration/--watchers/
+    # --watch-classes/--serve-window/--serve-depth/--chaos-seed.
+    ap.add_argument("--soak-out", metavar="PATH", default=None,
+                    help="soak mode: write the SOAK artifact JSON (config "
+                         "+ sampled trajectories + verdicts + audits)")
     ap.add_argument("--multichip-out", metavar="PATH", default=None,
                     help="run __graft_entry__.dryrun_multichip(8) in a "
                          "subprocess and write the MULTICHIP artifact "
@@ -1070,7 +1110,8 @@ def main():
     from kubernetes_tpu.perf.harness import (is_transient_error,
                                              retry_transient)
     n_nodes = args.nodes if args.nodes is not None \
-        else (1000 if args.mode in ("preempt", "chaos", "serve", "fleet")
+        else (1000 if args.mode in ("preempt", "chaos", "serve", "fleet",
+                                    "soak")
               else (300 if args.mode == "churn" else 15000))
     n_pods = args.pods if args.pods is not None \
         else (5000 if args.mode == "chaos"
@@ -1087,6 +1128,19 @@ def main():
         result = retry_transient(lambda: run_fleet_bench(
             n_nodes, args.instances, args.arrival_rate, args.duration,
             window=args.serve_window, depth=args.serve_depth))
+        finish(result)
+        return
+    if args.mode == "soak":
+        # host-only composition lane (device work rides the fleet
+        # instances' own serve paths); watcher defaults follow the
+        # matrix gate cell, not the commit lane's tiny default
+        soak_watchers = args.watchers if args.watchers != 8 else 10_000
+        soak_classes = args.watch_classes if args.watch_classes != 1 else 64
+        result = retry_transient(lambda: run_soak_bench(
+            n_nodes, args.instances, args.arrival_rate, args.duration,
+            watchers=soak_watchers, watch_classes=soak_classes,
+            window=args.serve_window, depth=args.serve_depth,
+            seed=args.chaos_seed, soak_out=args.soak_out))
         finish(result)
         return
     if args.mode == "preempt":

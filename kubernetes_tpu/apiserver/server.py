@@ -31,6 +31,7 @@ from urllib.parse import urlparse, parse_qs
 
 from kubernetes_tpu import obs
 from kubernetes_tpu.obs import trace as obs_trace
+from kubernetes_tpu.obs import timeseries as obs_timeseries
 from kubernetes_tpu.api import serde
 from kubernetes_tpu.apiserver.admission import AdmissionChain, AdmissionError
 from kubernetes_tpu.apiserver.auth import Attributes
@@ -260,6 +261,25 @@ def make_handler(store: Store, admission: AdmissionChain,
                 snap = obs.debug_snapshot()
                 snap["store"] = store.debug_state()
                 self._send(200, snap)
+                return
+            if path == "/debug/timeseries":
+                # the in-process time-series ring (obs.timeseries.SCRAPER):
+                # `?family=NAME` filters to one family, `?window=N` keeps
+                # the newest N samples. Empty (samples: 0) until a bench
+                # cell or operator starts the scraper.
+                window = q.get("window", [None])[0]
+                if window is not None:
+                    try:
+                        window = int(window)
+                        if window < 0:
+                            raise ValueError(window)
+                    except ValueError:
+                        self._error(400, "BadRequest",
+                                    f"invalid window {window!r}")
+                        return
+                family = q.get("family", [None])[0]
+                self._send(200, obs_timeseries.SCRAPER.series(
+                    family=family, window=window))
                 return
             if path == "/version":
                 self._send(200, {"gitVersion": "v0.3.0-kubernetes-tpu"})

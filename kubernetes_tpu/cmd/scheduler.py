@@ -112,6 +112,23 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, json.dumps(obs_trace.to_chrome(limit=limit,
                                                            cat=cat)),
                        "application/json")
+        elif u.path == "/debug/timeseries":
+            # the in-process time-series ring — same query knobs as the
+            # apiserver route: ?family= one family, ?window= newest N
+            from kubernetes_tpu.obs import timeseries as obs_timeseries
+            q = parse_qs(u.query)
+            window = q.get("window", [None])[0]
+            if window is not None:
+                try:
+                    window = int(window)
+                    if window < 0:
+                        raise ValueError(window)
+                except ValueError:
+                    self._send(400, f"invalid window {window!r}")
+                    return
+            family = q.get("family", [None])[0]
+            self._send(200, json.dumps(obs_timeseries.SCRAPER.series(
+                family=family, window=window)), "application/json")
         elif u.path == "/debug/sched":
             from kubernetes_tpu import obs
             snap = obs.debug_snapshot()

@@ -79,6 +79,11 @@ counter("obs_trace_dropped_total",
         "ring keeps the newest spans; resize with "
         "obs.trace.set_capacity).")
 
-# imported LAST: both modules register families against REGISTRY above
+# imported LAST: these modules register families against REGISTRY above.
+# procmetrics registers the process self-metrics (RSS/fds/threads/gc
+# pauses) EAGERLY so the time-series scraper sees them from sample 0;
+# timeseries hangs the scraper + verdict engine off the same registry.
 from kubernetes_tpu.obs import ledger       # noqa: F401,E402
 from kubernetes_tpu.obs import flight       # noqa: F401,E402
+from kubernetes_tpu.obs import procmetrics  # noqa: F401,E402
+from kubernetes_tpu.obs import timeseries   # noqa: F401,E402

@@ -85,6 +85,15 @@ LEDGER_FINALIZED = obs.counter(
 #: density.go:56 — the pod-startup latency SLO the gauges score against
 STARTUP_SLO_SECONDS = 5.0
 
+#: rolling window (seconds) the windowed SLO twins score over — long
+#: enough to smooth a single launch, short enough that a minute-40
+#: degradation flips the gauge within a window
+STARTUP_WINDOW_SECONDS = 30.0
+
+#: SLO error budget: the fraction of pods allowed to miss the startup
+#: SLO before the burn rate reads 1.0 (burn = violation_frac / budget)
+STARTUP_ERROR_BUDGET = 0.01
+
 
 class PodLifecycleLedger:
     """Process-global per-pod phase stamper (see module docstring)."""
@@ -96,6 +105,10 @@ class PodLifecycleLedger:
         self._recs: dict[str, list] = {}      # key -> [t0..t6] (pre-commit)
         self._awaiting: dict[str, float] = {}  # key -> commit ts (fan-out)
         self._e2e: deque = deque(maxlen=reservoir)   # admission->commit
+        # (commit_ts, latency) pairs for the WINDOWED twins: the
+        # cumulative reservoir above is since-reset and averages a
+        # late-run stall away; this one is filtered by commit time
+        self._recent: deque = deque(maxlen=reservoir)
         self._phase_sum = {p: 0.0 for p in PHASES}
         self._completed = 0
         self._trace: Optional[dict] = None    # key -> stamps (test mode)
@@ -112,6 +125,7 @@ class PodLifecycleLedger:
             self._recs.clear()
             self._awaiting.clear()
             self._e2e.clear()
+            self._recent.clear()
             self._phase_sum = {p: 0.0 for p in PHASES}
             self._completed = 0
             if self._trace is not None:
@@ -267,7 +281,9 @@ class PodLifecycleLedger:
             if not folds:
                 return
             for rec in folds:
-                self._e2e.append(rec[COMMIT] - rec[ADMISSION])
+                lat = rec[COMMIT] - rec[ADMISSION]
+                self._e2e.append(lat)
+                self._recent.append((tt, lat))
             self._completed += len(folds)
         # histogram folds outside the ledger lock (families self-lock)
         for slot, phase in ((ENQUEUE, "admission"), (POP, "queue"),
@@ -313,6 +329,59 @@ class PodLifecycleLedger:
         p99 = self.percentile(0.99)
         return 1.0 if p99 <= slo else 0.0
 
+    # -- windowed twins ------------------------------------------------------
+    def _window_vals(self, window: Optional[float],
+                     now: Optional[float]) -> list:
+        """Startup latencies of pods committed within the trailing
+        window (commit-stamp clock: perf_counter)."""
+        w = STARTUP_WINDOW_SECONDS if window is None else window
+        tt = time.perf_counter() if now is None else now
+        cutoff = tt - w
+        with self._lock:
+            # _recent is commit-time ordered: walk from the newest end
+            out = []
+            for t, lat in reversed(self._recent):
+                if t < cutoff:
+                    break
+                out.append(lat)
+        return out
+
+    def window_percentile(self, q: float, window: Optional[float] = None,
+                          now: Optional[float] = None) -> float:
+        """Startup percentile over pods committed in the trailing window
+        only — the rolling twin of `percentile` (which is since-reset
+        and shows a late-run stall only after it has drowned the early
+        samples). 0.0 with no pods in the window."""
+        vals = sorted(self._window_vals(window, now))
+        if not vals:
+            return 0.0
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def window_violation_fraction(self, slo: float = STARTUP_SLO_SECONDS,
+                                  window: Optional[float] = None,
+                                  now: Optional[float] = None) -> float:
+        """Fraction of pods committed in the trailing window whose
+        startup latency missed the SLO; 0.0 with no pods."""
+        vals = self._window_vals(window, now)
+        if not vals:
+            return 0.0
+        return sum(1 for v in vals if v > slo) / len(vals)
+
+    def window_slo_ok(self, slo: float = STARTUP_SLO_SECONDS,
+                      window: Optional[float] = None,
+                      now: Optional[float] = None) -> float:
+        p99 = self.window_percentile(0.99, window=window, now=now)
+        return 1.0 if p99 <= slo else 0.0
+
+    def burn_rate(self, slo: float = STARTUP_SLO_SECONDS,
+                  budget: float = STARTUP_ERROR_BUDGET,
+                  window: Optional[float] = None,
+                  now: Optional[float] = None) -> float:
+        """SLO burn rate over the trailing window: the violation
+        fraction divided by the error budget (1.0 = burning budget
+        exactly as provisioned; >1 = on track to exhaust it)."""
+        return self.window_violation_fraction(slo, window, now) / budget
+
     def snapshot(self) -> dict:
         """Bench/harness readout: startup percentiles + the per-phase
         split over everything folded since the last reset(). phase_split
@@ -327,6 +396,10 @@ class PodLifecycleLedger:
             "startup_p50": round(self.percentile(0.50), 6),
             "startup_p99": round(self.percentile(0.99), 6),
             "startup_slo_ok": bool(self.slo_ok()),
+            "startup_p50_windowed": round(self.window_percentile(0.50), 6),
+            "startup_p99_windowed": round(self.window_percentile(0.99), 6),
+            "startup_slo_ok_windowed": bool(self.window_slo_ok()),
+            "slo_burn_rate": round(self.burn_rate(), 6),
             "phase_split": {p: round(v, 6) for p, v in split.items()},
             "pods_completed": n,
         }
@@ -354,3 +427,26 @@ _SLO = obs.gauge("pod_startup_slo_ok",
                  "1 when the p99 pod-startup latency meets the 5s SLO "
                  "(density.go:56); vacuously 1 with no data.")
 _SLO.set_function(lambda: LEDGER.slo_ok())
+
+# windowed twins: the rolling-window view the soak scoreboard samples —
+# a late-run stall flips these while the cumulative gauges above are
+# still averaging it away (pinned by the stall test)
+_P50W = obs.gauge("pod_startup_seconds_p50_windowed",
+                  "Median pod startup latency over pods committed in the "
+                  "trailing 30s window (rolling twin of "
+                  "pod_startup_seconds_p50; 0 with no pods in window).")
+_P50W.set_function(lambda: LEDGER.window_percentile(0.50))
+_P99W = obs.gauge("pod_startup_seconds_p99_windowed",
+                  "p99 pod startup latency over pods committed in the "
+                  "trailing 30s window (rolling twin of "
+                  "pod_startup_seconds_p99; 0 with no pods in window).")
+_P99W.set_function(lambda: LEDGER.window_percentile(0.99))
+_SLOW = obs.gauge("pod_startup_slo_ok_windowed",
+                  "1 when the trailing-window p99 startup latency meets "
+                  "the 5s SLO; vacuously 1 with no pods in window.")
+_SLOW.set_function(lambda: LEDGER.window_slo_ok())
+_BURN = obs.gauge("slo_burn_rate",
+                  "Startup-SLO burn rate over the trailing window: "
+                  "fraction of pods missing the 5s SLO divided by the 1% "
+                  "error budget (1.0 = burning exactly as provisioned).")
+_BURN.set_function(lambda: LEDGER.burn_rate())

@@ -605,6 +605,12 @@ def run_serve_cell(n_nodes: int = 1000, arrival_rate: float = 2000.0,
         "startup_p50": led["startup_p50"],
         "startup_p99": led["startup_p99"],
         "startup_slo_ok": led["startup_slo_ok"],
+        # windowed twins (trailing 30 s): a late-run stall flips these
+        # while the cumulative numbers above still average it away
+        "startup_p50_windowed": led["startup_p50_windowed"],
+        "startup_p99_windowed": led["startup_p99_windowed"],
+        "startup_slo_ok_windowed": led["startup_slo_ok_windowed"],
+        "slo_burn_rate": led["slo_burn_rate"],
         "phase_split": led["phase_split"],
         # the round-17 host-prologue score: encode + admission
         # pod-seconds (the two phases the encode-at-admission row cache
@@ -852,6 +858,10 @@ def run_fleet_cell(n_nodes: int = 1000, instances: int = 2,
         "startup_p50": led["startup_p50"],
         "startup_p99": led["startup_p99"],
         "startup_slo_ok": led["startup_slo_ok"],
+        "startup_p50_windowed": led["startup_p50_windowed"],
+        "startup_p99_windowed": led["startup_p99_windowed"],
+        "startup_slo_ok_windowed": led["startup_slo_ok_windowed"],
+        "slo_burn_rate": led["slo_burn_rate"],
         "workload_reaped": reaped,
         "arrivals": g,
         "admission": gate.debug_state(),
@@ -900,6 +910,16 @@ BENCHMARK_MATRIX = {
     # zero-double-bind audit); the 4-instance cell probes claim churn
     # at higher membership.
     "fleet": [(1000, 2, 4000, 20), (1000, 4, 4000, 20)],
+    # soak scoreboard cells (round 21): (nodes, instances, arrivals/s,
+    # seconds, watchers) — run via perf.soak.run_soak_cell (fleet x
+    # mixed profiles x serve arrivals x churn x chaos with the
+    # time-series scraper + verdict engine attached). The 10k-watcher
+    # cell is the standing gate; the 100k-watcher/120s cell is the
+    # million-object north star (ROADMAP item 1) and slow tier-2 —
+    # ~240k pods through the store, ~480k bind/delete events fanned
+    # through ~64 shared classes (PROFILE.md round 21 arithmetic).
+    "soak": [(1000, 2, 1500, 45, 10_000),
+             (2000, 2, 2000, 120, 100_000)],   # 100k cell: slow tier-2
 }
 
 
@@ -1195,6 +1215,13 @@ def run_e2e_density(n_nodes: int = 50, n_pods: int = 150,
         # 5 seconds go" for the density SLO
         "sched_startup_p50": led["startup_p50"],
         "sched_startup_p99": led["startup_p99"],
+        # windowed twins (trailing 30 s) beside the cumulative numbers:
+        # a stall in the run's last seconds moves these while the
+        # cumulative percentiles still average it away
+        "sched_startup_p50_windowed": led["startup_p50_windowed"],
+        "sched_startup_p99_windowed": led["startup_p99_windowed"],
+        "sched_slo_ok_windowed": led["startup_slo_ok_windowed"],
+        "sched_slo_burn_rate": led["slo_burn_rate"],
         "sched_phase_split": led["phase_split"],
         "node_churn": (dict(churn_report,
                             stale_refusals=int(STALE_BINDS.value - stale0))

@@ -1,0 +1,705 @@
+"""Soak matrix cell — the million-object steady-state scoreboard.
+
+ROADMAP item 1: every instrument exists (ledger, flight recorder,
+chaos seams, serve arrivals, active-active fleet, scheduling profiles,
+the round-20 shared watch plane) but nothing had composed them into ONE
+sustained run and asked "what falls over first?". `run_soak_cell` is
+that composition:
+
+    fleet mode (N instances, partitioned claims, fenced binds)
+  x mixed profiles (default + a batch profile; pods carry
+    spec.schedulerName, instances serve only their own)
+  x serve arrivals (ArrivalGenerator through one fleet-wide
+    backpressure gate)
+  x steady-state churn at production-plausible rates:
+      - a completion reaper (workloads finish),
+      - rolling updates (delete K bound + recreate K with a new
+        revision label),
+      - node drains through the REAL zone-paced evictor
+        (NodeLifecycleController: Ready=False -> taints -> PDB-guarded
+        evictions),
+      - gang arrivals (small PodGroups, all-or-nothing),
+      - HPA oscillation (a cohort tracking a sinusoidal replica
+        target — the hollow stand-in for a horizontal autoscaler),
+      - chaos at low rates (watch drops, fan-out faults, device fetch
+        faults, a bounded number of lease losses)
+  x 10k-100k live watchers sharing subscription classes (half
+    consuming the object stream, half the serialize-once byte ring)
+
+with the time-series scraper (obs.timeseries.SCRAPER) sampling the
+whole registry throughout and the verdict engine reading the result.
+The SOAK artifact carries config + full trajectories + every verdict +
+the audit results; the bench JSON line carries the summary.
+
+The audits are the fleet/serve cells' composed: every arrival bound or
+accounted (in-store + observed deletions == created, zero unbound at
+settle), zero double-binds (BindAuditor), per-profile claim
+disjointness, and a post-run parity pass (flight-recorder replay of
+fresh windows through instance 0 against the serial oracle).
+
+Million-object arithmetic (the 100k-watcher matrix cell): 2k nodes +
+~120s x 2k arrivals/s ~= 240k pod objects through the store, ~480k
+bind/delete events, each fanned to 100k watchers through ~64 classes
+= O(10^10) watcher-event deliveries collapsed to O(10^5) per-event
+materializations by the class plane — the scoreboard proves the plane
+holds that compression under churn, chaos, and drains at once.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from kubernetes_tpu import chaos, obs
+from kubernetes_tpu.store.store import (
+    Store, BackpressureError, ConflictError, ExpiredError, MODIFIED,
+    DELETED, NODES, PODS, PODGROUPS,
+)
+
+GI = 1024 ** 3
+MI = 1024 ** 2
+
+#: the non-default profile of the mixed-profile soak: a batch-packing
+#: scoring vector (bin-pack over spread) — names from TPU_WEIGHT_KEYS
+SOAK_BATCH_PROFILE = "soak-batch"
+
+
+def _mknode(i: int):
+    from kubernetes_tpu.api.types import Node, NodeCondition
+    return Node(
+        name=f"node-{i}",
+        labels={"failure-domain.beta.kubernetes.io/zone": f"zone-{i % 3}",
+                "kubernetes.io/hostname": f"node-{i}"},
+        allocatable={"cpu": 4000, "memory": 32 * GI, "pods": 110},
+        # a Ready condition from the start: the drain actor flips it and
+        # the node-lifecycle controller grades/taints off it
+        conditions=(NodeCondition(type="Ready", status="True"),))
+
+
+def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
+                  arrival_rate: float = 1500.0, instances: int = 2,
+                  watchers: int = 10_000, watch_classes: int = 64,
+                  window: int = 2048, depth: int = 3,
+                  use_tpu: bool = True, seed: int = 0,
+                  scrape_interval: float = 0.5,
+                  soak_out: Optional[str] = None,
+                  gang_every: float = 4.0, gang_size: int = 4,
+                  roll_every: float = 2.0, roll_batch: int = 16,
+                  drain_nodes: int = 8, eviction_rate: float = 20.0,
+                  hpa_period: float = 20.0, hpa_base: int = 64,
+                  hpa_amp: int = 48,
+                  chaos_rates: Optional[dict] = None,
+                  parity_pods: int = 128,
+                  max_resident: Optional[int] = None) -> dict:
+    """One soak cell (module docstring); returns the summary dict the
+    bench prints and (with `soak_out`) writes the full SOAK artifact."""
+    from kubernetes_tpu.api.types import Container, Pod
+    from kubernetes_tpu.apiserver.server import wire_line
+    from kubernetes_tpu.controllers.nodelifecycle import (
+        NodeLifecycleController)
+    from kubernetes_tpu.coscheduling.types import LABEL_POD_GROUP, PodGroup
+    from kubernetes_tpu.fleet import BindAuditor, FleetInstance, shard_of
+    from kubernetes_tpu.obs import flight as obs_flight
+    from kubernetes_tpu.obs.ledger import LEDGER
+    from kubernetes_tpu.obs.timeseries import SCRAPER, evaluate_verdicts
+    from kubernetes_tpu.profiles import (
+        DEFAULT_PROFILE_NAME, ProfileSet, SchedulingProfile)
+    from kubernetes_tpu.serve import ArrivalGenerator
+    from kubernetes_tpu.serve.backpressure import fleet_gate
+
+    instances = max(1, int(instances))
+    n_shards = max(8, 4 * instances)
+    store = Store(watch_log_size=1 << 18)
+    store.set_wire_encoder(wire_line)
+    for i in range(n_nodes):
+        store.create(NODES, _mknode(i))
+
+    # -- mixed profiles + fleet ---------------------------------------------
+    pset = ProfileSet([
+        SchedulingProfile(name=DEFAULT_PROFILE_NAME),
+        SchedulingProfile(name=SOAK_BATCH_PROFILE, weights=(
+            ("BalancedResourceAllocation", 1),
+            ("MostRequestedPriority", 2),
+            ("TaintTolerationPriority", 1),
+        )),
+    ])
+    prof_names = [DEFAULT_PROFILE_NAME, SOAK_BATCH_PROFILE]
+    inst_profiles = [prof_names[i % len(prof_names)]
+                     for i in range(instances)]
+    # only profiles with a live instance may appear on a pod: an unknown
+    # (or unserved) schedulerName is REPORTED, never scheduled, and the
+    # settle audit would hang on it
+    served_profiles = sorted(set(inst_profiles))
+    idents = [f"soak-sched-{i}" for i in range(instances)]
+    # claims partition per PROFILE: an instance's peer set is the
+    # instances serving the SAME profile
+    peers_of = {p: [idents[i] for i in range(instances)
+                    if inst_profiles[i] == p] for p in served_profiles}
+    fleet = [FleetInstance(store, idents[i], peers_of[inst_profiles[i]],
+                           profile=inst_profiles[i], profiles=pset,
+                           use_tpu=use_tpu, window=window, depth=depth,
+                           n_shards=n_shards, lease_duration=5.0,
+                           renew_deadline=3.0,
+                           percentage_of_nodes_to_score=100)
+             for i in range(instances)]
+    for inst in fleet:
+        inst.sync()
+
+    def mkpod(name: str) -> Pod:
+        h = zlib.crc32(name.encode())
+        return Pod(name=name, namespace=f"ns-{h % (4 * n_shards)}",
+                   labels={"app": "soak"},
+                   scheduler_name=served_profiles[
+                       (h >> 8) % len(served_profiles)],
+                   containers=(Container.make(
+                       name="c",
+                       requests={"cpu": 100, "memory": 500 * MI}),))
+
+    # warmup (ungated): jit compiles + claim settling for every profile
+    warm = ArrivalGenerator(store, rate=10 ** 9, total=32 * instances,
+                            pod_fn=mkpod, name_prefix="soakwarm-",
+                            seed=seed)
+    for _ in range(3):
+        warm.tick()
+        for inst in fleet:
+            inst.step()
+
+    def fleet_idle() -> bool:
+        for inst in fleet:
+            if inst.sched.queue.num_pending() > 0:
+                return False
+            if inst.sched.informers.informer(PODS).backlog() > 0:
+                return False
+        return True
+
+    deadline_warm = time.perf_counter() + 60.0
+    while time.perf_counter() < deadline_warm:
+        if sum(inst.step() for inst in fleet) == 0 and fleet_idle():
+            break
+
+    # -- watcher plane -------------------------------------------------------
+    # `watchers` live watches over `watch_classes` subscription classes
+    # (identical (kind, selector) shares one class in the commit core);
+    # odd watchers consume the serialize-once byte ring, even ones the
+    # object stream. Drained in rotating slices; a watcher the ring
+    # expired is STICKY-dropped (round-20 resync contract) and counted.
+    watch_classes = max(1, min(int(watch_classes), max(1, int(watchers))))
+    watch_pool = [store.watch(PODS, selector=f"wc{i % watch_classes}")
+                  for i in range(int(watchers))]
+    expired_watchers = 0
+    rotate_at = 0
+    slice_size = max(64, int(watchers) // 128) if watchers else 0
+
+    def drain_watch_slice() -> None:
+        nonlocal rotate_at, expired_watchers
+        if not watch_pool:
+            return
+        for _ in range(min(slice_size, len(watch_pool))):
+            i = rotate_at % len(watch_pool)
+            rotate_at += 1
+            w = watch_pool[i]
+            try:
+                if i % 2:
+                    w.drain_bytes()
+                else:
+                    w.drain()
+            except ExpiredError:
+                # sticky: ExpiredError forever -> drop from rotation
+                # (classmates stay undisturbed); real consumers re-list
+                w.stop()
+                watch_pool.pop(i)
+                expired_watchers += 1
+
+    # -- soak gauges: watcher-lag tail + utilization ------------------------
+    lag_count = obs.gauge(
+        "store_watchers", "Live watchers registered on the soak store "
+        "(from watcher_lag_summary — all watchers, not the 1k debug "
+        "sample).")
+    lag_max = obs.gauge(
+        "store_watcher_backlog_max", "Largest published-but-unconsumed "
+        "watcher backlog across ALL watchers (watcher_lag_summary).")
+    lag_p99 = obs.gauge(
+        "store_watcher_backlog_p99", "p99 watcher backlog across ALL "
+        "watchers — the soak verdict engine's watcher-lag-tail input.")
+    lag_count.set_function(
+        lambda: float(store.watcher_lag_summary(ttl=1.0)["count"]))
+    lag_max.set_function(
+        lambda: float(store.watcher_lag_summary(ttl=1.0)["max"]))
+    lag_p99.set_function(
+        lambda: float(store.watcher_lag_summary(ttl=1.0)["p99"]))
+
+    # utilization under the constraint mix, maintained from the
+    # bookkeeper watch (binds in, deletions out) — not a store walk
+    resident_bound = [0]
+    cpu_capacity = float(n_nodes * 4000)
+    pods_capacity = float(n_nodes * 110)
+    util_cpu = obs.gauge(
+        "cluster_cpu_utilization", "Requested-CPU utilization of the "
+        "soak cluster under the live constraint mix (bound resident "
+        "pods x request / allocatable).")
+    util_pods = obs.gauge(
+        "cluster_pods_utilization", "Pod-slot utilization of the soak "
+        "cluster (bound resident pods / allocatable pod slots).")
+    util_cpu.set_function(
+        lambda: resident_bound[0] * 100.0 / cpu_capacity)
+    util_pods.set_function(
+        lambda: resident_bound[0] / pods_capacity)
+
+    # -- bookkeeper watch: reaper + accounting + hpa fifo -------------------
+    # cohorts the accounting audit covers (every pod this cell creates)
+    prefixes = ("soak-", "roll-", "gang-", "hpa-", "soakwarm-")
+    created_total = warm.stats()["created"]
+    deleted_total = 0
+    accounting_resynced = False
+    book_watch = store.watch(PODS)
+    bound_fifo: deque = deque()
+    seen_bound: set = set()
+    hpa_bound: deque = deque()
+    reaped = 0
+    cap = n_nodes * min(110, 4000 // 100)
+    resident_target = (int(max_resident) if max_resident is not None
+                       else max(4 * window, cap // 2))
+
+    def _ours(name: str) -> bool:
+        return name.startswith(prefixes)
+
+    def bookkeep() -> None:
+        nonlocal reaped, deleted_total, accounting_resynced
+        try:
+            events = book_watch.drain()
+        except ExpiredError:
+            # the ring expired under us (possible under chaos watch
+            # drops): rebuild the resident view from a full list and
+            # re-derive the deletion count from the accounting identity
+            accounting_resynced = True
+            bound_fifo.clear()
+            seen_bound.clear()
+            hpa_bound.clear()
+            in_store = bound = 0
+            for p in store.list(PODS)[0]:
+                if not _ours(p.name):
+                    continue
+                in_store += 1
+                if p.node_name:
+                    bound += 1
+                    bound_fifo.append(p.key)
+                    seen_bound.add(p.key)
+                    if p.name.startswith("hpa-"):
+                        hpa_bound.append(p.key)
+            deleted_total = max(deleted_total, created_total - in_store)
+            resident_bound[0] = bound
+            return
+        for ev in events:
+            if not _ours(ev.obj.name):
+                continue
+            if ev.type == MODIFIED and ev.obj.node_name \
+                    and ev.obj.key not in seen_bound:
+                seen_bound.add(ev.obj.key)
+                resident_bound[0] += 1
+                if not ev.obj.name.startswith("hpa-"):
+                    bound_fifo.append(ev.obj.key)
+                else:
+                    hpa_bound.append(ev.obj.key)
+            elif ev.type == DELETED:
+                deleted_total += 1
+                if ev.obj.key in seen_bound:
+                    seen_bound.discard(ev.obj.key)
+                    resident_bound[0] -= 1
+        if len(bound_fifo) > resident_target:
+            batch = []
+            while len(bound_fifo) > resident_target:
+                batch.append(bound_fifo.popleft())
+            reaped += len(store.delete_many(PODS, batch))
+
+    # -- churn actors --------------------------------------------------------
+    churn = {"rolled": 0, "roll_shed": 0, "gangs": 0, "gang_pods": 0,
+             "gang_shed": 0, "hpa_up": 0, "hpa_down": 0, "hpa_shed": 0,
+             "drained_nodes": 0, "drain_restored": 0}
+
+    def gated_create(pod: Pod, shed_key: str) -> bool:
+        nonlocal created_total
+        try:
+            store.create(PODS, pod)
+        except BackpressureError:
+            churn[shed_key] += 1
+            return False
+        except ConflictError:
+            return False
+        created_total += 1
+        return True
+
+    roll_seq = [0]
+
+    def roll_tick() -> None:
+        """Rolling update: the oldest K bound pods 'roll' — deleted,
+        replaced by fresh creates carrying the next revision label."""
+        k = min(roll_batch, len(bound_fifo))
+        if k <= 0:
+            return
+        batch = [bound_fifo.popleft() for _ in range(k)]
+        n = len(store.delete_many(PODS, batch))
+        rev = f"r{roll_seq[0] // max(1, roll_batch)}"
+        for _ in range(n):
+            name = f"roll-{roll_seq[0]}"
+            roll_seq[0] += 1
+            pod = mkpod(name)
+            pod.name = name
+            pod.labels = {"app": "soak", "revision": rev}
+            if gated_create(pod, "roll_shed"):
+                churn["rolled"] += 1
+
+    gang_seq = [0]
+
+    def gang_tick() -> None:
+        """Gang arrival: one PodGroup of `gang_size` spec-identical
+        members, all in ONE namespace (one instance owns the gang) on
+        the default profile — scheduled all-or-nothing."""
+        g = gang_seq[0]
+        gang_seq[0] += 1
+        gname = f"gang-{seed}-{g}"
+        ns = f"ns-{(g * 7) % (4 * n_shards)}"
+        try:
+            store.create(PODGROUPS, PodGroup(name=gname,
+                                             min_member=gang_size))
+        except ConflictError:
+            return
+        placed = 0
+        for r in range(gang_size):
+            pod = Pod(name=f"{gname}-r{r}", namespace=ns,
+                      labels={LABEL_POD_GROUP: gname, "app": "gang"},
+                      scheduler_name=DEFAULT_PROFILE_NAME,
+                      containers=(Container.make(
+                          name="c",
+                          requests={"cpu": 100, "memory": 500 * MI}),))
+            if gated_create(pod, "gang_shed"):
+                placed += 1
+        churn["gangs"] += 1
+        churn["gang_pods"] += placed
+
+    hpa_seq = [0]
+    t_start = [0.0]
+
+    def hpa_tick(now: float) -> None:
+        """HPA oscillation (hollow stand-in for a horizontal
+        autoscaler): the 'hpa-' cohort tracks a sinusoidal replica
+        target — scale-ups are gated creates, scale-downs delete the
+        newest bound members."""
+        phase = 2.0 * math.pi * (now - t_start[0]) / hpa_period
+        target = int(hpa_base + hpa_amp * math.sin(phase))
+        current = len(hpa_bound)
+        if current < target:
+            for _ in range(min(target - current, 32)):
+                name = f"hpa-{hpa_seq[0]}"
+                hpa_seq[0] += 1
+                pod = mkpod(name)
+                pod.name = name
+                if gated_create(pod, "hpa_shed"):
+                    churn["hpa_up"] += 1
+        elif current > target:
+            batch = [hpa_bound.pop()
+                     for _ in range(min(current - target, 32))]
+            churn["hpa_down"] += len(store.delete_many(PODS, batch))
+
+    # node drains through the real zone-paced evictor: the controller
+    # monitors Ready conditions, taints, and drains each flipped node's
+    # pods through the PDB-guarded eviction subresource at
+    # `eviction_rate`/s per zone (rate scaled for the compressed soak)
+    lifecycle = NodeLifecycleController(
+        store, eviction_rate=eviction_rate,
+        secondary_eviction_rate=eviction_rate / 10.0)
+    lifecycle.sync()
+    drained: list = []
+    drain_window = (0.35 * duration, 0.70 * duration)
+
+    def flip_ready(name: str, status: str) -> None:
+        from kubernetes_tpu.api.types import NodeCondition
+
+        def mutate(n):
+            n.conditions = (NodeCondition(type="Ready", status=status),)
+            return n
+        store.guaranteed_update(NODES, name, mutate)
+
+    def drain_tick(now: float) -> None:
+        rel = now - t_start[0]
+        if not drained and rel >= drain_window[0] and drain_nodes > 0:
+            # drain a zone-0 slice: Ready=False -> the controller taints
+            # NoSchedule+NoExecute and zone-paces the evictions
+            for i in range(0, 3 * drain_nodes, 3):
+                if i >= n_nodes:
+                    break
+                flip_ready(f"node-{i}", "False")
+                drained.append(f"node-{i}")
+            churn["drained_nodes"] = len(drained)
+        elif drained and churn["drain_restored"] == 0 \
+                and rel >= drain_window[1]:
+            for name in drained:
+                flip_ready(name, "True")
+            churn["drain_restored"] = len(drained)
+
+    # pre-touch the fence-conflict children (inc(0) creates the child
+    # without moving it): labeled families with no children are absent
+    # from the scraper's series, and the fence-spike detector would
+    # read "no fleet live" when the truth is "fleet ran, zero conflicts"
+    from kubernetes_tpu.fleet import BIND_CONFLICTS
+    from kubernetes_tpu.store.store import FENCED_WRITES
+    for outcome in ("requeued", "fenced"):
+        BIND_CONFLICTS.labels(outcome).inc(0)
+    for verb in ("commit_wave", "bind"):
+        FENCED_WRITES.labels(verb).inc(0)
+
+    # -- chaos plan (production-plausible rates) ----------------------------
+    rates = dict(chaos_rates) if chaos_rates else {
+        "store.fanout": 1.0 / 5000.0,
+        "watch.drop": 1.0 / 2000.0,
+        "device.fetch": 1.0 / 5000.0,
+        "fleet.lease-loss": 1.0 / 2000.0,
+    }
+    chaos.plan(seed=seed, rates=rates,
+               limits={"fleet.lease-loss": 2})
+
+    # -- the timed soak ------------------------------------------------------
+    auditor = BindAuditor(store)
+    gate = fleet_gate([inst.loop for inst in fleet],
+                      max_depth=max(4 * window, int(2 * arrival_rate)))
+    store.admission_gate = gate
+    LEDGER.reset()
+    # ring must hold the soak AND the settle tail — newest-N eviction
+    # dropping the run's first minutes would blind every trend detector
+    n_samples_target = int((duration + 150.0) / scrape_interval) + 64
+    SCRAPER.reset(capacity=max(720, n_samples_target),
+                  interval=scrape_interval)
+    SCRAPER.start()
+    gen = ArrivalGenerator(store, rate=arrival_rate, pod_fn=mkpod,
+                           name_prefix="soak-", seed=seed)
+    stop = threading.Event()
+
+    def drive(inst: FleetInstance) -> None:
+        while not stop.is_set():
+            if inst.step() == 0:
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=drive, args=(inst,), daemon=True,
+                                name=f"soak-{inst.identity}")
+               for inst in fleet]
+    partition_overlap = False
+    bound0 = sum(inst.loop.pods_bound for inst in fleet)
+    t0 = time.perf_counter()
+    t_start[0] = t0
+    for th in threads:
+        th.start()
+    next_roll = t0 + roll_every
+    next_gang = t0 + gang_every
+    next_hpa = t0 + 1.0
+    next_pump = t0 + 0.25
+    next_probe = t0 + 0.5
+    t_end = t0 + duration
+    now = t0
+    while now < t_end:
+        bookkeep()
+        gen.tick()
+        drain_watch_slice()
+        if now >= next_roll:
+            roll_tick()
+            next_roll = now + roll_every
+        if now >= next_gang and gang_size > 0:
+            gang_tick()
+            next_gang = now + gang_every
+        if now >= next_hpa and hpa_amp > 0:
+            hpa_tick(now)
+            next_hpa = now + 1.0
+        if now >= next_pump:
+            drain_tick(now)
+            lifecycle.pump()
+            next_pump = now + 0.25
+        if now >= next_probe:
+            auditor.scan()
+            # obs delta-sync: the commit core counts materializations /
+            # shared hits monotonically; watch_plane_state() folds the
+            # deltas into the process counters the scraper samples —
+            # without this call the copy-out rate series never moves
+            store.watch_plane_state()
+            # claims must stay disjoint WITHIN a profile (two profiles
+            # legitimately own the same namespace shard)
+            for prof in served_profiles:
+                seen: set = set()
+                for i, inst in enumerate(fleet):
+                    if inst_profiles[i] != prof:
+                        continue
+                    owned = inst.claims.owned()
+                    if owned & seen:
+                        partition_overlap = True
+                    seen |= owned
+            next_probe = now + 0.5
+        time.sleep(0.002)
+        now = time.perf_counter()
+    elapsed = time.perf_counter() - t0
+    aggregate = (sum(inst.loop.pods_bound for inst in fleet) - bound0) \
+        / elapsed if elapsed else 0.0
+
+    # -- settle: arrivals + churn stop; everything admitted must bind -------
+    chaos.disable()
+    for name in drained:                # no node may stay cordoned
+        flip_ready(name, "True")
+    settle_deadline = time.perf_counter() + 90.0
+    idle_polls = 0
+    while time.perf_counter() < settle_deadline:
+        gen.flush_retries(timeout=0.2)
+        bookkeep()
+        drain_watch_slice()
+        lifecycle.pump()
+        auditor.scan()
+        if gen.stats()["pending_retry"] == 0 and fleet_idle():
+            idle_polls += 1
+            if idle_polls >= 3:
+                break
+        else:
+            idle_polls = 0
+        time.sleep(0.05)
+    stop.set()
+    for th in threads:
+        th.join(timeout=5.0)
+    drain_deadline = time.perf_counter() + 30.0
+    while not fleet_idle() and time.perf_counter() < drain_deadline:
+        bookkeep()
+        for inst in fleet:
+            inst.step()
+    auditor.scan()
+    SCRAPER.stop()
+    led = LEDGER.snapshot()
+    lag_summary = store.watcher_lag_summary(ttl=0)
+
+    # -- audits --------------------------------------------------------------
+    g = gen.stats()
+    created_total += g["created"]
+    bookkeep()
+    measured = [p for p in store.list(PODS)[0] if _ours(p.name)]
+    unbound = sum(1 for p in measured if not p.node_name)
+    audit_accounting = (len(measured) + deleted_total == created_total)
+    assert audit_accounting or accounting_resynced, \
+        (f"soak accounting leak: {len(measured)} in store + "
+         f"{deleted_total} deleted != {created_total} created")
+    assert unbound == 0, f"{unbound} admitted pods never bound at settle"
+    assert not auditor.violations, \
+        f"DOUBLE BINDS observed: {auditor.violations[:5]}"
+    assert not partition_overlap, \
+        "live claims overlapped within a profile"
+
+    # -- parity: replay fresh windows through instance 0 --------------------
+    inst0 = fleet[0]
+    owned = inst0.claims.owned()
+    par_namespaces = [f"ns-{i}" for i in range(4 * n_shards)
+                      if shard_of(f"ns-{i}", n_shards) in owned]
+    violations: list = []
+    if par_namespaces and parity_pods > 0:
+        from kubernetes_tpu.api.types import Container as _C, Pod as _P
+        par_i = [0]
+
+        def par_pod(name: str) -> Pod:
+            ns = par_namespaces[par_i[0] % len(par_namespaces)]
+            par_i[0] += 1
+            return _P(name=name, namespace=ns, labels={"app": "par"},
+                      scheduler_name=inst0.profile,
+                      containers=(_C.make(
+                          name="c",
+                          requests={"cpu": 100, "memory": 500 * MI}),))
+
+        obs_flight.RECORDER.configure(mode="replay", capacity=8)
+        obs_flight.RECORDER.clear()
+        par = ArrivalGenerator(store, rate=10 ** 9, total=parity_pods,
+                               pod_fn=par_pod, name_prefix="par-",
+                               seed=seed + 1)
+        try:
+            while not par.finished():
+                par.tick()
+                inst0.step()
+            inst0.loop.drain(timeout=30.0)
+            violations = obs_flight.RECORDER.replay_all()
+        finally:
+            obs_flight.RECORDER.configure(mode="digest")
+            obs_flight.RECORDER.clear()
+
+    # -- teardown ------------------------------------------------------------
+    book_watch.stop()
+    auditor.stop()
+    for w in watch_pool:
+        w.stop()
+    store.admission_gate = None
+    # drop the cell's store/deque refs from the process-global gauges
+    for gfam in (lag_count, lag_max, lag_p99, util_cpu, util_pods):
+        gfam.set_function(lambda: 0.0)
+
+    # -- scoreboard: series + verdicts + artifact ---------------------------
+    report = evaluate_verdicts(SCRAPER)
+    doc = SCRAPER.series()
+    sampled = sorted(doc["families"])
+    required = {
+        "windowed_startup_p99": "pod_startup_seconds_p99_windowed",
+        "rate_series": "serve_pods_scheduled_total",
+        "process_self_metric": "process_resident_memory_bytes",
+    }
+    summary = {
+        "nodes": n_nodes,
+        "instances": instances,
+        "profiles": served_profiles,
+        "arrival_rate": arrival_rate,
+        "duration": round(elapsed, 2),
+        "aggregate_pods_per_s": round(aggregate, 1),
+        "watchers": int(watchers),
+        "watch_classes": int(watch_classes),
+        "watchers_expired": expired_watchers,
+        "watcher_lag_summary": lag_summary,
+        "startup_p50": led["startup_p50"],
+        "startup_p99": led["startup_p99"],
+        "startup_p50_windowed": led["startup_p50_windowed"],
+        "startup_p99_windowed": led["startup_p99_windowed"],
+        "startup_slo_ok": led["startup_slo_ok"],
+        "startup_slo_ok_windowed": led["startup_slo_ok_windowed"],
+        "slo_burn_rate": led["slo_burn_rate"],
+        "pods_created": created_total,
+        "pods_deleted": deleted_total,
+        "workload_reaped": reaped,
+        "churn": churn,
+        "arrivals": g,
+        "chaos_injections": {
+            s: chaos.INJECTIONS.labels(s).value for s in chaos.SEAMS},
+        "timeseries_samples": doc["samples"],
+        "timeseries_families": len(sampled),
+        "required_families": {k: (v in sampled)
+                              for k, v in required.items()},
+        "verdicts": [v["verdict"] for v in report["verdicts"]],
+        "verdicts_evaluated": len(report["verdicts"]),
+        "first_failure": report["first_failure"],
+        "parity_violations": len(violations),
+        "parity_errors": violations[:3],
+        "double_binds": len(auditor.violations),
+        "partition_disjoint": not partition_overlap,
+        "accounting_resynced": accounting_resynced,
+        "audit_all_admitted_or_accounted": True,   # asserted above
+        "audit_no_double_bind": True,
+    }
+    if soak_out:
+        artifact = {
+            "config": {
+                "nodes": n_nodes, "duration": duration,
+                "arrival_rate": arrival_rate, "instances": instances,
+                "watchers": int(watchers),
+                "watch_classes": int(watch_classes),
+                "scrape_interval": scrape_interval, "seed": seed,
+                "chaos_rates": rates,
+            },
+            "summary": {k: v for k, v in summary.items()
+                        if k != "parity_errors"},
+            "ledger": led,
+            "verdict_report": report,
+            "timeseries": doc,
+        }
+        with open(soak_out, "w") as f:
+            json.dump(artifact, f, sort_keys=True)
+        summary["soak_artifact"] = soak_out
+    return summary

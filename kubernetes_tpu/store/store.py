@@ -26,6 +26,8 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Callable, Iterable, Optional
 
+import numpy as _np
+
 from kubernetes_tpu import chaos, obs
 
 ADDED = "ADDED"
@@ -403,6 +405,9 @@ class Store:
         # (the core counts monotonically; obs counters get the deltas)
         self._fanout_obs_synced = {"materializations": 0, "shared_hits": 0}
         self._gauge_kinds: set = set()
+        # watcher_lag_summary()'s TTL cache ({"at": t, "summary": {...}});
+        # the all-watchers backlog walk is O(watchers) core calls
+        self._lag_summary_cache: Optional[dict] = None
         self._log_size = watch_log_size
         # audit-record retention (the event-TTL analog); None/0 = unbounded
         self._events_cap = events_cap
@@ -538,6 +543,40 @@ class Store:
                 continue
         return out
 
+    def watcher_lag_summary(self, ttl: float = 2.0) -> dict:
+        """Backlog summary over ALL watchers in one pass — count, max,
+        p99, total — the true-tail complement to the sampled
+        watcher_lags() list (which stops at 1k entries and, at 100k
+        watchers, would report the FIRST thousand's health as the
+        plane's). One `backlog(wid)` call per watcher; results are
+        cached for `ttl` seconds because the soak scraper reads this at
+        2 Hz via a callback gauge and 100k core calls per sample would
+        be a self-inflicted fan-out storm (ttl=0 forces a fresh walk)."""
+        now = _time.perf_counter()
+        with self._lock:
+            cached = self._lag_summary_cache
+            if cached is not None and ttl > 0 \
+                    and now - cached["at"] < ttl:
+                return dict(cached["summary"])
+            ids = list(self._watch_ids)
+        backlogs = []
+        for wid in ids:
+            try:
+                backlogs.append(int(self._core.backlog(wid)))
+            except Exception:
+                continue
+        if backlogs:
+            arr = _np.asarray(backlogs, dtype=_np.int64)
+            summary = {"count": int(arr.size),
+                       "max": int(arr.max()),
+                       "p99": int(_np.percentile(arr, 99)),
+                       "total": int(arr.sum())}
+        else:
+            summary = {"count": 0, "max": 0, "p99": 0, "total": 0}
+        with self._lock:
+            self._lag_summary_cache = {"at": now, "summary": summary}
+        return dict(summary)
+
     def set_wire_encoder(self, fn) -> None:
         """Install the byte ring's wire encoder ((etype, obj, rv) ->
         bytes; the apiserver passes its serde line encoder). Kept on the
@@ -586,6 +625,7 @@ class Store:
                 "objects": n_objs,
                 "watchers_total": n_watchers,
                 "watchers": self.watcher_lags(),
+                "watcher_lag_summary": self.watcher_lag_summary(),
                 "watch_plane": self.watch_plane_state()}
 
     # -- alias tripwire ------------------------------------------------------
