@@ -676,7 +676,7 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
         "verdicts_evaluated": len(report["verdicts"]),
         "first_failure": report["first_failure"],
         "parity_violations": len(violations),
-        "parity_errors": violations[:3],
+        "parity_violation_samples": violations[:3],
         "double_binds": len(auditor.violations),
         "partition_disjoint": not partition_overlap,
         "accounting_resynced": accounting_resynced,
@@ -694,7 +694,7 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
                 "chaos_rates": rates,
             },
             "summary": {k: v for k, v in summary.items()
-                        if k != "parity_errors"},
+                        if k != "parity_violation_samples"},
             "ledger": led,
             "verdict_report": report,
             "timeseries": doc,
