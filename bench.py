@@ -755,6 +755,36 @@ def run_soak_bench(n_nodes: int, instances: int, arrival_rate: float,
     return out
 
 
+def run_tune_bench(n_nodes: int, arrival_rate: float, duration: float,
+                   window: int = 512, depth: int = 2, seed: int = 0,
+                   search_budget: int = 48) -> dict:
+    """`--mode tune` (round 22): the closed-loop learned-scoring lane —
+    record flight-recorder worlds, run the seeded offline search (with
+    the in-cell determinism audit), then serve a two-instance shadow
+    A/B split where the tuner installs the searched row MID-RUN via
+    ProfileSet.set_row and the promotion gate judges the windowed
+    evidence at the end. The acceptance floor: the tuned shadow lane
+    beats the incumbent default row on the cell's objective (windowed
+    p99 and/or packing utilization) at >= 0.9x throughput, with zero
+    parity violations and zero double-binds. One JSON line."""
+    from kubernetes_tpu.perf.harness import run_tuner_cell
+    r = run_tuner_cell(n_nodes, arrival_rate=arrival_rate,
+                       duration=duration, window=window, depth=depth,
+                       seed=seed, search_budget=search_budget)
+    out = {
+        "metric": (f"tune_shadow_ab_{n_nodes}n_{int(arrival_rate)}rps_"
+                   f"{int(duration)}s"),
+        "value": r["lanes"]["shadow"]["utilization"],
+        "unit": "mean_node_cpu_fill",
+        "baseline_note": "shadow (tuned row) lane's packing utilization "
+                         "vs the incumbent default-row lane in the SAME "
+                         "run; objective_win + the throughput ratio are "
+                         "the floor's inputs",
+    }
+    out.update(r)
+    return out
+
+
 def run_commit_bench(n_pods: int = 4096, waves: int = 8,
                      watchers: int = 8, watch_classes: int = 1) -> dict:
     """`--mode commit`: the round-11 commit-core lane — the store-write +
@@ -942,7 +972,7 @@ def main():
     ap.add_argument("--mode",
                     choices=["burst", "serial", "oracle", "preempt", "matrix",
                              "gang", "commit", "chaos", "churn", "serve",
-                             "fleet", "soak"],
+                             "fleet", "soak", "tune"],
                     default="burst")
     # `--mode fleet` (round 18): N partitioned scheduler instances on
     # their own threads against one shared store, vs the solo serve
@@ -1030,6 +1060,12 @@ def main():
     # the time-series scraper + verdict engine reading the whole run.
     # Reuses --nodes/--instances/--arrival-rate/--duration/--watchers/
     # --watch-classes/--serve-window/--serve-depth/--chaos-seed.
+    # `--mode tune` (round 22): the closed-loop learned-scoring lane.
+    # Reuses --nodes/--arrival-rate/--duration/--serve-window/
+    # --serve-depth/--chaos-seed; the budget caps offline simulator
+    # evaluations (CEM generations = budget // 16)
+    ap.add_argument("--search-budget", type=int, default=48,
+                    help="tune mode: offline search evaluation budget")
     ap.add_argument("--soak-out", metavar="PATH", default=None,
                     help="soak mode: write the SOAK artifact JSON (config "
                          "+ sampled trajectories + verdicts + audits)")
@@ -1112,7 +1148,8 @@ def main():
     n_nodes = args.nodes if args.nodes is not None \
         else (1000 if args.mode in ("preempt", "chaos", "serve", "fleet",
                                     "soak")
-              else (300 if args.mode == "churn" else 15000))
+              else (300 if args.mode == "churn"
+                    else (256 if args.mode == "tune" else 15000)))
     n_pods = args.pods if args.pods is not None \
         else (5000 if args.mode == "chaos"
               else (3000 if args.mode == "churn" else 10000))
@@ -1141,6 +1178,22 @@ def main():
             watchers=soak_watchers, watch_classes=soak_classes,
             window=args.serve_window, depth=args.serve_depth,
             seed=args.chaos_seed, soak_out=args.soak_out))
+        finish(result)
+        return
+    if args.mode == "tune":
+        # host+device composition lane; the serve-scale flag defaults
+        # (2000 rps / 30 s / 2048-window) are sized for one full-rate
+        # lane — the tune cell splits arrivals across TWO half-rate
+        # lanes, so untouched defaults drop to the matrix gate cell
+        tune_rate = args.arrival_rate if args.arrival_rate != 2000.0 \
+            else 250.0
+        tune_duration = args.duration if args.duration != 30.0 else 12.0
+        tune_window = args.serve_window if args.serve_window != 2048 \
+            else 512
+        result = retry_transient(lambda: run_tune_bench(
+            n_nodes, tune_rate, tune_duration, window=tune_window,
+            depth=args.serve_depth, seed=args.chaos_seed,
+            search_budget=args.search_budget))
         finish(result)
         return
     if args.mode == "preempt":

@@ -195,3 +195,26 @@ class NodeInfo:
         out.image_states = dict(self.image_states)
         out.generation = self.generation
         return out
+
+
+def cluster_utilization(node_infos) -> dict:
+    """Requested/allocatable fill fractions over a NodeInfo snapshot —
+    the `cluster_resource_utilization{resource}` gauge family's source
+    and the tuner reward's live input (round 22). Resources with zero
+    cluster allocatable read 0.0 (an empty snapshot is 0, not NaN: the
+    scraper treats NaN as no-data and the gate must see "empty", not
+    "absent")."""
+    req = {"cpu": 0, "memory": 0, "ephemeral_storage": 0}
+    alloc = {"cpu": 0, "memory": 0, "ephemeral_storage": 0}
+    for ni in (node_infos.values() if hasattr(node_infos, "values")
+               else node_infos):
+        if ni.node is None:
+            continue
+        req["cpu"] += ni.requested.milli_cpu
+        req["memory"] += ni.requested.memory
+        req["ephemeral_storage"] += ni.requested.ephemeral_storage
+        alloc["cpu"] += ni.allocatable.milli_cpu
+        alloc["memory"] += ni.allocatable.memory
+        alloc["ephemeral_storage"] += ni.allocatable.ephemeral_storage
+    return {r: (req[r] / alloc[r] if alloc[r] > 0 else 0.0)
+            for r in req}

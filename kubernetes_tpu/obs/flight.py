@@ -115,12 +115,21 @@ class FlightRecorder:
                 "enabled": (None if algo.enabled_predicates is None
                             else set(algo.enabled_predicates)),
                 "weights": algo.priority_name_weights,
-                # round-19 scheduling profiles: the set is decision
-                # INPUT (per-pod weight rows + the rank-aware gang
-                # objective), so replay must select configs per pod the
-                # same way (the set is immutable once validated)
-                "profiles": getattr(algo, "profiles", None),
             }
+            # round-19 scheduling profiles: the set is decision INPUT
+            # (per-pod weight rows + the rank-aware gang objective), so
+            # replay must select configs per pod the same way. Round 22
+            # makes rows WRITABLE (the tuner), so the capture pins a
+            # SNAPSHOT + the active weight-table slice — a mid-run
+            # set_row() must not retro-edit an already-recorded burst
+            # (round-18 rule: every cross-run input is RECORDED).
+            profs = getattr(algo, "profiles", None)
+            if profs is not None:
+                capture["profiles"] = profs.snapshot()
+                capture["wtab"] = profs.weight_table().copy()
+                capture["profile_version"] = profs.version
+            else:
+                capture["profiles"] = None
         rec = BurstRecord(
             kind, [(list(seg), bool(g)) for seg, g in segments],
             list(names), algo.last_index, algo.last_node_index,
@@ -238,6 +247,14 @@ class FlightRecorder:
             nominated_pods_fn=lambda _n: [])
         oracle.last_index, oracle.last_node_index = rec.li, rec.lni
         profiles = cap.get("profiles")
+        if profiles is not None and cap.get("wtab") is not None:
+            # the recorded tensor slice must still derive from the
+            # snapshot — a divergence means the capture failed to pin the
+            # rows across a tuner set_row() (replay would silently score
+            # with the WRONG weights otherwise)
+            if not np.array_equal(profiles.weight_table(), cap["wtab"]):
+                return ["recorded weight table diverges from the profile "
+                        "snapshot (capture did not pin the tensor rows)"]
         if profiles is not None:
             prof_cfgs = [profiles.oracle_configs(
                 i, services_fn=lambda: services,

@@ -255,12 +255,14 @@ class PodLifecycleLedger:
         (completed by the commit core's copy-out sink)."""
         tt = t if t is not None else time.perf_counter()
         folds: list[list] = []
+        fold_keys: list[str] = []
         with self._lock:
             recs = self._recs
             for k in keys:
                 rec = recs.pop(k, None)
                 if rec is None:
                     continue
+                fold_keys.append(k)
                 rec[COMMIT] = tt
                 # a pod that never crossed an admission gate collapses the
                 # admission phase to zero width at its enqueue stamp
@@ -280,10 +282,12 @@ class PodLifecycleLedger:
                     self._trace[k] = rec
             if not folds:
                 return
-            for rec in folds:
+            for k, rec in zip(fold_keys, folds):
                 lat = rec[COMMIT] - rec[ADMISSION]
                 self._e2e.append(lat)
-                self._recent.append((tt, lat))
+                # the key rides along so windowed readouts can filter by
+                # lane (round 22: the tuner's shadow-vs-incumbent split)
+                self._recent.append((tt, lat, k))
             self._completed += len(folds)
         # histogram folds outside the ledger lock (families self-lock)
         for slot, phase in ((ENQUEUE, "admission"), (POP, "queue"),
@@ -331,31 +335,41 @@ class PodLifecycleLedger:
 
     # -- windowed twins ------------------------------------------------------
     def _window_vals(self, window: Optional[float],
-                     now: Optional[float]) -> list:
+                     now: Optional[float], match=None) -> list:
         """Startup latencies of pods committed within the trailing
-        window (commit-stamp clock: perf_counter)."""
+        window (commit-stamp clock: perf_counter). `match` filters by
+        pod key — the per-lane readout (tuner shadow vs incumbent)."""
         w = STARTUP_WINDOW_SECONDS if window is None else window
         tt = time.perf_counter() if now is None else now
         cutoff = tt - w
         with self._lock:
             # _recent is commit-time ordered: walk from the newest end
             out = []
-            for t, lat in reversed(self._recent):
+            for t, lat, key in reversed(self._recent):
                 if t < cutoff:
                     break
-                out.append(lat)
+                if match is None or match(key):
+                    out.append(lat)
         return out
 
     def window_percentile(self, q: float, window: Optional[float] = None,
-                          now: Optional[float] = None) -> float:
+                          now: Optional[float] = None,
+                          match=None) -> float:
         """Startup percentile over pods committed in the trailing window
         only — the rolling twin of `percentile` (which is since-reset
         and shows a late-run stall only after it has drowned the early
-        samples). 0.0 with no pods in the window."""
-        vals = sorted(self._window_vals(window, now))
+        samples). 0.0 with no pods in the window. `match` (key ->
+        bool) restricts to one lane's pods."""
+        vals = sorted(self._window_vals(window, now, match))
         if not vals:
             return 0.0
         return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    def window_count(self, window: Optional[float] = None,
+                     now: Optional[float] = None, match=None) -> int:
+        """Pods committed in the trailing window (optionally one lane's)
+        — the promotion gate's minimum-evidence denominator."""
+        return len(self._window_vals(window, now, match))
 
     def window_violation_fraction(self, slo: float = STARTUP_SLO_SECONDS,
                                   window: Optional[float] = None,

@@ -49,8 +49,16 @@ def escape_help(v: str) -> str:
 
 def format_value(v) -> str:
     """Integral values render without a decimal point (counters read as
-    event counts); everything else as shortest float repr."""
+    event counts); everything else as shortest float repr. NaN and the
+    infinities use the Prometheus text-format spellings — callback
+    gauges publish NaN as the no-data value (a dead component's reader,
+    a lane that committed nothing), and the exposition must carry that
+    through rather than crash the whole scrape on int(NaN)."""
     f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
     if f == int(f) and abs(f) < 1e15:
         return str(int(f))
     return repr(f)

@@ -135,6 +135,9 @@ class ProfileSet:
         #: per-profile scheduled counts (the /debug/sched section's copy;
         #: the obs counter is the wire-visible one)
         self.scheduled_counts = [0] * len(self.profiles)
+        #: bumped on every successful set_row — serving caches key their
+        #: refresh off it (round 22: the tuner's write path)
+        self.version = 0
         if validate:
             self.validate()
 
@@ -185,6 +188,58 @@ class ProfileSet:
                 errs.append(f"profile {p.name}: {e}")
         if errs:
             raise ProfileValidationError("; ".join(errs))
+
+    # -- row updates (round 22: the tuner's write path) ----------------------
+    def set_row(self, name_or_index, weights, rank_aware=None,
+                gang_weight=None) -> "SchedulingProfile":
+        """Replace one profile's weight row IN PLACE (same name, same
+        index — the tensor row a tuner writes). Runs the EXACT ctor
+        validation (unknown priorities, duplicate names, policy weight
+        bounds) against the full trial set; on failure nothing mutates.
+        Returns the installed profile. `weights` is a {priority name:
+        weight} mapping (or the ctor's tuple form); empty means the
+        DefaultProvider vector. `tensor_mode()` stays dynamic, so an
+        identity write of the default vector does NOT flip a degenerate
+        default set into tensor mode."""
+        if isinstance(name_or_index, int):
+            i = name_or_index
+            if not 0 <= i < len(self.profiles):
+                raise ProfileValidationError(f"no profile at index {i}")
+        else:
+            idx = self._index.get(name_or_index)
+            if idx is None:
+                raise ProfileValidationError(
+                    f"no profile named {name_or_index!r}")
+            i = idx
+        old = self.profiles[i]
+        if isinstance(weights, dict):
+            wt = tuple(sorted((str(k), int(v)) for k, v in weights.items()))
+        else:
+            wt = tuple(weights)
+        cand = SchedulingProfile(
+            name=old.name, weights=wt,
+            rank_aware=old.rank_aware if rank_aware is None
+            else bool(rank_aware),
+            gang_weight=old.gang_weight if gang_weight is None
+            else int(gang_weight))
+        trial = list(self.profiles)
+        trial[i] = cand
+        # ctor-equivalent validation by construction: the trial set runs
+        # the same validate() a fresh ProfileSet would
+        ProfileSet(trial, validate=True)
+        self.profiles[i] = cand
+        self.version += 1
+        return cand
+
+    def snapshot(self) -> "ProfileSet":
+        """An immutable-enough copy for replay capture: profiles are
+        frozen dataclasses, so a fresh list pins the rows as of NOW —
+        later set_row() calls replace entries in the LIVE list and leave
+        the snapshot's rows untouched (round-18 rule: every cross-run
+        decision input is recorded)."""
+        snap = ProfileSet(list(self.profiles), validate=False)
+        snap.version = self.version
+        return snap
 
     # -- lookups -------------------------------------------------------------
     def __len__(self) -> int:

@@ -77,6 +77,12 @@ STALE_BINDS = obs.counter(
     "with backoff in creation order, and the dead node's device-mirror "
     "row, victim-table row, cache entry, and NodeTree slot are "
     "invalidated eagerly (the informer's DELETED event confirms later).")
+CLUSTER_UTILIZATION = obs.gauge(
+    "cluster_resource_utilization",
+    "Cluster-wide requested/allocatable fill fraction by resource "
+    "(cpu/memory/ephemeral_storage), computed from the scheduler's "
+    "NodeInfo snapshot at collect time — the packing-lane report and "
+    "the tuner reward's live input (round 22).", ("resource",))
 COMMIT_RETRIES = obs.counter(
     "store_commit_retries_total",
     "commit_wave store-write retries by the scheduler's idempotent retry "
@@ -221,6 +227,7 @@ class Scheduler:
                     "profiles and priority_weights are mutually exclusive")
             profiles.validate()
         self.profiles = profiles
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
         self.recorder = EventRecorder(store, component=scheduler_name)
         self.clock = clock or RealClock()
         self.cache = SchedulerCache(clock=self.clock)
@@ -400,6 +407,24 @@ class Scheduler:
                 return None
             return s.debug_state()
         obs.register_debug("scheduler", snap)
+        # cluster_resource_utilization{resource}: callback gauges over
+        # the live snapshot (read at collect time — /metrics and the
+        # timeseries scraper see the CURRENT fill, no push cadence).
+        # Latest scheduler wins per child, same as the debug sections.
+        for res in ("cpu", "memory", "ephemeral_storage"):
+            def _util_reader(r=res):
+                s = ref()
+                if s is None:
+                    return float("nan")
+                from kubernetes_tpu.cache.node_info import (
+                    cluster_utilization)
+                try:
+                    return cluster_utilization(s._snapshot.node_infos)[r]
+                except RuntimeError:
+                    # snapshot dict mutating under the scrape thread:
+                    # this window reads no-data, never a crash
+                    return float("nan")
+            CLUSTER_UTILIZATION.labels(res).set_function(_util_reader)
         if self.profiles is not None:
             # loaded profiles, weight rows, per-profile scheduled counts
             pref = weakref.ref(self.profiles)
@@ -409,12 +434,35 @@ class Scheduler:
                 return None if ps is None else ps.debug_state()
             obs.register_debug("profiles", psnap)
 
+    def reload_profiles(self) -> None:
+        """Re-derive every profile-dependent cache after a ProfileSet row
+        write (the tuner's set_row): the per-profile oracle
+        PriorityConfig lists AND the device-side weight tensor (the TPU
+        algorithm's set_profiles clears _ptab/_wtab_dev/_union_weights/
+        _profile_static so the next launch gathers the NEW rows). A
+        serving scheduler that skips this keeps scoring with the stale
+        tensor — the write is not live until reload."""
+        if self.profiles is None:
+            return
+        self._profile_configs = [
+            self.profiles.oracle_configs(
+                i, services_fn=self._services_fn,
+                replicasets_fn=self._replicasets_fn,
+                hard_pod_affinity_weight=self.hard_pod_affinity_weight)
+            for i in range(len(self.profiles))]
+        self._priority_configs = self._profile_configs[0]
+        set_prof = getattr(self.algorithm, "set_profiles", None)
+        if set_prof is not None:
+            set_prof(self.profiles)
+
     def debug_state(self) -> dict:
         from kubernetes_tpu.obs.ledger import LEDGER
+        from kubernetes_tpu.cache.node_info import cluster_utilization
         out = {
             "name": self.name,
             "queue": self.queue.debug_state(),
             "ledger": LEDGER.debug_state(),
+            "utilization": cluster_utilization(self._snapshot.node_infos),
         }
         algo_dbg = getattr(self.algorithm, "debug_state", None)
         if algo_dbg is not None:

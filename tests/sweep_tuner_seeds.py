@@ -1,0 +1,193 @@
+"""Tuner closed-loop seed sweep (the round-22 42-trial run).
+
+Not collected by pytest (no test_ prefix): run by hand after any tuner,
+profiles/set_row, flight-capture, or promotion-gate change —
+
+    JAX_PLATFORMS=cpu python tests/sweep_tuner_seeds.py [trials] [base_seed]
+
+Each trial runs the WHOLE loop under the parity harness with a fresh
+seed: record replay-mode flight worlds from a TPU-path burst cluster,
+run the seeded offline search TWICE (the winner must reproduce
+bit-for-bit — nondeterministic search is an instant fail), then serve a
+two-instance shadow A/B fleet (partitioned by claimed profile) where
+the searched row is installed MID-RUN via ProfileSet.set_row +
+reload_profiles while a BindAuditor folds the shared pod watch and the
+replay-mode recorder captures every burst. Every trial asserts: zero
+double-binds EVER, every created pod bound, flight replay green for
+every record — including the records straddling the row write (the
+capture pins a ProfileSet snapshot) — and the promotion gate renders a
+sane verdict (a promote must actually land the shadow's row in the
+incumbent; no-data never promotes).
+"""
+import random
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import tests.conftest  # noqa: F401  (forces the 8-device CPU mesh config)
+
+
+def run_tuner_trial(seed: int) -> str:
+    import zlib
+
+    from kubernetes_tpu.api.types import Container, Node, Pod
+    from kubernetes_tpu.fleet import BindAuditor, FleetInstance
+    from kubernetes_tpu.obs.flight import RECORDER
+    from kubernetes_tpu.obs.ledger import LEDGER
+    from kubernetes_tpu.obs.timeseries import SCRAPER, SeriesView
+    from kubernetes_tpu.profiles import (
+        DEFAULT_PROFILE_NAME, ProfileSet, SchedulingProfile)
+    from kubernetes_tpu.scheduler import Scheduler
+    from kubernetes_tpu.store.store import NODES, PODS, Store
+    from kubernetes_tpu.tuner import (
+        PromotionGate, ShadowTuner, tune, worlds_from_recorder)
+    from kubernetes_tpu.tuner.controller import prefix_lanes
+
+    GI = 1024 ** 3
+    rng = random.Random(seed)
+    shadow_name = "shadow-tuner"
+
+    def mknode(i, cpu=4000):
+        return Node(name=f"n{i}",
+                    labels={"kubernetes.io/hostname": f"n{i}",
+                            "failure-domain.beta.kubernetes.io/zone":
+                            f"z{i % 3}"},
+                    allocatable={"cpu": cpu, "memory": 32 * GI,
+                                 "pods": 110})
+
+    def mkpod(name, sched_name, cpu):
+        ns = f"ns-{zlib.crc32(name.encode()) % 16}"
+        return Pod(name=name, namespace=ns, scheduler_name=sched_name,
+                   labels={"app": "tune"},
+                   containers=(Container.make(
+                       name="c", requests={"cpu": cpu,
+                                           "memory": GI}),))
+
+    # ---- phase 1: record worlds (TPU burst path, replay mode) ----------
+    RECORDER.configure(mode="replay", capacity=16)
+    RECORDER.clear()
+    store_a = Store()
+    for i in range(rng.randint(4, 8)):
+        store_a.create(NODES, mknode(i))
+    sched_a = Scheduler(store_a, use_tpu=True,
+                        percentage_of_nodes_to_score=100)
+    sched_a.sync()
+    for j in range(rng.randint(10, 24)):
+        store_a.create(PODS, mkpod(f"w{j}", DEFAULT_PROFILE_NAME,
+                                   rng.choice((100, 300, 700))))
+    sched_a.pump()
+    while sched_a.schedule_burst(max_pods=8):
+        pass
+    sched_a.pump()
+    worlds = worlds_from_recorder()
+    assert worlds, "no replayable worlds recorded"
+
+    # ---- phase 2: seeded search, twice — identical or bust -------------
+    keys = ["LeastRequestedPriority", "MostRequestedPriority",
+            "BalancedResourceAllocation", "SelectorSpreadPriority"]
+    budget = rng.choice((8, 16, 32))
+    a = tune(worlds, keys, seed=seed, budget=budget)
+    b = tune(worlds, keys, seed=seed, budget=budget)
+    assert (a.best_weights, a.best_reward, a.history) == \
+        (b.best_weights, b.best_reward, b.history), \
+        f"search nondeterministic: {a.as_dict()} vs {b.as_dict()}"
+
+    # ---- phase 3: shadow A/B serve with the mid-run row write ----------
+    RECORDER.clear()
+    LEDGER.reset()
+    SCRAPER.reset()
+    store = Store(watch_log_size=1 << 15)
+    per_lane = rng.randint(8, 20)
+    chunks = rng.randint(2, 4)
+    # every pod must FIT: 2 lanes x chunks x per_lane pods at worst-case
+    # 300 mcpu against 4000-mcpu nodes, sized to <= ~60% cluster fill
+    # (an unschedulable tail would fail the all-bound audit by design)
+    n_nodes = max(rng.randint(6, 12),
+                  (2 * chunks * per_lane * 300) // (4000 * 6 // 10) + 1)
+    for i in range(n_nodes):
+        store.create(NODES, mknode(i))
+    pset = ProfileSet([SchedulingProfile(DEFAULT_PROFILE_NAME),
+                       SchedulingProfile(shadow_name)])
+    idents = ["ti", "ts"]
+    lanes = ((DEFAULT_PROFILE_NAME, "tn-i-"), (shadow_name, "tn-s-"))
+    fleet = [FleetInstance(store, idents[k], [idents[k]],
+                           profile=lanes[k][0], profiles=pset,
+                           use_tpu=True, window=rng.choice((4, 8)),
+                           depth=2, n_shards=4,
+                           percentage_of_nodes_to_score=100)
+             for k in range(2)]
+    for inst in fleet:
+        inst.sync()
+
+    def drain(rounds=200):
+        for _ in range(rounds):
+            if sum(inst.step() for inst in fleet) == 0 and all(
+                    inst.sched.queue.num_pending() == 0
+                    and inst.sched.informers.informer(PODS).backlog() == 0
+                    for inst in fleet):
+                break
+
+    drain()                       # claims settle before the auditor
+    auditor = BindAuditor(store)
+    tuner = ShadowTuner(pset, shadow_name, schedulers=fleet,
+                        lane_match=prefix_lanes("tn-i-", "tn-s-"))
+    install_chunk = rng.randint(0, chunks - 1)
+    made = 0
+    for c in range(chunks):
+        if c == install_chunk:
+            tuner.install(a.best_weights)       # the live row write
+        for j in range(per_lane):
+            for prof, prefix in lanes:
+                store.create(PODS, mkpod(f"{prefix}{made}-{j}", prof,
+                                         rng.choice((100, 200, 300))))
+        made += 1
+        drain()
+        auditor.scan()
+        tuner.observe(fleet[0].sched._snapshot.node_infos)
+        SCRAPER.sample()
+    drain(400)
+    auditor.scan()
+    tuner.observe(fleet[0].sched._snapshot.node_infos)
+    SCRAPER.sample()
+    auditor.stop()
+
+    unbound = [p.key for p in store.list(PODS)[0]
+               if p.name.startswith("tn-") and not p.node_name]
+    assert not unbound, f"{len(unbound)} pods never bound: {unbound[:4]}"
+    assert not auditor.violations, \
+        f"DOUBLE BINDS: {auditor.violations[:4]}"
+    errs = RECORDER.replay_all()
+    assert errs == [], f"replay parity broke across set_row: {errs[:4]}"
+
+    # ---- phase 4: the gate's verdict ------------------------------------
+    decision = tuner.apply(
+        PromotionGate(min_samples=2).decide(SeriesView(SCRAPER.series())))
+    d = decision["decision"]
+    assert d in ("promote", "hold", "demote"), decision
+    if d == "promote":
+        assert pset.default.name_weights() == \
+            pset.profile_for(shadow_name).name_weights(), \
+            "promote did not land the shadow row in the incumbent"
+    RECORDER.configure(mode="digest")
+    RECORDER.clear()
+    return d
+
+
+def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
+    rng = random.Random(base_seed)
+    verdicts: dict = {}
+    for trial in range(trials):
+        seed = rng.randint(1, 10_000)
+        try:
+            d = run_tuner_trial(seed)
+        except Exception:
+            print(f"FAIL seed={seed}")
+            raise
+        verdicts[d] = verdicts.get(d, 0) + 1
+        print(f"ok {trial + 1}/{trials} seed={seed} -> {d}")
+    print(f"tuner sweep green: {trials} trials, verdicts={verdicts}")
+
+
+if __name__ == "__main__":
+    run_sweep(int(sys.argv[1]) if len(sys.argv) > 1 else 42,
+              int(sys.argv[2]) if len(sys.argv) > 2 else 0)

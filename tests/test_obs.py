@@ -96,6 +96,22 @@ class TestRegistry:
         assert format_value(0) == "0"
         assert format_value(0.25) == "0.25"
 
+    def test_format_value_specials_use_prometheus_spellings(self):
+        # NaN is the no-data value for callback gauges (a GC'd
+        # component's reader, a tuner lane that committed nothing) —
+        # the scrape must carry it, never crash on int(NaN)
+        assert format_value(float("nan")) == "NaN"
+        assert format_value(float("inf")) == "+Inf"
+        assert format_value(float("-inf")) == "-Inf"
+
+    def test_nan_callback_gauge_renders_and_lints(self):
+        r = Registry()
+        g = r.gauge("t_gone_util", "Reader outlived its component.")
+        g.set_function(lambda: float("nan"))
+        text = r.render()
+        assert "t_gone_util NaN" in text
+        assert lint_exposition(text) == []
+
     def test_callback_gauge_reads_at_collect_time(self):
         r = Registry()
         depth = [7]
