@@ -436,6 +436,39 @@ def make_handler(store: Store, admission: AdmissionChain,
                 self._send(200, {"kind": "Status", "status": "Success",
                                  "scope": scope, "token": token})
                 return
+            # batched eviction (round 23): POST /api/v1/pods/evictions
+            # {"keys": [...], "reason"?, "stop_on_refusal"?} — the churn
+            # plane's one-call PDB-guarded delete. Per-item outcomes come
+            # back in the body (evicted/refused/missing/skipped/invalid);
+            # a refusal is an OUTCOME here, never a 429 — the whole batch
+            # always answers, callers refund tokens per "refused" item.
+            if len(parts) == 4 and parts[2] == PODS \
+                    and parts[3] == "evictions":
+                if not self._authorized(user, "create", PODS):
+                    return
+                body = self._body()
+                keys = list(body.get("keys") or [])
+                outcomes: dict = {}
+                attempt: list = []
+                for key in keys:
+                    try:
+                        admission.admit_delete(
+                            PODS, store.get(PODS, key), store,
+                            user=self._user_name(user))
+                    except AdmissionError:
+                        outcomes[key] = "invalid"
+                        continue
+                    except NotFoundError:
+                        outcomes[key] = "missing"
+                        continue
+                    attempt.append(key)
+                if attempt:
+                    outcomes.update(store.evict_many(
+                        attempt, reason=body.get("reason", "api"),
+                        stop_on_refusal=bool(body.get("stop_on_refusal"))))
+                self._send(200, {"kind": "Status", "status": "Success",
+                                 "outcomes": outcomes})
+                return
             # eviction subresource: POST /api/v1/pods/{ns}/{name}/eviction
             # — PDB-guarded delete (reference: registry/core/pod/rest/
             # eviction.go). An exhausted budget answers 429 TooManyRequests
@@ -557,6 +590,61 @@ def make_handler(store: Store, admission: AdmissionChain,
             self._send(201, {"kind": "Status", "status": "Success",
                              "created": len(stored or admitted)})
 
+        def _update_collection(self, kind, body, user) -> None:
+            """Batched update (round 23): every item rides the update
+            admission chain against its current stored object, then ONE
+            `store.update_many` (rv-CAS per item: resource_version 0/absent
+            skips the CAS, anything else must match). The response carries
+            per-item refusals — `conflicts` and `missing` key lists —
+            instead of failing the batch; refused items' admission deltas
+            are rolled back (the write never landed). An optional "fence"
+            rejects the WHOLE batch atomically (409 Fenced), exactly like
+            the binding subresource."""
+            fence = [(str(s), int(t)) for s, t in body.get("fence") or []]
+            pairs: list = []
+            rollback: dict = {}    # key -> (old, admitted) for refunds
+            missing: list = []
+            try:
+                for d in body["items"]:
+                    obj = serde.from_dict(kind, d)
+                    try:
+                        old = store.get(kind, obj.key)
+                    except NotFoundError:
+                        missing.append(obj.key)
+                        continue
+                    obj = admission.admit_update(
+                        kind, old, obj, store, user=self._user_name(user))
+                    rollback[obj.key] = (old, obj)
+                    pairs.append((obj, obj.resource_version or None))
+            except AdmissionError as e:
+                for old, a in rollback.values():
+                    admission.refund_update(kind, old, a, store)
+                self._error(422, "Invalid", str(e))
+                return
+            except (TypeError, ValueError, KeyError) as e:
+                for old, a in rollback.values():
+                    admission.refund_update(kind, old, a, store)
+                self._error(400, "BadRequest", str(e))
+                return
+            conflicts: list = []
+            try:
+                stored = store.update_many(
+                    kind, pairs, fence=fence or None,
+                    conflicts=conflicts, missing=missing) if pairs else []
+            except FencedError as e:
+                for old, a in rollback.values():
+                    admission.refund_update(kind, old, a, store)
+                self._error(409, "Fenced", str(e))
+                return
+            for key in conflicts + missing:
+                old, a = rollback.get(key, (None, None))
+                if a is not None:   # the admitted write never landed
+                    admission.refund_update(kind, old, a, store)
+            self._send(200, {"kind": "Status", "status": "Success",
+                             "updated": len(stored),
+                             "items": [serde.to_dict(s) for s in stored],
+                             "conflicts": conflicts, "missing": missing})
+
         def _serve_PUT(self):
             path, parts, q = self._route()
             # status subresource: PUT /api/v1/podgroups/{ns}/{name}/status
@@ -582,6 +670,21 @@ def make_handler(store: Store, admission: AdmissionChain,
                     self._error(400, "BadRequest", str(e))
                     return
                 self._send(200, serde.to_dict(updated))
+                return
+            if len(parts) == 3 and parts[2] in serde.KIND_TYPES:
+                # collection PUT (round 23): {"items": [...]} — the churn
+                # plane's batched update, mirroring the round-17
+                # collection POST on the mutation side
+                kind = parts[2]
+                user = self._authenticate()
+                if not self._authorized(user, "update", kind):
+                    return
+                body = self._body()
+                if not (isinstance(body, dict) and "items" in body):
+                    self._error(400, "BadRequest",
+                                "collection PUT takes {\"items\": [...]}")
+                    return
+                self._update_collection(kind, body, user)
                 return
             if len(parts) < 4 or parts[2] not in serde.KIND_TYPES:
                 self._error(404, "NotFound", path)

@@ -90,6 +90,8 @@ SEAMS = (
     "device.fetch",
     "store.commit_wave",
     "store.commit_wave.ambiguous",
+    "store.update_many",
+    "store.evict_many",
     "store.fanout",
     "native.commitcore",
     "native.heapcore",
@@ -106,7 +108,14 @@ SEAMS = (
 #: plumbing (a wrapped clock, a crash-driving harness, a node-kill hook,
 #: an attached serving backpressure gate)
 OPT_IN_SEAMS = ("clock.jump", "sched.crash", "node.dead", "serve.shed",
-                "fleet.lease-loss")
+                "fleet.lease-loss",
+                # batched-mutation seams (round 23): pre-land StoreFaults
+                # at update_many / evict_many. Opt-in because the batched
+                # verbs' callers (churn actors, the zone evictor) surface
+                # the raise to their own tick loop — a blanket `all=`
+                # plan must not start failing paths that round-13 chaos
+                # runs never armed
+                "store.update_many", "store.evict_many")
 
 INJECTIONS = obs.counter(
     "chaos_injections_total",
@@ -169,6 +178,8 @@ _FAULT_FOR = {
     "device.fetch": DeviceFault,
     "store.commit_wave": StoreFault,
     "store.commit_wave.ambiguous": StoreFault,
+    "store.update_many": StoreFault,
+    "store.evict_many": StoreFault,
     "store.fanout": FanoutFault,
     "native.commitcore": NativeFault,
     "native.heapcore": NativeFault,

@@ -372,10 +372,15 @@ class NodeLifecycleController:
 
     # -- NoExecute taint manager: paced drain ---------------------------------
     def drain_evictions(self) -> int:
-        """Drain each zone's eviction queue through its token bucket.
-        A FullDisruption zone (rate 0) performs zero evictions; a
-        budget-exhausted pod (DisruptionBudgetError) refunds its token
-        and stays queued for a later pump. Returns pods evicted."""
+        """Drain each zone's eviction queue through its token bucket, one
+        batched `store.evict_many` per zone per tick (round 23): the tick
+        takes as many tokens as it has due pods (up to the bucket), lands
+        them in ONE store critical section, then settles outcomes —
+        "refused" and "skipped" pods refund their tokens and stay queued
+        IN ORDER for a later pump (stop_on_refusal preserves the serial
+        path's head-of-line pacing: nothing behind a budget-blocked pod
+        jumps it). A FullDisruption zone (rate 0) performs zero
+        evictions. Returns pods evicted."""
         now = self.clock.now()
         evicted = 0
         for zone, q in self._evict_q.items():
@@ -385,54 +390,69 @@ class NodeLifecycleController:
                     self.eviction_rate, self.eviction_burst)
             if pacer.rate <= 0.0:
                 continue
+            batch: list = []   # (pod_key, node_name, pod) — tokens taken
             while q:
                 pod_key, node_name = q[0]
-                if not self._still_due(pod_key, node_name, now):
+                pod = self._still_due(pod_key, node_name, now)
+                if pod is None:
                     q.popleft()
                     self._queued.discard(pod_key)
                     continue
                 if not pacer.try_take(now):
                     break
-                try:
-                    gone = self.store.evict_pod(pod_key,
-                                                reason="taint-manager")
-                except NotFoundError:
-                    q.popleft()
-                    self._queued.discard(pod_key)
-                    continue
-                except DisruptionBudgetError:
-                    pacer.refund()
-                    break
+                batch.append((pod_key, node_name, pod))
                 q.popleft()
-                self._queued.discard(pod_key)
-                evicted += 1
-                self._evicted_by_zone[zone] = \
-                    self._evicted_by_zone.get(zone, 0) + 1
-                self.recorder.pod_event(
-                    gone, NORMAL, "TaintManagerEviction",
-                    f"Deleting pod {pod_key} from node {node_name}")
+            if not batch:
+                continue
+            outcomes = self.store.evict_many(
+                [k for k, _n, _p in batch], reason="taint-manager",
+                stop_on_refusal=True)
+            requeue: list = []
+            for pod_key, node_name, pod in batch:
+                out = outcomes.get(pod_key, "missing")
+                if out == "evicted":
+                    self._queued.discard(pod_key)
+                    evicted += 1
+                    self._evicted_by_zone[zone] = \
+                        self._evicted_by_zone.get(zone, 0) + 1
+                    self.recorder.pod_event(
+                        pod, NORMAL, "TaintManagerEviction",
+                        f"Deleting pod {pod_key} from node {node_name}")
+                elif out == "missing":
+                    # vanished between the due-check and the write: the
+                    # serial path consumed the token here too (no refund)
+                    self._queued.discard(pod_key)
+                else:   # refused (budget) or skipped (behind a refusal)
+                    pacer.refund()
+                    requeue.append((pod_key, node_name))
+            for item in reversed(requeue):
+                q.appendleft(item)
         return evicted
 
-    def _still_due(self, pod_key: str, node_name: str, now: float) -> bool:
+    def _still_due(self, pod_key: str, node_name: str,
+                   now: float) -> Optional[Pod]:
         """Re-validate a queued eviction at drain time: the taint may have
         cleared, the pod may have moved/vanished, the node may be gone
-        (podgc's orphan sweep owns that case)."""
+        (podgc's orphan sweep owns that case). Returns the pod when the
+        eviction is still due, else None."""
         try:
             node = self.store.get(NODES, node_name)
         except NotFoundError:
-            return False
+            return None
         noexec = [t for t in node.taints if t.effect == NO_EXECUTE]
         if not noexec:
-            return False
+            return None
         try:
             pod = self.store.get(PODS, pod_key)
         except NotFoundError:
-            return False
+            return None
         if pod.node_name != node_name or pod.deleted:
-            return False
+            return None
         since = self._noexec_since.get(node_name, {})
         deadline = self._eviction_deadline(pod, noexec, since)
-        return deadline is not None and deadline <= now
+        if deadline is not None and deadline <= now:
+            return pod
+        return None
 
     @staticmethod
     def _eviction_deadline(pod: Pod, noexec: list[Taint],

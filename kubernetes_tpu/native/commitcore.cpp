@@ -403,6 +403,72 @@ int create_batch_body(CommitCore* self, PyObject* bucket, const char* kind,
     return 0;
 }
 
+// Appends MODIFIED entries to `staged` and stored snapshots to `out` (may
+// be null) — the batched update body (round 23). Every object is cloned
+// (the caller's object never aliases the bucket), assigned the next rv,
+// and replaces its bucket entry. NotFound / rv-CAS refusals are the
+// store's per-item pre-scan under the same lock, so everything here lands.
+int update_batch_body(CommitCore* self, PyObject* bucket, PyObject* objs,
+                      PyObject* out, std::vector<Entry>& staged) {
+    PyObject* seq = PySequence_Fast(objs, "objs must be a sequence");
+    if (!seq) return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* obj = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* key = PyObject_GetAttr(obj, S_key);
+        if (!key) { Py_DECREF(seq); return -1; }
+        PyObject* stored = clone_obj(obj);
+        if (!stored) { Py_DECREF(key); Py_DECREF(seq); return -1; }
+        long long rv = assign_rv(self, stored);
+        if (rv < 0) { Py_DECREF(stored); Py_DECREF(key); Py_DECREF(seq); return -1; }
+        if (PyDict_SetItem(bucket, key, stored) < 0) {
+            Py_DECREF(stored); Py_DECREF(key); Py_DECREF(seq); return -1;
+        }
+        Py_DECREF(key);
+        if (out != nullptr && PyList_Append(out, stored) < 0) {
+            Py_DECREF(stored); Py_DECREF(seq); return -1;
+        }
+        Py_INCREF(S_MODIFIED);
+        staged.push_back(Entry{S_MODIFIED, stored, rv});  // stored ref moves
+    }
+    Py_DECREF(seq);
+    return 0;
+}
+
+// Appends DELETED entries to `staged` and the popped originals to `gone`
+// (may be null) — the batched delete body (round 23). The DELETED payload
+// is a snapshot keeping the object's last stored rv; only the log entry
+// carries the delete's own rv (store.delete semantics). Missing keys skip.
+int delete_batch_body(CommitCore* self, PyObject* bucket, PyObject* keys,
+                      PyObject* gone, std::vector<Entry>& staged) {
+    PyObject* seq = PySequence_Fast(keys, "keys must be a sequence");
+    if (!seq) return -1;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* key = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject* current = PyDict_GetItemWithError(bucket, key);  // borrowed
+        if (current == nullptr) {
+            if (PyErr_Occurred()) { Py_DECREF(seq); return -1; }
+            continue;
+        }
+        Py_INCREF(current);   // keep alive across the DelItem
+        PyObject* logged = clone_obj(current);
+        if (!logged) { Py_DECREF(current); Py_DECREF(seq); return -1; }
+        if (PyDict_DelItem(bucket, key) < 0) {
+            Py_DECREF(logged); Py_DECREF(current); Py_DECREF(seq); return -1;
+        }
+        if (gone != nullptr && PyList_Append(gone, current) < 0) {
+            Py_DECREF(logged); Py_DECREF(current); Py_DECREF(seq); return -1;
+        }
+        Py_DECREF(current);
+        self->rv += 1;
+        Py_INCREF(S_DELETED);
+        staged.push_back(Entry{S_DELETED, logged, self->rv});  // logged ref moves
+    }
+    Py_DECREF(seq);
+    return 0;
+}
+
 // -- fencing tokens (round 18; caller holds the store lock) ------------------
 // Twin: PyCommitCore.fence_ok / advance_fence / fence_token / fence_table —
 // identical semantics (a token below the recorded maximum is superseded).
@@ -520,6 +586,42 @@ PyObject* core_create_batch(CommitCore* self, PyObject* args) {
     drop_entries(evicted);
     if (rc < 0) { Py_DECREF(out); return nullptr; }
     return out;
+}
+
+PyObject* core_update_batch(CommitCore* self, PyObject* args) {
+    PyObject* bucket;
+    const char* kind;
+    PyObject* objs;
+    if (!PyArg_ParseTuple(args, "O!sO", &PyDict_Type, &bucket, &kind,
+                          &objs))
+        return nullptr;
+    PyObject* out = PyList_New(0);
+    if (!out) return nullptr;
+    std::vector<Entry> staged, evicted;
+    int rc = update_batch_body(self, bucket, objs, out, staged);
+    // staged entries still enter the log on error (the twin appends per
+    // item before any raise); callers treat a raise as partially-applied
+    splice(self, kind, staged, evicted);
+    drop_entries(evicted);
+    if (rc < 0) { Py_DECREF(out); return nullptr; }
+    return out;
+}
+
+PyObject* core_delete_batch(CommitCore* self, PyObject* args) {
+    PyObject* bucket;
+    const char* kind;
+    PyObject* keys;
+    if (!PyArg_ParseTuple(args, "O!sO", &PyDict_Type, &bucket, &kind,
+                          &keys))
+        return nullptr;
+    PyObject* gone = PyList_New(0);
+    if (!gone) return nullptr;
+    std::vector<Entry> staged, evicted;
+    int rc = delete_batch_body(self, bucket, keys, gone, staged);
+    splice(self, kind, staged, evicted);
+    drop_entries(evicted);
+    if (rc < 0) { Py_DECREF(gone); return nullptr; }
+    return gone;
 }
 
 PyObject* core_commit_wave(CommitCore* self, PyObject* args) {
@@ -1448,6 +1550,12 @@ PyMethodDef core_methods[] = {
      "bind_batch(bucket, kind, bindings) -> missing keys"},
     {"create_batch", (PyCFunction)core_create_batch, METH_VARARGS,
      "create_batch(bucket, kind, objs, move) -> stored objects"},
+    {"update_batch", (PyCFunction)core_update_batch, METH_VARARGS,
+     "update_batch(bucket, kind, objs) -> stored snapshots (batched "
+     "MODIFIED; per-item NotFound/rv-CAS refusal is the store's pre-scan)"},
+    {"delete_batch", (PyCFunction)core_delete_batch, METH_VARARGS,
+     "delete_batch(bucket, kind, keys) -> popped objects (batched "
+     "DELETED; missing keys skipped)"},
     {"commit_wave", (PyCFunction)core_commit_wave, METH_VARARGS,
      "commit_wave(pod_bucket, pod_kind, bindings, ev_bucket, ev_kind, "
      "recs) -> missing keys"},

@@ -109,6 +109,12 @@ class PodLifecycleLedger:
         # cumulative reservoir above is since-reset and averages a
         # late-run stall away; this one is filtered by commit time
         self._recent: deque = deque(maxlen=reservoir)
+        #: windowed-reservoir retention (round 23): commit_many trims
+        #: `_recent` entries older than this at APPEND time — 4x the
+        #: default startup window so every in-repo readout (30 s
+        #: windowed twins, the tuner's 60 s lane windows) stays whole
+        #: while minutes-scale soaks hold O(window) memory
+        self.retention_seconds = 4 * STARTUP_WINDOW_SECONDS
         self._phase_sum = {p: 0.0 for p in PHASES}
         self._completed = 0
         self._trace: Optional[dict] = None    # key -> stamps (test mode)
@@ -288,6 +294,18 @@ class PodLifecycleLedger:
                 # the key rides along so windowed readouts can filter by
                 # lane (round 22: the tuner's shadow-vs-incumbent split)
                 self._recent.append((tt, lat, k))
+            # age-out at append time (round 23): entries older than every
+            # readout window can never be walked again (_recent is
+            # commit-time ordered), so a minutes-scale soak holds
+            # O(window) memory instead of riding the reservoir cap. The
+            # retention carries slack past the default 30 s window because
+            # the tuner's lane readouts ask for 60 s; the cutoff keys off
+            # this batch's stamp, so synthetic clocks trim exactly like
+            # wall time.
+            cutoff = tt - self.retention_seconds
+            recent = self._recent
+            while recent and recent[0][0] < cutoff:
+                recent.popleft()
             self._completed += len(folds)
         # histogram folds outside the ledger lock (families self-lock)
         for slot, phase in ((ENQUEUE, "admission"), (POP, "queue"),

@@ -212,6 +212,12 @@ class PodRowCache:
         """Delete-side invalidation (the informer's on_delete)."""
         self.invalidate_uid(pod.uid)
 
+    def invalidate_many(self, pods: list) -> None:
+        """Batched delete-side invalidation (round 23): one call per
+        informer delete run — the freed slots land in one pass."""
+        for pod in pods:
+            self.invalidate_uid(pod.uid)
+
     # -- window-prologue reads ------------------------------------------------
     def _slot(self, pod: Pod) -> int:
         """Row slot for `pod` at its exact resourceVersion, or -1 (miss /

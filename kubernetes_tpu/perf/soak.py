@@ -59,7 +59,7 @@ import numpy as np
 from kubernetes_tpu import chaos, obs
 from kubernetes_tpu.store.store import (
     Store, BackpressureError, ConflictError, ExpiredError, MODIFIED,
-    DELETED, NODES, PODS, PODGROUPS,
+    DELETED, NODES, NotFoundError, PODS, PODGROUPS,
 )
 
 GI = 1024 ** 3
@@ -318,8 +318,14 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
             reaped += len(store.delete_many(PODS, batch))
 
     # -- churn actors --------------------------------------------------------
+    # round 23: every actor flushes ONE batched verb per tick — creates
+    # ride the gated create_many (429 carries `accepted`), deletes ride
+    # delete_many, in-place restamps ride update_many with per-item
+    # rv-CAS, and the drain flips batch through update_many with a
+    # guaranteed_update fallback for CAS losers
     churn = {"rolled": 0, "roll_shed": 0, "gangs": 0, "gang_pods": 0,
              "gang_shed": 0, "hpa_up": 0, "hpa_down": 0, "hpa_shed": 0,
+             "restamped": 0, "restamp_conflicts": 0,
              "drained_nodes": 0, "drain_restored": 0}
 
     def gated_create(pod: Pod, shed_key: str) -> bool:
@@ -334,25 +340,72 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
         created_total += 1
         return True
 
+    def gated_create_many(pods: list, shed_key: str) -> int:
+        """One gated create_many per actor tick: the gate admits a
+        prefix, the 429 carries `accepted`, and the shed tail is the
+        actor's loss (churn pods are synthetic — nothing retries)."""
+        nonlocal created_total
+        if not pods:
+            return 0
+        try:
+            landed = len(store.create_many(PODS, pods))
+        except BackpressureError as e:
+            landed = int(getattr(e, "accepted", 0))
+            churn[shed_key] += len(pods) - landed
+        created_total += landed
+        return landed
+
     roll_seq = [0]
 
     def roll_tick() -> None:
-        """Rolling update: the oldest K bound pods 'roll' — deleted,
-        replaced by fresh creates carrying the next revision label."""
+        """Rolling update: the oldest K bound pods 'roll' — one batched
+        delete, one batched create carrying the next revision label."""
         k = min(roll_batch, len(bound_fifo))
         if k <= 0:
             return
         batch = [bound_fifo.popleft() for _ in range(k)]
         n = len(store.delete_many(PODS, batch))
         rev = f"r{roll_seq[0] // max(1, roll_batch)}"
+        fresh = []
         for _ in range(n):
             name = f"roll-{roll_seq[0]}"
             roll_seq[0] += 1
             pod = mkpod(name)
             pod.name = name
             pod.labels = {"app": "soak", "revision": rev}
-            if gated_create(pod, "roll_shed"):
-                churn["rolled"] += 1
+            fresh.append(pod)
+        churn["rolled"] += gated_create_many(fresh, "roll_shed")
+
+    restamp_seq = [0]
+
+    def restamp_tick() -> None:
+        """In-place revision restamp on bound pods: ONE update_many per
+        tick with per-item rv-CAS — a pod the scheduler (or reaper)
+        touched between the read and the write is a conflict/missing
+        outcome, counted and dropped, never retried and never clobbered
+        (CAS keeps the bind that raced us)."""
+        k = min(roll_batch, len(bound_fifo))
+        if k <= 0:
+            return
+        rev = f"g{restamp_seq[0]}"
+        restamp_seq[0] += 1
+        updates = []
+        for key in list(bound_fifo)[-k:]:      # newest bound: least
+            try:                               # likely mid-reap
+                cur = store.get(PODS, key)
+            except NotFoundError:
+                continue
+            cur.labels = dict(cur.labels)
+            cur.labels["restamp"] = rev
+            updates.append((cur, cur.resource_version))
+        if not updates:
+            return
+        confl: list = []
+        miss: list = []
+        out = store.update_many(PODS, updates, conflicts=confl,
+                                missing=miss)
+        churn["restamped"] += len(out)
+        churn["restamp_conflicts"] += len(confl) + len(miss)
 
     gang_seq = [0]
 
@@ -394,13 +447,14 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
         target = int(hpa_base + hpa_amp * math.sin(phase))
         current = len(hpa_bound)
         if current < target:
+            fresh = []
             for _ in range(min(target - current, 32)):
                 name = f"hpa-{hpa_seq[0]}"
                 hpa_seq[0] += 1
                 pod = mkpod(name)
                 pod.name = name
-                if gated_create(pod, "hpa_shed"):
-                    churn["hpa_up"] += 1
+                fresh.append(pod)
+            churn["hpa_up"] += gated_create_many(fresh, "hpa_shed")
         elif current > target:
             batch = [hpa_bound.pop()
                      for _ in range(min(current - target, 32))]
@@ -425,6 +479,26 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
             return n
         store.guaranteed_update(NODES, name, mutate)
 
+    def flip_ready_many(names: list, status: str) -> None:
+        """All of a drain wave's Ready flips in ONE update_many with
+        per-node rv-CAS; a CAS loser (the lifecycle controller tainting
+        the same node concurrently) falls back to guaranteed_update —
+        last-writer-wins would silently clobber its taints."""
+        from kubernetes_tpu.api.types import NodeCondition
+        updates = []
+        for name in names:
+            try:
+                node = store.get(NODES, name)
+            except NotFoundError:
+                continue
+            node.conditions = (NodeCondition(type="Ready",
+                                             status=status),)
+            updates.append((node, node.resource_version))
+        confl: list = []
+        store.update_many(NODES, updates, conflicts=confl)
+        for key in confl:
+            flip_ready(key, status)
+
     def drain_tick(now: float) -> None:
         rel = now - t_start[0]
         if not drained and rel >= drain_window[0] and drain_nodes > 0:
@@ -433,13 +507,12 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
             for i in range(0, 3 * drain_nodes, 3):
                 if i >= n_nodes:
                     break
-                flip_ready(f"node-{i}", "False")
                 drained.append(f"node-{i}")
+            flip_ready_many(drained, "False")
             churn["drained_nodes"] = len(drained)
         elif drained and churn["drain_restored"] == 0 \
                 and rel >= drain_window[1]:
-            for name in drained:
-                flip_ready(name, "True")
+            flip_ready_many(drained, "True")
             churn["drain_restored"] = len(drained)
 
     # pre-touch the fence-conflict children (inc(0) creates the child
@@ -464,6 +537,14 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
                limits={"fleet.lease-loss": 2})
 
     # -- the timed soak ------------------------------------------------------
+    # round-23 churn-plane instrument: the batch-verb counters are
+    # process-cumulative — the cell reports (and asserts on) its DELTA
+    from kubernetes_tpu.store.store import (
+        BATCH_MUTATION_CALLS, BATCH_MUTATIONS)
+    _batch_verbs = ("update_many", "delete_many", "evict_many")
+    batch_base = {v: (int(BATCH_MUTATION_CALLS.labels(v).value),
+                      int(BATCH_MUTATIONS.labels(v).value))
+                  for v in _batch_verbs}
     auditor = BindAuditor(store)
     gate = fleet_gate([inst.loop for inst in fleet],
                       max_depth=max(4 * window, int(2 * arrival_rate)))
@@ -496,6 +577,7 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
     next_roll = t0 + roll_every
     next_gang = t0 + gang_every
     next_hpa = t0 + 1.0
+    next_restamp = t0 + 1.0
     next_pump = t0 + 0.25
     next_probe = t0 + 0.5
     t_end = t0 + duration
@@ -513,6 +595,9 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
         if now >= next_hpa and hpa_amp > 0:
             hpa_tick(now)
             next_hpa = now + 1.0
+        if now >= next_restamp:
+            restamp_tick()
+            next_restamp = now + 1.0
         if now >= next_pump:
             drain_tick(now)
             lifecycle.pump()
@@ -544,8 +629,8 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
 
     # -- settle: arrivals + churn stop; everything admitted must bind -------
     chaos.disable()
-    for name in drained:                # no node may stay cordoned
-        flip_ready(name, "True")
+    if drained:                         # no node may stay cordoned
+        flip_ready_many(drained, "True")
     settle_deadline = time.perf_counter() + 90.0
     idle_polls = 0
     while time.perf_counter() < settle_deadline:
@@ -638,6 +723,37 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
     report = evaluate_verdicts(SCRAPER)
     doc = SCRAPER.series()
     sampled = sorted(doc["families"])
+
+    # round 23: churn mutations must land as BATCHED verbs — the counter
+    # delta is the proof (O(batches) store-lock acquisitions, not
+    # O(pods)); the eviction lane is drain-gated, the others always run
+    batch_lane = {}
+    for verb in _batch_verbs:
+        calls = int(BATCH_MUTATION_CALLS.labels(verb).value) \
+            - batch_base[verb][0]
+        objs = int(BATCH_MUTATIONS.labels(verb).value) \
+            - batch_base[verb][1]
+        batch_lane[verb] = {"calls": calls, "objects": objs}
+    if duration >= 10.0:
+        assert batch_lane["update_many"]["calls"] > 0, \
+            "churn restamps/flips never rode update_many"
+        assert batch_lane["delete_many"]["calls"] > 0, \
+            "rolls/reaps never rode delete_many"
+
+    # packing lane: the cpu child of cluster_resource_utilization (the
+    # scheduler-snapshot fill gauge, round 22) — children must NOT be
+    # summed (SeriesView.col would blend cpu+memory+slots)
+    packing = {"samples": 0, "mean": None, "max": None}
+    _fam = doc["families"].get("cluster_resource_utilization")
+    if _fam is not None:
+        _vals = [float(v)
+                 for v in _fam["series"].get('resource="cpu"', {})
+                 .get("value", ()) or ()
+                 if v is not None and not math.isnan(float(v))]
+        if _vals:
+            packing = {"samples": len(_vals),
+                       "mean": round(sum(_vals) / len(_vals), 4),
+                       "max": round(max(_vals), 4)}
     required = {
         "windowed_startup_p99": "pod_startup_seconds_p99_windowed",
         "rate_series": "serve_pods_scheduled_total",
@@ -665,6 +781,8 @@ def run_soak_cell(n_nodes: int = 2000, duration: float = 60.0,
         "pods_deleted": deleted_total,
         "workload_reaped": reaped,
         "churn": churn,
+        "batch_mutations": batch_lane,
+        "packing_utilization": packing,
         "arrivals": g,
         "chaos_injections": {
             s: chaos.INJECTIONS.labels(s).value for s in chaos.SEAMS},

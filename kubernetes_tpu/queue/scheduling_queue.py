@@ -385,6 +385,21 @@ class PriorityQueue:
             self._backoff.clear(pod.key)
             self.nominated.delete(pod)
 
+    def update_many(self, pairs: list) -> None:
+        """Batched update (round 23): one queue-lock acquisition for a
+        whole informer run of (old, new) pairs — per-pair semantics are
+        exactly update()'s (the inner acquires are reentrant no-ops)."""
+        with self._cond:
+            for old, new in pairs:
+                self.update(old, new)
+
+    def delete_many(self, pods: list) -> None:
+        """Batched delete (round 23): one queue-lock acquisition for a
+        whole informer run."""
+        with self._cond:
+            for pod in pods:
+                self.delete(pod)
+
     # -- event-driven moves --------------------------------------------------
     def move_all_to_active(self) -> None:
         """Cluster changed → retry everything (reference: :519)."""

@@ -492,16 +492,20 @@ class Scheduler:
             on_add=self._add_pod_to_cache,
             on_update=self._update_pod_in_cache,
             on_delete=self._delete_pod_from_cache,
+            on_delete_many=self._delete_pods_from_cache,
             filter_fn=lambda p: bool(p.node_name))
-        # unassigned pods owned by this scheduler -> queue (adds arrive in
-        # informer batches: one queue lock + one native heap push per
-        # batch, and the pod-row cache encodes each row here — at
-        # delivery — so window planning gathers instead of re-encoding)
+        # unassigned pods owned by this scheduler -> queue (adds, updates,
+        # and deletes all arrive in informer run batches: one queue lock +
+        # one native heap push / row-cache pass per batch, and the pod-row
+        # cache encodes each row here — at delivery — so window planning
+        # gathers instead of re-encoding)
         pods.add_event_handler(
             on_add=self._add_pod_to_queue,
             on_add_many=self._add_pods_to_queue,
             on_update=self._update_pod_in_queue,
+            on_update_many=self._update_pods_in_queue,
             on_delete=self._delete_pod_from_queue,
+            on_delete_many=self._delete_pods_from_queue,
             filter_fn=lambda p: not p.node_name and self._responsible_for(p))
         nodes = self.informers.informer(NODES)
         nodes.add_event_handler(
@@ -555,6 +559,14 @@ class Scheduler:
         self.cache.remove_pod(pod)
         self.queue.move_all_to_active()
 
+    def _delete_pods_from_cache(self, pods: list) -> None:
+        """Batched delete run (round 23): per-pod cache removal, then ONE
+        move_all_to_active for the whole run — the per-event loop would
+        re-walk the unschedulable map once per delete."""
+        for pod in pods:
+            self.cache.remove_pod(pod)
+        self.queue.move_all_to_active()
+
     def _add_pod_to_queue(self, pod: Pod) -> None:
         if self.pod_rows is not None:
             self.pod_rows.insert(pod)
@@ -574,6 +586,13 @@ class Scheduler:
             self.pod_rows.insert(new)
         self.queue.update(old, new)
 
+    def _update_pods_in_queue(self, pairs: list) -> None:
+        """Batched informer update run (round 23): re-encode every row
+        once, then ONE queue lock for the whole run."""
+        if self.pod_rows is not None:
+            self.pod_rows.insert_many([new for _old, new in pairs])
+        self.queue.update_many(pairs)
+
     def _delete_pod_from_queue(self, pod: Pod) -> None:
         if self.pod_rows is not None:
             # covers real deletes AND the unassigned->assigned transition
@@ -581,6 +600,13 @@ class Scheduler:
             # object): a bound or gone pod's row is never gathered again
             self.pod_rows.invalidate(pod)
         self.queue.delete(pod)
+
+    def _delete_pods_from_queue(self, pods: list) -> None:
+        """Batched informer delete run (round 23): one row-cache
+        invalidation pass + ONE queue lock for the whole run."""
+        if self.pod_rows is not None:
+            self.pod_rows.invalidate_many(pods)
+        self.queue.delete_many(pods)
 
     def _add_node(self, node: Node) -> None:
         self.cache.add_node(node)

@@ -291,6 +291,44 @@ class PyCommitCore:
             out.append(stored)
         return out
 
+    def update_batch(self, bucket: dict, kind: str, objs: list) -> list:
+        """The store's batched update body (round 23; update() semantics
+        per object): snapshot the caller's replacement object, assign the
+        next rv, replace the bucket entry, log MODIFIED — one commit
+        stamp for the whole batch. NotFound / rv-CAS refusals are the
+        STORE's per-item pre-scan (under the same lock), so every object
+        reaching the core lands. Returns the stored snapshots."""
+        log = self._kind_log(kind)
+        ts = _time.perf_counter()   # one commit stamp for the whole batch
+        out = []
+        for obj in objs:
+            stored = _clone(obj)
+            self._rv += 1
+            stored.resource_version = self._rv
+            bucket[obj.key] = stored
+            self._append(log, MODIFIED, stored, self._rv, ts)
+            out.append(stored)
+        return out
+
+    def delete_batch(self, bucket: dict, kind: str, keys: list) -> list:
+        """The store's batched delete body (round 23; delete() semantics
+        per key): pop the bucket entry and log DELETED with a snapshot at
+        the next rv — one commit stamp for the whole batch. The DELETED
+        payload keeps the object's last stored rv (only the log entry
+        carries the delete's own rv, exactly like the serial verb).
+        Missing keys are skipped; returns the popped originals."""
+        log = self._kind_log(kind)
+        ts = _time.perf_counter()   # one commit stamp for the whole batch
+        gone = []
+        for key in keys:
+            obj = bucket.pop(key, None)
+            if obj is None:
+                continue
+            self._rv += 1
+            self._append(log, DELETED, _clone(obj), self._rv, ts)
+            gone.append(obj)
+        return gone
+
     def commit_wave(self, pod_bucket: dict, pod_kind: str,
                     bindings: list[tuple[str, str]],
                     ev_bucket: dict, ev_kind: str, recs: list) -> list[str]:

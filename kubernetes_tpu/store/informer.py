@@ -53,18 +53,30 @@ class ResourceEventHandler:
     pump that delivered a RUN of consecutive adds hands the whole run to
     this callback in one call (per-object filter still applied) instead of
     one `on_add` per object — per-handler delivery ORDER is unchanged, so
-    a handler never observes anything a per-event loop wouldn't."""
+    a handler never observes anything a per-event loop wouldn't.
+
+    `on_update_many` / `on_delete_many` extend the same contract to the
+    mutation plane (round 23): runs of consecutive MODIFIED land as one
+    [(old, new), ...] call, runs of consecutive DELETED as one [obj, ...]
+    call. A MODIFIED run batches ONLY when every pair is a plain update
+    under the filter (both sides pass) — mixed filter categories
+    (update-as-add / update-as-delete) fall back to the per-event loop so
+    their interleaved order is bit-identical to the unbatched path."""
 
     def __init__(self,
                  on_add: Optional[Handler] = None,
                  on_update: Optional[UpdateHandler] = None,
                  on_delete: Optional[Handler] = None,
                  filter_fn: Optional[Callable[[Any], bool]] = None,
-                 on_add_many: Optional[BatchHandler] = None):
+                 on_add_many: Optional[BatchHandler] = None,
+                 on_update_many: Optional[BatchHandler] = None,
+                 on_delete_many: Optional[BatchHandler] = None):
         self.on_add = on_add
         self.on_add_many = on_add_many
         self.on_update = on_update
+        self.on_update_many = on_update_many
         self.on_delete = on_delete
+        self.on_delete_many = on_delete_many
         self.filter_fn = filter_fn
 
     def _passes(self, obj: Any) -> bool:
@@ -87,6 +99,38 @@ class ResourceEventHandler:
                 self.on_add(o)
         else:
             self.on_add_many(passing)
+
+    def handle_updated_run(self, pairs: list) -> None:
+        """A run of consecutive MODIFIED (old, new) pairs, in delivery
+        order: one `on_update_many` call when registered and EVERY pair
+        is a plain update under the filter — anything else (an
+        update-as-add or update-as-delete in the run) replays the exact
+        per-event loop, preserving the interleaved category order."""
+        if self.on_update_many is not None and len(pairs) > 1 and all(
+                old is not None and self._passes(old) and self._passes(new)
+                for old, new in pairs):
+            self.on_update_many(pairs)
+            return
+        for old, new in pairs:
+            self.handle(MODIFIED, old, new)
+
+    def handle_deleted_run(self, objs: list) -> None:
+        """A run of consecutive DELETED objects, in delivery order: one
+        `on_delete_many` call for the filtered batch when registered,
+        else the per-object `on_delete` loop."""
+        if self.on_delete is None and self.on_delete_many is None:
+            return
+        passing = objs if self.filter_fn is None \
+            else [o for o in objs if self.filter_fn(o)]
+        if not passing:
+            return
+        if self.on_delete_many is not None and len(passing) > 1:
+            self.on_delete_many(passing)
+        elif self.on_delete is not None:
+            for o in passing:
+                self.on_delete(o)
+        else:
+            self.on_delete_many(passing)
 
     def handle(self, ev_type: str, old: Any, new: Any) -> None:
         if ev_type == ADDED:
@@ -144,10 +188,14 @@ class SharedInformer:
                           on_update: Optional[UpdateHandler] = None,
                           on_delete: Optional[Handler] = None,
                           filter_fn: Optional[Callable[[Any], bool]] = None,
-                          on_add_many: Optional[BatchHandler] = None) -> None:
+                          on_add_many: Optional[BatchHandler] = None,
+                          on_update_many: Optional[BatchHandler] = None,
+                          on_delete_many: Optional[BatchHandler] = None,
+                          ) -> None:
         self._handlers.append(ResourceEventHandler(
             on_add, on_update, on_delete, filter_fn,
-            on_add_many=on_add_many))
+            on_add_many=on_add_many, on_update_many=on_update_many,
+            on_delete_many=on_delete_many))
 
     # -- lister (reference: informer.Lister()) ------------------------------
     def list(self) -> list[Any]:
@@ -314,22 +362,28 @@ class SharedInformer:
         i = 0
         n = len(prepared)
         while i < n:
+            # run of consecutive same-type events: one batched dispatch
+            # per handler (per-handler order identical to the per-event
+            # loop; singletons take the plain _dispatch path)
             etype, old, new = prepared[i]
-            if etype != ADDED:
-                self._dispatch(etype, old, new)
-                i += 1
-                continue
-            # run of consecutive fresh adds: one batched dispatch per
-            # handler (per-handler order identical to the per-event loop)
             j = i + 1
-            while j < n and prepared[j][0] == ADDED:
+            while j < n and prepared[j][0] == etype:
                 j += 1
-            run = [prepared[k][2] for k in range(i, j)]
             if j - i == 1:
-                self._dispatch(ADDED, None, new)
-            else:
+                self._dispatch(etype, old, new)
+            elif etype == ADDED:
+                run = [prepared[k][2] for k in range(i, j)]
                 for h in self._handlers:
                     h.handle_added_run(run)
+            elif etype == MODIFIED:
+                pairs = [(prepared[k][1], prepared[k][2])
+                         for k in range(i, j)]
+                for h in self._handlers:
+                    h.handle_updated_run(pairs)
+            else:   # DELETED
+                run = [prepared[k][2] for k in range(i, j)]
+                for h in self._handlers:
+                    h.handle_deleted_run(run)
             i = j
 
     def _dispatch(self, ev_type: str, old: Any, new: Any) -> None:

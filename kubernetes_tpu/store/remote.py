@@ -430,6 +430,40 @@ class RemoteStore:
         return serde.from_dict(kind, self._request(
             "PUT", f"/api/v1/{kind}/{obj.key}", d, verb_class=verb))
 
+    def update_many(self, kind: str, updates: list, fence=None,
+                    token: Optional[str] = None,
+                    conflicts: Optional[list] = None,
+                    missing: Optional[list] = None) -> list:
+        """Batched update: ONE collection PUT ({"items": [...]}) — the
+        churn plane's mutation twin of create_many. `updates` takes
+        objects or (obj, expect_rv) pairs; each item's rv-CAS rides its
+        serialized resource_version (0 = unconditional, matching the
+        serial update()'s wire contract). Per-item refusals come back in
+        the body and land in the caller's `conflicts`/`missing` lists —
+        never an exception; 409 reason=Fenced (whole-batch) maps to
+        FencedError. NOT idempotent under partial landing: no transport
+        auto-retry (write verb class), same stance as create_many.
+        Returns the stored snapshots echoed by the server, exactly like
+        the embedded verb."""
+        del token   # the server-side verb dedupes embedded callers only
+        items = []
+        for u in updates:
+            obj, expect_rv = u if isinstance(u, tuple) else (u, None)
+            d = serde.to_dict(obj)
+            d["resource_version"] = expect_rv if expect_rv is not None \
+                else 0
+            items.append(d)
+        body: dict = {"items": items}
+        if fence:
+            body["fence"] = [[s, t] for s, t in fence]
+        out = self._request("PUT", f"/api/v1/{kind}", body,
+                            verb_class="write")
+        if conflicts is not None:
+            conflicts.extend(out.get("conflicts") or [])
+        if missing is not None:
+            missing.extend(out.get("missing") or [])
+        return [serde.from_dict(kind, d) for d in out.get("items") or []]
+
     def delete(self, kind: str, key: str) -> Any:
         return serde.from_dict(kind, self._request(
             "DELETE", f"/api/v1/{kind}/{key}", verb_class="write"))
@@ -453,6 +487,23 @@ class RemoteStore:
         return serde.from_dict(PODS, self._request(
             "POST", f"/api/v1/{PODS}/{pod_key}/eviction", {},
             verb_class="write"))
+
+    def evict_many(self, pod_keys: list, reason: str = "api", fence=None,
+                   token: Optional[str] = None,
+                   stop_on_refusal: bool = False) -> dict:
+        """POST pods/evictions — the batched PDB-guarded delete. Answers
+        the embedded verb's per-item outcome dict ({key: "evicted" |
+        "refused" | "missing" | "skipped" | "invalid"}); a refusal is an
+        OUTCOME, never a 429, so callers refund tokens item-by-item. NOT
+        idempotent (evicted items charged budgets): no auto-retry,
+        matching evict_pod."""
+        del fence, token   # embedded-verb seams; the wire batch is one POST
+        out = self._request(
+            "POST", f"/api/v1/{PODS}/evictions",
+            {"keys": list(pod_keys), "reason": reason,
+             "stop_on_refusal": bool(stop_on_refusal)},
+            verb_class="write")
+        return dict(out.get("outcomes") or {})
 
     def bind_pod(self, pod_key: str, node_name: str, fence=None) -> Any:
         """POST pods/{ns}/{name}/binding (factory.go:710), idempotent

@@ -161,6 +161,17 @@ BIND_CAS_CONFLICTS = obs.counter(
     "existing binding is never overwritten — this counter plus the "
     "fleet's zero-double-bind tripwire are the two sides of the same "
     "invariant.")
+# churn-plane batching proof (round 23): objects per call >> 1 means a
+# churn tick's mutations take O(batches) store-lock acquisitions, not
+# O(pods) — the soak asserts it on these two families.
+BATCH_MUTATIONS = obs.counter(
+    "store_batch_mutations_total",
+    "Objects landed through the batched mutation verbs (update_many / "
+    "evict_many / delete_many), by verb.", ("verb",))
+BATCH_MUTATION_CALLS = obs.counter(
+    "store_batch_mutation_calls_total",
+    "Batched mutation verb invocations — one store-lock acquisition and "
+    "one commit-core call each — by verb.", ("verb",))
 
 
 class ConflictError(Exception):
@@ -416,6 +427,9 @@ class Store:
         # commit_wave after an ambiguous failure replays the RESULT, not
         # the write.
         self._wave_tokens: "OrderedDict[str, list]" = OrderedDict()
+        # batched-mutation dedupe (round 23): update_many / evict_many
+        # replays answer the recorded RESULT, exactly the wave contract
+        self._mutation_tokens: "OrderedDict[str, Any]" = OrderedDict()
         # chaos store.fanout seam: a deferred wave delivery is flushed by
         # the next fan-out call or the next consumer poll (never lost)
         self._fanout_deferred = False
@@ -872,6 +886,130 @@ class Store:
             except ConflictError:
                 continue
 
+    # -- batched mutation bodies (round 23; caller holds the lock) -----------
+    def _update_batch_locked(self, bucket: dict, kind: str,
+                             objs: list) -> list:
+        """One core call lands a whole batch of replacement objects (the
+        per-object body identical to update()); a stale prebuilt .so
+        without the verb degrades to per-entry appends."""
+        ub = getattr(self._core, "update_batch", None)
+        if ub is not None:
+            stored = ub(bucket, kind, objs)
+        else:
+            core = self._core
+            stored = []
+            for obj in objs:
+                snap = _clone(obj)
+                rv = core.next_rv()
+                snap.resource_version = rv
+                bucket[_key_of(obj)] = snap
+                core.append(MODIFIED, kind, snap, rv)
+                stored.append(snap)
+        if self._integrity is not None:
+            for o in stored:
+                self._record_entry(kind, _key_of(o), o)
+        return stored
+
+    def _delete_batch_locked(self, bucket: dict, kind: str,
+                             keys: list) -> list:
+        """One core call pops a whole batch of keys (delete() semantics
+        per key; missing keys skip); stale-.so fallback appends per entry."""
+        db = getattr(self._core, "delete_batch", None)
+        if db is not None:
+            return db(bucket, kind, keys)
+        core = self._core
+        gone = []
+        for key in keys:
+            obj = bucket.pop(key, None)
+            if obj is None:
+                continue
+            core.append(DELETED, kind, _clone(obj), core.next_rv())
+            gone.append(obj)
+        return gone
+
+    def _mutation_token_hit(self, token: Optional[str]):
+        if token is None:
+            return None
+        hit = self._mutation_tokens.get(token)
+        if hit is not None:
+            WAVE_DEDUP.inc()
+        return hit
+
+    def _mutation_token_record(self, token: Optional[str], result) -> None:
+        if token is None:
+            return
+        self._mutation_tokens[token] = result
+        while len(self._mutation_tokens) > WAVE_TOKEN_CAP:
+            self._mutation_tokens.popitem(last=False)
+
+    def update_many(self, kind: str, updates: list, fence=None,
+                    token: Optional[str] = None,
+                    conflicts: Optional[list] = None,
+                    missing: Optional[list] = None) -> list:
+        """Batched update under ONE lock and ONE commit-core call (the
+        churn plane's mutation verb, round 23 — the round-17 ingest
+        batching mirrored onto the write path). `updates` is a list of
+        replacement objects or (obj, expect_rv) pairs; a bare object
+        updates unconditionally (expect_rv None), exactly like update().
+
+        Per-item semantics are update()'s, reported per item instead of
+        raised: a vanished key lands in `missing`, an rv-CAS loser in
+        `conflicts` (both optional out-lists; refused items are skipped,
+        never partially applied). Returns the stored snapshots of the
+        items that landed, in batch order.
+
+        `fence` carries the writer's partition-lease token(s) and is
+        validated BEFORE any write — a superseded token rejects the whole
+        batch atomically (FencedError), the commit_wave contract. `token`
+        is the caller's idempotency key: a batch that already landed under
+        it returns its recorded result without touching the core."""
+        pairs = [(u[0], u[1]) if isinstance(u, tuple) else (u, None)
+                 for u in updates]
+        with self._lock:
+            hit = self._mutation_token_hit(token)
+            if hit is not None:
+                stored, confl, miss = hit
+                if conflicts is not None:
+                    conflicts.extend(confl)
+                if missing is not None:
+                    missing.extend(miss)
+                return list(stored)
+            # fence validation FIRST — before the chaos seam and every
+            # core write (the commit_wave ordering contract)
+            if fence is not None:
+                self._check_fences_locked(fence, "update_many")
+            chaos.check("store.update_many")
+            self._core_guard()
+            bucket = self._objs.setdefault(kind, {})
+            confl: list = []
+            miss: list = []
+            live: list = []
+            for obj, expect_rv in pairs:
+                key = _key_of(obj)
+                current = bucket.get(key)
+                if current is None:
+                    miss.append(key)
+                    continue
+                if expect_rv is not None \
+                        and current.resource_version != expect_rv:
+                    confl.append(key)
+                    continue
+                self._check_entry(kind, key, current)
+                live.append(obj)
+            stored = self._update_batch_locked(bucket, kind, live) \
+                if live else []
+            self._flush()
+            self._mutation_token_record(
+                token, (list(stored), list(confl), list(miss)))
+        BATCH_MUTATION_CALLS.labels("update_many").inc()
+        if stored:
+            BATCH_MUTATIONS.labels("update_many").inc(len(stored))
+        if conflicts is not None:
+            conflicts.extend(confl)
+        if missing is not None:
+            missing.extend(miss)
+        return stored
+
     def delete(self, kind: str, key: str) -> Any:
         with self._lock:
             bucket = self._objs.get(kind, {})
@@ -901,21 +1039,26 @@ class Store:
         on the serving loop's critical path). Missing keys are skipped;
         returns the deleted objects. Per-key semantics otherwise identical
         to delete()."""
-        gone = []
         with self._lock:
             bucket = self._objs.get(kind, {})
             self._core_guard()
-            core = self._core
+            present = []
             for key in keys:
-                obj = bucket.pop(key, None)
+                obj = bucket.get(key)
                 if obj is None:
                     continue
                 self._check_entry(kind, key, obj)
                 if self._integrity is not None:
                     self._integrity.pop((kind, key), None)
-                core.append(DELETED, kind, _clone(obj), core.next_rv())
-                gone.append(obj)
+                present.append(key)
+            # ONE core call pops + logs the whole batch (round 23; one
+            # log-ring splice instead of one per key on the native core)
+            gone = self._delete_batch_locked(bucket, kind, present) \
+                if present else []
             self._flush()
+        BATCH_MUTATION_CALLS.labels("delete_many").inc()
+        if gone:
+            BATCH_MUTATIONS.labels("delete_many").inc(len(gone))
         if kind == PODS and gone:
             from kubernetes_tpu.obs.ledger import LEDGER
             for obj in gone:
@@ -1290,6 +1433,85 @@ class Store:
             gone = self.delete(PODS, pod_key)
         EVICTIONS.labels(reason).inc()
         return gone
+
+    def evict_many(self, pod_keys: list, reason: str = "api", fence=None,
+                   token: Optional[str] = None,
+                   stop_on_refusal: bool = False) -> dict:
+        """Batched PDB-charging eviction (round 23): the whole batch runs
+        in ONE critical section with per-item outcomes — returns
+        {pod_key: "evicted" | "refused" | "missing" | "skipped"}. Budget
+        charges are visible WITHIN the batch (a budget of 1 facing two
+        pods answers one evicted + one refused, exactly like two serial
+        racers), and the writes land as one batched MODIFIED per touched
+        budget (carrying the cumulative charge) plus one batched DELETED
+        pass for the evicted pods — two commit-core calls per batch
+        instead of O(pods) serial verbs. A refused item charges nothing
+        and deletes nothing.
+
+        `stop_on_refusal` preserves the zone evictor's head-of-line
+        pacing: the first refusal ends processing and every later item
+        reports "skipped" (not attempted — its token is refundable).
+        `fence` validates before any write (whole-batch FencedError);
+        `token` dedupes a retried batch onto its recorded outcomes."""
+        with self._lock:
+            hit = self._mutation_token_hit(token)
+            if hit is not None:
+                return dict(hit)
+            if fence is not None:
+                self._check_fences_locked(fence, "evict_many")
+            chaos.check("store.evict_many")
+            self._core_guard()
+            pods = self._objs.get(PODS, {})
+            pdb_bucket = self._objs.setdefault(PDBS, {})
+            outcomes: dict = {}
+            charged: dict = {}   # pdb key -> working clone (batch-visible)
+            to_delete: list = []
+            stopped = False
+            for pod_key in pod_keys:
+                if stopped:
+                    outcomes[pod_key] = "skipped"
+                    continue
+                pod = pods.get(pod_key)
+                if pod is None:
+                    outcomes[pod_key] = "missing"
+                    continue
+                self._check_entry(PODS, pod_key, pod)
+                blockers = [
+                    charged.get(b.key, b)
+                    for b in pdb_bucket.values()
+                    if b.namespace == pod.namespace
+                    and b.selector is not None
+                    and b.selector.matches(pod.labels)]
+                if any(b.disruptions_allowed <= 0 for b in blockers):
+                    outcomes[pod_key] = "refused"
+                    if stop_on_refusal:
+                        stopped = True
+                    continue
+                for b in blockers:
+                    c = charged.get(b.key)
+                    if c is None:
+                        c = charged[b.key] = _clone(b)
+                    c.disruptions_allowed -= 1
+                outcomes[pod_key] = "evicted"
+                to_delete.append(pod_key)
+            if charged:
+                self._update_batch_locked(pdb_bucket, PDBS,
+                                          list(charged.values()))
+            if to_delete:
+                if self._integrity is not None:
+                    for pod_key in to_delete:
+                        self._integrity.pop((PODS, pod_key), None)
+                self._delete_batch_locked(pods, PODS, to_delete)
+            self._flush()
+            self._mutation_token_record(token, dict(outcomes))
+        BATCH_MUTATION_CALLS.labels("evict_many").inc()
+        if to_delete:
+            BATCH_MUTATIONS.labels("evict_many").inc(len(to_delete))
+            EVICTIONS.labels(reason).inc(len(to_delete))
+            from kubernetes_tpu.obs.ledger import LEDGER
+            for pod_key in to_delete:
+                LEDGER.finalize_delete(pod_key)
+        return outcomes
 
     def set_nominated_node_name(self, pod_key: str, node_name: str) -> Any:
         return self.guaranteed_update(PODS, pod_key,

@@ -45,6 +45,46 @@ def _with_flight(fn, s, w):
         fn(s, w, rec, chaos=True)
 
 
+# round 23: the churn-verb seams are OPT-IN (blanket `all=` never arms
+# them) — this trial arms them explicitly against the commit-core random
+# program, which is the only harness that compares BOTH cores under the
+# same injection schedule
+CHURN_RATES = {
+    "store.update_many": 0.15,
+    "store.evict_many": 0.15,
+    "store.commit_wave": 0.1,
+}
+
+
+def _churn_random_program(seed: int) -> None:
+    """The round-23 churn differential UNDER INJECTION: the commit-core
+    random program (update_many / evict_many / PDB-charged refusals /
+    fenced + token-deduped variants) runs on the native core and the twin
+    with the SAME plan re-installed before each run. Per-seam streams are
+    keyed (plan seed, seam, call count) and both runs make the identical
+    seam-call sequence, so the two cores see the identical injection
+    schedule — every InjectedFault is itself a compared observable, and
+    a faulted batch must land NOTHING (the pre-land seam placement is
+    what this pins)."""
+    from kubernetes_tpu import chaos as chaos_mod
+    from tests.test_commit_core import (_Recorderless, _random_program,
+                                        have_native)
+    prog = _random_program(seed)
+    impls = ("native", "twin") if have_native() else ("twin",)
+    runs = []
+    for impl in impls:
+        chaos_mod.plan(seed=seed, rates=dict(CHURN_RATES))
+        h = _Recorderless(impl, seed)
+        for op in prog:
+            h.op(*op)
+        runs.append((h.log, h.snapshot_pods(),
+                     h.store.resource_version(), h.store.fence_table()))
+        chaos_mod.disable()
+    if len(runs) == 2:
+        assert runs[0] == runs[1], \
+            "churn differential diverged under injection"
+
+
 def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
     from kubernetes_tpu import chaos as chaos_mod
     from tests.test_tpu_parity import (TestMixedWorkloadShellFuzz,
@@ -63,6 +103,8 @@ def run_sweep(trials: int = 42, base_seed: int = 0) -> None:
              s, w, chaos=True)),
         ("gang", TestGangBurstParity(),
          lambda t, s, w: t.test_gang_parity(s, w, chaos=True)),
+        ("churn", None,
+         lambda t, s, w: _churn_random_program(s)),
     ]
     def injected() -> dict[str, int]:
         # the plan object dies when the oracle world disables the plane;

@@ -473,6 +473,65 @@ def test_fleet_mode_floor():
     assert len(shares) == 2 and all(s > 0 for s in shares), out
 
 
+# the pre-batched-churn-plane soak smoke number, recorded on the
+# reference CPU box immediately before the round-23 PR landed (the
+# 2000n / 2 inst / 600 rps / 60 s / 5k-watcher cell; arrival-bound, so
+# the headline sits just above the drained arrival rate rather than at
+# machine capacity). The floor is 0.9x: batching the churn verbs must
+# never COST sustained throughput — the win shows up in verb-count and
+# lock-hold arithmetic (PROFILE.md round 23), not this arrival-bound
+# headline.
+ROUND22_SOAK_SMOKE_PODS_PER_S = 157.2
+
+
+@pytest.mark.slow
+def test_soak_mode_floor():
+    """`bench.py --mode soak` at the smoke cell (round 23): the churn
+    plane rides BATCHED verbs end to end — the cell must finish with
+    zero double-binds, zero parity violations, every detector evaluated
+    pass-or-named, sustained pods/s >= 0.9x the recorded pre-PR smoke
+    number, the batch-mutation counters proving the churn actors and
+    the zone evictor really flushed one verb per batch, and the
+    packing_utilization lane (cluster_resource_utilization's cpu child)
+    sampled."""
+    from kubernetes_tpu.obs.timeseries import DETECTORS
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--mode", "soak",
+         "--nodes", "2000", "--instances", "2",
+         "--arrival-rate", "600", "--duration", "60",
+         "--watchers", "5000", "--watch-classes", "64"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["unit"] == "pods/s"
+    # audits gate the number
+    assert out["double_binds"] == 0, out
+    assert out["parity_violations"] == 0, out
+    assert out["partition_disjoint"] is True
+    assert out["audit_no_double_bind"] is True
+    assert out["audit_all_admitted_or_accounted"] is True
+    # every detector answered — by name, pass or fail, never skipped
+    assert out["verdicts_evaluated"] == len(DETECTORS)
+    names = {v.split(":", 1)[0] for v in out["verdicts"]}
+    assert names == set(DETECTORS)
+    # throughput floor vs the recorded pre-PR smoke number
+    assert out["value"] >= 0.9 * ROUND22_SOAK_SMOKE_PODS_PER_S, out
+    # the churn plane really rode the batched verbs: restamps + drain
+    # flips on update_many, rolls + the reaper on delete_many, and the
+    # drained zone's pods through the batched PDB-charging eviction
+    bm = out["batch_mutations"]
+    assert bm["update_many"]["calls"] > 0, bm
+    assert bm["delete_many"]["calls"] > 0, bm
+    assert bm["evict_many"]["calls"] > 0, bm
+    assert bm["update_many"]["objects"] >= bm["update_many"]["calls"], bm
+    # the packing lane was sampled from the live fill gauge
+    packing = out["packing_utilization"]
+    assert packing["samples"] > 0, packing
+    assert packing["max"] is not None and packing["max"] > 0.0, packing
+
+
 @pytest.mark.slow
 def test_sharded_lane_floor():
     """Round-15 sharded lane: `bench.py --devices` must (a) report the

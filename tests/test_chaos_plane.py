@@ -66,6 +66,7 @@ class TestPlanMechanics:
             "store.fanout", "native.commitcore", "native.heapcore",
             "remote.http", "watch.drop", "clock.jump", "sched.crash",
             "node.dead", "serve.shed", "fleet.lease-loss",
+            "store.update_many", "store.evict_many",
         }
         assert set(chaos._FAULT_FOR) == set(chaos.SEAMS)
         assert set(chaos.OPT_IN_SEAMS) <= set(chaos.SEAMS)

@@ -28,12 +28,16 @@ import time
 import pytest
 
 from kubernetes_tpu import native
-from kubernetes_tpu.api.types import Affinity, Container, Node, Pod, Toleration
+from kubernetes_tpu.api.types import (
+    Affinity, Container, LabelSelector, Node, Pod, PodDisruptionBudget,
+    Toleration,
+)
+from kubernetes_tpu.chaos import InjectedFault
 from kubernetes_tpu.scheduler import Scheduler
 from kubernetes_tpu.store.commit_core import PyCommitCore
 from kubernetes_tpu.store.store import (
     WATCH_DROPPED, Store, AlreadyExistsError, ConflictError, Event,
-    ExpiredError, NODES, NotFoundError, PODS,
+    ExpiredError, NODES, NotFoundError, PDBS, PODS,
 )
 from kubernetes_tpu.utils.clock import FakeClock
 
@@ -82,7 +86,10 @@ class _Recorderless:
             out = getattr(self, "op_" + kind)(*args)
             self.log.append((kind, args, "ok", out))
         except (NotFoundError, AlreadyExistsError, ConflictError,
-                ExpiredError) as e:
+                ExpiredError, InjectedFault) as e:
+            # InjectedFault: the chaos-armed sweep variant fires the
+            # store.update_many / store.evict_many seams pre-land — the
+            # raise itself is an observable both cores must share
             self.log.append((kind, args, type(e).__name__, None))
 
     def op_create(self, name):
@@ -152,6 +159,46 @@ class _Recorderless:
         self.store.fanout_wave()
         return (missing, confl)
 
+    def op_update_many(self, specs, token=None, scope=None, ftoken=None):
+        # the round-23 batched mutation verb: rv-CAS per item (0 = no
+        # CAS), per-item conflict/missing reporting, optional fence
+        # (whole-batch FencedError, caught as a ConflictError subclass)
+        # and wave-style token dedupe — a replayed token answers the
+        # recorded result without burning rvs
+        updates = []
+        for name, rv in specs:
+            try:
+                cur = self.store.get(PODS, f"default/{name}")
+            except NotFoundError:
+                cur = mkpod(name)   # pre-scan refuses it as missing
+            cur.labels["gen"] = f"um-{rv}-{len(self.log)}"
+            updates.append((cur, rv or None))
+        fence = [(f"fleet-par-s{scope}", ftoken)] if scope is not None \
+            else None
+        confl: list = []
+        miss: list = []
+        out = self.store.update_many(PODS, updates, fence=fence,
+                                     token=token, conflicts=confl,
+                                     missing=miss)
+        return ([(o.key, o.resource_version) for o in out], confl, miss)
+
+    def op_create_pdb(self, name, budget):
+        # empty selector matches everything in the namespace: the
+        # budget gates op_evict_many refusals deterministically
+        b = self.store.create(PDBS, PodDisruptionBudget(
+            name=name, selector=LabelSelector.from_dict({}),
+            disruptions_allowed=budget))
+        return (b.key, b.resource_version)
+
+    def op_evict_many(self, names, stop, token=None):
+        # the round-23 batched PDB-charging eviction: per-item outcomes
+        # (charges visible WITHIN the batch), stop_on_refusal tail-skip,
+        # and token dedupe — all observable in the compared log, and the
+        # charged-PDB MODIFIED + pod DELETED entries ride the rv stream
+        out = self.store.evict_many([f"default/{n}" for n in names],
+                                    stop_on_refusal=stop, token=token)
+        return sorted(out.items())
+
     def op_watch(self, wid, since_rv, selector=None):
         self.watches[wid] = self.store.watch(PODS, since_rv=since_rv,
                                              selector=selector)
@@ -201,54 +248,84 @@ def _random_program(seed: int, n_ops: int = 120):
     prog.append(("watch", 0, None))
     for i in range(n_ops):
         r = rng.random()
-        if r < 0.18:
+        if r < 0.15:
             prog.append(("create", rng.choice(names)))
-        elif r < 0.30:
+        elif r < 0.23:
             prog.append(("update", rng.choice(names),
                          rng.randint(1, 6) if rng.random() < 0.4 else 0))
-        elif r < 0.40:
+        elif r < 0.32:
+            # round 23: the batched mutation verb — plain, fenced, and
+            # token-deduped variants all ride the compared stream (a
+            # replayed token must answer the recorded result on BOTH
+            # cores without burning rvs)
+            specs = tuple((n, rng.randint(1, 6) if rng.random() < 0.4 else 0)
+                          for n in rng.sample(names, rng.randint(1, 5)))
+            roll = rng.random()
+            if roll < 0.25:
+                prog.append(("update_many", specs, None,
+                             rng.randint(0, 2), rng.randint(1, 30)))
+            elif roll < 0.45:
+                prog.append(("update_many", specs,
+                             f"um-tok-{rng.randint(0, 2)}"))
+            else:
+                prog.append(("update_many", specs))
+        elif r < 0.39:
             prog.append(("delete", rng.choice(names)))
-        elif r < 0.52:
+        elif r < 0.48:
             prog.append(("bind", rng.choice(names), f"n{rng.randint(0, 3)}"))
-        elif r < 0.64:
+        elif r < 0.57:
             prog.append(("bind_many",
                          tuple(rng.sample(names, rng.randint(1, 5))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.72:
+        elif r < 0.64:
             prog.append(("commit_wave",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.78:
+        elif r < 0.69:
             prog.append(("commit_wave_binds",
                          tuple(rng.sample(names, rng.randint(1, 6))),
                          f"n{rng.randint(0, 3)}"))
-        elif r < 0.81:
+        elif r < 0.72:
             # fenced-writer ops (round 18): fence advances interleave
             # with fenced waves so both STALE rejections (atomic, no rv)
             # and valid advances land in the compared stream
             prog.append(("advance_fence", rng.randint(0, 2),
                          rng.randint(1, 30)))
-        elif r < 0.86:
+        elif r < 0.76:
             prog.append(("fenced_wave",
                          tuple(rng.sample(names, rng.randint(1, 4))),
                          f"n{rng.randint(0, 3)}",
                          rng.randint(0, 2), rng.randint(1, 30)))
-        elif r < 0.90:
+        elif r < 0.78:
+            # round 23: PDBs gate the batched evictions — low budgets
+            # make refusals (and the within-batch charge overlay) common
+            prog.append(("create_pdb", f"pdb{rng.randint(0, 1)}",
+                         rng.randint(0, 3)))
+        elif r < 0.83:
+            # round 23: batched PDB-charging eviction — refused /
+            # missing / skipped outcomes and the charged-PDB MODIFIED +
+            # pod DELETED log entries are the compared observables
+            ev = ["evict_many", tuple(rng.sample(names, rng.randint(1, 5))),
+                  rng.random() < 0.5]
+            if rng.random() < 0.2:
+                ev.append(f"ev-tok-{rng.randint(0, 2)}")
+            prog.append(tuple(ev))
+        elif r < 0.875:
             # round 20: watches land in shared (kind, selector) classes —
             # repeated selectors make classmates, None joins the default
             # class, and resumes-from-rv must replay from the class cache
             prog.append(("watch", rng.randint(0, 3),
                          rng.randint(0, 40) if rng.random() < 0.5 else None,
                          rng.choice([None, "s0", "s0", "s1"])))
-        elif r < 0.935:
+        elif r < 0.92:
             prog.append(("drain", rng.randint(0, 3)))
-        elif r < 0.96:
+        elif r < 0.95:
             # byte-ring drains interleave with Event drains on the SAME
             # cursors (a stream serves either representation)
             prog.append(("drain_bytes", rng.randint(0, 3)))
-        elif r < 0.975:
+        elif r < 0.965:
             prog.append(("stop_watch", rng.randint(0, 3)))
-        elif r < 0.99:
+        elif r < 0.985:
             prog.append(("rv",))
         else:
             # mid-program core demotion: adoption must carry class

@@ -207,6 +207,37 @@ class TestLedgerBookkeeping:
         # true startup: 1s from the ACCEPTED create, not 5s from the shed
         assert led.percentile(0.5) == pytest.approx(1.0)
 
+    def test_recent_window_bounded_at_append_time(self):
+        # round-23 satellite: the windowed reservoir trims aged-out
+        # entries as commits land (not only during readout walks), so a
+        # minutes-scale soak holds O(window) memory — a synthetic
+        # hour-long run must never accumulate more than one retention
+        # span of entries, and the windowed readouts stay correct at
+        # every step.
+        led = L.PodLifecycleLedger()
+        rate = 50                        # commits per synthetic second
+        for sec in range(3600):
+            t = 1000.0 + sec
+            keys = [f"ns/p-{sec}-{i}" for i in range(rate)]
+            for k in keys:
+                led.stamp_enqueue(k, t=t)
+            led.commit_many(keys, t=t + 0.05)
+            # invariant: the deque never outgrows one retention span
+            # (+1 batch of slack: the landing batch trims BEFORE it is
+            # counted against the span) even though its maxlen reservoir
+            # would hold far more
+            assert len(led._recent) <= (led.retention_seconds + 1) * rate
+        assert len(led._recent) <= (led.retention_seconds + 1) * rate
+        # the window survives the trim: the trailing 30 s still answers
+        now = 1000.0 + 3600
+        assert led.window_count(now=now) == pytest.approx(
+            30 * rate, abs=2 * rate)
+        assert led.window_percentile(0.99, now=now) == pytest.approx(0.05)
+        # entries older than retention are really gone (memory bound),
+        # cumulative stats are untouched
+        assert led._recent[0][0] >= now - led.retention_seconds - 1.0
+        assert led.snapshot()["pods_completed"] == 3600 * rate
+
     def test_slo_gauges_render_through_registry(self):
         from kubernetes_tpu import obs
         text = obs.render_global()

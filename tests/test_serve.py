@@ -351,19 +351,38 @@ class TestServeWindowParity:
                     except BackpressureError:
                         carry.append(pod)   # readmit next round, in order
                 if update_rate:
-                    # mid-window pod updates (round-17 row-cache variant):
-                    # both worlds mutate the same pending pods — same rng
-                    # stream over the same unbound set (identical under
-                    # parity-so-far) — so update-in-place invalidation is
-                    # exercised without breaking the differential harness
+                    # mid-window pod updates (round-17 row-cache variant,
+                    # batched in round 23): both worlds mutate the same
+                    # pending pods — same rng stream over the same
+                    # unbound set (identical under parity-so-far) — and
+                    # the whole round's mutations land as ONE update_many
+                    # at the window boundary. The consecutive MODIFIED
+                    # run dispatches the informer's batched
+                    # on_update_many invalidation, which the row-by-row
+                    # lookup_row == encode_row assert below then covers.
                     unbound = sorted(p.key for p in store.list(PODS)[0]
                                      if not p.node_name)
+                    updates = []
                     for key in unbound:
                         if rng.random() < update_rate:
                             cur = store.get(PODS, key)
                             cur.priority += 1
                             cur.labels["upd"] = str(r)
-                            store.update(PODS, cur)
+                            updates.append((cur, cur.resource_version))
+                    if updates:
+                        from kubernetes_tpu.store.store import (
+                            BATCH_MUTATION_CALLS)
+                        calls0 = BATCH_MUTATION_CALLS.labels(
+                            "update_many").value
+                        confl: list = []
+                        miss: list = []
+                        store.update_many(PODS, updates,
+                                          conflicts=confl, missing=miss)
+                        # pending pods, single-threaded harness: every
+                        # CAS must land, in ONE batched verb call
+                        assert not confl and not miss, (confl, miss)
+                        assert BATCH_MUTATION_CALLS.labels(
+                            "update_many").value == calls0 + 1
                 if kill is not None and r == kill_round:
                     live = sorted(
                         n.name for n in store.list(NODES)[0])
